@@ -1,0 +1,26 @@
+"""fleetflow-tpu: a TPU-native container-fleet orchestration framework.
+
+A ground-up re-architecture of the capabilities of chronista-club/fleetflow
+(declarative KDL fleet config -> placement -> execution -> observation ->
+multi-node control plane), built TPU-first: the placement problem (services x
+nodes x resources under dependency / port / volume / label constraints) is
+lowered to dense constraint tensors and solved on-device with JAX (vmapped
+feasibility + scoring kernels, mesh-sharded simulated-annealing chains),
+while the host-side runtime (executors, control plane, agents) stays native.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  core/      L0  config model + KDL parser + template + loader + discovery
+  lower/     --  Flow -> ProblemTensors lowering (the TPU on-ramp)
+  solver/    --  JAX placement solver (replaces engine.rs order_by_dependencies)
+  sched/     --  Scheduler interface + host greedy + TPU backends
+  runtime/   L1  execution engines (deploy engine, converter, waiter, backends)
+  build/     L1b image build/push
+  cloud/     L2  cloud/infra abstraction (plan/apply, ssh, state)
+  cp/        L3  control plane (db, channels, agent registry, log router)
+  daemon/    L4a control-plane daemon (fleetflowd analog)
+  agent/     L4b per-node agent
+  registry/  L5  multi-fleet registry
+  cli/, mcp/ L6  user surfaces
+"""
+
+__version__ = "0.1.0"

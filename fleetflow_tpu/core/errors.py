@@ -1,0 +1,30 @@
+"""Error types (reference: crates/fleetflow-core/src/error.rs `FlowError`)."""
+
+from __future__ import annotations
+
+__all__ = ["FlowError", "ConfigNotFound", "ContainerError", "CloudError",
+           "ControlPlaneError", "SolverError"]
+
+
+class FlowError(Exception):
+    """Config-layer error (parse, template, discovery, load)."""
+
+
+class ConfigNotFound(FlowError):
+    """No .fleetflow/fleet.kdl found walking up from cwd."""
+
+
+class ContainerError(Exception):
+    """Execution-engine error (reference: fleetflow-container/src/error.rs)."""
+
+
+class CloudError(Exception):
+    """Cloud provider error."""
+
+
+class ControlPlaneError(Exception):
+    """Control-plane / wire-protocol error."""
+
+
+class SolverError(Exception):
+    """Placement solver error (infeasible, bad tensors)."""

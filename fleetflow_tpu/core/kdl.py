@@ -1,0 +1,495 @@
+"""KDL document parser.
+
+A small, dependency-free recursive-descent parser for the KDL configuration
+language (https://kdl.dev), covering the surface the fleet config language
+uses (reference: crates/fleetflow-core/src/parser/*.rs parses KDL via kdl-rs;
+we parse the same documents natively):
+
+  - nodes with string/number/bool/null arguments and key=value properties
+  - children blocks ``{ ... }``, ``;`` node terminators
+  - ``//`` line comments, nestable ``/* */`` block comments,
+    ``/-`` slash-dash comments (node / entry / children-block)
+  - escaped strings, raw strings ``r"..."`` / ``r#"..."#``
+  - decimal / hex / octal / binary numbers with ``_`` separators
+  - ``\\`` line continuations
+  - ``(type)`` annotations (parsed and stored, not interpreted)
+
+The output is a list of :class:`KdlNode`. This module is pure and heavily
+unit-tested (tests/test_kdl.py), mirroring the reference's parser test corpus
+(crates/fleetflow-core/src/parser/tests.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+__all__ = ["KdlNode", "KdlError", "parse_document", "format_document"]
+
+
+class KdlError(ValueError):
+    """Raised on malformed KDL input, with 1-based line/column context."""
+
+    def __init__(self, message: str, line: int, col: int):
+        super().__init__(f"KDL parse error at {line}:{col}: {message}")
+        self.line = line
+        self.col = col
+
+
+@dataclass
+class KdlNode:
+    """A single KDL node: ``name arg1 arg2 key=value { children }``."""
+
+    name: str
+    args: list[Any] = field(default_factory=list)
+    props: dict[str, Any] = field(default_factory=dict)
+    children: list["KdlNode"] = field(default_factory=list)
+    type_annotation: Optional[str] = None
+
+    # -- convenience accessors used throughout the config parsers ----------
+
+    def arg(self, i: int = 0, default: Any = None) -> Any:
+        return self.args[i] if i < len(self.args) else default
+
+    def prop(self, key: str, default: Any = None) -> Any:
+        return self.props.get(key, default)
+
+    def child(self, name: str) -> Optional["KdlNode"]:
+        for c in self.children:
+            if c.name == name:
+                return c
+        return None
+
+    def children_named(self, name: str) -> Iterator["KdlNode"]:
+        return (c for c in self.children if c.name == name)
+
+    def first_string(self, default: Any = None) -> Any:
+        """First argument coerced to str (fleet configs use string-ish args)."""
+        v = self.arg(0, default)
+        if v is None:
+            return default
+        return v if isinstance(v, str) else _value_to_str(v)
+
+
+def _value_to_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+# Characters that terminate a bare identifier.
+_NON_IDENTIFIER = set('\\/(){}<>;[]=,"')
+_WS = set(" \t\ufeff\u00a0\u1680\u2000\u2001\u2002\u2003\u2004\u2005\u2006"
+          "\u2007\u2008\u2009\u200a\u202f\u205f\u3000")
+_NEWLINES = set("\r\n\x0c\u0085\u2028\u2029")
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.pos = 0
+        self.n = len(text)
+
+    # -- error helpers ------------------------------------------------------
+
+    def _line_col(self) -> tuple[int, int]:
+        upto = self.text[: self.pos]
+        line = upto.count("\n") + 1
+        col = self.pos - (upto.rfind("\n") + 1) + 1
+        return line, col
+
+    def error(self, msg: str) -> KdlError:
+        line, col = self._line_col()
+        return KdlError(msg, line, col)
+
+    # -- low-level cursor ---------------------------------------------------
+
+    def peek(self, off: int = 0) -> str:
+        i = self.pos + off
+        return self.text[i] if i < self.n else ""
+
+    def at_end(self) -> bool:
+        return self.pos >= self.n
+
+    def startswith(self, s: str) -> bool:
+        return self.text.startswith(s, self.pos)
+
+    # -- whitespace / comments ---------------------------------------------
+
+    def _skip_block_comment(self) -> None:
+        assert self.startswith("/*")
+        start = self.pos
+        self.pos += 2
+        depth = 1
+        while depth and self.pos < self.n:
+            if self.startswith("/*"):
+                depth += 1
+                self.pos += 2
+            elif self.startswith("*/"):
+                depth -= 1
+                self.pos += 2
+            else:
+                self.pos += 1
+        if depth:
+            self.pos = start
+            raise self.error("unterminated block comment")
+
+    def skip_ws(self, newlines: bool = False) -> None:
+        """Skip horizontal whitespace, comments, and line continuations.
+
+        With ``newlines=True`` also skips newlines and line (``//``) comments;
+        otherwise stops at a newline (which terminates a node).
+        """
+        while self.pos < self.n:
+            c = self.peek()
+            if c in _WS:
+                self.pos += 1
+            elif self.startswith("/*"):
+                self._skip_block_comment()
+            elif c == "\\" and not newlines:
+                # line continuation: \ ws* (// comment)? newline
+                save = self.pos
+                self.pos += 1
+                while self.peek() in _WS:
+                    self.pos += 1
+                if self.startswith("//"):
+                    while self.pos < self.n and self.peek() not in _NEWLINES:
+                        self.pos += 1
+                if self.peek() in _NEWLINES:
+                    self._consume_newline()
+                else:
+                    self.pos = save
+                    return
+            elif newlines and c in _NEWLINES:
+                self.pos += 1
+            elif newlines and self.startswith("//"):
+                while self.pos < self.n and self.peek() not in _NEWLINES:
+                    self.pos += 1
+            else:
+                return
+
+    def _consume_newline(self) -> None:
+        if self.startswith("\r\n"):
+            self.pos += 2
+        elif self.peek() in _NEWLINES:
+            self.pos += 1
+
+    # -- tokens -------------------------------------------------------------
+
+    def parse_string(self) -> str:
+        assert self.peek() == '"'
+        self.pos += 1
+        out: list[str] = []
+        while True:
+            if self.at_end():
+                raise self.error("unterminated string")
+            c = self.peek()
+            if c == '"':
+                self.pos += 1
+                return "".join(out)
+            if c == "\\":
+                self.pos += 1
+                e = self.peek()
+                simple = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                          '"': '"', "b": "\b", "f": "\f", "/": "/",
+                          "s": " "}
+                if e in simple:
+                    out.append(simple[e])
+                    self.pos += 1
+                elif e == "u":
+                    self.pos += 1
+                    if self.peek() != "{":
+                        raise self.error("expected '{' in \\u escape")
+                    self.pos += 1
+                    hexdigits = []
+                    while self.peek() != "}":
+                        if self.at_end() or len(hexdigits) > 6:
+                            raise self.error("bad \\u escape")
+                        hexdigits.append(self.peek())
+                        self.pos += 1
+                    self.pos += 1
+                    try:
+                        out.append(chr(int("".join(hexdigits), 16)))
+                    except ValueError:
+                        raise self.error("bad \\u escape") from None
+                else:
+                    raise self.error(f"unknown escape '\\{e}'")
+            else:
+                out.append(c)
+                self.pos += 1
+
+    def parse_raw_string(self) -> str:
+        # r"..."  or  r#"..."#  (any number of #)
+        assert self.peek() == "r"
+        start = self.pos
+        self.pos += 1
+        hashes = 0
+        while self.peek() == "#":
+            hashes += 1
+            self.pos += 1
+        if self.peek() != '"':
+            self.pos = start
+            raise self.error("malformed raw string")
+        self.pos += 1
+        terminator = '"' + "#" * hashes
+        end = self.text.find(terminator, self.pos)
+        if end < 0:
+            self.pos = start
+            raise self.error("unterminated raw string")
+        s = self.text[self.pos : end]
+        self.pos = end + len(terminator)
+        return s
+
+    def parse_number(self) -> Any:
+        start = self.pos
+        if self.peek() in "+-":
+            self.pos += 1
+        two = self.text[self.pos : self.pos + 2].lower()
+        digits: str
+        base = 10
+        if two == "0x":
+            base, allowed = 16, "0123456789abcdefABCDEF_"
+            self.pos += 2
+        elif two == "0o":
+            base, allowed = 8, "01234567_"
+            self.pos += 2
+        elif two == "0b":
+            base, allowed = 2, "01_"
+            self.pos += 2
+        else:
+            allowed = "0123456789_.eE+-"
+        tok_start = self.pos
+        if base == 10:
+            # decimal: digits, optional fraction / exponent
+            seen_e = False
+            while not self.at_end():
+                c = self.peek()
+                if c in "0123456789_":
+                    self.pos += 1
+                elif c == "." and self.peek(1).isdigit():
+                    self.pos += 1
+                elif c in "eE" and not seen_e:
+                    seen_e = True
+                    self.pos += 1
+                    if self.peek() in "+-":
+                        self.pos += 1
+                else:
+                    break
+            tok = self.text[start : self.pos].replace("_", "")
+            try:
+                if any(ch in tok for ch in ".eE"):
+                    return float(tok)
+                return int(tok)
+            except ValueError:
+                raise self.error(f"bad number {tok!r}") from None
+        else:
+            while not self.at_end() and self.peek() in allowed:
+                self.pos += 1
+            tok = self.text[tok_start : self.pos].replace("_", "")
+            sign = -1 if self.text[start] == "-" else 1
+            try:
+                return sign * int(tok, base)
+            except ValueError:
+                raise self.error(f"bad number {tok!r}") from None
+
+    def parse_identifier(self) -> str:
+        start = self.pos
+        while not self.at_end():
+            c = self.peek()
+            if c in _WS or c in _NEWLINES or c in _NON_IDENTIFIER:
+                break
+            self.pos += 1
+        if self.pos == start:
+            raise self.error("expected identifier")
+        return self.text[start : self.pos]
+
+    def _at_value_start(self) -> bool:
+        c = self.peek()
+        if c == '"':
+            return True
+        if c == "r" and (self.peek(1) == '"' or self.peek(1) == "#"):
+            return True
+        if c.isdigit():
+            return True
+        if c in "+-" and self.peek(1).isdigit():
+            return True
+        return False
+
+    def parse_value(self) -> Any:
+        c = self.peek()
+        if c == '"':
+            return self.parse_string()
+        if c == "r" and (self.peek(1) == '"' or self.peek(1) == "#"):
+            return self.parse_raw_string()
+        if c.isdigit() or (c in "+-" and self.peek(1).isdigit()):
+            return self.parse_number()
+        ident = self.parse_identifier()
+        if ident == "true":
+            return True
+        if ident == "false":
+            return False
+        if ident == "null":
+            return None
+        # Lenient mode: bare words as string values (strict KDL rejects these,
+        # but fleet configs in the wild use them for enum-ish fields).
+        return ident
+
+    # -- nodes ----------------------------------------------------------------
+
+    def parse_type_annotation(self) -> Optional[str]:
+        if self.peek() != "(":
+            return None
+        self.pos += 1
+        ty = self.parse_identifier() if self.peek() != '"' else self.parse_string()
+        if self.peek() != ")":
+            raise self.error("expected ')' after type annotation")
+        self.pos += 1
+        return ty
+
+    def parse_node(self) -> Optional[KdlNode]:
+        """Parse one node. Returns None for a slash-dash'd node."""
+        slashdash = False
+        if self.startswith("/-"):
+            slashdash = True
+            self.pos += 2
+            self.skip_ws(newlines=True)
+        ty = self.parse_type_annotation()
+        if self.peek() == '"':
+            name = self.parse_string()
+        else:
+            name = self.parse_identifier()
+        node = KdlNode(name=name, type_annotation=ty)
+
+        while True:
+            self.skip_ws(newlines=False)
+            if self.at_end():
+                break
+            c = self.peek()
+            if c in _NEWLINES or c == ";":
+                if c == ";":
+                    self.pos += 1
+                else:
+                    self._consume_newline()
+                break
+            if self.startswith("//"):
+                while self.pos < self.n and self.peek() not in _NEWLINES:
+                    self.pos += 1
+                continue
+            if c == "{":
+                self.pos += 1
+                node.children = self.parse_nodes(until_brace=True)
+                continue
+            if c == "}":
+                break  # let caller consume the closing brace
+
+            entry_slashdash = False
+            if self.startswith("/-"):
+                entry_slashdash = True
+                self.pos += 2
+                self.skip_ws(newlines=False)
+                if self.peek() == "{":
+                    self.pos += 1
+                    self.parse_nodes(until_brace=True)  # discard
+                    continue
+
+            if c == "(":
+                # (type)value annotation on an argument: parse and discard
+                # the annotation, keep the value
+                self.parse_type_annotation()
+                val = self.parse_value()
+                if not entry_slashdash:
+                    node.args.append(val)
+                continue
+
+            if self._at_value_start():
+                val = self.parse_value()
+                if not entry_slashdash:
+                    node.args.append(val)
+                continue
+
+            # identifier: either prop key or bare-word arg
+            ident = self.parse_identifier()
+            if self.peek() == "=":
+                self.pos += 1
+                val = self.parse_value()
+                if not entry_slashdash:
+                    node.props[ident] = val
+            else:
+                if not entry_slashdash:
+                    if ident == "true":
+                        node.args.append(True)
+                    elif ident == "false":
+                        node.args.append(False)
+                    elif ident == "null":
+                        node.args.append(None)
+                    else:
+                        node.args.append(ident)
+        return None if slashdash else node
+
+    def parse_nodes(self, until_brace: bool = False) -> list[KdlNode]:
+        nodes: list[KdlNode] = []
+        while True:
+            self.skip_ws(newlines=True)
+            while self.peek() == ";":
+                self.pos += 1
+                self.skip_ws(newlines=True)
+            if self.at_end():
+                if until_brace:
+                    raise self.error("unexpected EOF, expected '}'")
+                return nodes
+            if self.peek() == "}":
+                if until_brace:
+                    self.pos += 1
+                    return nodes
+                raise self.error("unexpected '}'")
+            n = self.parse_node()
+            if n is not None:
+                nodes.append(n)
+
+
+def parse_document(text: str) -> list[KdlNode]:
+    """Parse a KDL document into a list of top-level nodes."""
+    return _Parser(text).parse_nodes()
+
+
+def _format_value(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v)
+    escaped = s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return f'"{escaped}"'
+
+
+def _format_node(node: KdlNode, indent: int) -> list[str]:
+    pad = "    " * indent
+    parts = [node.name if _is_bare(node.name) else _format_value(node.name)]
+    parts += [_format_value(a) for a in node.args]
+    parts += [f"{k}={_format_value(v)}" for k, v in node.props.items()]
+    line = pad + " ".join(parts)
+    if not node.children:
+        return [line]
+    lines = [line + " {"]
+    for c in node.children:
+        lines.extend(_format_node(c, indent + 1))
+    lines.append(pad + "}")
+    return lines
+
+
+def _is_bare(name: str) -> bool:
+    if not name or name[0].isdigit():
+        return False
+    return not any(c in _NON_IDENTIFIER or c in _WS or c in _NEWLINES for c in name)
+
+
+def format_document(nodes: list[KdlNode]) -> str:
+    """Serialize nodes back to KDL text (used by init wizard / quadlet sync)."""
+    out: list[str] = []
+    for n in nodes:
+        out.extend(_format_node(n, 0))
+    return "\n".join(out) + "\n"

@@ -1,0 +1,145 @@
+"""The config load pipeline.
+
+Analog of crates/fleetflow-core/src/loader.rs: discover files, collect
+variables in the reference's fixed priority chain, Tera/jinja-render every
+file, concatenate in fixed order, include-expand, and parse into a Flow.
+
+Variable priority (low → high, reference: loader.rs:77-134):
+
+  1. builtin  PROJECT_ROOT (+ FLEET_PROJECT_ROOT, FLEET_STAGE)
+  2. ``variables{}`` blocks in fleet.kdl (pre-pass over raw text)
+  3. ``variables/*.kdl`` files (pre-pass)
+  4. ``.env``
+  5. ``.env.external``
+  6. ``.env.{stage}``
+  7. allowlisted process env (FLEET_* / CI_* / APP_*)
+  8. stage-scoped ``variables{}`` blocks for the selected stage
+
+``op://`` secret references are resolved as variables enter the context.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .discovery import DiscoveredFiles, discover_files_with_stage, find_project_root
+from .errors import FlowError
+from .model import Flow
+from .parser import parse_kdl_string, read_kdl_with_includes
+from .template import TemplateProcessor, extract_variables_with_stage, parse_dotenv
+
+__all__ = ["load_project", "load_project_from_root_with_stage",
+           "prepare_template_processor", "expand_all_files", "LoadDebug"]
+
+
+class LoadDebug:
+    """Collects per-step artifacts for `fleet config --debug`
+    (reference: loader.rs:214 debug loader)."""
+
+    def __init__(self) -> None:
+        self.files: list[str] = []
+        self.variables: dict[str, str] = {}
+        self.rendered: dict[str, str] = {}
+        self.concatenated: str = ""
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError as e:
+        raise FlowError(f"cannot read {path}: {e}") from e
+
+
+def prepare_template_processor(files: DiscoveredFiles,
+                               stage: Optional[str] = None,
+                               environ: Optional[dict[str, str]] = None,
+                               resolve_secrets: bool = True) -> TemplateProcessor:
+    """Build the variable context in the reference's priority order
+    (loader.rs:77-134)."""
+    environ = environ if environ is not None else dict(os.environ)
+    tp = TemplateProcessor()
+
+    # 1. builtins
+    builtins = {"PROJECT_ROOT": files.root}
+    if stage:
+        builtins["FLEET_STAGE"] = stage
+    tp.add_variables(builtins, resolve_secrets=False)
+
+    # 2. variables{} in main + cloud files (raw-text pre-pass)
+    for f in filter(None, (files.cloud_file, files.main_file)):
+        tp.add_variables(extract_variables_with_stage(_read(f), None),
+                         resolve_secrets=resolve_secrets)
+
+    # 3. variables/*.kdl
+    for f in files.variable_files:
+        tp.add_variables(extract_variables_with_stage(_read(f), None),
+                         resolve_secrets=resolve_secrets)
+
+    # 4-6. dotenv chain
+    for name in (".env", ".env.external") + ((f".env.{stage}",) if stage else ()):
+        for base in (files.root, files.config_dir):
+            p = os.path.join(base, name)
+            if os.path.isfile(p):
+                tp.add_variables(parse_dotenv(_read(p)),
+                                 resolve_secrets=resolve_secrets)
+
+    # 7. allowlisted env
+    tp.add_allowlisted_env(environ)
+
+    # 8. stage-scoped variables{} (highest)
+    if stage:
+        for f in filter(None, [files.main_file, *files.stage_files,
+                               files.stage_override_file,
+                               files.local_override_file]):
+            all_with_stage = extract_variables_with_stage(_read(f), stage)
+            top_only = extract_variables_with_stage(_read(f), None)
+            stage_only = {k: v for k, v in all_with_stage.items()
+                          if top_only.get(k) != v or k not in top_only}
+            if stage_only:
+                tp.add_variables(stage_only, resolve_secrets=resolve_secrets)
+    return tp
+
+
+def expand_all_files(files: DiscoveredFiles, tp: TemplateProcessor,
+                     debug: Optional[LoadDebug] = None) -> str:
+    """Render every discovered file and concatenate in fixed order
+    (reference: loader.rs:137-209)."""
+    parts: list[str] = []
+    for path in files.all_files():
+        text = read_kdl_with_includes(path)
+        rendered = tp.render_str(text, source=path)
+        if debug is not None:
+            debug.files.append(path)
+            debug.rendered[path] = rendered
+        parts.append(rendered)
+    out = "\n".join(parts)
+    if debug is not None:
+        debug.concatenated = out
+        debug.variables = dict(tp.variables)
+    return out
+
+
+def load_project_from_root_with_stage(root: str, stage: Optional[str] = None,
+                                      environ: Optional[dict[str, str]] = None,
+                                      resolve_secrets: bool = True,
+                                      debug: Optional[LoadDebug] = None) -> Flow:
+    """Full pipeline from a known project root (reference: loader.rs:42-74)."""
+    files = discover_files_with_stage(root, stage)
+    if files.main_file is None:
+        raise FlowError(f"no {files.config_dir}/fleet.kdl")
+    tp = prepare_template_processor(files, stage, environ, resolve_secrets)
+    text = expand_all_files(files, tp, debug)
+    flow = parse_kdl_string(text)
+    # expose the final variable context on the flow
+    merged = dict(tp.variables)
+    merged.update(flow.variables)
+    flow.variables = merged
+    return flow
+
+
+def load_project(stage: Optional[str] = None, start: Optional[str] = None,
+                 **kw) -> Flow:
+    """Discover the project root from cwd and load (reference: loader.rs:25)."""
+    return load_project_from_root_with_stage(find_project_root(start), stage, **kw)

@@ -1,0 +1,584 @@
+"""KDL → Flow parser.
+
+Python analog of crates/fleetflow-core/src/parser/ (mod.rs top-level dispatch,
+service.rs, stage.rs, port.rs, volume.rs, cloud.rs, tenant.rs). Accepts the
+same configuration language the reference parses:
+
+    project "name"
+    provider "sakura-cloud" { zone "tk1a" }
+    server "cp-1" { provider "..." plan "2core-4gb" ... }
+    service "db" { image "..." ports { port host=5432 container=5432 } ... }
+    stage "live" { server "cp-1"; service "db" { ...overrides... } }
+    variables { KEY "value" }
+    include "services/*.kdl"
+    registry "ghcr.io/org"
+    tenant "acme"
+
+Top-level service redefinition merges onto the existing definition
+(reference: parser/mod.rs:184-299); per-stage service nodes become overrides
+merged at resolve time (model.Stage.resolved_services).
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+from typing import Any, Optional
+
+from .errors import FlowError
+from .kdl import KdlNode, parse_document
+from .model import (
+    Backend, BuildConfig, CloudProviderDecl, DeployConfig, FallbackPolicy, Flow,
+    HealthCheck, PlacementPolicy, PlacementStrategy, Port, Protocol,
+    ReadinessCheck, RegistryRef, ResourceQuota, ResourceSpec, RestartPolicy,
+    ServerLabels, ServerResource, Service, ServiceType, SpreadConstraint, Stage,
+    TenantSpec, Volume, WaitConfig,
+)
+
+__all__ = [
+    "parse_kdl_string", "parse_kdl_file", "read_kdl_with_includes",
+    "parse_service", "parse_stage", "parse_provider", "parse_server",
+    "parse_port", "parse_volume", "parse_tenant",
+]
+
+
+def _as_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _str_args(node: KdlNode) -> list[str]:
+    return [_as_str(a) for a in node.args if a is not None]
+
+
+def _env_from_children(node: KdlNode) -> dict[str, str]:
+    """`env { KEY "value" }` or `environment { ... }` blocks; also accepts
+    `KEY=value` props on the block node. An explicit `null` value maps to
+    the empty string (unset-ish), not the literal "None"."""
+    out: dict[str, str] = {}
+    for k, v in node.props.items():
+        out[k] = "" if v is None else _as_str(v)
+    for child in node.children:
+        v = child.arg(0, "")
+        out[child.name] = "" if v is None else _as_str(v)
+    return out
+
+
+def _duration(v: Any, default: float) -> float:
+    """Seconds from number or '30s'/'5m'/'1h' strings."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    s = str(v).strip().lower()
+    mult = 1.0
+    if s.endswith("ms"):
+        mult, s = 0.001, s[:-2]
+    elif s.endswith("s"):
+        mult, s = 1.0, s[:-1]
+    elif s.endswith("m"):
+        mult, s = 60.0, s[:-1]
+    elif s.endswith("h"):
+        mult, s = 3600.0, s[:-1]
+    try:
+        return float(s) * mult
+    except ValueError:
+        raise FlowError(f"bad duration {v!r}") from None
+
+
+# --------------------------------------------------------------------------
+# Leaf parsers (port.rs, volume.rs)
+# --------------------------------------------------------------------------
+
+def parse_port(node: KdlNode) -> Port:
+    """`port host=8080 container=80 protocol="udp" host-ip="127.0.0.1"`
+    or positional `port 8080 80` (reference: parser/port.rs)."""
+    host = node.prop("host", node.arg(0))
+    container = node.prop("container", node.arg(1, host))
+    if host is None:
+        raise FlowError(f"port node missing host port: {node}")
+    proto = node.prop("protocol", node.prop("proto", "tcp"))
+    host_ip = node.prop("host-ip", node.prop("host_ip"))
+    return Port(host=int(host), container=int(container),
+                protocol=Protocol.parse(_as_str(proto)),
+                host_ip=host_ip if host_ip is None else _as_str(host_ip))
+
+
+def parse_volume(node: KdlNode) -> Volume:
+    """`volume "./host" "/container" read-only=true` (reference: parser/volume.rs)."""
+    args = _str_args(node)
+    if not args:
+        raise FlowError("volume node needs at least a host path")
+    host = args[0]
+    container = args[1] if len(args) > 1 else host
+    ro = bool(node.prop("read-only", node.prop("read_only", node.prop("ro", False))))
+    return Volume(host=host, container=container, read_only=ro)
+
+
+# --------------------------------------------------------------------------
+# Service parser (service.rs)
+# --------------------------------------------------------------------------
+
+def _parse_build(node: KdlNode) -> BuildConfig:
+    b = BuildConfig()
+    if node.args:
+        b.context = _as_str(node.arg(0))
+    for c in node.children:
+        if c.name == "context":
+            b.context = c.first_string(".")
+        elif c.name == "dockerfile":
+            b.dockerfile = c.first_string()
+        elif c.name in ("args", "build_args", "build-args"):
+            b.args = _env_from_children(c)
+        elif c.name == "target":
+            b.target = c.first_string()
+        elif c.name in ("no_cache", "no-cache"):
+            b.no_cache = bool(c.arg(0, True))
+        elif c.name in ("image_tag", "image-tag", "tag"):
+            b.image_tag = c.first_string()
+    for k, v in node.props.items():
+        if k == "context":
+            b.context = _as_str(v)
+        elif k == "dockerfile":
+            b.dockerfile = _as_str(v)
+        elif k == "target":
+            b.target = _as_str(v)
+    return b
+
+
+def _parse_deploy(node: KdlNode) -> DeployConfig:
+    d = DeployConfig()
+    if node.args:
+        d.type = _as_str(node.arg(0))
+    for c in node.children:
+        if c.name == "type":
+            d.type = c.first_string(d.type)
+        elif c.name == "output":
+            d.output = c.first_string()
+        elif c.name == "command":
+            d.command = c.first_string()
+        elif c.name == "project":
+            d.project = c.first_string()
+    return d
+
+
+def _parse_healthcheck(node: KdlNode) -> HealthCheck:
+    h = HealthCheck()
+    if node.args:
+        h.test = _str_args(node)
+    for c in node.children:
+        if c.name in ("test", "command"):
+            h.test = _str_args(c)
+        elif c.name == "interval":
+            h.interval = _duration(c.arg(0), h.interval)
+        elif c.name == "timeout":
+            h.timeout = _duration(c.arg(0), h.timeout)
+        elif c.name == "retries":
+            h.retries = int(c.arg(0, h.retries))
+        elif c.name in ("start_period", "start-period"):
+            h.start_period = _duration(c.arg(0), h.start_period)
+    return h
+
+
+def _parse_readiness(node: KdlNode) -> ReadinessCheck:
+    r = ReadinessCheck()
+    for c in node.children:
+        if c.name == "type":
+            r.type = c.first_string(r.type)
+        elif c.name == "path":
+            r.path = c.first_string(r.path)
+        elif c.name == "port":
+            r.port = int(c.arg(0)) if c.arg(0) is not None else None
+        elif c.name == "timeout":
+            r.timeout = _duration(c.arg(0), r.timeout)
+        elif c.name == "interval":
+            r.interval = _duration(c.arg(0), r.interval)
+    for k, v in node.props.items():
+        if k == "path":
+            r.path = _as_str(v)
+        elif k == "port":
+            r.port = int(v)
+    return r
+
+
+def _parse_wait(node: KdlNode) -> WaitConfig:
+    w = WaitConfig()
+    for c in node.children:
+        if c.name in ("max_retries", "max-retries", "retries"):
+            w.max_retries = int(c.arg(0, w.max_retries))
+        elif c.name in ("initial_delay", "initial-delay"):
+            w.initial_delay = _duration(c.arg(0), w.initial_delay)
+        elif c.name in ("max_delay", "max-delay"):
+            w.max_delay = _duration(c.arg(0), w.max_delay)
+        elif c.name == "multiplier":
+            w.multiplier = float(c.arg(0, w.multiplier))
+    return w
+
+
+def _parse_resources(node: KdlNode) -> ResourceSpec:
+    r = ResourceSpec()
+    for c in node.children:
+        if c.name == "cpu":
+            r.cpu = float(c.arg(0, r.cpu))
+        elif c.name in ("memory", "mem"):
+            r.memory = _mem_mb(c.arg(0, r.memory))
+        elif c.name == "disk":
+            r.disk = _mem_mb(c.arg(0, r.disk))
+    for k, v in node.props.items():
+        if k == "cpu":
+            r.cpu = float(v)
+        elif k in ("memory", "mem"):
+            r.memory = _mem_mb(v)
+        elif k == "disk":
+            r.disk = _mem_mb(v)
+    return r
+
+
+def _mem_mb(v: Any) -> float:
+    """MiB from number or '512m'/'2g'/'1t' strings."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    s = str(v).strip().lower()
+    for suffix, mult in (("gib", 1024.0), ("gb", 1024.0), ("g", 1024.0),
+                         ("mib", 1.0), ("mb", 1.0), ("m", 1.0),
+                         ("tib", 1024.0 * 1024), ("tb", 1024.0 * 1024), ("t", 1024.0 * 1024),
+                         ("kib", 1 / 1024.0), ("kb", 1 / 1024.0), ("k", 1 / 1024.0)):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    return float(s)
+
+
+def parse_service(node: KdlNode) -> Service:
+    """Parse a `service "name" { ... }` node (reference: parser/service.rs)."""
+    name = node.first_string()
+    if not name:
+        raise FlowError("service node requires a name argument")
+    svc = Service(name=name)
+    for k, v in node.props.items():
+        if k == "image":
+            svc.image = _as_str(v)
+        elif k == "version":
+            svc.version = _as_str(v)
+        elif k == "type":
+            svc.service_type = ServiceType(_as_str(v))
+    for c in node.children:
+        n = c.name
+        if n == "image":
+            svc.image = c.first_string()
+        elif n == "version":
+            svc.version = _as_str(c.arg(0, ""))
+        elif n == "command":
+            args = _str_args(c)
+            svc.command = " ".join(args) if args else None
+        elif n == "restart":
+            svc.restart = RestartPolicy.parse(c.first_string("no"))
+        elif n in ("service_type", "service-type", "type"):
+            svc.service_type = ServiceType(c.first_string("container"))
+        elif n == "ports":
+            svc.ports = [parse_port(p) for p in c.children_named("port")]
+        elif n == "port":
+            svc.ports.append(parse_port(c))
+        elif n == "volumes":
+            svc.volumes = [parse_volume(v) for v in c.children_named("volume")]
+        elif n == "volume":
+            svc.volumes.append(parse_volume(c))
+        elif n in ("env", "environment"):
+            svc.environment.update(_env_from_children(c))
+        elif n == "depends_on" or n == "depends-on":
+            svc.depends_on.extend(_str_args(c))
+        elif n == "build":
+            svc.build = _parse_build(c)
+        elif n == "deploy":
+            svc.deploy = _parse_deploy(c)
+        elif n == "healthcheck":
+            svc.healthcheck = _parse_healthcheck(c)
+        elif n in ("readiness", "readiness_check", "readiness-check"):
+            svc.readiness = _parse_readiness(c)
+        elif n in ("wait", "wait_for", "wait-for"):
+            svc.wait = _parse_wait(c)
+        elif n == "variables":
+            svc.variables.update(_env_from_children(c))
+        elif n == "resources":
+            svc.resources = _parse_resources(c)
+            svc._resources_set = True
+        elif n == "labels":
+            svc.labels.update(_env_from_children(c))
+        elif n in ("colocate_with", "colocate-with"):
+            svc.colocate_with.extend(_str_args(c))
+        elif n in ("anti_affinity", "anti-affinity"):
+            svc.anti_affinity.extend(_str_args(c))
+        elif n == "replicas":
+            svc.replicas = int(c.arg(0, 1))
+            svc._replicas_set = True
+    return svc
+
+
+# --------------------------------------------------------------------------
+# Stage parser (stage.rs)
+# --------------------------------------------------------------------------
+
+def _parse_quota(node: KdlNode) -> ResourceQuota:
+    q = ResourceQuota()
+    for c in node.children:
+        if c.name == "cpu":
+            q.cpu = float(c.arg(0))
+        elif c.name in ("memory", "mem"):
+            q.memory = _mem_mb(c.arg(0))
+        elif c.name == "disk":
+            q.disk = _mem_mb(c.arg(0))
+    return q
+
+
+def _parse_placement(node: KdlNode) -> PlacementPolicy:
+    p = PlacementPolicy()
+    if node.args:
+        p.strategy = PlacementStrategy.parse(_as_str(node.arg(0)))
+    for c in node.children:
+        if c.name == "strategy":
+            p.strategy = PlacementStrategy.parse(c.first_string("spread_across_pool"))
+        elif c.name == "tier":
+            p.tier = c.first_string()
+        elif c.name in ("preferred_labels", "preferred-labels"):
+            p.preferred_labels = _env_from_children(c)
+        elif c.name in ("required_labels", "required-labels"):
+            p.required_labels = _env_from_children(c)
+        elif c.name in ("resource_quota", "resource-quota", "quota"):
+            p.resource_quota = _parse_quota(c)
+        elif c.name in ("spread", "spread_constraint", "spread-constraint"):
+            p.spread_constraint = SpreadConstraint(
+                topology_key=_as_str(c.prop("topology_key",
+                                            c.prop("topology-key", c.arg(0, "node")))),
+                max_skew=int(c.prop("max_skew", c.prop("max-skew", 1))))
+        elif c.name in ("fallback", "fallback_policy", "fallback-policy"):
+            p.fallback_policy = FallbackPolicy(relax_order=_str_args(c)
+                                               or FallbackPolicy().relax_order)
+    return p
+
+
+def parse_stage(node: KdlNode) -> Stage:
+    """Parse a `stage "name" { ... }` node (reference: parser/stage.rs)."""
+    name = node.first_string()
+    if not name:
+        raise FlowError("stage node requires a name argument")
+    st = Stage(name=name)
+    for c in node.children:
+        if c.name == "service":
+            sname = c.first_string()
+            if not sname:
+                raise FlowError(f"stage {name!r}: service node requires a name")
+            if sname not in st.services:
+                st.services.append(sname)
+            if c.children or c.props:
+                st.service_overrides[sname] = parse_service(c)
+        elif c.name == "server":
+            st.servers.extend(_str_args(c))
+        elif c.name == "servers":
+            st.servers.extend(_str_args(c))
+        elif c.name == "variables":
+            st.variables.update(_env_from_children(c))
+        elif c.name == "registry":
+            st.registry = c.first_string()
+        elif c.name == "backend":
+            st.backend = Backend.parse(c.first_string("docker"))
+        elif c.name == "placement":
+            st.placement = _parse_placement(c)
+    return st
+
+
+# --------------------------------------------------------------------------
+# Cloud parsers (cloud.rs)
+# --------------------------------------------------------------------------
+
+def parse_provider(node: KdlNode) -> CloudProviderDecl:
+    name = node.first_string()
+    if not name:
+        raise FlowError("provider node requires a name argument")
+    p = CloudProviderDecl(name=name)
+    for c in node.children:
+        if c.name == "zone":
+            p.zone = c.first_string()
+        else:
+            p.options[c.name] = c.arg(0) if len(c.args) <= 1 else list(c.args)
+    p.options.update(node.props)
+    return p
+
+
+def _parse_server_labels(node: KdlNode) -> ServerLabels:
+    lbl = ServerLabels()
+    d = _env_from_children(node)
+    lbl.tier = d.pop("tier", None)
+    lbl.region = d.pop("region", None)
+    lbl.clazz = d.pop("class", None)
+    lbl.arch = d.pop("arch", None)
+    lbl.extra = d
+    return lbl
+
+
+def parse_server(node: KdlNode) -> ServerResource:
+    """Parse a `server "name" { ... }` node (reference: parser/cloud.rs)."""
+    name = node.first_string()
+    if not name:
+        raise FlowError("server node requires a name argument")
+    s = ServerResource(name=name)
+    for c in node.children:
+        n = c.name.replace("_", "-")
+        if n == "provider":
+            s.provider = c.first_string()
+        elif n == "plan":
+            s.plan = c.first_string()
+        elif n == "disk-size":
+            s.disk_size = int(c.arg(0, 0))
+        elif n == "os":
+            s.os = c.first_string()
+        elif n in ("ssh-key", "ssh-keys"):
+            s.ssh_keys.extend(_str_args(c))
+        elif n == "ssh-host":
+            s.ssh_host = c.first_string()
+        elif n == "ssh-user":
+            s.ssh_user = c.first_string()
+        elif n == "tags":
+            s.tags.extend(_str_args(c))
+        elif n == "startup-script":
+            s.startup_script = c.first_string()
+        elif n == "dns":
+            for d in c.children:
+                if d.name == "hostname":
+                    s.dns_hostname = d.first_string()
+                elif d.name in ("alias", "aliases"):
+                    s.dns_aliases.extend(_str_args(d))
+        elif n in ("dns-hostname",):
+            s.dns_hostname = c.first_string()
+        elif n in ("dns-alias", "dns-aliases"):
+            s.dns_aliases.extend(_str_args(c))
+        elif n == "capacity":
+            s.capacity = _parse_resources(c)
+        elif n == "labels":
+            s.labels = _parse_server_labels(c)
+    return s
+
+
+def parse_tenant(node: KdlNode) -> TenantSpec:
+    name = node.first_string()
+    if not name:
+        raise FlowError("tenant node requires a name argument")
+    t = TenantSpec(name=name)
+    for c in node.children:
+        if c.name in ("display_name", "display-name"):
+            t.display_name = c.first_string()
+        else:
+            t.options[c.name] = c.arg(0)
+    return t
+
+
+# --------------------------------------------------------------------------
+# Top-level dispatch (mod.rs)
+# --------------------------------------------------------------------------
+
+def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
+    """Parse KDL text into (or onto) a Flow.
+
+    Reference: parser/mod.rs:160,184-299. Top-level nodes: project / stage /
+    service / provider / server / variables / registry / tenant / include
+    (include must be resolved beforehand via read_kdl_with_includes; a
+    leftover include node raises). Service redefinition merges; stage
+    redefinition merges service lists/overrides. Stage selection happens at
+    load time (template pre-pass) and resolve time (Stage.resolved_services),
+    not at parse time.
+    """
+    flow = flow if flow is not None else Flow()
+    try:
+        nodes = parse_document(text)
+    except Exception as e:
+        raise FlowError(f"KDL parse failed: {e}") from e
+
+    for node in nodes:
+        n = node.name
+        if n == "project":
+            flow.name = node.first_string(flow.name)
+        elif n == "service":
+            flow.merge_service(parse_service(node))
+        elif n == "stage":
+            st = parse_stage(node)
+            if st.name in flow.stages:
+                old = flow.stages[st.name]
+                for sname in st.services:
+                    if sname not in old.services:
+                        old.services.append(sname)
+                for sname, ov in st.service_overrides.items():
+                    if sname in old.service_overrides:
+                        old.service_overrides[sname] = \
+                            old.service_overrides[sname].merge(ov)
+                    else:
+                        old.service_overrides[sname] = ov
+                old.servers = st.servers or old.servers
+                old.variables.update(st.variables)
+                old.registry = st.registry or old.registry
+                if st.backend != Backend.DOCKER:
+                    old.backend = st.backend
+                old.placement = st.placement or old.placement
+            else:
+                flow.stages[st.name] = st
+        elif n == "provider":
+            p = parse_provider(node)
+            flow.providers[p.name] = p
+        elif n == "server":
+            s = parse_server(node)
+            flow.servers[s.name] = s
+        elif n == "variables":
+            flow.variables.update(_env_from_children(node))
+        elif n == "registry":
+            flow.registry = RegistryRef(url=node.first_string(""),
+                                        username=node.prop("username"))
+        elif n == "tenant":
+            flow.tenant = parse_tenant(node)
+        elif n == "include":
+            raise FlowError(
+                "include nodes must be expanded before parsing "
+                "(use read_kdl_with_includes)")
+        # unknown top-level nodes are ignored (forward compat), matching the
+        # reference's lenient dispatch
+    return flow
+
+
+def read_kdl_with_includes(path: str, _seen: Optional[set[str]] = None) -> str:
+    """Read a KDL file, expanding `include "glob"` nodes inline with cycle
+    detection (reference: parser/mod.rs:54)."""
+    real = os.path.realpath(path)
+    seen = _seen if _seen is not None else set()
+    if real in seen:
+        raise FlowError(f"include cycle detected at {path}")
+    seen.add(real)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        raise FlowError(f"cannot read {path}: {e}") from e
+
+    base = os.path.dirname(real)
+    out_lines: list[str] = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("include ") or stripped == "include":
+            try:
+                nodes = parse_document(stripped)
+            except Exception:
+                out_lines.append(line)
+                continue
+            if nodes and nodes[0].name == "include":
+                patterns = [str(a) for a in nodes[0].args]
+                for pat in patterns:
+                    full = pat if os.path.isabs(pat) else os.path.join(base, pat)
+                    matches = sorted(globmod.glob(full))
+                    if not matches and not globmod.has_magic(full):
+                        raise FlowError(f"include target not found: {pat}")
+                    for m in matches:
+                        out_lines.append(read_kdl_with_includes(m, seen))
+                continue
+        out_lines.append(line)
+    return "\n".join(out_lines)
+
+
+def parse_kdl_file(path: str) -> Flow:
+    """Load + include-expand + parse one KDL file (reference: parser/mod.rs:31)."""
+    return parse_kdl_string(read_kdl_with_includes(path))

@@ -1,0 +1,67 @@
+"""Test harness configuration.
+
+Tier-1 tests run without TPU hardware (the analog of the reference's
+"no Docker in fast tests" CI tier, .github/workflows/ci.yml:15-70): JAX is
+forced onto a virtual 8-device CPU platform so mesh/sharding paths are
+exercised on any machine. Real-TPU runs are the gated Tier 2 (bench.py).
+"""
+
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def project(tmp_path):
+    """Write a minimal .fleetflow project into tmp_path (the analog of the
+    reference's TestProject fixture, fleetflow/tests/common/mod.rs:10-37)."""
+    cfg = tmp_path / ".fleetflow"
+    cfg.mkdir()
+
+    def write(name: str, content: str):
+        p = cfg / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+        return p
+
+    write("fleet.kdl", DEFAULT_FLEET_KDL)
+    return tmp_path, write
+
+
+DEFAULT_FLEET_KDL = '''
+project "testproj"
+
+service "postgres" {
+    image "postgres"
+    version "16"
+    ports { port host=11432 container=5432 }
+    env { POSTGRES_USER "flowuser" }
+    resources { cpu 0.5; memory 256 }
+}
+
+service "redis" {
+    image "redis"
+    version "7"
+    ports { port host=11379 container=6379 }
+}
+
+service "app" {
+    image "myapp"
+    version "latest"
+    ports { port host=11080 container=8080 }
+    depends_on "postgres" "redis"
+}
+
+stage "local" {
+    service "postgres"
+    service "redis"
+    service "app"
+}
+'''

@@ -1,0 +1,207 @@
+"""KDL parser corpus (analog of crates/fleetflow-core/src/parser/tests.rs)."""
+
+import pytest
+
+from fleetflow_tpu.core.kdl import KdlError, format_document, parse_document
+
+
+def one(text):
+    nodes = parse_document(text)
+    assert len(nodes) == 1, nodes
+    return nodes[0]
+
+
+class TestBasics:
+    def test_empty_document(self):
+        assert parse_document("") == []
+        assert parse_document("\n\n  \n") == []
+
+    def test_bare_node(self):
+        n = one("node")
+        assert n.name == "node" and n.args == [] and n.props == {}
+
+    def test_string_args(self):
+        n = one('service "postgres" "extra"')
+        assert n.name == "service"
+        assert n.args == ["postgres", "extra"]
+
+    def test_numbers(self):
+        n = one("nums 1 -2 3.5 1e3 0x1F 0o17 0b101 1_000_000")
+        assert n.args == [1, -2, 3.5, 1000.0, 31, 15, 5, 1000000]
+
+    def test_keywords(self):
+        n = one("kw true false null")
+        assert n.args == [True, False, None]
+
+    def test_props(self):
+        n = one('port host=8080 container=80 protocol="udp"')
+        assert n.props == {"host": 8080, "container": 80, "protocol": "udp"}
+
+    def test_props_and_args_mixed(self):
+        n = one('volume "./data" "/data" read-only=true')
+        assert n.args == ["./data", "/data"]
+        assert n.props == {"read-only": True}
+
+    def test_semicolon_separators(self):
+        nodes = parse_document("a; b; c")
+        assert [n.name for n in nodes] == ["a", "b", "c"]
+
+    def test_quoted_node_name(self):
+        n = one('"weird name" 1')
+        assert n.name == "weird name" and n.args == [1]
+
+
+class TestChildren:
+    def test_children_block(self):
+        n = one('service "db" {\n  image "postgres"\n  version "16"\n}')
+        assert [c.name for c in n.children] == ["image", "version"]
+        assert n.child("image").args == ["postgres"]
+
+    def test_nested_children(self):
+        n = one("a { b { c 1 } }")
+        assert n.children[0].children[0].args == [1]
+
+    def test_inline_children(self):
+        n = one("a { b 1; c 2 }")
+        assert [c.name for c in n.children] == ["b", "c"]
+
+    def test_children_then_more_entries_error_free(self):
+        # `}` on same line as entries
+        n = one('ports { port host=1 container=2 }')
+        assert n.children[0].props["host"] == 1
+
+    def test_unbalanced_brace(self):
+        with pytest.raises(KdlError):
+            parse_document("a {")
+        with pytest.raises(KdlError):
+            parse_document("a }")
+
+
+class TestComments:
+    def test_line_comment(self):
+        nodes = parse_document("// hi\nnode 1 // trailing\nother")
+        assert [n.name for n in nodes] == ["node", "other"]
+        assert nodes[0].args == [1]
+
+    def test_block_comment(self):
+        n = one("node /* inline */ 1 /* another */ 2")
+        assert n.args == [1, 2]
+
+    def test_nested_block_comment(self):
+        nodes = parse_document("/* outer /* inner */ still */ node")
+        assert nodes[0].name == "node"
+
+    def test_unterminated_block_comment(self):
+        with pytest.raises(KdlError):
+            parse_document("/* oops")
+
+    def test_slashdash_node(self):
+        nodes = parse_document("/-dead 1 2\nalive")
+        assert [n.name for n in nodes] == ["alive"]
+
+    def test_slashdash_node_with_children(self):
+        nodes = parse_document("/-dead { child 1 }\nalive")
+        assert [n.name for n in nodes] == ["alive"]
+
+    def test_slashdash_arg(self):
+        n = one('node /-"dead" "alive"')
+        assert n.args == ["alive"]
+
+    def test_slashdash_prop(self):
+        n = one("node /-dead=1 live=2")
+        assert n.props == {"live": 2}
+
+
+class TestStrings:
+    def test_escapes(self):
+        n = one(r'node "a\nb\tc\"d\\e"')
+        assert n.args == ['a\nb\tc"d\\e']
+
+    def test_unicode_escape(self):
+        n = one(r'node "\u{1F600}"')
+        assert n.args == ["\U0001F600"]
+
+    def test_raw_string(self):
+        n = one('node r"c:\\path\\no-escape"')
+        assert n.args == ["c:\\path\\no-escape"]
+
+    def test_raw_string_hashes(self):
+        n = one('node r#"has "quotes" inside"#')
+        assert n.args == ['has "quotes" inside']
+
+    def test_unterminated_string(self):
+        with pytest.raises(KdlError):
+            parse_document('node "oops')
+
+    def test_multibyte_content(self):
+        n = one('stage "live" { service "db" }\n')
+        assert n.name == "stage"
+        n = one('node "日本語のサービス"')
+        assert n.args == ["日本語のサービス"]
+
+
+class TestLineContinuation:
+    def test_backslash_continuation(self):
+        n = one('node 1 \\\n  2 3')
+        assert n.args == [1, 2, 3]
+
+    def test_continuation_with_comment(self):
+        n = one('node 1 \\ // comment\n  2')
+        assert n.args == [1, 2]
+
+
+class TestTypeAnnotations:
+    def test_node_annotation(self):
+        n = one('(author)node "x"')
+        assert n.type_annotation == "author"
+        assert n.name == "node"
+
+
+class TestRealConfigs:
+    def test_reference_shaped_config(self):
+        text = '''
+project "fleetflow-services"
+
+provider "sakura-cloud" { zone "tk1a" }
+
+server "fleetflow-cp" {
+    provider "sakura-cloud"
+    plan "2core-4gb"
+    disk-size 40
+    tags "fleetflow:cp"
+}
+
+service "fleetflowd" {
+    image "ghcr.io/example/fleetflowd:latest"
+    restart "unless-stopped"
+    ports {
+        port host=4510 container=4510
+        port host=32080 container=32080
+    }
+    volumes {
+        volume "/etc/fleetflow" "/etc/fleetflow" read-only=true
+    }
+    env {
+        RUST_LOG "info"
+    }
+}
+
+stage "live" {
+    server "fleetflow-cp"
+    service "fleetflowd"
+}
+'''
+        nodes = parse_document(text)
+        names = [n.name for n in nodes]
+        assert names == ["project", "provider", "server", "service", "stage"]
+        svc = nodes[3]
+        ports = svc.child("ports")
+        assert len(list(ports.children_named("port"))) == 2
+
+    def test_roundtrip(self):
+        text = 'service "db" { image "postgres"; ports { port host=1 container=2 } }'
+        nodes = parse_document(text)
+        text2 = format_document(nodes)
+        nodes2 = parse_document(text2)
+        assert nodes2[0].child("image").args == ["postgres"]
+        assert nodes2[0].child("ports").children[0].props == {"host": 1, "container": 2}

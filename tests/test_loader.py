@@ -1,0 +1,165 @@
+"""Loader + discovery pipeline tests (analog of loader.rs:319-669 and
+discovery.rs:263-420 test suites)."""
+
+import os
+
+import pytest
+
+from fleetflow_tpu.core import (ConfigNotFound, discover_files_with_stage,
+                                find_project_root,
+                                load_project_from_root_with_stage)
+
+
+class TestDiscovery:
+    def test_find_project_root_walk_up(self, project):
+        root, _ = project
+        nested = root / "src" / "deep"
+        nested.mkdir(parents=True)
+        assert find_project_root(str(nested)) == os.path.realpath(str(root))
+
+    def test_no_root_raises(self, tmp_path):
+        with pytest.raises(ConfigNotFound):
+            find_project_root(str(tmp_path))
+
+    def test_env_override(self, project, tmp_path, monkeypatch):
+        root, _ = project
+        monkeypatch.setenv("FLEET_PROJECT_ROOT", str(root))
+        assert find_project_root(str(tmp_path)) == os.path.realpath(str(root))
+
+    def test_bad_env_override_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_PROJECT_ROOT", str(tmp_path))
+        with pytest.raises(ConfigNotFound):
+            find_project_root(str(tmp_path))
+
+    def test_discover_file_set(self, project):
+        root, write = project
+        write("cloud.kdl", 'provider "x" { }')
+        write("services/db.kdl", 'service "db2" { }')
+        write("services/sub/extra.kdl", 'service "db3" { }')
+        write("stages/prod.kdl", 'stage "prod" { service "db2" }')
+        write("variables/common.kdl", 'variables { V "1" }')
+        write("flow.prod.kdl", 'project "override"')
+        write("flow.local.kdl", 'variables { L "local" }')
+
+        d = discover_files_with_stage(str(root), "prod")
+        assert d.cloud_file.endswith("cloud.kdl")
+        assert d.main_file.endswith("fleet.kdl")
+        assert [os.path.basename(f) for f in d.service_files] == ["db.kdl", "extra.kdl"]
+        assert len(d.stage_files) == 1
+        assert len(d.variable_files) == 1
+        assert d.stage_override_file.endswith("flow.prod.kdl")
+        assert d.local_override_file.endswith("flow.local.kdl")
+        # fixed concat order
+        names = [os.path.basename(f) for f in d.all_files()]
+        assert names == ["cloud.kdl", "fleet.kdl", "db.kdl", "extra.kdl",
+                         "prod.kdl", "flow.prod.kdl", "flow.local.kdl"]
+
+    def test_no_stage_override_when_absent(self, project):
+        root, _ = project
+        d = discover_files_with_stage(str(root), "ghost")
+        assert d.stage_override_file is None
+
+
+class TestLoader:
+    def test_basic_load(self, project):
+        root, _ = project
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.name == "testproj"
+        assert set(flow.services) == {"postgres", "redis", "app"}
+        assert flow.stages["local"].services == ["postgres", "redis", "app"]
+
+    def test_template_variables_from_fleet_kdl(self, project):
+        root, write = project
+        write("fleet.kdl", '''
+project "p"
+variables { PG_VERSION "16" }
+service "db" { image "postgres:{{ PG_VERSION }}" }
+stage "local" { service "db" }
+''')
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.services["db"].image == "postgres:16"
+
+    def test_dotenv_chain_priority(self, project):
+        root, write = project
+        write("fleet.kdl", '''
+project "p"
+service "db" { image "postgres:{{ V }}" }
+''')
+        (root / ".env").write_text("V=from-env\n")
+        (root / ".env.external").write_text("V=from-external\n")
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.services["db"].image == "postgres:from-external"
+        (root / ".env.prod").write_text("V=from-stage-env\n")
+        flow = load_project_from_root_with_stage(str(root), "prod")
+        assert flow.services["db"].image == "postgres:from-stage-env"
+
+    def test_allowlisted_env_beats_dotenv(self, project):
+        root, write = project
+        write("fleet.kdl", 'project "p"\nservice "db" { image "postgres:{{ FLEET_V }}" }')
+        (root / ".env").write_text("FLEET_V=dotenv\n")
+        flow = load_project_from_root_with_stage(
+            str(root), environ={"FLEET_V": "process-env"})
+        assert flow.services["db"].image == "postgres:process-env"
+
+    def test_stage_scoped_variables_highest(self, project):
+        root, write = project
+        write("fleet.kdl", '''
+project "p"
+variables { V "top" }
+service "db" { image "postgres:{{ V }}" }
+stage "dev" {
+    service "db"
+    variables { V "stage" }
+}
+''')
+        flow = load_project_from_root_with_stage(str(root), "dev")
+        assert flow.services["db"].image == "postgres:stage"
+        flow2 = load_project_from_root_with_stage(str(root))
+        assert flow2.services["db"].image == "postgres:top"
+
+    def test_flow_local_override_wins(self, project):
+        root, write = project
+        write("fleet.kdl", 'project "p"\nservice "db" { image "a"; version "1" }')
+        write("flow.local.kdl", 'service "db" { version "2-local" }')
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.services["db"].version == "2-local"
+        assert flow.services["db"].image == "a"  # merge kept base image
+
+    def test_stage_override_file_order(self, project):
+        root, write = project
+        write("fleet.kdl", 'project "p"\nservice "db" { version "1" }')
+        write("flow.prod.kdl", 'service "db" { version "prod" }')
+        write("flow.local.kdl", 'service "db" { version "local" }')
+        # flow.local.kdl renders after flow.{stage}.kdl → local wins
+        flow = load_project_from_root_with_stage(str(root), "prod")
+        assert flow.services["db"].version == "local"
+
+    def test_services_dir_merge(self, project):
+        root, write = project
+        write("services/db.kdl", 'service "postgres" { env { EXTRA "1" } }')
+        flow = load_project_from_root_with_stage(str(root))
+        svc = flow.services["postgres"]
+        assert svc.image == "postgres"  # from fleet.kdl
+        assert svc.environment["EXTRA"] == "1"  # merged from services/
+
+    def test_builtin_project_root(self, project):
+        root, write = project
+        write("fleet.kdl",
+              'project "p"\nservice "db" { volumes { volume "{{ PROJECT_ROOT }}/data" "/data" } }')
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.services["db"].volumes[0].host == f"{os.path.realpath(str(root))}/data"
+
+    def test_variables_dir(self, project):
+        root, write = project
+        write("fleet.kdl", 'project "p"\nservice "db" { image "pg:{{ COMMON }}" }')
+        write("variables/common.kdl", 'variables { COMMON "shared" }')
+        flow = load_project_from_root_with_stage(str(root))
+        assert flow.services["db"].image == "pg:shared"
+
+    def test_debug_loader(self, project):
+        from fleetflow_tpu.core import LoadDebug
+        root, _ = project
+        dbg = LoadDebug()
+        load_project_from_root_with_stage(str(root), debug=dbg)
+        assert dbg.files and dbg.concatenated
+        assert "PROJECT_ROOT" in dbg.variables

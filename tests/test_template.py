@@ -1,0 +1,147 @@
+"""Template + dotenv + variables pre-pass tests (analog of template.rs tests)."""
+
+import pytest
+
+from fleetflow_tpu.core import FlowError
+from fleetflow_tpu.core.template import (TemplateProcessor,
+                                         extract_variables_with_stage,
+                                         parse_dotenv)
+
+
+class TestDotenv:
+    def test_basic(self):
+        env = parse_dotenv("A=1\nB=two\n# comment\n\nC=three four")
+        assert env == {"A": "1", "B": "two", "C": "three four"}
+
+    def test_quotes_and_export(self):
+        env = parse_dotenv('export A="quoted value"\nB=\'single\'\nC=bare # trailing')
+        assert env == {"A": "quoted value", "B": "single", "C": "bare"}
+
+    def test_garbage_lines_skipped(self):
+        env = parse_dotenv("not a kv line\nA=1")
+        assert env == {"A": "1"}
+
+
+class TestTemplateProcessor:
+    def test_basic_substitution(self):
+        tp = TemplateProcessor()
+        tp.add_variables({"VERSION": "1.2.3"})
+        assert tp.render_str('image "app:{{ VERSION }}"') == 'image "app:1.2.3"'
+
+    def test_layering_later_wins(self):
+        tp = TemplateProcessor()
+        tp.add_variables({"X": "low"})
+        tp.add_variables({"X": "high"})
+        assert tp.render_str("{{ X }}") == "high"
+
+    def test_undefined_variable_errors(self):
+        tp = TemplateProcessor()
+        with pytest.raises(FlowError, match="NOPE"):
+            tp.render_str("{{ NOPE }}")
+
+    def test_default_filter_tera_style(self):
+        tp = TemplateProcessor()
+        tp.add_variables({"SET": "v"})
+        assert tp.render_str('{{ SET | default(value="d") }}') == "v"
+        # undefined goes through default via jinja-style too
+        tp2 = TemplateProcessor(strict=False)
+        assert tp2.render_str('{{ UNSET | default("d") }}') == "d"
+        assert tp2.render_str('{{ UNSET | default(value="d") }}') == "d"
+
+    def test_env_allowlist(self, monkeypatch):
+        tp = TemplateProcessor()
+        tp.add_allowlisted_env({"FLEET_STAGE": "live", "CI_JOB": "42",
+                                "APP_KEY": "k", "SECRET_TOKEN": "no",
+                                "PATH": "/bin"})
+        assert tp.variables == {"FLEET_STAGE": "live", "CI_JOB": "42",
+                                "APP_KEY": "k"}
+
+    def test_env_function(self, monkeypatch):
+        monkeypatch.setenv("SOME_VAR", "hello")
+        tp = TemplateProcessor()
+        assert tp.render_str('{{ env(name="SOME_VAR") }}') == "hello"
+        assert tp.render_str('{{ env(name="MISSING_VAR", default="d") }}') == "d"
+        with pytest.raises(FlowError):
+            tp.render_str('{{ env(name="MISSING_VAR") }}')
+
+    def test_shell_style_passthrough(self):
+        # ${VAR:-default} is NOT template syntax; must survive rendering
+        tp = TemplateProcessor()
+        s = 'image "app:${APP_VERSION:-latest}"'
+        assert tp.render_str(s) == s
+
+    def test_conditional(self):
+        tp = TemplateProcessor()
+        tp.add_variables({"STAGE": "live"})
+        out = tp.render_str('{% if STAGE == "live" %}prod{% else %}dev{% endif %}')
+        assert out == "prod"
+
+
+class TestVariablesPrePass:
+    TEXT = '''
+variables {
+    GLOBAL "g"
+    SHARED "top"
+}
+service "a" { image "x:{{ GLOBAL }}" }
+stage "dev" {
+    variables {
+        SHARED "dev-wins"
+        DEV_ONLY "d"
+    }
+}
+stage "live" {
+    variables { SHARED "live-wins" }
+}
+'''
+
+    def test_top_level_only(self):
+        vars = extract_variables_with_stage(self.TEXT, None)
+        assert vars == {"GLOBAL": "g", "SHARED": "top"}
+
+    def test_stage_scoped_overlay(self):
+        vars = extract_variables_with_stage(self.TEXT, "dev")
+        assert vars["SHARED"] == "dev-wins"
+        assert vars["DEV_ONLY"] == "d"
+        assert vars["GLOBAL"] == "g"
+
+    def test_other_stage_not_leaked(self):
+        vars = extract_variables_with_stage(self.TEXT, "live")
+        assert vars["SHARED"] == "live-wins"
+        assert "DEV_ONLY" not in vars
+
+    def test_tolerates_template_syntax(self):
+        text = 'variables { V "1" }\nservice "a" { image "{{ V }}" }\n{% if x %}{% endif %}'
+        assert extract_variables_with_stage(text, None) == {"V": "1"}
+
+
+class TestOpReferences:
+    def test_detection(self):
+        from fleetflow_tpu.core.secrets import is_op_reference
+        assert is_op_reference("op://vault/item/field")
+        assert is_op_reference("op://v/i/f/extra")
+        assert not is_op_reference("op://vault/item")
+        assert not is_op_reference("not-a-ref")
+        assert not is_op_reference("")
+
+    def test_missing_binary_raises(self, monkeypatch):
+        import fleetflow_tpu.core.secrets as secrets
+        monkeypatch.setattr(secrets, "_op_binary", lambda: None)
+        with pytest.raises(FlowError, match="op"):
+            secrets.resolve_reference("op://v/i/f")
+
+    def test_batch_resolution_mocked(self, monkeypatch):
+        import fleetflow_tpu.core.secrets as secrets
+        monkeypatch.setattr(secrets, "resolve_reference",
+                            lambda ref, timeout=30.0: f"resolved:{ref}")
+        out = secrets.resolve_op_references(
+            {"A": "op://v/i/f", "B": "plain"})
+        assert out == {"A": "resolved:op://v/i/f", "B": "plain"}
+
+
+class TestReviewRegressions:
+    def test_variable_value_with_slashes(self):
+        # '//' inside a quoted value must not be eaten as a comment
+        vars = extract_variables_with_stage(
+            'variables { BASE_URL "https://example.com/x" }', None)
+        assert vars == {"BASE_URL": "https://example.com/x"}
