@@ -378,9 +378,13 @@ class _Parser:
                     self.pos += 1
                 continue
             if c == "{":
+                # children terminate the node (KDL spec: nothing may follow a
+                # children block). Anything after `}` on the same line parses
+                # as a sibling node, so `capacity { cpu 4 } labels { ... }`
+                # reads naturally.
                 self.pos += 1
                 node.children = self.parse_nodes(until_brace=True)
-                continue
+                break
             if c == "}":
                 break  # let caller consume the closing brace
 

@@ -1,0 +1,4 @@
+"""Lowering pass: Flow → dense constraint tensors for the TPU solver."""
+
+from .tensors import (LOCAL_NODE_NAME, ProblemTensors, dependency_depths,
+                      lower_stage, synthetic_problem)

@@ -1,0 +1,373 @@
+"""Lowering: Flow + stage → dense constraint tensors (the TPU on-ramp).
+
+This is the reformulation at the heart of the framework (BASELINE.json
+north star): the reference's placement inputs — `depends_on` DAGs
+(engine.rs:67-85), host-port bindings (converter.rs port bindings), volume
+binds, server capacity/labels and placement policies (control-plane
+model.rs:82-95,400-442) — become dense, device-ready arrays:
+
+  demand        (S, R) f32   per-service resource demand (cpu, memMiB, diskMiB)
+  capacity      (N, R) f32   per-node capacity
+  dep_adj       (S, S) bool  dep_adj[i, j] = i depends on j (start ordering)
+  dep_depth     (S,)   i32   topological depth (Kahn levels; cycles rejected)
+  port_ids      (S, P) i32   host-port conflict ids, -1 padded (anti-affinity)
+  volume_ids    (S, V) i32   exclusive-volume conflict ids, -1 padded
+  anti_ids      (S, A) i32   explicit anti-affinity group ids, -1 padded
+  coloc_ids     (S, C) i32   colocation group ids, -1 padded (soft)
+  eligible      (S, N) bool  label/tier eligibility mask
+  node_valid    (N,)   bool  membership/health mask (churn flips bits here)
+  node_topology (N,)   i32   topology-domain id for the spread constraint
+
+Everything is numpy here (host, pure, unit-testable); the solver uploads
+once and keeps the tensors device-resident across re-solves.
+
+Replicas are expanded at lowering time: `service "w" { replicas 3 }` becomes
+rows w#0, w#1, w#2 sharing demand/ports/volumes; replica host-port conflicts
+make replicas of a port-publishing service mutually anti-affine exactly like
+the reference's one-host-port-per-node reality.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..core.errors import SolverError
+from ..core.model import (Flow, PlacementPolicy, PlacementStrategy,
+                          ResourceSpec, ServerResource, Service, Stage)
+
+__all__ = ["ProblemTensors", "lower_stage", "dependency_depths",
+           "LOCAL_NODE_NAME", "synthetic_problem"]
+
+LOCAL_NODE_NAME = "local"
+_R = len(ResourceSpec.axes())  # cpu, memory, disk
+
+
+@dataclass
+class ProblemTensors:
+    service_names: list[str]
+    node_names: list[str]
+    demand: np.ndarray          # (S, R) f32
+    capacity: np.ndarray        # (N, R) f32
+    dep_adj: np.ndarray         # (S, S) bool
+    dep_depth: np.ndarray       # (S,) i32
+    port_ids: np.ndarray        # (S, P) i32, -1 pad
+    volume_ids: np.ndarray      # (S, V) i32, -1 pad
+    anti_ids: np.ndarray        # (S, A) i32, -1 pad
+    coloc_ids: np.ndarray       # (S, C) i32, -1 pad
+    eligible: np.ndarray        # (S, N) bool
+    node_valid: np.ndarray      # (N,) bool
+    node_topology: np.ndarray   # (N,) i32
+    strategy: PlacementStrategy = PlacementStrategy.SPREAD_ACROSS_POOL
+    max_skew: int = 0           # 0 = no spread constraint
+    preferred: Optional[np.ndarray] = None  # (S, N) f32 soft preference, or None
+    replica_of: list[str] = field(default_factory=list)  # base service per row
+
+    @property
+    def S(self) -> int:
+        return self.demand.shape[0]
+
+    @property
+    def N(self) -> int:
+        return self.capacity.shape[0]
+
+    def validate(self) -> None:
+        S, N = self.S, self.N
+        assert self.demand.shape == (S, _R)
+        assert self.capacity.shape == (N, _R)
+        assert self.dep_adj.shape == (S, S)
+        assert self.dep_depth.shape == (S,)
+        assert self.eligible.shape == (S, N)
+        assert self.node_valid.shape == (N,)
+        assert self.node_topology.shape == (N,)
+        for arr in (self.port_ids, self.volume_ids, self.anti_ids, self.coloc_ids):
+            assert arr.ndim == 2 and arr.shape[0] == S
+
+
+def dependency_depths(dep_adj: np.ndarray,
+                      names: Optional[list[str]] = None) -> np.ndarray:
+    """Kahn-style level assignment: depth(s) = 1 + max(depth(deps)), 0 for
+    roots. Rejects cycles. This replaces the reference's single-pass
+    partition (engine.rs:67-85 `order_by_dependencies`, which is NOT a true
+    topo sort) with an exact level schedule that vectorizes: all services at
+    depth d can start concurrently once depth d-1 is ready."""
+    S = dep_adj.shape[0]
+    depth = np.zeros(S, dtype=np.int32)
+    remaining = dep_adj.copy()
+    unresolved = np.ones(S, dtype=bool)
+    level = 0
+    while unresolved.any():
+        # ready: unresolved services whose remaining deps are all resolved
+        ready = unresolved & ~remaining[:, unresolved].any(axis=1)
+        if not ready.any():
+            cyc = np.flatnonzero(unresolved)
+            label = ([names[i] for i in cyc[:5]] if names else cyc[:5].tolist())
+            raise SolverError(f"dependency cycle among services {label}")
+        depth[ready] = level
+        unresolved &= ~ready
+        level += 1
+        if level > S + 1:
+            raise SolverError("dependency depth exceeded service count (bug)")
+    return depth
+
+
+def _pad_ids(groups: list[list[int]], pad_to_multiple: int = 1) -> np.ndarray:
+    """list-of-id-lists → (S, K) int32 padded with -1."""
+    k = max((len(g) for g in groups), default=0)
+    k = max(k, 1)
+    if pad_to_multiple > 1:
+        k = ((k + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
+    out = np.full((len(groups), k), -1, dtype=np.int32)
+    for i, g in enumerate(groups):
+        out[i, : len(g)] = g
+    return out
+
+
+def _server_matches(policy: Optional[PlacementPolicy],
+                    server: ServerResource) -> bool:
+    if policy is None:
+        return True
+    labels = server.labels.as_dict()
+    if policy.tier is not None and labels.get("tier") not in (None, policy.tier):
+        return False
+    for k, v in policy.required_labels.items():
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+def _preference_row(policy: Optional[PlacementPolicy],
+                    server: ServerResource) -> float:
+    if policy is None or not policy.preferred_labels:
+        return 0.0
+    labels = server.labels.as_dict()
+    hits = sum(1 for k, v in policy.preferred_labels.items()
+               if labels.get(k) == v)
+    return hits / max(len(policy.preferred_labels), 1)
+
+
+def lower_stage(flow: Flow, stage_name: str,
+                nodes: Optional[list[ServerResource]] = None) -> ProblemTensors:
+    """Lower one stage of a Flow into ProblemTensors.
+
+    Node set: explicit `nodes` arg > stage.servers > all flow.servers > a
+    single implicit "local" node with generous capacity (the `fleet up local`
+    story, where placement degenerates to ordering).
+    """
+    stage = flow.stage(stage_name)
+    services = stage.resolved_services(flow)
+    policy = stage.placement
+
+    if nodes is None:
+        if stage.servers:
+            missing = [s for s in stage.servers if s not in flow.servers]
+            if missing:
+                raise SolverError(
+                    f"stage {stage_name!r} references unknown servers {missing}")
+            nodes = [flow.servers[s] for s in stage.servers]
+        elif flow.servers:
+            nodes = list(flow.servers.values())
+        else:
+            nodes = [ServerResource(
+                name=LOCAL_NODE_NAME,
+                capacity=ResourceSpec(cpu=1e6, memory=1e9, disk=1e9))]
+
+    # ---- replica expansion -------------------------------------------------
+    rows: list[Service] = []
+    row_names: list[str] = []
+    replica_of: list[str] = []
+    base_index: dict[str, list[int]] = {}
+    for svc in services:
+        reps = max(svc.replicas, 1)
+        idxs = []
+        for r in range(reps):
+            idxs.append(len(rows))
+            rows.append(svc)
+            row_names.append(svc.name if reps == 1 else f"{svc.name}#{r}")
+            replica_of.append(svc.name)
+        base_index[svc.name] = idxs
+    S, N = len(rows), len(nodes)
+    if S == 0:
+        raise SolverError(f"stage {stage_name!r} has no services")
+
+    # ---- demand / capacity -------------------------------------------------
+    demand = np.array([r.resources.as_tuple() for r in rows], dtype=np.float32)
+    capacity = np.array([n.capacity.as_tuple() for n in nodes], dtype=np.float32)
+
+    # ---- dependency DAG over expanded rows ---------------------------------
+    dep_adj = np.zeros((S, S), dtype=bool)
+    for svc in services:
+        for i in base_index[svc.name]:
+            for dep in rows[i].depends_on:
+                if dep not in base_index:
+                    raise SolverError(
+                        f"service {rows[i].name!r} depends on unknown service {dep!r}")
+                for j in base_index[dep]:
+                    dep_adj[i, j] = True
+    dep_depth = dependency_depths(dep_adj, row_names)
+
+    # ---- conflict id groups ------------------------------------------------
+    port_key_ids: dict[tuple, int] = {}
+    vol_key_ids: dict[str, int] = {}
+    anti_key_ids: dict[str, int] = {}
+    coloc_key_ids: dict[str, int] = {}
+
+    port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
+    for i, svc in enumerate(rows):
+        pg = []
+        for p in svc.ports:
+            key = p.key()
+            pg.append(port_key_ids.setdefault(key, len(port_key_ids)))
+        port_groups.append(pg)
+        vg = []
+        for v in svc.volumes:
+            ck = v.conflict_key()
+            if ck is not None:
+                vg.append(vol_key_ids.setdefault(ck, len(vol_key_ids)))
+        vol_groups.append(vg)
+        ag = [anti_key_ids.setdefault(k, len(anti_key_ids))
+              for k in svc.anti_affinity]
+        anti_groups.append(ag)
+        cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
+              for k in svc.colocate_with]
+        coloc_groups.append(cg)
+
+    # ---- eligibility / preference / validity / topology --------------------
+    eligible = np.zeros((S, N), dtype=bool)
+    preferred = np.zeros((S, N), dtype=np.float32)
+    for j, node in enumerate(nodes):
+        ok = _server_matches(policy, node)
+        pref = _preference_row(policy, node)
+        for i in range(S):
+            eligible[i, j] = ok
+            preferred[i, j] = pref
+    if not eligible.any(axis=1).all():
+        bad = [row_names[i] for i in np.flatnonzero(~eligible.any(axis=1))[:5]]
+        raise SolverError(
+            f"services {bad} have no eligible node under the placement policy")
+    node_valid = np.ones(N, dtype=bool)
+
+    topo_key = (policy.spread_constraint.topology_key
+                if policy and policy.spread_constraint else None)
+    topo_ids: dict[str, int] = {}
+    node_topology = np.zeros(N, dtype=np.int32)
+    if topo_key and topo_key != "node":
+        for j, node in enumerate(nodes):
+            lbl = node.labels.as_dict().get(topo_key, f"__node_{j}")
+            node_topology[j] = topo_ids.setdefault(lbl, len(topo_ids))
+    else:
+        node_topology = np.arange(N, dtype=np.int32)
+
+    pt = ProblemTensors(
+        service_names=row_names,
+        node_names=[n.name for n in nodes],
+        demand=demand,
+        capacity=capacity,
+        dep_adj=dep_adj,
+        dep_depth=dep_depth,
+        port_ids=_pad_ids(port_groups),
+        volume_ids=_pad_ids(vol_groups),
+        anti_ids=_pad_ids(anti_groups),
+        coloc_ids=_pad_ids(coloc_groups),
+        eligible=eligible,
+        node_valid=node_valid,
+        node_topology=node_topology,
+        strategy=policy.strategy if policy else PlacementStrategy.SPREAD_ACROSS_POOL,
+        max_skew=(policy.spread_constraint.max_skew
+                  if policy and policy.spread_constraint else 0),
+        preferred=preferred if preferred.any() else None,
+        replica_of=replica_of,
+    )
+    pt.validate()
+    return pt
+
+
+# --------------------------------------------------------------------------
+# Synthetic problem generator (BASELINE.json eval configs 2-4)
+# --------------------------------------------------------------------------
+
+def synthetic_problem(S: int, N: int, seed: int = 0,
+                      dep_depth_max: int = 5,
+                      port_fraction: float = 0.2,
+                      volume_fraction: float = 0.1,
+                      n_tenants: int = 1,
+                      strategy: PlacementStrategy = PlacementStrategy.SPREAD_ACROSS_POOL,
+                      ) -> ProblemTensors:
+    """Generate a synthetic placement instance shaped like the BASELINE.json
+    eval configs: depends_on chains of depth ≤ dep_depth_max, a fraction of
+    services publishing host ports (mutual anti-affinity per port), exclusive
+    volumes, and optional multi-tenant eligibility blocks (config 4's
+    registry-aggregation analog: tenants share the node pool but only see a
+    slice)."""
+    rng = np.random.default_rng(seed)
+
+    demand = np.stack([
+        rng.uniform(0.05, 0.5, S),           # cpu
+        rng.uniform(32, 512, S),             # memory MiB
+        rng.uniform(0, 1024, S),             # disk MiB
+    ], axis=1).astype(np.float32)
+
+    # Capacity sized for ~70% aggregate utilization at feasibility
+    per_node = demand.sum(axis=0) / N / 0.7
+    jitter = rng.uniform(0.8, 1.2, (N, _R)).astype(np.float32)
+    capacity = (per_node[None, :] * jitter).astype(np.float32)
+
+    # dependency chains: partition services into chains of length ≤ depth max
+    dep_adj = np.zeros((S, S), dtype=bool)
+    order = rng.permutation(S)
+    i = 0
+    while i < len(order):
+        chain_len = int(rng.integers(1, dep_depth_max + 1))
+        chain = order[i : i + chain_len]
+        for a, b in zip(chain[1:], chain[:-1]):
+            dep_adj[a, b] = True
+        i += chain_len
+    dep_depth = dependency_depths(dep_adj)
+
+    # port conflicts: port_fraction of services publish 1-2 host ports drawn
+    # from a pool sized so each port is shared by a handful of services
+    n_ports = max(int(S * port_fraction / 4), 1)
+    port_groups: list[list[int]] = []
+    for s in range(S):
+        if rng.random() < port_fraction:
+            k = int(rng.integers(1, 3))
+            port_groups.append(rng.integers(0, n_ports, k).tolist())
+        else:
+            port_groups.append([])
+    n_vols = max(int(S * volume_fraction / 3), 1)
+    vol_groups = [([int(rng.integers(0, n_vols))] if rng.random() < volume_fraction else [])
+                  for _ in range(S)]
+
+    # multi-tenant eligibility: tenant t's services may only use its node slice
+    eligible = np.ones((S, N), dtype=bool)
+    if n_tenants > 1:
+        svc_tenant = rng.integers(0, n_tenants, S)
+        node_tenant = rng.integers(0, n_tenants, N)
+        # shared pool: a third of nodes serve everyone
+        shared = rng.random(N) < 0.33
+        eligible = (svc_tenant[:, None] == node_tenant[None, :]) | shared[None, :]
+        # guarantee every service has at least one eligible node
+        for s in np.flatnonzero(~eligible.any(axis=1)):
+            eligible[s, int(rng.integers(0, N))] = True
+
+    pt = ProblemTensors(
+        service_names=[f"svc{s}" for s in range(S)],
+        node_names=[f"node{n}" for n in range(N)],
+        demand=demand,
+        capacity=capacity,
+        dep_adj=dep_adj,
+        dep_depth=dep_depth,
+        port_ids=_pad_ids(port_groups),
+        volume_ids=_pad_ids(vol_groups),
+        anti_ids=_pad_ids([[] for _ in range(S)]),
+        coloc_ids=_pad_ids([[] for _ in range(S)]),
+        eligible=eligible,
+        node_valid=np.ones(N, dtype=bool),
+        node_topology=np.arange(N, dtype=np.int32),
+        strategy=strategy,
+        replica_of=[f"svc{s}" for s in range(S)],
+    )
+    pt.validate()
+    return pt

@@ -1,0 +1,14 @@
+"""JAX placement solver: the device-resident heart of the framework.
+
+Replaces the reference's sequential placement path (engine.rs
+order_by_dependencies + per-service Docker loop) with greedy seeding +
+mesh-sharded simulated annealing over dense constraint tensors.
+"""
+
+from .anneal import anneal, chain_states_from_assignment
+from .api import CHAIN_AXIS, SolveResult, make_chain_inits, solve
+from .greedy import greedy_place, placement_order
+from .kernels import (node_loads, soft_score, total_cost, total_violations,
+                      violation_stats)
+from .problem import DeviceProblem, prepare_problem
+from .repair import RepairResult, repair, verify

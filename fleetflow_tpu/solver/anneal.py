@@ -1,0 +1,193 @@
+"""Simulated-annealing refinement: vmapped independent chains.
+
+The pmapped/mesh-sharded annealing pass of the north star ("a pmapped
+simulated-annealing pass"). Each chain keeps an incremental view of the
+placement state — node loads (N, R), conflict-group occupancy (N, G),
+colocation occupancy (N, Gc), topology-domain counts (T,) — so one proposal
+costs O(R + K + T), not a full re-score. Chains are vmapped; sharding the
+chain axis over a jax.sharding.Mesh makes the whole sweep SPMD with a single
+argmin all-reduce at the end (solver/api.py), which is how the solver scales
+to a v5e-8 the way the reference scales agents over QUIC fan-out.
+
+The annealing cost mirrors kernels.total_cost in *shape* (hard >> soft) but
+uses overflow mass instead of overflow cell count so moves feel a gradient;
+final chain ranking and the zero-violation check use the exact kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .problem import DeviceProblem
+
+__all__ = ["anneal", "chain_states_from_assignment", "ChainState"]
+
+W_CAP = 1e3     # per-unit overflow mass (normalized units)
+W_CONF = 1e4    # per conflicting co-placement
+W_ELIG = 1e6    # per ineligible placement
+W_SKEW = 1e3    # per unit of excess skew
+
+
+class ChainState(NamedTuple):
+    assignment: jax.Array   # (S,) i32
+    load: jax.Array         # (N, R) f32
+    used: jax.Array         # (N, G) i32   conflict-group occupancy
+    coloc: jax.Array        # (N, Gc) i32  colocation occupancy (Gc>=1)
+    topo: jax.Array         # (T,) i32     services per topology domain
+
+
+def chain_states_from_assignment(prob: DeviceProblem,
+                                 assignment: jax.Array) -> ChainState:
+    """Build the incremental state for one chain from a dense assignment."""
+    R = prob.demand.shape[1]
+    load = jnp.zeros((prob.N, R), jnp.float32).at[assignment].add(prob.demand)
+
+    valid = prob.conflict_ids >= 0
+    safe = jnp.where(valid, prob.conflict_ids, 0)
+    nodes = jnp.broadcast_to(assignment[:, None], safe.shape)
+    used = jnp.zeros((prob.N, prob.G), jnp.int32).at[nodes, safe].add(
+        valid.astype(jnp.int32))
+
+    Gc = max(prob.Gc, 1)
+    cvalid = prob.coloc_ids >= 0
+    csafe = jnp.where(cvalid, prob.coloc_ids, 0)
+    cnodes = jnp.broadcast_to(assignment[:, None], csafe.shape)
+    coloc = jnp.zeros((prob.N, Gc), jnp.int32).at[cnodes, csafe].add(
+        cvalid.astype(jnp.int32))
+
+    topo = jnp.zeros(prob.T, jnp.int32).at[prob.node_topology[assignment]].add(1)
+    return ChainState(assignment, load, used, coloc, topo)
+
+
+def _overflow_mass(prob: DeviceProblem, load_rows: jax.Array,
+                   cap_rows: jax.Array) -> jax.Array:
+    """Normalized overflow mass for the given (k, R) rows."""
+    return (jnp.maximum(load_rows - cap_rows, 0.0)
+            / jnp.maximum(cap_rows, 1e-6)).sum()
+
+
+def _skew_pen(prob: DeviceProblem, topo: jax.Array) -> jax.Array:
+    if prob.max_skew <= 0:
+        return jnp.float32(0.0)
+    skew = (topo.max() - topo.min()).astype(jnp.float32)
+    return jnp.maximum(skew - prob.max_skew, 0.0) * W_SKEW
+
+
+def _soft_rows(prob: DeviceProblem, load_rows: jax.Array,
+               cap_rows: jax.Array) -> jax.Array:
+    """Strategy soft term restricted to the touched node rows."""
+    u = load_rows / jnp.maximum(cap_rows, 1e-6)
+    usq = (u * u).sum()
+    if prob.strategy == 0:
+        return usq / prob.N
+    if prob.strategy == 1:
+        return -usq / prob.N
+    return jnp.float32(0.0)
+
+
+def _propose_and_apply(prob: DeviceProblem, state: ChainState,
+                       key: jax.Array, temp: jax.Array) -> ChainState:
+    """One Metropolis step: move a random service to a random node."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s = jax.random.randint(k1, (), 0, prob.S)
+    b = jax.random.randint(k2, (), 0, prob.N)
+    a = state.assignment[s]
+
+    d = prob.demand[s]
+    ids = prob.conflict_ids[s]
+    valid = (ids >= 0)
+    safe = jnp.where(valid, ids, 0)
+    cids = prob.coloc_ids[s]
+    cvalid = (cids >= 0)
+    csafe = jnp.where(cvalid, cids, 0)
+
+    cap_a, cap_b = prob.capacity[a], prob.capacity[b]
+    load_a, load_b = state.load[a], state.load[b]
+
+    # -- hard deltas ---------------------------------------------------------
+    # capacity overflow mass on the two touched rows
+    over_before = (_overflow_mass(prob, load_a, cap_a)
+                   + _overflow_mass(prob, load_b, cap_b))
+    load_a2, load_b2 = load_a - d, load_b + d
+    over_after = (_overflow_mass(prob, load_a2, cap_a)
+                  + _overflow_mass(prob, load_b2, cap_b))
+    d_cap = (over_after - over_before) * W_CAP
+
+    # conflicts: occupancy excluding s itself on its current node
+    conf_a = ((state.used[a, safe] - 1) * valid).sum()
+    conf_b = (state.used[b, safe] * valid).sum()
+    d_conf = (conf_b - conf_a).astype(jnp.float32) * W_CONF
+
+    # eligibility / validity
+    elig_a = prob.eligible[s, a] & prob.node_valid[a]
+    elig_b = prob.eligible[s, b] & prob.node_valid[b]
+    d_elig = (elig_a.astype(jnp.float32) - elig_b.astype(jnp.float32)) * W_ELIG
+
+    # skew
+    ta, tb = prob.node_topology[a], prob.node_topology[b]
+    topo2 = state.topo.at[ta].add(-1).at[tb].add(1)
+    d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, state.topo)
+
+    # -- soft deltas ---------------------------------------------------------
+    soft_before = _soft_rows(prob, jnp.stack([load_a, load_b]),
+                             jnp.stack([cap_a, cap_b]))
+    soft_after = _soft_rows(prob, jnp.stack([load_a2, load_b2]),
+                            jnp.stack([cap_a, cap_b]))
+    d_pref = (prob.preferred[s, a] - prob.preferred[s, b]) / prob.S
+    col_a = ((state.coloc[a, csafe] - 1) * cvalid).sum()
+    col_b = (state.coloc[b, csafe] * cvalid).sum()
+    d_coloc = (col_a - col_b).astype(jnp.float32) / max(prob.S, 1)
+
+    delta = (d_cap + d_conf + d_elig + d_skew
+             + (soft_after - soft_before) + d_pref + d_coloc)
+
+    accept = (delta < 0) | (jax.random.uniform(k3, ()) < jnp.exp(
+        -delta / jnp.maximum(temp, 1e-8)))
+    accept = accept & (a != b)
+
+    def apply(st: ChainState) -> ChainState:
+        return ChainState(
+            assignment=st.assignment.at[s].set(b.astype(jnp.int32)),
+            load=st.load.at[a].add(-d).at[b].add(d),
+            used=st.used.at[a, safe].add(-valid.astype(jnp.int32))
+                        .at[b, safe].add(valid.astype(jnp.int32)),
+            coloc=st.coloc.at[a, csafe].add(-cvalid.astype(jnp.int32))
+                          .at[b, csafe].add(cvalid.astype(jnp.int32)),
+            topo=topo2,
+        )
+
+    return jax.tree.map(lambda new, old: jnp.where(accept, new, old),
+                        apply(state), state)
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def anneal(prob: DeviceProblem, init_assignments: jax.Array, key: jax.Array,
+           steps: int = 2000, t0: float = 1.0, t1: float = 1e-3) -> jax.Array:
+    """Run `steps` Metropolis steps on C independent chains.
+
+    init_assignments: (C, S) int32; returns refined assignments (C, S).
+    Temperature decays geometrically t0 → t1 (in units of soft-score; hard
+    violation weights are orders of magnitude above t0, so hard-violating
+    moves are only ever accepted to escape an existing violation).
+    """
+    C = init_assignments.shape[0]
+    states = jax.vmap(partial(chain_states_from_assignment, prob))(init_assignments)
+    keys = jax.random.split(key, C)
+
+    decay = (t1 / t0) ** (1.0 / max(steps - 1, 1))
+
+    def sweep(carry, i):
+        states, keys = carry
+        temp = t0 * decay ** i.astype(jnp.float32)
+        keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
+        states = jax.vmap(
+            lambda st, k: _propose_and_apply(prob, st, k, temp))(states, keys)
+        return (states, keys), None
+
+    (states, _), _ = jax.lax.scan(sweep, (states, keys),
+                                  jnp.arange(steps, dtype=jnp.int32))
+    return states.assignment

@@ -1,0 +1,129 @@
+"""Top-level solve pipeline.
+
+    ProblemTensors ──prepare──▶ DeviceProblem (staged once)
+        ──greedy seed (lax.scan FFD)──▶ assignment
+        ──perturbed chain fan-out──▶ (C, S)
+        ──anneal (vmapped chains, mesh-shardable)──▶ (C, S)
+        ──exact rank + pick best──▶ assignment
+        ──host repair backstop──▶ SolveResult (zero violations or infeasible)
+
+`mesh=` shards the chain axis over a jax.sharding.Mesh so chains run
+data-parallel across devices (the "pmapped independent annealing chains" of
+the north star); with mesh=None everything runs on one device.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .anneal import anneal
+from .greedy import greedy_place, placement_order
+from .kernels import soft_score, total_cost, violation_stats
+from .problem import DeviceProblem, prepare_problem
+from .repair import RepairResult, repair, verify
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["solve", "SolveResult", "make_chain_inits"]
+
+CHAIN_AXIS = "chains"
+
+
+@dataclass
+class SolveResult:
+    assignment: np.ndarray          # (S,) node index per service
+    stats: dict                     # exact violation stats (host-verified)
+    soft: float                     # soft score of the final assignment
+    feasible: bool
+    moves_repaired: int = 0
+    timings_ms: dict = field(default_factory=dict)
+    chains: int = 0
+    steps: int = 0
+
+    @property
+    def violations(self) -> int:
+        return int(self.stats["total"])
+
+
+def make_chain_inits(prob: DeviceProblem, seed_assignment: jax.Array,
+                     chains: int, key: jax.Array,
+                     perturb_frac: float = 0.08) -> jax.Array:
+    """(C, S) chain initializations: chain 0 is the pure greedy seed, the
+    rest perturb a random `perturb_frac` of services onto random nodes for
+    basin diversity."""
+    def one(k):
+        k1, k2 = jax.random.split(k)
+        mask = jax.random.uniform(k1, (prob.S,)) < perturb_frac
+        rand = jax.random.randint(k2, (prob.S,), 0, prob.N, dtype=jnp.int32)
+        return jnp.where(mask, rand, seed_assignment)
+
+    keys = jax.random.split(key, chains)
+    inits = jax.vmap(one)(keys)
+    return inits.at[0].set(seed_assignment)
+
+
+def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = 3000,
+          seed: int = 0, do_repair: bool = True,
+          mesh: Optional[Mesh] = None,
+          prob: Optional[DeviceProblem] = None,
+          init_assignment: Optional[np.ndarray] = None,
+          t0: float = 1.0, t1: float = 1e-3) -> SolveResult:
+    """Solve a placement instance end to end.
+
+    `init_assignment` warm-starts from a previous solve (streaming reschedule
+    path: BASELINE config 5 — keep the old placement, anneal the delta).
+    `prob` reuses an already-staged DeviceProblem across re-solves.
+    """
+    timings: dict[str, float] = {}
+    t = time.perf_counter
+
+    t_start = t()
+    if prob is None:
+        prob = prepare_problem(pt)
+    timings["stage_ms"] = (t() - t_start) * 1e3
+
+    t_seed = t()
+    if init_assignment is not None:
+        seed_assignment = jnp.asarray(init_assignment, dtype=jnp.int32)
+    else:
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth,
+                                            np.asarray(prob.conflict_ids)))
+        seed_assignment = greedy_place(prob, order)
+    key = jax.random.PRNGKey(seed)
+    k_init, k_anneal = jax.random.split(key)
+    inits = make_chain_inits(prob, seed_assignment, chains, k_init)
+    if mesh is not None:
+        inits = jax.device_put(inits, NamedSharding(mesh, P(CHAIN_AXIS, None)))
+    jax.block_until_ready(inits)
+    timings["seed_ms"] = (t() - t_seed) * 1e3
+
+    t_anneal = t()
+    refined = anneal(prob, inits, k_anneal, steps=steps, t0=t0, t1=t1)
+    costs = jax.vmap(lambda a: total_cost(prob, a))(refined)
+    best = jnp.argmin(costs)
+    best_assignment = refined[best]
+    jax.block_until_ready(best_assignment)
+    timings["anneal_ms"] = (t() - t_anneal) * 1e3
+
+    t_verify = t()
+    assignment = np.asarray(best_assignment)
+    stats = verify(pt, assignment)
+    moves = 0
+    if do_repair and stats["total"] > 0:
+        rr: RepairResult = repair(pt, assignment)
+        assignment, stats, moves = rr.assignment, rr.stats, rr.moves
+    timings["verify_repair_ms"] = (t() - t_verify) * 1e3
+    timings["total_ms"] = (t() - t_start) * 1e3
+
+    soft = float(jax.device_get(soft_score(prob, jnp.asarray(assignment))))
+    return SolveResult(
+        assignment=assignment, stats=stats, soft=soft,
+        feasible=stats["total"] == 0, moves_repaired=moves,
+        timings_ms=timings, chains=chains, steps=steps,
+    )

@@ -1,0 +1,97 @@
+"""Device greedy placer: vectorized first-fit-decreasing via lax.scan.
+
+The seed stage of the solve pipeline (SURVEY.md section 7 phase 2: "greedy
+seed (vectorized topo-order by dependency depth)"). One scan step places one
+service: score every node at once (capacity fit, conflict freedom,
+eligibility, strategy preference) and pick the best — O(N·(R+K)) per step,
+S steps, no data-dependent shapes. Replaces the reference's sequential
+`order_by_dependencies` partition + per-service Docker round-trip
+(engine.rs:67-85,157-167) as the placement front-end.
+
+When no node is feasible the service is placed best-effort (least overflow,
+fewest conflicts) and the annealer repairs it — matching the reference's
+FallbackPolicy relax-order semantics (model.rs:49) in spirit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .problem import DeviceProblem
+
+__all__ = ["greedy_place", "placement_order"]
+
+_NEG = -1e30
+
+
+def placement_order(demand: np.ndarray, dep_depth: np.ndarray,
+                    conflict_ids: np.ndarray | None = None) -> np.ndarray:
+    """Host-side placement order: most-constrained-first, then
+    first-fit-decreasing. Services carrying anti-affinity constraints (host
+    ports, exclusive volumes) go first — they need conflict-free nodes while
+    plenty remain — then by normalized demand descending; dependency depth
+    breaks ties."""
+    norm = demand / np.maximum(demand.max(axis=0, keepdims=True), 1e-6)
+    weight = norm.sum(axis=1)
+    if conflict_ids is not None and conflict_ids.size:
+        n_constraints = (conflict_ids >= 0).sum(axis=1)
+        weight = weight + n_constraints * (weight.max() + 1.0)
+    return np.lexsort((dep_depth, -weight)).astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("best_effort",))
+def greedy_place(prob: DeviceProblem, order: jax.Array,
+                 best_effort: bool = True) -> jax.Array:
+    """Place services in `order`; returns assignment (S,) int32."""
+    R = prob.demand.shape[1]
+    eps = 1e-6
+
+    def step(carry, s):
+        load, used, assignment = carry
+        d = prob.demand[s]                      # (R,)
+        ids = prob.conflict_ids[s]              # (K,)
+        valid_ids = (ids >= 0)
+        safe = jnp.where(valid_ids, ids, 0)
+
+        conflict = (used[:, safe] * valid_ids[None, :]).sum(-1) > 0   # (N,)
+        new_load = load + d[None, :]                                   # (N, R)
+        fits = (new_load <= prob.capacity + eps).all(-1)
+        ok = fits & prob.eligible[s] & prob.node_valid & ~conflict
+
+        u_after = new_load / jnp.maximum(prob.capacity, 1e-6)
+        usq = (u_after * u_after).sum(-1)                              # (N,)
+        if prob.strategy == 0:      # spread: balance → lowest resulting util²
+            score = -usq
+        elif prob.strategy == 1:    # pack: consolidate → highest resulting util²
+            score = usq
+        else:                       # fill_lowest: low node index first
+            score = -jnp.arange(prob.N, dtype=jnp.float32)
+        score = score + prob.preferred[s] * 0.5
+
+        best_ok = jnp.argmax(jnp.where(ok, score, _NEG))
+        if best_effort:
+            overflow = jnp.maximum(new_load - prob.capacity, 0.0).sum(-1)
+            n_conf = (used[:, safe] * valid_ids[None, :]).sum(-1)
+            fb_score = -(overflow * 1e3 + n_conf.astype(jnp.float32) * 1e3) + score
+            fb_ok = prob.eligible[s] & prob.node_valid
+            best_fb = jnp.argmax(jnp.where(fb_ok, fb_score, fb_score - 1e15))
+            node = jnp.where(ok.any(), best_ok, best_fb)
+        else:
+            node = best_ok
+
+        load = load.at[node].add(d)
+        used = used.at[node, safe].add(valid_ids.astype(used.dtype))
+        assignment = assignment.at[s].set(node.astype(jnp.int32))
+        return (load, used, assignment), None
+
+    init = (
+        jnp.zeros((prob.N, R), dtype=jnp.float32),
+        jnp.zeros((prob.N, prob.G), dtype=jnp.int32),
+        jnp.full((prob.S,), -1, dtype=jnp.int32),
+    )
+    (_, _, assignment), _ = jax.lax.scan(step, init, order)
+    return assignment

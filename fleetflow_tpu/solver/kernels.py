@@ -1,0 +1,131 @@
+"""Core solver kernels: feasibility, violation accounting, scoring.
+
+These are the vmapped/jitted kernels the north star prescribes (BASELINE.json:
+"a vmapped feasibility/scoring kernel"). All take a dense assignment vector
+``assignment: (S,) int32`` (service → node) and the staged DeviceProblem, and
+are pure — differentiable where meaningful, jit/vmap/shard_map friendly
+everywhere (static shapes, no data-dependent control flow).
+
+Violation semantics (the "zero constraint violations" contract):
+  - capacity:   count of (node, resource) cells where load exceeds capacity
+  - conflicts:  count of same-node pairs sharing a conflict id (host ports,
+                exclusive volumes, explicit anti-affinity — unified id space)
+  - eligibility: count of services placed on ineligible or invalid nodes
+  - skew:       excess of (max - min) services per topology domain over
+                max_skew, when a spread constraint is active
+
+Soft score (lower is better) encodes the reference's placement strategies
+(control-plane model.rs:68-75): spread_across_pool minimizes squared
+utilization (load balancing), pack_into_dedicated maximizes it (bin
+consolidation), fill_lowest prefers low node indices; plus preferred-label
+affinity and colocation rewards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .problem import DeviceProblem
+
+__all__ = ["node_loads", "group_counts", "violation_stats", "total_violations",
+           "soft_score", "total_cost", "W_HARD"]
+
+W_HARD = 1e4  # weight of one hard violation vs the soft score range
+
+
+def node_loads(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
+    """(N, R) resource load per node under `assignment`."""
+    return jnp.zeros((prob.N, prob.demand.shape[1]),
+                     dtype=jnp.float32).at[assignment].add(prob.demand)
+
+
+def group_counts(prob: DeviceProblem, assignment: jax.Array,
+                 ids: jax.Array, G: int) -> jax.Array:
+    """(N, G) count of services per (node, group-id). Padded (-1) slots are
+    routed to id 0 with weight 0."""
+    valid = ids >= 0
+    safe_ids = jnp.where(valid, ids, 0)
+    nodes = jnp.broadcast_to(assignment[:, None], ids.shape)
+    return jnp.zeros((prob.N, G), dtype=jnp.int32).at[
+        nodes, safe_ids].add(valid.astype(jnp.int32))
+
+
+def _conflict_pairs(counts: jax.Array) -> jax.Array:
+    """Sum over cells of C(count, 2) — number of conflicting same-node pairs."""
+    c = counts.astype(jnp.float32)
+    return (c * (c - 1.0) / 2.0).sum()
+
+
+def _skew_excess(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
+    """relu((max - min services per topology domain) - max_skew); 0 when no
+    spread constraint is active."""
+    if prob.max_skew <= 0:
+        return jnp.float32(0.0)
+    topo = prob.node_topology[assignment]                       # (S,)
+    per_domain = jnp.zeros(prob.T, dtype=jnp.int32).at[topo].add(1)
+    skew = per_domain.max() - per_domain.min()
+    return jnp.maximum(skew - prob.max_skew, 0).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=())
+def violation_stats(prob: DeviceProblem, assignment: jax.Array) -> dict:
+    """Exact hard-violation accounting. Returns float32 scalars."""
+    load = node_loads(prob, assignment)
+    cap_cells = (load > prob.capacity * (1 + 1e-6)).sum().astype(jnp.float32)
+
+    counts = group_counts(prob, assignment, prob.conflict_ids, prob.G)
+    conflict_pairs = _conflict_pairs(counts)
+
+    inelig = (~prob.eligible[jnp.arange(prob.S), assignment]).sum()
+    invalid = (~prob.node_valid[assignment]).sum()
+    elig = (inelig + invalid).astype(jnp.float32)
+
+    skew = _skew_excess(prob, assignment)
+    return {
+        "capacity": cap_cells,
+        "conflicts": conflict_pairs,
+        "eligibility": elig,
+        "skew": skew,
+        "total": cap_cells + conflict_pairs + elig + skew,
+    }
+
+
+def total_violations(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
+    return violation_stats(prob, assignment)["total"]
+
+
+def _utilization_sq(prob: DeviceProblem, load: jax.Array) -> jax.Array:
+    u = load / jnp.maximum(prob.capacity, 1e-6)
+    return (u * u).sum()
+
+
+def soft_score(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
+    """Strategy-dependent soft objective; lower is better. Bounded so W_HARD
+    dominates any soft gradient."""
+    load = node_loads(prob, assignment)
+    usq = _utilization_sq(prob, load)
+    denom = jnp.float32(max(prob.N, 1))
+    if prob.strategy == 0:        # spread_across_pool: balance load
+        strat = usq / denom
+    elif prob.strategy == 1:      # pack_into_dedicated: concentrate load
+        strat = -usq / denom
+    else:                         # fill_lowest: prefer low node indices
+        strat = (assignment.astype(jnp.float32) / denom).mean()
+
+    pref = -prob.preferred[jnp.arange(prob.S), assignment].mean()
+
+    # colocation reward: pairs sharing a coloc id on the same node
+    if prob.Gc > 0:
+        ccounts = group_counts(prob, assignment, prob.coloc_ids, prob.Gc)
+        coloc = -_conflict_pairs(ccounts) / jnp.float32(max(prob.S, 1))
+    else:
+        coloc = jnp.float32(0.0)
+    return strat + pref + coloc
+
+
+def total_cost(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
+    """Hard violations (dominant) + soft score: the annealing objective."""
+    return W_HARD * total_violations(prob, assignment) + soft_score(prob, assignment)

@@ -1,0 +1,108 @@
+"""Device-resident problem representation.
+
+Converts host ProblemTensors (numpy) into a pytree of jnp arrays shaped for
+the solver kernels, staged onto the device once and reused across re-solves
+(SURVEY.md section 7 hard part (d): keep host↔device transfers out of the
+per-reschedule path).
+
+Key transformation: the three anti-affinity families (host ports, exclusive
+volumes, explicit anti-affinity groups) are unified into ONE conflict-id
+space — a service carries up to K conflict ids (padded -1); two services
+conflict iff they share any id and land on the same node. This keeps the
+hot kernels free of per-family branching and avoids any S×S matrix: conflict
+rows are computed on the fly from the (S, K) id table, so 10k×1k fits easily
+in HBM (SURVEY.md hard part (b)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.model import PlacementStrategy
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["DeviceProblem", "STRATEGY_CODES", "prepare_problem"]
+
+STRATEGY_CODES = {
+    PlacementStrategy.SPREAD_ACROSS_POOL: 0,
+    PlacementStrategy.PACK_INTO_DEDICATED: 1,
+    PlacementStrategy.FILL_LOWEST: 2,
+}
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DeviceProblem:
+    """Pytree of device arrays + static metadata for the solver kernels."""
+    demand: jax.Array          # (S, R) f32
+    capacity: jax.Array        # (N, R) f32
+    conflict_ids: jax.Array    # (S, K) i32, -1 pad (ports ∪ volumes ∪ anti)
+    coloc_ids: jax.Array       # (S, C) i32, -1 pad
+    eligible: jax.Array        # (S, N) bool
+    node_valid: jax.Array      # (N,) bool
+    node_topology: jax.Array   # (N,) i32 in [0, T)
+    preferred: jax.Array       # (S, N) f32 (zeros when unused)
+    # static (not traced)
+    S: int = field(metadata=dict(static=True))
+    N: int = field(metadata=dict(static=True))
+    G: int = field(metadata=dict(static=True))   # number of conflict ids
+    Gc: int = field(metadata=dict(static=True))  # number of coloc ids (0 = none)
+    T: int = field(metadata=dict(static=True))   # number of topology domains
+    strategy: int = field(metadata=dict(static=True))
+    max_skew: int = field(metadata=dict(static=True))
+
+
+def _unify_conflict_ids(pt: ProblemTensors) -> np.ndarray:
+    """Concatenate the three id families into one id space, compacting out
+    unused slots per row."""
+    parts = []
+    offset = 0
+    for arr in (pt.port_ids, pt.volume_ids, pt.anti_ids):
+        shifted = np.where(arr >= 0, arr + offset, -1)
+        if arr.size:
+            offset += int(arr.max(initial=-1)) + 1
+        parts.append(shifted)
+    merged = np.concatenate(parts, axis=1)
+    # dedupe within each row (a repeated id on one service is one constraint,
+    # not a self-conflict): sort descending, blank repeats, then trim all-pad
+    # columns
+    merged = -np.sort(-merged, axis=1)
+    dup = np.zeros_like(merged, dtype=bool)
+    dup[:, 1:] = (merged[:, 1:] == merged[:, :-1]) & (merged[:, 1:] >= 0)
+    merged = np.where(dup, -1, merged)
+    merged = -np.sort(-merged, axis=1)
+    keep = int((merged >= 0).sum(axis=1).max(initial=1))
+    return merged[:, : max(keep, 1)].astype(np.int32)
+
+
+def prepare_problem(pt: ProblemTensors,
+                    device: Optional[Any] = None) -> DeviceProblem:
+    """Stage a ProblemTensors onto the device (or default backend)."""
+    conflict = _unify_conflict_ids(pt)
+    G = int(conflict.max(initial=-1)) + 1
+    T = int(pt.node_topology.max(initial=0)) + 1
+    preferred = (pt.preferred if pt.preferred is not None
+                 else np.zeros((pt.S, pt.N), dtype=np.float32))
+
+    put = partial(jax.device_put, device=device)
+    return DeviceProblem(
+        demand=put(jnp.asarray(pt.demand, dtype=jnp.float32)),
+        capacity=put(jnp.asarray(pt.capacity, dtype=jnp.float32)),
+        conflict_ids=put(jnp.asarray(conflict)),
+        coloc_ids=put(jnp.asarray(pt.coloc_ids, dtype=jnp.int32)),
+        eligible=put(jnp.asarray(pt.eligible)),
+        node_valid=put(jnp.asarray(pt.node_valid)),
+        node_topology=put(jnp.asarray(pt.node_topology, dtype=jnp.int32)),
+        preferred=put(jnp.asarray(preferred, dtype=jnp.float32)),
+        S=pt.S, N=pt.N, G=max(G, 1),
+        Gc=int(pt.coloc_ids.max(initial=-1)) + 1,
+        T=T,
+        strategy=STRATEGY_CODES[pt.strategy],
+        max_skew=int(pt.max_skew),
+    )

@@ -1,0 +1,173 @@
+"""Host-side exact repair + verification.
+
+The deterministic backstop behind the zero-violation contract: the device
+solver (greedy + annealing) lands feasible in practice, but the contract is
+exact, so any residual violations are repaired here with vectorized numpy —
+move each violating service to the best feasible node, smallest first, a
+bounded number of rounds. Also home to `verify()`, the numpy ground-truth
+violation accounting that tests use to cross-check the device kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["verify", "repair", "RepairResult"]
+
+
+def _group_counts(assignment: np.ndarray, ids: np.ndarray, N: int,
+                  G: int) -> np.ndarray:
+    valid = ids >= 0
+    counts = np.zeros((N, G), dtype=np.int64)
+    rows = np.repeat(assignment, ids.shape[1])[valid.ravel()]
+    cols = ids.ravel()[valid.ravel()]
+    np.add.at(counts, (rows, cols), 1)
+    return counts
+
+
+def _unified_ids(pt: ProblemTensors) -> np.ndarray:
+    parts, offset = [], 0
+    for arr in (pt.port_ids, pt.volume_ids, pt.anti_ids):
+        parts.append(np.where(arr >= 0, arr + offset, -1))
+        if arr.size:
+            offset += int(arr.max(initial=-1)) + 1
+    merged = np.concatenate(parts, axis=1)
+    # dedupe within rows (mirrors problem._unify_conflict_ids): a repeated id
+    # on one service is one constraint, not a self-conflict
+    merged = -np.sort(-merged, axis=1)
+    dup = np.zeros_like(merged, dtype=bool)
+    dup[:, 1:] = (merged[:, 1:] == merged[:, :-1]) & (merged[:, 1:] >= 0)
+    return np.where(dup, -1, merged)
+
+
+def verify(pt: ProblemTensors, assignment: np.ndarray) -> dict:
+    """Exact violation accounting on the host (numpy ground truth)."""
+    S, N = pt.S, pt.N
+    assignment = np.asarray(assignment)
+    load = np.zeros((N, pt.demand.shape[1]), dtype=np.float64)
+    np.add.at(load, assignment, pt.demand.astype(np.float64))
+    cap_cells = int((load > pt.capacity * (1 + 1e-6)).sum())
+
+    ids = _unified_ids(pt)
+    G = int(ids.max(initial=-1)) + 1
+    conflict_pairs = 0
+    if G > 0:
+        counts = _group_counts(assignment, ids, N, G)
+        conflict_pairs = int((counts * (counts - 1) // 2).sum())
+
+    elig = int((~pt.eligible[np.arange(S), assignment]).sum()
+               + (~pt.node_valid[assignment]).sum())
+
+    skew = 0
+    if pt.max_skew > 0:
+        per = np.bincount(pt.node_topology[assignment],
+                          minlength=int(pt.node_topology.max()) + 1)
+        skew = max(int(per.max() - per.min()) - pt.max_skew, 0)
+
+    total = cap_cells + conflict_pairs + elig + skew
+    return {"capacity": cap_cells, "conflicts": conflict_pairs,
+            "eligibility": elig, "skew": skew, "total": total}
+
+
+@dataclass
+class RepairResult:
+    assignment: np.ndarray
+    moves: int
+    stats: dict
+    feasible: bool
+
+
+def repair(pt: ProblemTensors, assignment: np.ndarray,
+           max_rounds: int = 5) -> RepairResult:
+    """Deterministically repair residual violations. Returns the repaired
+    assignment (copy) and final stats; `feasible` is False when some
+    violation could not be repaired (genuinely infeasible instances)."""
+    S, N = pt.S, pt.N
+    assignment = np.asarray(assignment).copy()
+    ids = _unified_ids(pt)
+    G = int(ids.max(initial=-1)) + 1
+    demand = pt.demand.astype(np.float64)
+    cap = pt.capacity.astype(np.float64)
+    moves = 0
+
+    for _ in range(max_rounds):
+        load = np.zeros((N, demand.shape[1]), dtype=np.float64)
+        np.add.at(load, assignment, demand)
+        counts = (_group_counts(assignment, ids, N, G) if G > 0
+                  else np.zeros((N, 1), dtype=np.int64))
+
+        # --- collect violating services ---------------------------------
+        bad = np.zeros(S, dtype=bool)
+        # ineligible / invalid node
+        bad |= ~pt.eligible[np.arange(S), assignment]
+        bad |= ~pt.node_valid[assignment]
+        # conflict groups: every service in an over-occupied (node, gid) cell
+        # except the first keeper
+        if G > 0:
+            valid = ids >= 0
+            svc_counts = np.where(
+                valid, counts[assignment[:, None],
+                              np.where(valid, ids, 0)], 0)
+            in_conflict = (svc_counts > 1).any(axis=1)
+            # keep one occupant per conflict cell: mark all, then unmark the
+            # first occurrence per (node, gid)
+            keeper = np.zeros(S, dtype=bool)
+            seen: set = set()
+            for s in range(S):
+                cells = [(int(assignment[s]), int(g)) for g in ids[s] if g >= 0]
+                if any(counts[c] > 1 for c in cells):
+                    if all(c not in seen for c in cells):
+                        keeper[s] = True
+                        seen.update(cells)
+            bad |= in_conflict & ~keeper
+        # overloaded nodes: evict smallest services until the node fits
+        over = (load > cap * (1 + 1e-6)).any(axis=1)
+        for n in np.flatnonzero(over):
+            members = np.flatnonzero((assignment == n) & ~bad)
+            if members.size == 0:
+                continue
+            sizes = demand[members].sum(axis=1)
+            for m in members[np.argsort(sizes)]:
+                if not (load[n] > cap[n] * (1 + 1e-6)).any():
+                    break
+                bad[m] = True
+                load[n] -= demand[m]
+
+        if not bad.any():
+            break
+
+        # --- relocate, smallest first ------------------------------------
+        # recompute load/counts excluding the evicted services
+        load = np.zeros((N, demand.shape[1]), dtype=np.float64)
+        np.add.at(load, assignment[~bad], demand[~bad])
+        counts = (_group_counts(assignment[~bad], ids[~bad], N, G) if G > 0
+                  else np.zeros((N, 1), dtype=np.int64))
+
+        order = np.flatnonzero(bad)[np.argsort(demand[bad].sum(axis=1))]
+        for s in order:
+            fits = (load + demand[s] <= cap * (1 + 1e-6)).all(axis=1)
+            ok = fits & pt.eligible[s] & pt.node_valid
+            if G > 0:
+                my = ids[s][ids[s] >= 0]
+                if my.size:
+                    ok &= (counts[:, my] == 0).all(axis=1)
+            cand = np.flatnonzero(ok)
+            if cand.size == 0:
+                continue  # leave in place; next round may free capacity
+            # balance: least-loaded feasible node
+            util = (load[cand] / np.maximum(cap[cand], 1e-6)).max(axis=1)
+            n = int(cand[np.argmin(util)])
+            assignment[s] = n
+            load[n] += demand[s]
+            if G > 0 and (ids[s] >= 0).any():
+                my = ids[s][ids[s] >= 0]
+                counts[n, my] += 1
+            moves += 1
+
+    stats = verify(pt, assignment)
+    return RepairResult(assignment=assignment, moves=moves, stats=stats,
+                        feasible=stats["total"] == 0)

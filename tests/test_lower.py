@@ -1,0 +1,211 @@
+"""Lowering pass tests: Flow → ProblemTensors."""
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core import SolverError, parse_kdl_string
+from fleetflow_tpu.core.model import PlacementStrategy
+from fleetflow_tpu.lower import (dependency_depths, lower_stage,
+                                 synthetic_problem)
+
+THREE_TIER = '''
+project "p"
+server "n1" { capacity { cpu 4; memory "8g"; disk "100g" } labels { region "east" } }
+server "n2" { capacity { cpu 4; memory "8g"; disk "100g" } labels { region "west" } }
+service "postgres" {
+    ports { port host=5432 container=5432 }
+    volumes { volume "./pg" "/data" }
+    resources { cpu 1; memory "2g"; disk "10g" }
+}
+service "redis" { resources { cpu 0.5; memory "1g" } }
+service "app" {
+    depends_on "postgres" "redis"
+    ports { port host=8080 container=80 }
+    resources { cpu 1; memory "1g" }
+}
+stage "live" { service "postgres"; service "redis"; service "app" }
+'''
+
+
+class TestDependencyDepths:
+    def test_chain(self):
+        adj = np.zeros((3, 3), dtype=bool)
+        adj[1, 0] = True  # 1 depends on 0
+        adj[2, 1] = True
+        assert dependency_depths(adj).tolist() == [0, 1, 2]
+
+    def test_diamond(self):
+        # 3 depends on 1,2; both depend on 0
+        adj = np.zeros((4, 4), dtype=bool)
+        adj[1, 0] = adj[2, 0] = adj[3, 1] = adj[3, 2] = True
+        assert dependency_depths(adj).tolist() == [0, 1, 1, 2]
+
+    def test_no_deps(self):
+        assert dependency_depths(np.zeros((5, 5), dtype=bool)).tolist() == [0] * 5
+
+    def test_cycle_rejected(self):
+        adj = np.zeros((2, 2), dtype=bool)
+        adj[0, 1] = adj[1, 0] = True
+        with pytest.raises(SolverError, match="cycle"):
+            dependency_depths(adj)
+
+    def test_self_cycle_rejected(self):
+        adj = np.zeros((2, 2), dtype=bool)
+        adj[0, 0] = True
+        with pytest.raises(SolverError, match="cycle"):
+            dependency_depths(adj, ["a", "b"])
+
+
+class TestLowerStage:
+    def test_shapes_and_depths(self):
+        flow = parse_kdl_string(THREE_TIER)
+        pt = lower_stage(flow, "live")
+        assert pt.S == 3 and pt.N == 2
+        assert pt.service_names == ["postgres", "redis", "app"]
+        assert pt.dep_depth.tolist() == [0, 0, 1]
+        assert pt.dep_adj[2, 0] and pt.dep_adj[2, 1]
+        assert pt.demand[0].tolist() == [1.0, 2048.0, 10240.0]
+        assert pt.capacity.shape == (2, 3)
+
+    def test_port_and_volume_ids(self):
+        flow = parse_kdl_string(THREE_TIER)
+        pt = lower_stage(flow, "live")
+        # postgres and app publish different ports → different ids
+        assert pt.port_ids[0, 0] != -1
+        assert pt.port_ids[2, 0] != -1
+        assert pt.port_ids[0, 0] != pt.port_ids[2, 0]
+        assert pt.port_ids[1, 0] == -1  # redis has none
+        assert pt.volume_ids[0, 0] != -1
+        assert pt.volume_ids[1, 0] == -1
+
+    def test_same_host_port_shares_id(self):
+        flow = parse_kdl_string('''
+service "a" { ports { port host=80 container=80 } }
+service "b" { ports { port host=80 container=8080 } }
+stage "s" { service "a"; service "b" }
+''')
+        pt = lower_stage(flow, "s")
+        assert pt.port_ids[0, 0] == pt.port_ids[1, 0]
+
+    def test_read_only_volume_no_conflict(self):
+        flow = parse_kdl_string('''
+service "a" { volumes { volume "/etc/shared" "/cfg" read-only=true } }
+stage "s" { service "a" }
+''')
+        pt = lower_stage(flow, "s")
+        assert (pt.volume_ids == -1).all()
+
+    def test_local_node_fallback(self):
+        flow = parse_kdl_string('service "a" { }\nstage "s" { service "a" }')
+        pt = lower_stage(flow, "s")
+        assert pt.node_names == ["local"]
+        assert pt.capacity[0, 0] >= 1e5  # effectively unbounded
+
+    def test_stage_servers_subset(self):
+        flow = parse_kdl_string(THREE_TIER + '\nstage "east" { server "n1"; service "redis" }')
+        pt = lower_stage(flow, "east")
+        assert pt.node_names == ["n1"]
+
+    def test_unknown_server_raises(self):
+        flow = parse_kdl_string('service "a" { }\nstage "s" { server "ghost"; service "a" }')
+        with pytest.raises(SolverError, match="ghost"):
+            lower_stage(flow, "s")
+
+    def test_replica_expansion(self):
+        flow = parse_kdl_string('''
+server "n1" { }
+server "n2" { }
+server "n3" { }
+service "w" { replicas 3; ports { port host=9000 container=9000 } }
+stage "s" { service "w" }
+''')
+        pt = lower_stage(flow, "s")
+        assert pt.S == 3
+        assert pt.service_names == ["w#0", "w#1", "w#2"]
+        assert pt.replica_of == ["w", "w", "w"]
+        # all replicas share the host port id → mutually anti-affine
+        assert len({pt.port_ids[i, 0] for i in range(3)}) == 1
+
+    def test_replica_deps_expand(self):
+        flow = parse_kdl_string('''
+service "db" { }
+service "w" { replicas 2; depends_on "db" }
+stage "s" { service "db"; service "w" }
+''')
+        pt = lower_stage(flow, "s")
+        assert pt.dep_depth.tolist() == [0, 1, 1]
+
+    def test_required_labels_eligibility(self):
+        flow = parse_kdl_string(THREE_TIER + '''
+stage "east-only" {
+    service "redis"
+    placement { required_labels { region "east" } }
+}
+''')
+        pt = lower_stage(flow, "east-only")
+        assert pt.eligible[0].tolist() == [True, False]
+
+    def test_infeasible_policy_raises(self):
+        flow = parse_kdl_string(THREE_TIER + '''
+stage "nowhere" {
+    service "redis"
+    placement { required_labels { region "mars" } }
+}
+''')
+        with pytest.raises(SolverError, match="no eligible node"):
+            lower_stage(flow, "nowhere")
+
+    def test_preferred_labels_soft(self):
+        flow = parse_kdl_string(THREE_TIER + '''
+stage "pref" {
+    service "redis"
+    placement { preferred_labels { region "west" } }
+}
+''')
+        pt = lower_stage(flow, "pref")
+        assert pt.preferred is not None
+        assert pt.preferred[0].tolist() == [0.0, 1.0]
+
+    def test_spread_topology(self):
+        flow = parse_kdl_string(THREE_TIER + '''
+stage "sp" {
+    service "redis"
+    placement { spread topology_key="region" max_skew=1 }
+}
+''')
+        pt = lower_stage(flow, "sp")
+        assert pt.max_skew == 1
+        assert pt.node_topology[0] != pt.node_topology[1]
+
+    def test_unknown_dep_raises(self):
+        flow = parse_kdl_string('service "a" { depends_on "nope" }\nstage "s" { service "a" }')
+        with pytest.raises(SolverError, match="nope"):
+            lower_stage(flow, "s")
+
+    def test_empty_stage_raises(self):
+        flow = parse_kdl_string('stage "s" { }')
+        with pytest.raises(SolverError, match="no services"):
+            lower_stage(flow, "s")
+
+
+class TestSyntheticProblem:
+    def test_shapes(self):
+        pt = synthetic_problem(100, 10, seed=1)
+        assert pt.S == 100 and pt.N == 10
+        assert pt.dep_depth.max() <= 4  # chains of length ≤ 5 → depth ≤ 4
+        pt.validate()
+
+    def test_determinism(self):
+        a = synthetic_problem(50, 5, seed=7)
+        b = synthetic_problem(50, 5, seed=7)
+        assert np.array_equal(a.demand, b.demand)
+        assert np.array_equal(a.port_ids, b.port_ids)
+
+    def test_multi_tenant_eligibility(self):
+        pt = synthetic_problem(200, 20, seed=3, n_tenants=4)
+        assert not pt.eligible.all()          # some blocked
+        assert pt.eligible.any(axis=1).all()  # everyone has a home
+
+    def test_aggregate_feasibility_headroom(self):
+        pt = synthetic_problem(100, 10, seed=0)
+        assert (pt.capacity.sum(axis=0) >= pt.demand.sum(axis=0)).all()

@@ -1,0 +1,193 @@
+"""Solver tests: kernels vs numpy ground truth, greedy, anneal, solve
+pipeline on the BASELINE eval configs (CPU tier — the analog of the
+reference's no-Docker fast tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core import parse_kdl_string
+from fleetflow_tpu.core.model import PlacementStrategy
+from fleetflow_tpu.lower import lower_stage, synthetic_problem
+from fleetflow_tpu.solver import (greedy_place, placement_order,
+                                  prepare_problem, repair, solve,
+                                  verify, violation_stats)
+
+
+def random_assignment(pt, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, pt.N, pt.S).astype(np.int32)
+
+
+class TestKernelsMatchNumpy:
+    """Device violation_stats must agree exactly with host verify()."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_assignments(self, seed):
+        pt = synthetic_problem(60, 6, seed=seed)
+        prob = prepare_problem(pt)
+        a = random_assignment(pt, seed)
+        dev = {k: float(v) for k, v in
+               violation_stats(prob, jnp.asarray(a)).items()}
+        host = verify(pt, a)
+        for k in ("capacity", "conflicts", "eligibility", "skew", "total"):
+            assert dev[k] == pytest.approx(host[k]), (k, dev, host)
+
+    def test_multi_tenant_eligibility_counted(self):
+        pt = synthetic_problem(80, 8, seed=3, n_tenants=3)
+        prob = prepare_problem(pt)
+        a = random_assignment(pt, 3)
+        dev = violation_stats(prob, jnp.asarray(a))
+        host = verify(pt, a)
+        assert float(dev["eligibility"]) == host["eligibility"] > 0
+
+    def test_zero_on_feasible_toy(self):
+        # 2 services, 2 nodes, same host port → must split; assignment [0,1]
+        flow = parse_kdl_string('''
+server "n1" { capacity { cpu 1; memory "1g" } }
+server "n2" { capacity { cpu 1; memory "1g" } }
+service "a" { ports { port host=80 container=80 } resources { cpu 0.5; memory 256 } }
+service "b" { ports { port host=80 container=80 } resources { cpu 0.5; memory 256 } }
+stage "s" { service "a"; service "b" }
+''')
+        pt = lower_stage(flow, "s")
+        prob = prepare_problem(pt)
+        good = jnp.array([0, 1], dtype=jnp.int32)
+        bad = jnp.array([0, 0], dtype=jnp.int32)
+        assert float(violation_stats(prob, good)["total"]) == 0
+        assert float(violation_stats(prob, bad)["conflicts"]) == 1
+
+
+class TestGreedy:
+    def test_three_tier_local(self):
+        # BASELINE config 1: postgres→redis→app on the implicit local node
+        flow = parse_kdl_string('''
+service "postgres" { ports { port host=5432 container=5432 } }
+service "redis" { }
+service "app" { depends_on "postgres" "redis" }
+stage "local" { service "postgres"; service "redis"; service "app" }
+''')
+        pt = lower_stage(flow, "local")
+        prob = prepare_problem(pt)
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+        a = greedy_place(prob, order)
+        assert verify(pt, np.asarray(a))["total"] == 0
+        assert set(np.asarray(a).tolist()) == {0}
+
+    def test_synthetic_100x10_feasible(self):
+        # BASELINE config 2
+        pt = synthetic_problem(100, 10, seed=0)
+        prob = prepare_problem(pt)
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+        a = np.asarray(greedy_place(prob, order))
+        stats = verify(pt, a)
+        assert stats["total"] == 0, stats
+
+    def test_port_anti_affinity_respected(self):
+        pt = synthetic_problem(120, 12, seed=1, port_fraction=0.5)
+        prob = prepare_problem(pt)
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+        a = np.asarray(greedy_place(prob, order))
+        assert verify(pt, a)["conflicts"] == 0
+
+    def test_eligibility_respected(self):
+        pt = synthetic_problem(90, 9, seed=2, n_tenants=3)
+        prob = prepare_problem(pt)
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+        a = np.asarray(greedy_place(prob, order))
+        assert verify(pt, a)["eligibility"] == 0
+
+    def test_pack_strategy_uses_fewer_nodes(self):
+        pt_s = synthetic_problem(60, 10, seed=4,
+                                 strategy=PlacementStrategy.SPREAD_ACROSS_POOL)
+        pt_p = synthetic_problem(60, 10, seed=4,
+                                 strategy=PlacementStrategy.PACK_INTO_DEDICATED)
+        o = jnp.asarray(placement_order(pt_s.demand, pt_s.dep_depth))
+        a_s = np.asarray(greedy_place(prepare_problem(pt_s), o))
+        a_p = np.asarray(greedy_place(prepare_problem(pt_p), o))
+        assert len(set(a_p.tolist())) <= len(set(a_s.tolist()))
+
+
+class TestRepair:
+    def test_repairs_random_assignment(self):
+        pt = synthetic_problem(80, 10, seed=5)
+        bad = random_assignment(pt, 5)
+        assert verify(pt, bad)["total"] > 0
+        rr = repair(pt, bad)
+        assert rr.feasible, rr.stats
+        assert rr.moves > 0
+
+    def test_repair_noop_on_feasible(self):
+        pt = synthetic_problem(50, 8, seed=6)
+        prob = prepare_problem(pt)
+        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+        a = np.asarray(greedy_place(prob, order))
+        rr = repair(pt, a)
+        assert rr.moves == 0
+        assert np.array_equal(rr.assignment, a)
+
+
+class TestSolve:
+    def test_config2_zero_violations(self):
+        pt = synthetic_problem(100, 10, seed=0)
+        res = solve(pt, chains=4, steps=300, seed=0)
+        assert res.feasible, res.stats
+        assert res.assignment.shape == (100,)
+
+    def test_config3_anti_affinity(self):
+        # BASELINE config 3 shape (scaled down for CPU): port/volume
+        # anti-affinity constraints
+        pt = synthetic_problem(200, 20, seed=1, port_fraction=0.4,
+                               volume_fraction=0.2)
+        res = solve(pt, chains=4, steps=300, seed=1)
+        assert res.feasible, res.stats
+
+    def test_multi_tenant(self):
+        # BASELINE config 4 shape (scaled): tenancy eligibility blocks
+        pt = synthetic_problem(150, 15, seed=2, n_tenants=4)
+        res = solve(pt, chains=4, steps=300, seed=2)
+        assert res.feasible, res.stats
+
+    def test_warm_start_reschedule(self):
+        # BASELINE config 5 shape: node churn → warm re-solve
+        pt = synthetic_problem(100, 10, seed=3)
+        res = solve(pt, chains=4, steps=300, seed=3)
+        assert res.feasible
+        # kill a node; services there must move, others should mostly stay
+        dead = int(np.bincount(res.assignment, minlength=pt.N).argmax())
+        pt.node_valid[dead] = False
+        pt.eligible[:, dead] = False
+        res2 = solve(pt, chains=4, steps=300, seed=4,
+                     init_assignment=res.assignment)
+        assert res2.feasible, res2.stats
+        assert not (res2.assignment == dead).any()
+        moved = (res2.assignment != res.assignment).mean()
+        assert moved < 0.6  # warm start keeps most placements
+
+    def test_spread_beats_random_balance(self):
+        pt = synthetic_problem(120, 12, seed=7)
+        res = solve(pt, chains=4, steps=500, seed=7)
+        loads = np.zeros((pt.N, 3))
+        np.add.at(loads, res.assignment, pt.demand)
+        util = loads[:, 0] / pt.capacity[:, 0]
+        assert res.feasible
+        assert util.std() < 0.25  # spread strategy balances cpu
+
+    def test_solve_is_deterministic_given_seed(self):
+        pt = synthetic_problem(60, 6, seed=8)
+        r1 = solve(pt, chains=2, steps=200, seed=9)
+        r2 = solve(pt, chains=2, steps=200, seed=9)
+        assert np.array_equal(r1.assignment, r2.assignment)
+
+
+class TestMeshSharding:
+    def test_chains_sharded_over_mesh(self):
+        # 8 virtual CPU devices from conftest XLA_FLAGS
+        devices = jax.devices()
+        assert len(devices) == 8, "conftest should provide 8 CPU devices"
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(devices), ("chains",))
+        pt = synthetic_problem(80, 8, seed=10)
+        res = solve(pt, chains=8, steps=200, seed=10, mesh=mesh)
+        assert res.feasible, res.stats
