@@ -309,11 +309,6 @@ def synthetic_problem(S: int, N: int, seed: int = 0,
         rng.uniform(0, 1024, S),             # disk MiB
     ], axis=1).astype(np.float32)
 
-    # Capacity sized for ~70% aggregate utilization at feasibility
-    per_node = demand.sum(axis=0) / N / 0.7
-    jitter = rng.uniform(0.8, 1.2, (N, _R)).astype(np.float32)
-    capacity = (per_node[None, :] * jitter).astype(np.float32)
-
     # dependency chains: partition services into chains of length ≤ depth max
     dep_adj = np.zeros((S, S), dtype=bool)
     order = rng.permutation(S)
@@ -328,12 +323,19 @@ def synthetic_problem(S: int, N: int, seed: int = 0,
 
     # port conflicts: port_fraction of services publish 1-2 host ports drawn
     # from a pool sized so each port is shared by a handful of services
+    # Each port id is capped at N-1 members: a group of k services needs k
+    # distinct nodes, and the cap keeps instances solvable even after a
+    # single-node churn event (BASELINE config 5 kills one node).
     n_ports = max(int(S * port_fraction / 4), 1)
+    members = np.zeros(n_ports, dtype=np.int64)
     port_groups: list[list[int]] = []
     for s in range(S):
         if rng.random() < port_fraction:
             k = int(rng.integers(1, 3))
-            port_groups.append(rng.integers(0, n_ports, k).tolist())
+            open_ids = np.flatnonzero(members < N - 1)
+            pick = open_ids[rng.permutation(open_ids.size)[:k]].tolist()
+            members[pick] += 1
+            port_groups.append(pick)
         else:
             port_groups.append([])
     n_vols = max(int(S * volume_fraction / 3), 1)
@@ -351,6 +353,33 @@ def synthetic_problem(S: int, N: int, seed: int = 0,
         # guarantee every service has at least one eligible node
         for s in np.flatnonzero(~eligible.any(axis=1)):
             eligible[s, int(rng.integers(0, N))] = True
+
+    # Capacity sized from a feasibility witness: place every service on an
+    # eligible node with no port/volume conflict (round-robin least-loaded),
+    # then set capacity = witness load / 0.7. This makes the instance feasible
+    # BY CONSTRUCTION even when tenant eligibility slices the pool unevenly —
+    # a tenant with many services and few eligible nodes gets bigger nodes,
+    # the way a real operator would size a dedicated pool.
+    w_load = np.zeros((N, _R), dtype=np.float64)
+    occupied: dict[tuple[int, str, int], bool] = {}
+    for s in np.argsort(-demand.sum(axis=1)):  # biggest first
+        cands = np.flatnonzero(eligible[s])
+        free = [n for n in cands
+                if not any((int(n), "p", g) in occupied for g in port_groups[s])
+                and not any((int(n), "v", g) in occupied for g in vol_groups[s])]
+        if not free:  # drop this service's conflicts rather than go infeasible
+            port_groups[s], vol_groups[s] = [], []
+            free = list(cands)
+        util = w_load[free].sum(axis=1)
+        n = int(free[int(np.argmin(util))])
+        w_load[n] += demand[s]
+        for g in port_groups[s]:
+            occupied[(n, "p", g)] = True
+        for g in vol_groups[s]:
+            occupied[(n, "v", g)] = True
+    floor = demand.max(axis=0)  # every node can host any single service
+    capacity = np.maximum(w_load / 0.7, floor[None, :]).astype(np.float32)
+    capacity *= rng.uniform(1.0, 1.15, (N, _R)).astype(np.float32)
 
     pt = ProblemTensors(
         service_names=[f"svc{s}" for s in range(S)],

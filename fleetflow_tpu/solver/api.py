@@ -14,6 +14,7 @@ the north star); with mesh=None everything runs on one device.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -73,12 +74,17 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = 3000,
           mesh: Optional[Mesh] = None,
           prob: Optional[DeviceProblem] = None,
           init_assignment: Optional[np.ndarray] = None,
-          t0: float = 1.0, t1: float = 1e-3) -> SolveResult:
+          t0: float = 1.0, t1: float = 1e-3,
+          migration_weight: float = 0.5) -> SolveResult:
     """Solve a placement instance end to end.
 
     `init_assignment` warm-starts from a previous solve (streaming reschedule
     path: BASELINE config 5 — keep the old placement, anneal the delta).
-    `prob` reuses an already-staged DeviceProblem across re-solves.
+    `migration_weight` makes warm starts sticky: each service pays that much
+    soft score for leaving its previous node, so a reschedule moves only what
+    churn forces (the analog of not restarting healthy containers on an
+    unrelated node failure). `prob` reuses an already-staged DeviceProblem
+    across re-solves.
     """
     timings: dict[str, float] = {}
     t = time.perf_counter
@@ -86,11 +92,25 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = 3000,
     t_start = t()
     if prob is None:
         prob = prepare_problem(pt)
+    orig_prob = prob  # soft score is reported against the un-bonused problem
     timings["stage_ms"] = (t() - t_start) * 1e3
 
     t_seed = t()
     if init_assignment is not None:
         seed_assignment = jnp.asarray(init_assignment, dtype=jnp.int32)
+        if migration_weight > 0:
+            # Stickiness as a preferred-node bonus on the previous placement.
+            # d_pref in the anneal kernel is (pref[s,a]-pref[s,b])/S, so the
+            # bonus is scaled by S to make one move cost `migration_weight`
+            # soft units. Device-side delta: nothing crosses the host link.
+            bonus = jnp.zeros_like(prob.preferred).at[
+                jnp.arange(prob.S), seed_assignment].add(
+                    migration_weight * prob.S)
+            # dead/ineligible nodes get no bonus: churn-forced moves are free
+            bonus = jnp.where(prob.eligible & prob.node_valid[None, :],
+                              bonus, 0.0)
+            prob = dataclasses.replace(prob, preferred=prob.preferred + bonus)
+        t0 = min(t0, 0.1)  # warm start: refine, don't re-scramble
     else:
         order = jnp.asarray(placement_order(pt.demand, pt.dep_depth,
                                             np.asarray(prob.conflict_ids)))
@@ -121,7 +141,7 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = 3000,
     timings["verify_repair_ms"] = (t() - t_verify) * 1e3
     timings["total_ms"] = (t() - t_start) * 1e3
 
-    soft = float(jax.device_get(soft_score(prob, jnp.asarray(assignment))))
+    soft = float(jax.device_get(soft_score(orig_prob, jnp.asarray(assignment))))
     return SolveResult(
         assignment=assignment, stats=stats, soft=soft,
         feasible=stats["total"] == 0, moves_repaired=moves,

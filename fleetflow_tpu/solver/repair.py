@@ -10,6 +10,7 @@ violation accounting that tests use to cross-check the device kernels.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 
 import numpy as np
@@ -82,10 +83,15 @@ class RepairResult:
 
 
 def repair(pt: ProblemTensors, assignment: np.ndarray,
-           max_rounds: int = 5) -> RepairResult:
-    """Deterministically repair residual violations. Returns the repaired
-    assignment (copy) and final stats; `feasible` is False when some
-    violation could not be repaired (genuinely infeasible instances)."""
+           max_rounds: int = 8, seed: int = 0) -> RepairResult:
+    """Repair residual violations (deterministic given `seed`). Returns the
+    repaired assignment (copy) and final stats; `feasible` is False when some
+    violation could not be repaired (genuinely infeasible instances).
+
+    Mechanics: worklist relocation with one-level ejection chains, plus
+    min-conflicts-style randomized escape — a service that keeps bouncing
+    between the same contested nodes is sent to a random eligible node so
+    deterministic ejection cycles (A evicts B evicts A…) break."""
     S, N = pt.S, pt.N
     assignment = np.asarray(assignment).copy()
     ids = _unified_ids(pt)
@@ -93,6 +99,8 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
     demand = pt.demand.astype(np.float64)
     cap = pt.capacity.astype(np.float64)
     moves = 0
+    rng = np.random.default_rng(seed)
+    bounce = np.zeros(S, dtype=np.int64)
 
     for _ in range(max_rounds):
         load = np.zeros((N, demand.shape[1]), dtype=np.float64)
@@ -147,25 +155,94 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
         counts = (_group_counts(assignment[~bad], ids[~bad], N, G) if G > 0
                   else np.zeros((N, 1), dtype=np.int64))
 
-        order = np.flatnonzero(bad)[np.argsort(demand[bad].sum(axis=1))]
-        for s in order:
+        # Worklist relocation with one-level ejection chains: when a service
+        # has no directly-feasible node, it may evict the services blocking
+        # the least-contended node; evictees rejoin the queue. `detached`
+        # marks queued services — their demand/conflicts are already out of
+        # load/counts and they must not be seen (or evicted) as residents.
+        # Bounded by a global move budget so pathological instances terminate.
+        id_sets = [set(ids[s][ids[s] >= 0].tolist()) if G > 0 else set()
+                   for s in range(S)]
+        size = demand.sum(axis=1)
+        node_members: list[set] = [set() for _ in range(N)]
+        for s in np.flatnonzero(~bad):
+            node_members[assignment[s]].add(int(s))
+        detached = bad.copy()
+
+        def plan_eviction(n: int, s: int) -> list | None:
+            """Residents of n to evict so s fits (conflicts + capacity);
+            None when even a full conflict eviction can't make room."""
+            evict = [r for r in node_members[n]
+                     if id_sets[s] & id_sets[r]] if id_sets[s] else []
+            new_load = load[n] + demand[s] - demand[evict].sum(axis=0)
+            rest = sorted((r for r in node_members[n] if r not in evict),
+                          key=size.__getitem__)
+            while (new_load > cap[n] * (1 + 1e-6)).any() and rest:
+                r = rest.pop(0)
+                evict.append(r)
+                new_load -= demand[r]
+            if (new_load > cap[n] * (1 + 1e-6)).any():
+                return None
+            return evict
+
+        def detach(r: int, n: int) -> None:
+            load[n] -= demand[r]
+            if id_sets[r]:
+                counts[n, list(id_sets[r])] -= 1
+            node_members[n].discard(r)
+            detached[r] = True
+            queue.append(r)
+
+        queue = deque(np.flatnonzero(bad)[np.argsort(size[bad])].tolist())
+        budget = 4 * S
+        while queue and budget > 0:
+            s = int(queue.popleft())
+            budget -= 1
+            bounce[s] += 1
+            my = list(id_sets[s])
             fits = (load + demand[s] <= cap * (1 + 1e-6)).all(axis=1)
             ok = fits & pt.eligible[s] & pt.node_valid
-            if G > 0:
-                my = ids[s][ids[s] >= 0]
-                if my.size:
-                    ok &= (counts[:, my] == 0).all(axis=1)
+            if my:
+                ok &= (counts[:, my] == 0).all(axis=1)
             cand = np.flatnonzero(ok)
-            if cand.size == 0:
-                continue  # leave in place; next round may free capacity
-            # balance: least-loaded feasible node
-            util = (load[cand] / np.maximum(cap[cand], 1e-6)).max(axis=1)
-            n = int(cand[np.argmin(util)])
+            if cand.size:
+                # balance: least-loaded feasible node (random when escaping
+                # a bounce cycle)
+                if bounce[s] > 3:
+                    n = int(rng.choice(cand))
+                else:
+                    util = (load[cand] / np.maximum(cap[cand], 1e-6)).max(axis=1)
+                    n = int(cand[np.argmin(util)])
+            else:
+                elig = np.flatnonzero(pt.eligible[s] & pt.node_valid)
+                if elig.size == 0:
+                    continue  # truly no node: infeasible service
+                if bounce[s] > 3:
+                    # randomized escape: random eligible node, evict blockers
+                    n = int(rng.choice(elig))
+                    evict = plan_eviction(n, s) or [
+                        r for r in node_members[n] if id_sets[s] & id_sets[r]]
+                else:
+                    # ejection: the eligible node whose blockers are cheapest
+                    best = None
+                    for n in elig:
+                        ev = plan_eviction(int(n), s)
+                        if ev is None:
+                            continue
+                        cost = size[ev].sum() if ev else 0.0
+                        if best is None or cost < best[1]:
+                            best = (int(n), cost, ev)
+                    if best is None:
+                        continue
+                    n, _, evict = best
+                for r in evict:
+                    detach(r, n)
             assignment[s] = n
             load[n] += demand[s]
-            if G > 0 and (ids[s] >= 0).any():
-                my = ids[s][ids[s] >= 0]
+            if my:
                 counts[n, my] += 1
+            node_members[n].add(s)
+            detached[s] = False
             moves += 1
 
     stats = verify(pt, assignment)

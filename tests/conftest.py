@@ -8,12 +8,19 @@ exercised on any machine. Real-TPU runs are the gated Tier 2 (bench.py).
 
 import os
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Under the axon tunnel, sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already consumed — env mutation alone is too late. Force
+# the CPU platform through jax.config (effective until the backend
+# initializes) and set XLA_FLAGS, which the CPU client reads lazily.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
