@@ -1,0 +1,68 @@
+"""North-star benchmark: 10k services x 1k nodes placed on one device.
+
+Prints ONE JSON line:
+  {"metric": "placements_per_sec_10kx1k", "value": N, "unit": "services/s",
+   "vs_baseline": N, ...}
+
+The baseline is the reference's own placement+execution path: a strictly
+sequential per-service Docker round-trip loop (fleetflow-container
+engine.rs:157-167; BASELINE.md "wall-time ~= S x docker-call latency"), at a
+conservative 20 ms per Docker API call -> 50 placements/s regardless of
+fleet size. vs_baseline = our placements/s / 50.
+
+The timed quantity is a full warm re-solve: greedy seed + annealing chains +
+exact device verification + host repair backstop, with the problem tensors
+already staged (the steady-state reschedule path). Compile time is excluded
+by a warm-up solve on identical shapes.
+
+BENCH_SMALL=1 drops to 1k x 100 for CPU smoke runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    S, N = (1000, 100) if os.environ.get("BENCH_SMALL") else (10000, 1000)
+    chains = int(os.environ.get("BENCH_CHAINS", "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "2000"))
+
+    from fleetflow_tpu.lower import synthetic_problem
+    from fleetflow_tpu.solver import prepare_problem, solve
+
+    pt = synthetic_problem(S, N, seed=0, n_tenants=8,
+                           port_fraction=0.2, volume_fraction=0.1)
+    prob = prepare_problem(pt)
+
+    # warm-up: compile every kernel on the final shapes
+    solve(pt, prob=prob, chains=chains, steps=steps, seed=0)
+
+    t0 = time.perf_counter()
+    res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1)
+    elapsed = time.perf_counter() - t0
+
+    pps = S / elapsed
+    baseline_pps = 50.0  # sequential docker loop at 20 ms/call
+    import jax
+    print(json.dumps({
+        "metric": f"placements_per_sec_{S//1000}kx{N}",
+        "value": round(pps, 1),
+        "unit": "services/s",
+        "vs_baseline": round(pps / baseline_pps, 1),
+        "solve_ms": round(elapsed * 1e3, 1),
+        "violations": res.violations,
+        "feasible": res.feasible,
+        "chains": chains,
+        "steps": steps,
+        "backend": jax.default_backend(),
+        "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -361,7 +361,7 @@ def synthetic_problem(S: int, N: int, seed: int = 0,
     # a tenant with many services and few eligible nodes gets bigger nodes,
     # the way a real operator would size a dedicated pool.
     w_load = np.zeros((N, _R), dtype=np.float64)
-    occupied: dict[tuple[int, str, int], bool] = {}
+    occupied: set[tuple[int, str, int]] = set()
     for s in np.argsort(-demand.sum(axis=1)):  # biggest first
         cands = np.flatnonzero(eligible[s])
         free = [n for n in cands
@@ -373,10 +373,8 @@ def synthetic_problem(S: int, N: int, seed: int = 0,
         util = w_load[free].sum(axis=1)
         n = int(free[int(np.argmin(util))])
         w_load[n] += demand[s]
-        for g in port_groups[s]:
-            occupied[(n, "p", g)] = True
-        for g in vol_groups[s]:
-            occupied[(n, "v", g)] = True
+        occupied.update((n, "p", g) for g in port_groups[s])
+        occupied.update((n, "v", g) for g in vol_groups[s])
     floor = demand.max(axis=0)  # every node can host any single service
     capacity = np.maximum(w_load / 0.7, floor[None, :]).astype(np.float32)
     capacity *= rng.uniform(1.0, 1.15, (N, _R)).astype(np.float32)

@@ -93,7 +93,8 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
     between the same contested nodes is sent to a random eligible node so
     deterministic ejection cycles (A evicts B evicts A…) break."""
     S, N = pt.S, pt.N
-    assignment = np.asarray(assignment).copy()
+    original = np.asarray(assignment)
+    assignment = original.copy()
     ids = _unified_ids(pt)
     G = int(ids.max(initial=-1)) + 1
     demand = pt.demand.astype(np.float64)
@@ -207,12 +208,14 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             cand = np.flatnonzero(ok)
             if cand.size:
                 # balance: least-loaded feasible node (random when escaping
-                # a bounce cycle)
+                # a bounce cycle); a direct placement ends the cycle, so the
+                # counter resets
                 if bounce[s] > 3:
                     n = int(rng.choice(cand))
                 else:
                     util = (load[cand] / np.maximum(cap[cand], 1e-6)).max(axis=1)
                     n = int(cand[np.argmin(util)])
+                bounce[s] = 0
             else:
                 elig = np.flatnonzero(pt.eligible[s] & pt.node_valid)
                 if elig.size == 0:
@@ -246,5 +249,10 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             moves += 1
 
     stats = verify(pt, assignment)
+    # Ejection leaves un-replaced evictees at stale nodes when the budget
+    # exhausts; never return something worse than the input.
+    in_stats = verify(pt, original)
+    if in_stats["total"] < stats["total"]:
+        assignment, stats, moves = original.copy(), in_stats, 0
     return RepairResult(assignment=assignment, moves=moves, stats=stats,
                         feasible=stats["total"] == 0)
