@@ -28,7 +28,8 @@ import numpy as np
 
 
 def main() -> None:
-    S, N = (1000, 100) if os.environ.get("BENCH_SMALL") else (10000, 1000)
+    small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
+    S, N = (1000, 100) if small else (10000, 1000)
     chains = int(os.environ.get("BENCH_CHAINS", "4"))
     steps = int(os.environ.get("BENCH_STEPS", "2000"))
 
@@ -50,7 +51,7 @@ def main() -> None:
     baseline_pps = 50.0  # sequential docker loop at 20 ms/call
     import jax
     print(json.dumps({
-        "metric": f"placements_per_sec_{S//1000}kx{N}",
+        "metric": f"placements_per_sec_{S//1000}kx{N//1000 or N}{'k' if N >= 1000 else ''}",
         "value": round(pps, 1),
         "unit": "services/s",
         "vs_baseline": round(pps / baseline_pps, 1),

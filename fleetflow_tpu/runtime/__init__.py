@@ -1,0 +1,23 @@
+"""Runtime layer (L1): execution engines.
+
+Analog of fleetflow-container (SURVEY.md §2.2): the deploy engine consumes a
+Placement from the scheduler layer and turns it into ordered container
+operations against a ContainerBackend (docker CLI shellout, or the in-memory
+mock used by tests — the "no Docker in Tier-1 CI" pattern of the reference,
+ci.yml:15-70). Quadlet and Compose generators are pure functions, testable
+without any runtime, exactly like the reference's (quadlet.rs, compose.rs).
+"""
+
+from .converter import ContainerConfig, container_name, network_name, \
+    service_to_container_config, stage_services
+from .backend import ContainerBackend, ContainerInfo, MockBackend, DockerCliBackend
+from .waiter import wait_for_service, check_container_health
+from .engine import DeployEngine, DeployRequest, DeployEvent, DeployResult
+
+__all__ = [
+    "ContainerConfig", "container_name", "network_name",
+    "service_to_container_config", "stage_services",
+    "ContainerBackend", "ContainerInfo", "MockBackend", "DockerCliBackend",
+    "wait_for_service", "check_container_health",
+    "DeployEngine", "DeployRequest", "DeployEvent", "DeployResult",
+]

@@ -1,0 +1,275 @@
+"""DeployEngine: the 5-step deploy pipeline.
+
+Analog of fleetflow-container engine.rs:100-194, re-architected around the
+scheduler layer: instead of the reference's sequential per-service loop over
+a 2-bucket partition (engine.rs:67-85,157-167), the engine takes a Placement
+(assignment + exact dependency level schedule) and executes wave by wave —
+every service in a level is independent, so a node executor can run a whole
+wave concurrently and the cross-node picture matches the solver's plan.
+
+Steps (engine.rs:100-194):
+  1. stop/remove existing stage containers (target-filtered)
+  2. pull images (unless no_pull)
+  3. ensure the stage network
+  4. create + start in dependency level order, waiting on each level
+  5. prune old images (unless no_prune; policy: >168h, engine.rs:458-489)
+
+`DeployRequest` is the serializable cross-machine contract (engine.rs:17-25)
+that rides the control-plane wire to node agents.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..core.errors import FlowError
+from ..core.model import Flow
+from ..core.serialize import flow_from_dict, flow_to_dict
+from ..lower.tensors import LOCAL_NODE_NAME, lower_stage
+from ..sched import HostGreedyScheduler, Placement, Scheduler
+from .backend import BackendError, ContainerBackend
+from .converter import (container_name, network_name,
+                        service_to_container_config, stage_services)
+from .waiter import wait_for_service
+
+__all__ = ["DeployEngine", "DeployRequest", "DeployEvent", "DeployResult"]
+
+
+@dataclass
+class DeployRequest:
+    """Serializable deploy order (engine.rs:17-25). `node` scopes execution
+    to one node's slice of the placement (agents set it to their slug)."""
+    flow: Flow
+    stage_name: str
+    target_services: list[str] = field(default_factory=list)
+    no_pull: bool = False
+    no_prune: bool = False
+    node: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {"flow": flow_to_dict(self.flow), "stage_name": self.stage_name}
+        if self.target_services:
+            d["target_services"] = self.target_services
+        if self.no_pull:
+            d["no_pull"] = True
+        if self.no_prune:
+            d["no_prune"] = True
+        if self.node:
+            d["node"] = self.node
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeployRequest":
+        return cls(flow=flow_from_dict(d["flow"]),
+                   stage_name=d["stage_name"],
+                   target_services=d.get("target_services", []),
+                   no_pull=d.get("no_pull", False),
+                   no_prune=d.get("no_prune", False),
+                   node=d.get("node"))
+
+
+@dataclass
+class DeployEvent:
+    """Progress callback payload (engine.rs DeployEvent:30-49)."""
+    step: str            # stop|pull|network|place|start|wait|prune|done|error
+    service: Optional[str] = None
+    message: str = ""
+    level: Optional[int] = None
+
+    def __str__(self) -> str:
+        svc = f" {self.service}" if self.service else ""
+        return f"[{self.step}]{svc} {self.message}".rstrip()
+
+
+@dataclass
+class DeployResult:
+    """Outcome summary (engine.rs DeployResult)."""
+    deployed: list[str] = field(default_factory=list)
+    removed: list[str] = field(default_factory=list)
+    failed: dict[str, str] = field(default_factory=dict)
+    placement: Optional[Placement] = None
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failed
+
+
+EventCb = Callable[[DeployEvent], None]
+
+
+class DeployEngine:
+    def __init__(self, backend: ContainerBackend, *,
+                 scheduler: Optional[Scheduler] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 project_root: str = "."):
+        self.backend = backend
+        self.scheduler = scheduler or HostGreedyScheduler()
+        self.sleep = sleep
+        self.project_root = project_root
+
+    # ------------------------------------------------------------------
+    def execute(self, req: DeployRequest,
+                on_event: Optional[EventCb] = None,
+                placement: Optional[Placement] = None) -> DeployResult:
+        """Run the 5-step pipeline. `placement` lets a control plane hand a
+        pre-solved plan to node agents so each agent executes only its slice
+        (req.node) without re-solving."""
+        emit = on_event or (lambda e: None)
+        t0 = time.perf_counter()
+        flow, stage = req.flow, req.flow.stage(req.stage_name)
+        services = stage_services(flow, stage, req.target_services or None)
+        by_name = {s.name: s for s in services}
+        result = DeployResult()
+
+        # ---- step 0: placement (replaces order_by_dependencies) ----------
+        if placement is None:
+            pt = lower_stage(flow, req.stage_name)
+            placement = self.scheduler.place(pt)
+        emit(DeployEvent("place", message=(
+            f"{len(placement.assignment)} rows -> "
+            f"{len(set(placement.assignment.values()))} nodes "
+            f"({placement.source}, {placement.solve_ms:.1f}ms, "
+            f"violations={placement.violations})")))
+        if not placement.feasible:
+            raise FlowError(
+                f"placement infeasible: {placement.violations} violations")
+        result.placement = placement
+
+        my_node = req.node or LOCAL_NODE_NAME
+        node_names = set(placement.assignment.values())
+        if my_node not in node_names and len(node_names) == 1:
+            my_node = next(iter(node_names))  # single-node: execute it all
+        levels = placement.node_levels(my_node)
+
+        # replica rows ("web#0") collapse back to their base service for
+        # container naming on this node; replica index keeps names unique
+        def parse_row(row: str) -> tuple[str, Optional[int]]:
+            if "#" in row:
+                base, idx = row.rsplit("#", 1)
+                return base, int(idx)
+            return row, None
+
+        mine: list[tuple[str, Optional[int]]] = [
+            parse_row(r) for lvl in levels for r in lvl]
+        mine = [(b, i) for b, i in mine if b in by_name]
+
+        # ---- step 1: stop/remove existing ---------------------------------
+        label_filter = {"fleetflow.project": flow.name,
+                        "fleetflow.stage": stage.name}
+        existing = self.backend.list(label_filter=label_filter)
+        targets = {b for b, _ in mine}
+        for info in existing:
+            svc_label = info.labels.get("fleetflow.service", "")
+            if req.target_services and svc_label.split("#")[0] not in targets:
+                continue
+            emit(DeployEvent("stop", service=svc_label, message=info.name))
+            self.backend.stop(info.name)
+            self.backend.remove(info.name, force=True)
+            result.removed.append(info.name)
+
+        # ---- step 2: pull -------------------------------------------------
+        if not req.no_pull:
+            for image in dict.fromkeys(by_name[b].image_name() for b, _ in mine):
+                emit(DeployEvent("pull", message=image))
+                try:
+                    self.backend.pull(image)
+                except BackendError as e:
+                    # a local build may provide the image; create will 404
+                    # if it truly doesn't exist (up.rs:329-441 recovery)
+                    emit(DeployEvent("pull", message=f"warn: {e}"))
+
+        # ---- step 3: network ----------------------------------------------
+        net = network_name(flow.name, stage.name)
+        emit(DeployEvent("network", message=net))
+        self.backend.ensure_network(net)
+
+        # ---- step 4: create + start, wave by wave -------------------------
+        for li, level in enumerate(levels):
+            started: list[tuple[str, str]] = []   # (container, base)
+            for row in level:
+                base, ridx = parse_row(row)
+                if base not in by_name:
+                    continue
+                svc = by_name[base]
+                cname = container_name(flow.name, stage.name, base)
+                if ridx is not None:
+                    cname = f"{cname}-{ridx}"
+                emit(DeployEvent("start", service=base, level=li, message=cname))
+                try:
+                    cfg = service_to_container_config(
+                        svc, flow.name, stage.name,
+                        project_root=self.project_root, network=net)
+                    cfg.name = cname
+                    if ridx is not None:
+                        cfg.labels["fleetflow.service"] = row
+                        cfg.labels["fleetflow.replica"] = str(ridx)
+                    self._create_start(cfg, svc, emit)
+                    started.append((cname, base))
+                    result.deployed.append(cname)
+                except BackendError as e:
+                    emit(DeployEvent("error", service=base, message=str(e)))
+                    result.failed[row] = str(e)
+            # wait for the whole wave before the next level starts
+            for cname, base in started:
+                svc = by_name[base]
+                if svc.healthcheck or li + 1 < len(levels):
+                    emit(DeployEvent("wait", service=base, level=li))
+                    wait_for_service(self.backend, cname, svc, sleep=self.sleep)
+
+        # ---- step 5: prune ------------------------------------------------
+        if not req.no_prune:
+            emit(DeployEvent("prune"))
+            self.backend.prune_images()
+
+        result.duration_s = time.perf_counter() - t0
+        emit(DeployEvent("done", message=(
+            f"{len(result.deployed)} deployed, {len(result.removed)} removed, "
+            f"{len(result.failed)} failed in {result.duration_s:.2f}s")))
+        return result
+
+    # ------------------------------------------------------------------
+    def _create_start(self, cfg, svc, emit: EventCb) -> None:
+        """create/start with the reference's recovery ladder
+        (up.rs:329-441): 409 conflict -> start-or-restart the existing
+        container; 404 missing image -> pull once and retry."""
+        try:
+            self.backend.create(cfg)
+        except BackendError as e:
+            msg = str(e)
+            if "409" in msg or "already exists" in msg:
+                emit(DeployEvent("start", service=svc.name,
+                                 message="exists; restarting"))
+                self.backend.restart(cfg.name)
+                return
+            if "404" in msg or "no such image" in msg.lower():
+                self.backend.pull(cfg.image)
+                self.backend.create(cfg)
+            else:
+                raise
+        self.backend.start(cfg.name)
+
+    # ------------------------------------------------------------------
+    def down(self, flow: Flow, stage_name: str,
+             target_services: Optional[list[str]] = None,
+             on_event: Optional[EventCb] = None,
+             remove_network: bool = True) -> DeployResult:
+        """Stop + remove a stage's containers (runtime.rs down:120)."""
+        emit = on_event or (lambda e: None)
+        stage = flow.stage(stage_name)
+        result = DeployResult()
+        label_filter = {"fleetflow.project": flow.name,
+                        "fleetflow.stage": stage.name}
+        for info in self.backend.list(label_filter=label_filter):
+            svc = info.labels.get("fleetflow.service", "").split("#")[0]
+            if target_services and svc not in target_services:
+                continue
+            emit(DeployEvent("stop", service=svc, message=info.name))
+            self.backend.stop(info.name)
+            self.backend.remove(info.name, force=True)
+            result.removed.append(info.name)
+        if remove_network and not target_services:
+            self.backend.remove_network(network_name(flow.name, stage.name))
+        return result

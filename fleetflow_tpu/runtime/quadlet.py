@@ -1,0 +1,201 @@
+"""Podman Quadlet backend: systemd unit generation.
+
+Analog of fleetflow-container quadlet.rs: pure generators that turn a stage
+into systemd `.container` / `.network` units (deps -> After=/Requires=,
+quadlet.rs:92-99; restart mapping :44; HealthCmd :57), plus the sync logic
+that only touches unit files carrying our ownership marker (:229,250) and
+the `systemctl --user` orchestration (:288-299,400).
+
+Generators are pure and tested without systemd, like the reference's.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from ..core.model import Flow, RestartPolicy, Service, Stage
+from .converter import container_name, network_name
+
+__all__ = ["generate_container_unit", "generate_network_unit",
+           "build_stage_units", "sync_units", "apply_stage",
+           "QuadletApplyOutcome", "OWNERSHIP_MARKER"]
+
+OWNERSHIP_MARKER = "# Managed by fleetflow-tpu; do not edit."
+
+_RESTART_MAP = {
+    RestartPolicy.NO: "no",
+    RestartPolicy.ALWAYS: "always",
+    RestartPolicy.ON_FAILURE: "on-failure",
+    RestartPolicy.UNLESS_STOPPED: "always",  # systemd has no unless-stopped
+}
+
+
+def _unit_name(project: str, stage: str, service: str) -> str:
+    return f"{container_name(project, stage, service)}.container"
+
+
+def _network_unit_name(project: str, stage: str) -> str:
+    return f"{network_name(project, stage)}.network"
+
+
+def generate_network_unit(project: str, stage: str) -> str:
+    """A .network Quadlet unit for the stage network (quadlet.rs network
+    unit generation)."""
+    net = network_name(project, stage)
+    return "\n".join([
+        OWNERSHIP_MARKER,
+        "[Unit]",
+        f"Description=fleetflow network {net}",
+        "",
+        "[Network]",
+        f"NetworkName={net}",
+        "",
+        "[Install]",
+        "WantedBy=default.target",
+        "",
+    ])
+
+
+def generate_container_unit(svc: Service, project: str, stage: str) -> str:
+    """A .container Quadlet unit for one service (quadlet.rs:76-120).
+
+    Dependencies become systemd ordering: After=/Requires= on the dep's
+    service unit (quadlet.rs:92-99), which delegates the reference's waiter
+    loop to systemd's dependency engine.
+    """
+    net_unit = _network_unit_name(project, stage)
+    lines = [OWNERSHIP_MARKER, "[Unit]",
+             f"Description=fleetflow service {svc.name} ({project}/{stage})"]
+    for dep in svc.depends_on:
+        dep_unit = f"{container_name(project, stage, dep)}.service"
+        lines.append(f"After={dep_unit}")
+        lines.append(f"Requires={dep_unit}")
+    lines += ["", "[Container]",
+              f"ContainerName={container_name(project, stage, svc.name)}",
+              f"Image={svc.image_name()}"]
+    for p in svc.ports:
+        host_ip = f"{p.host_ip}:" if p.host_ip else ""
+        lines.append(f"PublishPort={host_ip}{p.host}:{p.container}"
+                     + ("/udp" if p.protocol.value == "udp" else ""))
+    for v in svc.volumes:
+        suffix = ":ro" if v.read_only else ""
+        lines.append(f"Volume={v.host}:{v.container}{suffix}")
+    for k, val in sorted(svc.environment.items()):
+        lines.append(f"Environment={k}={val}")
+    lines.append(f"Network={net_unit}")
+    for k, val in sorted({"fleetflow.project": project,
+                          "fleetflow.stage": stage,
+                          "fleetflow.service": svc.name,
+                          **svc.labels}.items()):
+        lines.append(f"Label={k}={val}")
+    if svc.healthcheck and svc.healthcheck.test:
+        hc = svc.healthcheck
+        test = hc.test
+        cmd = " ".join(test[1:] if test[0] in ("CMD", "CMD-SHELL") else test)
+        lines.append(f"HealthCmd={cmd}")
+        lines.append(f"HealthInterval={int(hc.interval)}s")
+        lines.append(f"HealthTimeout={int(hc.timeout)}s")
+        lines.append(f"HealthRetries={hc.retries}")
+        lines.append(f"HealthStartPeriod={int(hc.start_period)}s")
+    if svc.command:
+        lines.append(f"Exec={svc.command}")
+    lines += ["", "[Service]"]
+    if svc.restart is not None:
+        lines.append(f"Restart={_RESTART_MAP[svc.restart]}")
+    else:
+        lines.append("Restart=always")
+    lines += ["", "[Install]", "WantedBy=default.target", ""]
+    return "\n".join(lines)
+
+
+def build_stage_units(flow: Flow, stage: Stage) -> dict[str, str]:
+    """filename -> unit text for a whole stage (quadlet.rs:326)."""
+    units = {_network_unit_name(flow.name, stage.name):
+             generate_network_unit(flow.name, stage.name)}
+    for svc in stage.resolved_services(flow):
+        units[_unit_name(flow.name, stage.name, svc.name)] = \
+            generate_container_unit(svc, flow.name, stage.name)
+    return units
+
+
+def sync_units(units: dict[str, str], unit_dir: str) -> tuple[list[str], list[str]]:
+    """Write units into `unit_dir`; remove stale fleetflow-owned units for
+    the same prefix. Never touches files without the ownership marker
+    (quadlet.rs:229-250). Returns (written, removed)."""
+    d = Path(unit_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    written, removed = [], []
+    prefixes = {name.rsplit("-", 1)[0] for name in units}
+    for f in d.iterdir():
+        if f.suffix not in (".container", ".network"):
+            continue
+        if f.name in units:
+            continue
+        try:
+            head = f.read_text().splitlines()[0] if f.stat().st_size else ""
+        except OSError:
+            continue
+        if head == OWNERSHIP_MARKER and any(f.name.startswith(p) for p in prefixes):
+            f.unlink()
+            removed.append(f.name)
+    for name, text in units.items():
+        target = d / name
+        if not target.exists() or target.read_text() != text:
+            target.write_text(text)
+            written.append(name)
+    return written, removed
+
+
+@dataclass
+class QuadletApplyOutcome:
+    """quadlet.rs:383."""
+    written: list[str] = field(default_factory=list)
+    removed: list[str] = field(default_factory=list)
+    started: list[str] = field(default_factory=list)
+    errors: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def default_unit_dir() -> str:
+    return os.path.expanduser("~/.config/containers/systemd")
+
+
+def apply_stage(flow: Flow, stage_name: str, *,
+                unit_dir: Optional[str] = None,
+                systemctl=None) -> QuadletApplyOutcome:
+    """Generate units, sync to disk, daemon-reload, start
+    (quadlet.rs apply_stage:400). `systemctl` is an injectable callable
+    (args: list[str]) -> (rc, output) for tests."""
+    stage = flow.stage(stage_name)
+    units = build_stage_units(flow, stage)
+    outcome = QuadletApplyOutcome()
+    outcome.written, outcome.removed = sync_units(
+        units, unit_dir or default_unit_dir())
+
+    if systemctl is None:
+        def systemctl(args: list[str]) -> tuple[int, str]:
+            proc = subprocess.run(["systemctl", "--user", *args],
+                                  capture_output=True, text=True)
+            return proc.returncode, proc.stdout + proc.stderr
+
+    rc, out = systemctl(["daemon-reload"])
+    if rc != 0:
+        outcome.errors["daemon-reload"] = out
+        return outcome
+    for name in units:
+        if not name.endswith(".container"):
+            continue
+        unit = name[: -len(".container")] + ".service"
+        rc, out = systemctl(["start", unit])
+        if rc == 0:
+            outcome.started.append(unit)
+        else:
+            outcome.errors[unit] = out
+    return outcome

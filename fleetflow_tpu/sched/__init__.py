@@ -1,0 +1,33 @@
+"""Scheduler layer: turn ProblemTensors into a Placement.
+
+The reference's "placer" is `order_by_dependencies` (fleetflow-container
+engine.rs:67-85) — a single-pass partition feeding a sequential deploy loop.
+Here placement is a first-class interface with three backends:
+
+  HostGreedyScheduler  pure-numpy first-fit-decreasing (default; no JAX
+                       needed; the `fleet up local` path)
+  TpuSolverScheduler   the device-resident annealing solver (fleetflow_tpu
+                       .solver) for fleet-scale instances
+  NativeGreedyScheduler C++ FFD via ctypes when the extension is built
+                       (fleetflow_tpu/native), numpy fallback otherwise
+
+All return the same `Placement`: an assignment (service row -> node) plus the
+dependency level schedule that replaces the reference's sequential ordering
+with concurrent per-level waves.
+"""
+
+from .base import Placement, Scheduler, level_schedule
+from .host import HostGreedyScheduler
+from .tpu import TpuSolverScheduler
+
+__all__ = ["Placement", "Scheduler", "level_schedule",
+           "HostGreedyScheduler", "TpuSolverScheduler", "pick_scheduler"]
+
+
+def pick_scheduler(S: int, N: int, *, prefer_tpu: bool = True) -> Scheduler:
+    """Default backend policy: single-node or tiny instances run the host
+    greedy placer (placement degenerates to ordering); fleet-scale instances
+    go to the TPU solver."""
+    if not prefer_tpu or N <= 1 or S * N < 512:
+        return HostGreedyScheduler()
+    return TpuSolverScheduler()

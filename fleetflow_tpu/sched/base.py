@@ -1,0 +1,56 @@
+"""Scheduler interface and the Placement result."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol
+
+import numpy as np
+
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["Placement", "Scheduler", "level_schedule"]
+
+
+def level_schedule(pt: ProblemTensors) -> list[list[str]]:
+    """Dependency level buckets in start order: all services at depth d can
+    start concurrently once depth d-1 is ready (exact Kahn levels from
+    lower.tensors.dependency_depths — the vectorizable replacement for the
+    reference's sequential ordering, engine.rs:67-85)."""
+    depth = np.asarray(pt.dep_depth)
+    levels: list[list[str]] = []
+    for d in range(int(depth.max()) + 1 if depth.size else 0):
+        levels.append([pt.service_names[i] for i in np.flatnonzero(depth == d)])
+    return levels
+
+
+@dataclass
+class Placement:
+    """A solved placement: where each service row runs and in what order."""
+    assignment: dict[str, str]       # service row name -> node name
+    levels: list[list[str]]          # start-order level buckets
+    feasible: bool
+    violations: int = 0
+    soft: float = 0.0
+    source: str = "host-greedy"
+    solve_ms: float = 0.0
+    raw: np.ndarray | None = field(default=None, repr=False)  # (S,) node idx
+
+    def services_on(self, node: str) -> list[str]:
+        """Rows assigned to `node`, in level-schedule order."""
+        order = {name: i for i, lvl in enumerate(self.levels) for name in lvl}
+        mine = [s for s, n in self.assignment.items() if n == node]
+        return sorted(mine, key=lambda s: (order.get(s, 0), s))
+
+    def node_levels(self, node: str) -> list[list[str]]:
+        """The level schedule restricted to one node (what that node's
+        executor runs, wave by wave)."""
+        mine = {s for s, n in self.assignment.items() if n == node}
+        return [[s for s in lvl if s in mine] for lvl in self.levels
+                if any(s in mine for s in lvl)]
+
+
+class Scheduler(Protocol):
+    """Placement backend: ProblemTensors in, Placement out."""
+
+    def place(self, pt: ProblemTensors) -> Placement: ...

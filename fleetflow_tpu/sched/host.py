@@ -1,0 +1,106 @@
+"""Host-side greedy placer: pure numpy, no JAX.
+
+First-fit-decreasing over dependency-depth order, honoring every hard
+constraint the TPU solver enforces (eligibility, node validity, capacity,
+port/volume/anti-affinity exclusivity). This is the default backend for
+small instances and the fallback when no accelerator is present — the moral
+successor of the reference's host-side `order_by_dependencies`
+(engine.rs:67-85), upgraded from "partition into two buckets" to an actual
+constrained bin-packer.
+
+Strategy scoring mirrors solver/kernels.py:
+  spread_across_pool  pick the least-utilized eligible node
+  pack_into_dedicated pick the most-utilized node that still fits
+  fill_lowest         pick the lowest-indexed node that fits
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .base import Placement, level_schedule
+from ..core.model import PlacementStrategy
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["HostGreedyScheduler", "greedy_host_place"]
+
+
+def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
+    """(assignment (S,), violations). Services that cannot be placed without
+    violating a hard constraint are put on their least-bad node and counted."""
+    S, N = pt.S, pt.N
+    demand = np.asarray(pt.demand, dtype=np.float64)
+    capacity = np.asarray(pt.capacity, dtype=np.float64)
+    load = np.zeros_like(capacity)
+    # conflict registries: (node, kind, group_id) occupancy
+    occupied: set[tuple[int, str, int]] = set()
+
+    def conflict_groups(s: int):
+        for kind, arr in (("p", pt.port_ids), ("v", pt.volume_ids),
+                          ("a", pt.anti_ids)):
+            for g in arr[s]:
+                if g >= 0:
+                    yield kind, int(g)
+
+    # order: dependency depth first (parents before children keeps waves
+    # balanced), then biggest demand first within a level
+    order = np.lexsort((-demand.sum(axis=1), np.asarray(pt.dep_depth)))
+
+    assignment = np.zeros(S, dtype=np.int32)
+    violations = 0
+    valid = np.asarray(pt.node_valid, dtype=bool)
+    eligible = np.asarray(pt.eligible, dtype=bool)
+
+    for s in order:
+        cands = np.flatnonzero(eligible[s] & valid)
+        if cands.size == 0:
+            cands = np.flatnonzero(valid)
+        if cands.size == 0:
+            cands = np.arange(N)
+        fits = []
+        for n in cands:
+            if np.any(load[n] + demand[s] > capacity[n]):
+                continue
+            if any((int(n), k, g) in occupied for k, g in conflict_groups(s)):
+                continue
+            fits.append(int(n))
+        if fits:
+            util = (load[fits] / np.maximum(capacity[fits], 1e-9)).mean(axis=1)
+            if pt.strategy == PlacementStrategy.PACK_INTO_DEDICATED:
+                n = fits[int(np.argmax(util))]
+            elif pt.strategy == PlacementStrategy.FILL_LOWEST:
+                n = min(fits)
+            else:  # spread
+                n = fits[int(np.argmin(util))]
+        else:
+            # least-bad: minimize overflow on an eligible node
+            over = (np.maximum(load[cands] + demand[s] - capacity[cands], 0)
+                    / np.maximum(capacity[cands], 1e-9)).sum(axis=1)
+            n = int(cands[int(np.argmin(over))])
+            violations += 1
+        assignment[s] = n
+        load[n] += demand[s]
+        occupied.update((n, k, g) for k, g in conflict_groups(s))
+
+    return assignment, violations
+
+
+class HostGreedyScheduler:
+    """Default host placer (see module docstring)."""
+
+    def place(self, pt: ProblemTensors) -> Placement:
+        t0 = time.perf_counter()
+        assignment, violations = greedy_host_place(pt)
+        ms = (time.perf_counter() - t0) * 1e3
+        return Placement(
+            assignment={pt.service_names[i]: pt.node_names[int(assignment[i])]
+                        for i in range(pt.S)},
+            levels=level_schedule(pt),
+            feasible=violations == 0,
+            violations=violations,
+            source="host-greedy",
+            solve_ms=ms,
+            raw=assignment,
+        )

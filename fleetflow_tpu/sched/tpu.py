@@ -1,0 +1,63 @@
+"""TPU solver scheduler backend: wraps fleetflow_tpu.solver.solve.
+
+Holds the staged DeviceProblem across re-solves so streaming reschedules
+(node churn) pay only the small delta upload, never a full re-stage
+(SURVEY.md hard part (d): keep the host<->device boundary out of the
+per-reschedule path).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .base import Placement, level_schedule
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["TpuSolverScheduler"]
+
+
+class TpuSolverScheduler:
+    def __init__(self, *, chains: int = 8, steps: int = 2000, seed: int = 0,
+                 mesh=None):
+        self.chains = chains
+        self.steps = steps
+        self.seed = seed
+        self.mesh = mesh
+        self._staged = None          # (pt id, DeviceProblem)
+        self._last_assignment: Optional[np.ndarray] = None
+
+    def place(self, pt: ProblemTensors, *,
+              warm_start: bool = False) -> Placement:
+        # imported lazily so the host path never pays JAX startup
+        from ..solver import prepare_problem, solve
+
+        t0 = time.perf_counter()
+        if self._staged is None or self._staged[0] is not pt:
+            self._staged = (pt, prepare_problem(pt))
+        prob = self._staged[1]
+
+        init = self._last_assignment if warm_start else None
+        res = solve(pt, prob=prob, chains=self.chains, steps=self.steps,
+                    seed=self.seed, mesh=self.mesh, init_assignment=init)
+        self._last_assignment = res.assignment
+        ms = (time.perf_counter() - t0) * 1e3
+
+        return Placement(
+            assignment={pt.service_names[i]: pt.node_names[int(res.assignment[i])]
+                        for i in range(pt.S)},
+            levels=level_schedule(pt),
+            feasible=res.feasible,
+            violations=res.violations,
+            soft=res.soft,
+            source="tpu-anneal",
+            solve_ms=ms,
+            raw=res.assignment,
+        )
+
+    def reschedule(self, pt: ProblemTensors) -> Placement:
+        """Streaming re-solve after churn: warm-start from the previous
+        assignment so only churn-forced moves happen (BASELINE config 5)."""
+        return self.place(pt, warm_start=True)

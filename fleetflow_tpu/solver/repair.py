@@ -251,8 +251,9 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
     stats = verify(pt, assignment)
     # Ejection leaves un-replaced evictees at stale nodes when the budget
     # exhausts; never return something worse than the input.
-    in_stats = verify(pt, original)
-    if in_stats["total"] < stats["total"]:
-        assignment, stats, moves = original.copy(), in_stats, 0
+    if stats["total"] > 0:
+        in_stats = verify(pt, original)
+        if in_stats["total"] < stats["total"]:
+            assignment, stats, moves = original.copy(), in_stats, 0
     return RepairResult(assignment=assignment, moves=moves, stats=stats,
                         feasible=stats["total"] == 0)

@@ -1,0 +1,134 @@
+"""Pure-generator tests: Quadlet units and Compose YAML.
+
+The reference tests these as pure functions without any runtime
+(quadlet.rs, compose.rs inline tests); same here, plus a YAML parse check
+since PyYAML is available transitively.
+"""
+
+from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+from fleetflow_tpu.core.model import (Flow, HealthCheck, Port, RestartPolicy,
+                                      Service, Stage)
+from fleetflow_tpu.runtime.compose import (compose_up, generate_compose_yaml,
+                                           write_compose_file)
+from fleetflow_tpu.runtime.quadlet import (OWNERSHIP_MARKER, apply_stage,
+                                           build_stage_units,
+                                           generate_container_unit,
+                                           sync_units)
+
+
+def demo_flow() -> Flow:
+    db = Service(name="db", image="postgres", version="16",
+                 ports=[Port(host=5432, container=5432)],
+                 environment={"POSTGRES_USER": "u"},
+                 restart=RestartPolicy.ALWAYS,
+                 healthcheck=HealthCheck(test=["CMD", "pg_isready"]))
+    app = Service(name="app", image="app", depends_on=["db"],
+                  restart=RestartPolicy.UNLESS_STOPPED)
+    flow = Flow(name="proj")
+    flow.services = {"db": db, "app": app}
+    flow.stages = {"live": Stage(name="live", services=["db", "app"])}
+    return flow
+
+
+class TestQuadlet:
+    def test_container_unit(self):
+        flow = demo_flow()
+        unit = generate_container_unit(flow.services["app"], "proj", "live")
+        assert unit.startswith(OWNERSHIP_MARKER)
+        # deps -> systemd ordering (quadlet.rs:92-99)
+        assert "After=proj-live-db.service" in unit
+        assert "Requires=proj-live-db.service" in unit
+        assert "ContainerName=proj-live-app" in unit
+        # unless-stopped has no systemd analog -> always (quadlet.rs:44)
+        assert "Restart=always" in unit
+
+    def test_healthcheck_lines(self):
+        flow = demo_flow()
+        unit = generate_container_unit(flow.services["db"], "proj", "live")
+        assert "HealthCmd=pg_isready" in unit
+        assert "PublishPort=5432:5432" in unit
+        assert "Environment=POSTGRES_USER=u" in unit
+
+    def test_stage_units_and_sync(self, tmp_path):
+        flow = demo_flow()
+        units = build_stage_units(flow, flow.stages["live"])
+        assert set(units) == {"proj-live.network", "proj-live-db.container",
+                              "proj-live-app.container"}
+        d = tmp_path / "systemd"
+        written, removed = sync_units(units, str(d))
+        assert sorted(written) == sorted(units)
+        # idempotent second sync writes nothing
+        written2, _ = sync_units(units, str(d))
+        assert written2 == []
+        # stale fleetflow-owned unit is removed; foreign unit untouched
+        (d / "proj-live-old.container").write_text(OWNERSHIP_MARKER + "\n")
+        (d / "proj-live-user.container").write_text("# hand-written\n")
+        _, removed = sync_units(units, str(d))
+        assert removed == ["proj-live-old.container"]
+        assert (d / "proj-live-user.container").exists()
+
+    def test_apply_stage_with_fake_systemctl(self, tmp_path):
+        flow = demo_flow()
+        calls = []
+
+        def fake_systemctl(args):
+            calls.append(args)
+            return 0, ""
+
+        outcome = apply_stage(flow, "live", unit_dir=str(tmp_path),
+                              systemctl=fake_systemctl)
+        assert outcome.ok
+        assert calls[0] == ["daemon-reload"]
+        assert sorted(outcome.started) == ["proj-live-app.service",
+                                           "proj-live-db.service"]
+
+
+class TestCompose:
+    def test_yaml_structure(self):
+        flow = demo_flow()
+        text = generate_compose_yaml(flow, flow.stages["live"])
+        import yaml
+        doc = yaml.safe_load(text)
+        assert doc["name"] == "proj-live"
+        assert doc["services"]["db"]["image"] == "postgres:16"
+        assert doc["services"]["db"]["ports"] == ["5432:5432"]
+        # healthy dep -> service_healthy condition
+        assert doc["services"]["app"]["depends_on"]["db"]["condition"] == \
+            "service_healthy"
+        assert doc["networks"]["default"]["name"] == "proj-live"
+
+    def test_escaping(self):
+        svc = Service(name="tricky", image="img",
+                      environment={"A": "true", "B": "3.14", "C": "a: b",
+                                   "D": 'say "hi"', "E": ""})
+        flow = Flow(name="p")
+        flow.services = {"tricky": svc}
+        flow.stages = {"s": Stage(name="s", services=["tricky"])}
+        import yaml
+        doc = yaml.safe_load(generate_compose_yaml(flow, flow.stages["s"]))
+        env = doc["services"]["tricky"]["environment"]
+        assert env == {"A": "true", "B": "3.14", "C": "a: b",
+                       "D": 'say "hi"', "E": ""}
+
+    def test_write_and_up(self, tmp_path):
+        flow = demo_flow()
+        path = write_compose_file(flow, "live", str(tmp_path))
+        assert path == tmp_path / ".fleetflow" / "compose.live.yaml"
+        assert path.exists()
+        cmds = []
+
+        def runner(cmd):
+            cmds.append(cmd)
+            return 0, "ok"
+
+        rc, _ = compose_up(flow, "live", str(tmp_path), runner=runner)
+        assert rc == 0
+        assert cmds[0][:2] == ["docker", "compose"]
+        assert "up" in cmds[0]
+
+    def test_project_fixture_compose(self, project):
+        root, _ = project
+        flow = load_project_from_root_with_stage(str(root), "local")
+        import yaml
+        doc = yaml.safe_load(generate_compose_yaml(flow, flow.stage("local")))
+        assert set(doc["services"]) == {"postgres", "redis", "app"}

@@ -1,0 +1,224 @@
+"""Runtime-layer tests: converter, waiter, engine, serialization round-trips.
+
+Models the reference's Tier-1 pattern: engine tests against a mock backend,
+no Docker (engine_test.rs self-skipping pattern; serialization round-trips
+engine.rs:547-601; waiter backoff math waiter.rs:103-117).
+"""
+
+import pytest
+
+from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+from fleetflow_tpu.core.model import (HealthCheck, Port, RestartPolicy,
+                                      Service, Volume, WaitConfig)
+from fleetflow_tpu.core.serialize import flow_from_dict, flow_to_dict
+from fleetflow_tpu.runtime import (DeployEngine, DeployRequest, MockBackend,
+                                   container_name, network_name,
+                                   service_to_container_config,
+                                   wait_for_service)
+from fleetflow_tpu.runtime.waiter import WaitTimeout
+
+
+def load(project):
+    root, _ = project
+    return load_project_from_root_with_stage(str(root), "local")
+
+
+# --------------------------------------------------------------------------
+# converter
+# --------------------------------------------------------------------------
+
+class TestConverter:
+    def test_naming_contract(self):
+        assert container_name("proj", "live", "db") == "proj-live-db"
+        assert network_name("proj", "live") == "proj-live"
+
+    def test_full_conversion(self):
+        svc = Service(
+            name="db", image="postgres", version="16",
+            ports=[Port(host=5432, container=5432)],
+            volumes=[Volume(host="./data", container="/var/lib/postgresql/data"),
+                     Volume(host="named", container="/cache", read_only=True)],
+            environment={"POSTGRES_USER": "u"},
+            restart=RestartPolicy.UNLESS_STOPPED,
+            healthcheck=HealthCheck(test=["CMD", "pg_isready"], interval=5.0),
+        )
+        cfg = service_to_container_config(svc, "p", "s", project_root="/proj")
+        assert cfg.name == "p-s-db"
+        assert cfg.image == "postgres:16"
+        assert cfg.env == ["POSTGRES_USER=u"]
+        assert cfg.exposed_ports == ["5432/tcp"]
+        assert cfg.port_bindings == {"5432/tcp": [{"HostPort": "5432"}]}
+        # relative path absolutized against project root; named volume kept
+        assert cfg.binds == ["/proj/data:/var/lib/postgresql/data",
+                             "named:/cache:ro"]
+        assert cfg.restart_policy == "unless-stopped"
+        assert cfg.labels["fleetflow.project"] == "p"
+        assert cfg.labels["com.docker.compose.project"] == "p-s"
+        assert cfg.network == "p-s"
+        assert cfg.aliases == ["db"]
+        # seconds -> nanoseconds at the API boundary (converter.rs:159-166)
+        assert cfg.healthcheck["interval"] == 5_000_000_000
+
+    def test_image_tag_already_present(self):
+        svc = Service(name="x", image="repo/app:v2", version="9")
+        cfg = service_to_container_config(svc, "p", "s")
+        assert cfg.image == "repo/app:v2"
+
+
+# --------------------------------------------------------------------------
+# waiter
+# --------------------------------------------------------------------------
+
+class TestWaiter:
+    def test_backoff_schedule(self):
+        w = WaitConfig()
+        delays = [w.delay_for_attempt(i) for i in range(6)]
+        assert delays == [1.0, 2.0, 4.0, 8.0, 16.0, 30.0]
+
+    def test_wait_success_after_transition(self):
+        b = MockBackend()
+        b.images.add("app:latest")
+        svc = Service(name="app", image="app")
+        from fleetflow_tpu.runtime.converter import ContainerConfig
+        b.create(ContainerConfig(name="c", image="app:latest"))
+        attempts = []
+
+        def sleeper(d):
+            attempts.append(d)
+            if len(attempts) == 3:
+                b.start("c")
+
+        n = wait_for_service(b, "c", svc, sleep=sleeper)
+        assert n == 3
+
+    def test_wait_timeout(self):
+        b = MockBackend()
+        svc = Service(name="app", wait=WaitConfig(max_retries=4))
+        with pytest.raises(WaitTimeout):
+            wait_for_service(b, "missing", svc, sleep=lambda d: None)
+
+    def test_healthcheck_gates_readiness(self):
+        b = MockBackend()
+        b.images.add("app:latest")
+        from fleetflow_tpu.runtime.converter import ContainerConfig
+        b.create(ContainerConfig(name="c", image="app:latest",
+                                 healthcheck={"test": ["CMD", "ok"]}))
+        b.start("c")
+        b.set_health("c", "unhealthy")
+        svc = Service(name="app",
+                      healthcheck=HealthCheck(test=["CMD", "ok"]),
+                      wait=WaitConfig(max_retries=2))
+        with pytest.raises(WaitTimeout):
+            wait_for_service(b, "c", svc, sleep=lambda d: None)
+        b.set_health("c", "healthy")
+        assert wait_for_service(b, "c", svc, sleep=lambda d: None) == 0
+
+
+# --------------------------------------------------------------------------
+# engine
+# --------------------------------------------------------------------------
+
+def make_engine():
+    b = MockBackend()
+    return DeployEngine(b, sleep=lambda d: None), b
+
+
+class TestEngine:
+    def test_full_deploy(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        events = []
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local"),
+                             on_event=events.append)
+        assert res.ok
+        assert sorted(res.deployed) == ["testproj-local-app",
+                                        "testproj-local-postgres",
+                                        "testproj-local-redis"]
+        assert "testproj-local" in b.networks
+        # dependency ordering: app (depth 1) starts after its deps (depth 0)
+        starts = [c[1] for c in b.calls if c[0] == "start"]
+        assert starts.index("testproj-local-app") > starts.index("testproj-local-postgres")
+        assert starts.index("testproj-local-app") > starts.index("testproj-local-redis")
+        steps = {e.step for e in events}
+        assert {"place", "pull", "network", "start", "prune", "done"} <= steps
+
+    def test_redeploy_removes_existing(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        assert len(res.removed) == 3
+        assert len(res.deployed) == 3
+
+    def test_target_filter(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"redis:7"})
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local",
+                                           target_services=["redis"]))
+        assert res.deployed == ["testproj-local-redis"]
+
+    def test_missing_image_pull_retry(self, project):
+        """404 recovery ladder: create fails on missing image, engine pulls
+        and retries (up.rs:329-441)."""
+        flow = load(project)
+        engine, b = make_engine()
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local",
+                                           no_pull=True))
+        assert res.ok  # every image was pulled on demand
+        assert ("pull", "postgres:16") in b.calls
+
+    def test_no_prune(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        engine.execute(DeployRequest(flow=flow, stage_name="local",
+                                     no_prune=True))
+        assert b.pruned == 0
+
+    def test_down(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        res = engine.down(flow, "local")
+        assert len(res.removed) == 3
+        assert b.containers == {}
+        assert "testproj-local" not in b.networks
+
+    def test_failure_recorded_not_raised(self, project):
+        flow = load(project)
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        b.fail_on["start:testproj-local-redis"] = 99
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        assert "redis" in res.failed
+        assert "testproj-local-postgres" in res.deployed
+
+
+# --------------------------------------------------------------------------
+# DeployRequest serialization (the cross-machine contract)
+# --------------------------------------------------------------------------
+
+class TestSerialization:
+    def test_flow_roundtrip(self, project):
+        flow = load(project)
+        d = flow_to_dict(flow)
+        back = flow_from_dict(d)
+        assert back == flow
+
+    def test_deploy_request_roundtrip(self, project):
+        import json
+        flow = load(project)
+        req = DeployRequest(flow=flow, stage_name="local",
+                            target_services=["app"], no_pull=True,
+                            node="worker-1")
+        wire = json.dumps(req.to_dict())
+        back = DeployRequest.from_dict(json.loads(wire))
+        assert back.flow == flow
+        assert back.stage_name == "local"
+        assert back.target_services == ["app"]
+        assert back.no_pull and not back.no_prune
+        assert back.node == "worker-1"
