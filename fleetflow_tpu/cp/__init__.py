@@ -1,0 +1,19 @@
+"""Control plane (L3): the distributed brain.
+
+Analog of fleetflow-controlplane (SURVEY.md §2.4): a store, channel-based
+wire protocol, agent registry with request-id correlation, log router,
+auth, mesh CA, secret crypto, and 13 channel handlers — plus the piece the
+reference doesn't have: a placement service that runs the TPU solver and a
+streaming re-solver that reacts to node churn (BASELINE config 5).
+
+Transport: the reference rides club-unison (QUIC + mTLS with a private
+MeshCa). Here the control RPC is asyncio TCP with length-prefixed JSON
+frames, optionally wrapped in TLS from the same private-CA scheme
+(cp/cert.py); the data plane (the solve itself) is JAX collectives on the
+device mesh, not host RPC.
+"""
+
+from .server import AppState, CpServerHandle, ServerConfig, start
+from .store import Store
+
+__all__ = ["start", "AppState", "CpServerHandle", "ServerConfig", "Store"]

@@ -1,0 +1,99 @@
+"""Agent registry: routing commands to connected node agents.
+
+Analog of controlplane agent_registry.rs: an in-memory map server_slug ->
+live connection, request/response with per-call timeouts (60s default,
+600s deploys, 1800s builds — agent_registry.rs:86-96), fire-and-forget
+sends, and re-register-overwrites-previous semantics (:51-53).
+
+The correlation contract matches the reference exactly (handlers/agent.rs
+:97-112 + fleet-agent agent.rs:215-254): the CP wraps each command as
+{"request_id": ..., "payload": ...} and the agent answers with a
+`command_result` EVENT carrying the same request_id — not a protocol-level
+response — which the registry correlates back to the waiting future.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Optional
+
+from ..core.errors import ControlPlaneError
+from .protocol import Connection
+
+__all__ = ["AgentRegistry", "DEFAULT_TIMEOUT", "DEPLOY_TIMEOUT",
+           "BUILD_TIMEOUT"]
+
+DEFAULT_TIMEOUT = 60.0     # agent_registry.rs:86
+DEPLOY_TIMEOUT = 600.0     # :94 (sized for image pulls)
+BUILD_TIMEOUT = 1800.0     # :95
+
+
+class AgentRegistry:
+    def __init__(self):
+        self._agents: dict[str, Connection] = {}
+        self._pending: dict[str, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+
+    # ------------------------------------------------------------------
+    def register(self, slug: str, conn: Connection) -> None:
+        """Re-registration overwrites the previous session
+        (agent_registry.rs:51-53): a reconnecting agent wins."""
+        self._agents[slug] = conn
+
+    def unregister(self, slug: str, conn: Optional[Connection] = None) -> None:
+        if conn is None or self._agents.get(slug) is conn:
+            self._agents.pop(slug, None)
+
+    def is_connected(self, slug: str) -> bool:
+        return slug in self._agents
+
+    def list_connected(self) -> list[str]:
+        return sorted(self._agents)
+
+    def connection_of(self, slug: str) -> Optional[Connection]:
+        return self._agents.get(slug)
+
+    # ------------------------------------------------------------------
+    async def send_command(self, slug: str, command: str,
+                           payload: dict | None = None,
+                           timeout: float = DEFAULT_TIMEOUT) -> dict:
+        """Request/response via the command_result correlation protocol
+        (agent_registry.rs send_command_with_timeout:97-134)."""
+        conn = self._agents.get(slug)
+        if conn is None:
+            raise ControlPlaneError(f"agent {slug!r} is not connected")
+        request_id = f"req_{next(self._ids)}"
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        try:
+            await conn.send_event("agent", command, {
+                "request_id": request_id, "payload": payload or {}})
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise ControlPlaneError(
+                f"agent {slug!r} command {command!r} timed out "
+                f"after {timeout:.0f}s") from None
+        finally:
+            self._pending.pop(request_id, None)
+
+    async def fire_and_forget(self, slug: str, command: str,
+                              payload: dict | None = None) -> None:
+        conn = self._agents.get(slug)
+        if conn is None:
+            raise ControlPlaneError(f"agent {slug!r} is not connected")
+        await conn.send_event("agent", command,
+                              {"request_id": None, "payload": payload or {}})
+
+    def resolve_result(self, request_id: str, payload: dict) -> bool:
+        """Called by the agent channel handler on an inbound command_result
+        event (handlers/agent.rs:97-112). Returns False for unknown/expired
+        ids (late results after timeout are dropped, like the reference)."""
+        fut = self._pending.get(request_id)
+        if fut is None or fut.done():
+            return False
+        if payload.get("error"):
+            fut.set_exception(ControlPlaneError(str(payload["error"])))
+        else:
+            fut.set_result(payload.get("result", payload))
+        return True

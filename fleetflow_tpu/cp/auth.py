@@ -1,0 +1,121 @@
+"""Auth providers.
+
+Analog of controlplane auth.rs:17-38: an enum-dispatched provider — NoAuth
+for local/dev, and a JWT verifier for production. The reference verifies
+Auth0 RS256 tokens against a cached JWKS; this build issues and verifies
+HS256 tokens with a shared secret (the CP is its own identity provider —
+the Device-Flow login of the reference CLI maps to `fleet cp login` minting
+one of these). Claims carry email + permissions like the reference's.
+
+JWT is implemented inline (HMAC-SHA256 + base64url): no external deps, and
+the token format stays interoperable with standard tooling.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.errors import ControlPlaneError
+
+__all__ = ["AuthError", "Claims", "NoAuth", "TokenAuth", "make_provider"]
+
+
+class AuthError(ControlPlaneError):
+    pass
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64url(s: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    return base64.urlsafe_b64decode(s + pad)
+
+
+@dataclass
+class Claims:
+    """auth.rs Claims: subject email + permission strings."""
+    sub: str = ""
+    email: str = ""
+    permissions: list[str] = field(default_factory=list)
+    tenant: str = "default"
+    exp: float = 0.0
+
+    def has(self, perm: str) -> bool:
+        return perm in self.permissions or "admin:all" in self.permissions
+
+
+class NoAuth:
+    """auth.rs NoAuth: everything is the anonymous admin."""
+
+    def verify(self, token: Optional[str]) -> Claims:
+        return Claims(sub="anonymous", email="anonymous@local",
+                      permissions=["admin:all"], exp=time.time() + 3600)
+
+    def issue(self, email: str, permissions: list[str],
+              tenant: str = "default", ttl_s: float = 86400.0) -> str:
+        return ""
+
+
+class TokenAuth:
+    """HS256 JWT issue + verify with a shared secret."""
+
+    def __init__(self, secret: str):
+        if not secret:
+            raise AuthError("TokenAuth requires a non-empty secret")
+        self._key = secret.encode()
+
+    def issue(self, email: str, permissions: list[str],
+              tenant: str = "default", ttl_s: float = 86400.0) -> str:
+        header = {"alg": "HS256", "typ": "JWT"}
+        now = time.time()
+        payload = {"sub": email, "email": email, "permissions": permissions,
+                   "tenant": tenant, "iat": int(now), "exp": int(now + ttl_s)}
+        signing = (_b64url(json.dumps(header, separators=(",", ":")).encode())
+                   + "." +
+                   _b64url(json.dumps(payload, separators=(",", ":")).encode()))
+        sig = hmac.new(self._key, signing.encode(), hashlib.sha256).digest()
+        return signing + "." + _b64url(sig)
+
+    def verify(self, token: Optional[str]) -> Claims:
+        if not token:
+            raise AuthError("missing token")
+        try:
+            signing, _, sig_part = token.rpartition(".")
+            header_part, _, payload_part = signing.partition(".")
+            header = json.loads(_unb64url(header_part))
+            if header.get("alg") != "HS256":
+                raise AuthError(f"unsupported alg {header.get('alg')!r}")
+            expected = hmac.new(self._key, signing.encode(),
+                                hashlib.sha256).digest()
+            if not hmac.compare_digest(expected, _unb64url(sig_part)):
+                raise AuthError("bad signature")
+            payload = json.loads(_unb64url(payload_part))
+        except AuthError:
+            raise
+        except Exception as e:
+            raise AuthError(f"malformed token: {e}") from None
+        exp = float(payload.get("exp", 0))
+        if exp and exp < time.time():
+            raise AuthError("token expired")
+        return Claims(sub=str(payload.get("sub", "")),
+                      email=str(payload.get("email", "")),
+                      permissions=list(payload.get("permissions", [])),
+                      tenant=str(payload.get("tenant", "default")),
+                      exp=exp)
+
+
+def make_provider(kind: str, secret: Optional[str] = None):
+    """auth.rs AuthProviderKind enum dispatch."""
+    if kind in ("none", "noauth", ""):
+        return NoAuth()
+    if kind in ("token", "jwt"):
+        return TokenAuth(secret or "")
+    raise AuthError(f"unknown auth provider {kind!r}")

@@ -1,0 +1,623 @@
+"""Channel handlers: the CP's RPC surface.
+
+Analog of controlplane handlers/ (13 channels, handlers/mod.rs:21-35), all
+shaped `method -> store/registry op -> payload`. The agent channel is the
+duplex session (handlers/agent.rs): register-first enforcement, heartbeat /
+alert / log / command_result events, CP->agent commands via AgentRegistry.
+
+Every handler is a closure over AppState; `register_all` wires them into the
+ProtocolServer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Optional
+
+from ..core.serialize import flow_from_dict
+from ..runtime.engine import DeployEngine, DeployRequest
+from .agent_registry import BUILD_TIMEOUT, DEPLOY_TIMEOUT
+from .log_router import LogEntry, topic_for
+from .models import (Alert, BuildJob, BuildStatus, CostEntry, Deployment,
+                     DeploymentStatus, DnsRecord, ObservedContainer, Project,
+                     Server, StageRecord, Tenant, TenantUser, VolumeRecord,
+                     VolumeSnapshot, WorkerPool, now_ts)
+from .protocol import Connection, ProtocolServer
+
+if TYPE_CHECKING:
+    from .server import AppState
+
+__all__ = ["register_all"]
+
+
+def _require(payload: dict, *keys: str) -> list:
+    missing = [k for k in keys if k not in payload]
+    if missing:
+        raise ValueError(f"missing fields: {missing}")
+    return [payload[k] for k in keys]
+
+
+def register_all(server: ProtocolServer, state: "AppState") -> None:
+    """handlers/mod.rs register_all:21-35."""
+    server.register_channel("tenant", _tenant(state))
+    server.register_channel("project", _project(state))
+    server.register_channel("stage", _stage(state))
+    server.register_channel("service", _service(state))
+    server.register_channel("container", _container(state))
+    server.register_channel("server", _server(state))
+    server.register_channel("health", _health(state))
+    server.register_channel("cost", _cost(state))
+    server.register_channel("dns", _dns(state))
+    server.register_channel("deploy", _deploy(state))
+    server.register_channel("volume", _volume(state))
+    server.register_channel("build", _build(state))
+    server.register_channel("placement", _placement(state))
+    agent_handler, agent_events = _agent(state)
+    server.register_channel("agent", agent_handler, agent_events)
+    server.on_disconnect = _on_disconnect(state)
+
+
+# --------------------------------------------------------------------------
+# simple CRUD channels
+# --------------------------------------------------------------------------
+
+def _tenant(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "create":
+            (name,) = _require(p, "name")
+            t = db.create("tenants", Tenant(
+                name=name, display_name=p.get("display_name", name)))
+            return {"tenant": t.to_dict()}
+        if method == "list":
+            return {"tenants": [t.to_dict() for t in db.list("tenants")]}
+        if method == "get":
+            t = db.tenant_by_name(p.get("name", ""))
+            return {"tenant": t.to_dict() if t else None}
+        if method == "delete":
+            t = db.tenant_by_name(p.get("name", ""))
+            return {"deleted": bool(t and db.delete("tenants", t.id))}
+        if method == "secret.set":
+            name, key, value = _require(p, "name", "key", "value")
+            t = db.ensure_tenant(name)
+            secrets = dict(t.secrets)
+            secrets[key] = (state.secret_box.encrypt(value, aad=name)
+                            if state.secret_box else value)
+            db.update("tenants", t.id, secrets=secrets)
+            return {"ok": True}
+        if method == "secret.get":
+            name, key = _require(p, "name", "key")
+            t = db.tenant_by_name(name)
+            if t is None or key not in t.secrets:
+                return {"value": None}
+            v = t.secrets[key]
+            return {"value": state.secret_box.decrypt(v, aad=name)
+                    if state.secret_box else v}
+        if method == "user.add":
+            tenant, email = _require(p, "tenant", "email")
+            u = db.create("tenant_users", TenantUser(
+                tenant=tenant, email=email, role=p.get("role", "member")))
+            return {"user": u.to_dict()}
+        if method == "user.list":
+            return {"users": [u.to_dict()
+                              for u in db.tenant_users(p.get("tenant", ""))]}
+        if method == "user.remove":
+            tenant, email = _require(p, "tenant", "email")
+            u = db.user_by_email(tenant, email)
+            return {"removed": bool(u and db.delete("tenant_users", u.id))}
+        raise ValueError(f"unknown method tenant.{method}")
+    return handle
+
+
+def _project(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "create":
+            (name,) = _require(p, "name")
+            rec = db.create("projects", Project(
+                tenant=p.get("tenant", "default"), name=name,
+                description=p.get("description", "")))
+            return {"project": rec.to_dict()}
+        if method == "list":
+            tenant = p.get("tenant")
+            return {"projects": [r.to_dict() for r in db.list(
+                "projects", lambda r: tenant is None or r.tenant == tenant)]}
+        if method == "get":
+            rec = db.project_by_name(p.get("tenant", "default"),
+                                     p.get("name", ""))
+            return {"project": rec.to_dict() if rec else None}
+        if method == "delete":
+            rec = db.project_by_name(p.get("tenant", "default"),
+                                     p.get("name", ""))
+            return {"deleted": bool(rec and db.delete("projects", rec.id))}
+        raise ValueError(f"unknown method project.{method}")
+    return handle
+
+
+def _stage(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "list":
+            project = p.get("project", "")
+            return {"stages": [s.to_dict() for s in db.stages_of(project)]}
+        if method == "ensure":
+            project, name = _require(p, "project", "name")
+            s = db.ensure_stage(project, name,
+                                backend=p.get("backend", "docker"),
+                                servers=p.get("servers", []))
+            return {"stage": s.to_dict()}
+        if method == "status":
+            # aggregate: services + last deployment + active alerts
+            sid = p.get("stage", "")
+            services = [s.to_dict() for s in db.services_of(sid)]
+            deps = db.deployment_history(stage=sid, limit=1)
+            stage = db.get("stages", sid)
+            alerts = []
+            if stage is not None:
+                alerts = [a.to_dict() for a in db.active_alerts()
+                          if any(a.server == srv for srv in stage.servers)]
+            return {"services": services,
+                    "last_deployment": deps[0].to_dict() if deps else None,
+                    "alerts": alerts}
+        if method == "adopt":
+            (sid,) = _require(p, "stage")
+            s = db.adopt_stage(sid)
+            return {"stage": s.to_dict() if s else None}
+        if method == "delete":
+            return {"deleted": db.delete("stages", p.get("stage", ""))}
+        raise ValueError(f"unknown method stage.{method}")
+    return handle
+
+
+def _service(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "list":
+            return {"services": [s.to_dict()
+                                 for s in db.services_of(p.get("stage", ""))]}
+        if method == "restart":
+            server, container = _require(p, "server", "container")
+            result = await state.agent_registry.send_command(
+                server, "restart", {"container": container})
+            return {"result": result}
+        raise ValueError(f"unknown method service.{method}")
+    return handle
+
+
+def _container(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "ps":
+            server = p.get("server")
+            rows = (db.observed_on(server) if server
+                    else db.list("observed_containers"))
+            return {"containers": [r.to_dict() for r in rows]}
+        if method == "logs":
+            server, container = _require(p, "server", "container")
+            entries = state.log_router.retained(
+                topic_for(server, container), limit=p.get("limit"))
+            return {"lines": [e.to_dict() for e in entries]}
+        raise ValueError(f"unknown method container.{method}")
+    return handle
+
+
+def _server(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "register":
+            (slug,) = _require(p, "slug")
+            rec = db.register_server(
+                slug, tenant=p.get("tenant", "default"),
+                hostname=p.get("hostname", slug),
+                provider=p.get("provider"))
+            if "capacity" in p:
+                cap = type(rec.capacity)(**p["capacity"])
+                db.update("servers", rec.id, capacity=cap)
+            if "labels" in p:
+                lbl = type(rec.labels)(**p["labels"])
+                db.update("servers", rec.id, labels=lbl)
+            return {"server": db.get("servers", rec.id).to_dict()}
+        if method == "list":
+            tenant = p.get("tenant")
+            return {"servers": [s.to_dict() for s in db.list(
+                "servers", lambda s: tenant is None or s.tenant == tenant)]}
+        if method == "get":
+            s = db.server_by_slug(p.get("slug", ""))
+            return {"server": s.to_dict() if s else None}
+        if method == "delete":
+            s = db.server_by_slug(p.get("slug", ""))
+            return {"deleted": bool(s and db.delete("servers", s.id))}
+        if method in ("cordon", "uncordon", "drain"):
+            s = db.server_by_slug(p.get("slug", ""))
+            if s is None:
+                return {"ok": False}
+            new_state = {"cordon": "cordoned", "uncordon": "schedulable",
+                         "drain": "draining"}[method]
+            db.update("servers", s.id, scheduling_state=new_state)
+            if method == "drain":
+                state.placement.node_event(s.slug, online=False)
+            return {"ok": True, "scheduling_state": new_state}
+        if method == "check_all":
+            # bulk connectivity: agent-connected -> online
+            statuses = {s.slug: ("online" if state.agent_registry.is_connected(s.slug)
+                                 else "offline")
+                        for s in db.list("servers")}
+            n = db.bulk_server_status(statuses)
+            return {"updated": n, "statuses": statuses}
+        if method == "pool.create":
+            (name,) = _require(p, "name")
+            pool = db.create("worker_pools", WorkerPool(
+                tenant=p.get("tenant", "default"), name=name,
+                required_labels=p.get("required_labels", {}),
+                preferred_labels=p.get("preferred_labels", {})))
+            return {"pool": pool.to_dict()}
+        if method == "pool.list":
+            return {"pools": [w.to_dict() for w in db.list("worker_pools")]}
+        raise ValueError(f"unknown method server.{method}")
+    return handle
+
+
+def _health(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "ping":
+            return {"pong": True, "ts": now_ts()}
+        if method == "overview":
+            servers = db.list("servers")
+            online = [s for s in servers if s.status == "online"]
+            return {
+                "servers": len(servers),
+                "online": len(online),
+                "agents": state.agent_registry.list_connected(),
+                "projects": len(db.list("projects")),
+                "deployments": len(db.list("deployments")),
+                "active_alerts": len(db.active_alerts()),
+            }
+        raise ValueError(f"unknown method health.{method}")
+    return handle
+
+
+def _cost(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "add":
+            month, amount = _require(p, "month", "amount")
+            rec = db.create("cost_entries", CostEntry(
+                tenant=p.get("tenant", "default"), server=p.get("server", ""),
+                provider=p.get("provider", ""), month=month,
+                amount=float(amount), currency=p.get("currency", "USD")))
+            return {"entry": rec.to_dict()}
+        if method == "summary":
+            (month,) = _require(p, "month")
+            tenant = p.get("tenant", "default")
+            return {"month": month, "tenant": tenant,
+                    "total": state.store.monthly_cost(tenant, month)}
+        raise ValueError(f"unknown method cost.{method}")
+    return handle
+
+
+def _dns(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "create":
+            zone, name, content = _require(p, "zone", "name", "content")
+            rec = db.create("dns_records", DnsRecord(
+                tenant=p.get("tenant", "default"), zone=zone, name=name,
+                type=p.get("record_type", "A"), content=content,
+                ttl=p.get("ttl", 300), proxied=p.get("proxied", False)))
+            return {"record": rec.to_dict()}
+        if method == "list":
+            zone = p.get("zone")
+            return {"records": [r.to_dict() for r in db.list(
+                "dns_records", lambda r: zone is None or r.zone == zone)]}
+        if method == "delete":
+            return {"deleted": db.delete("dns_records", p.get("id", ""))}
+        if method == "sync":
+            # push unsynced records through the cloud DNS adapter when wired
+            pending = db.list("dns_records", lambda r: not r.synced)
+            synced = 0
+            for rec in pending:
+                if state.dns_backend is not None:
+                    state.dns_backend.ensure_record(
+                        rec.zone, rec.name, rec.type, rec.content,
+                        ttl=rec.ttl, proxied=rec.proxied)
+                db.update("dns_records", rec.id, synced=True)
+                synced += 1
+            return {"synced": synced}
+        raise ValueError(f"unknown method dns.{method}")
+    return handle
+
+
+# --------------------------------------------------------------------------
+# deploy channel (handlers/deploy.rs)
+# --------------------------------------------------------------------------
+
+def _deploy(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "history":
+            return {"deployments": [d.to_dict() for d in db.deployment_history(
+                stage=p.get("stage"), limit=p.get("limit", 50))]}
+        if method == "execute":
+            req = DeployRequest.from_dict(p["request"])
+            tenant_name = p.get("tenant", "default")
+            tenant = db.ensure_tenant(tenant_name)
+            project = db.ensure_project(tenant.name, req.flow.name)
+            stage_cfg = req.flow.stage(req.stage_name)
+            stage = db.ensure_stage(project.id, req.stage_name,
+                                    backend=stage_cfg.backend.value,
+                                    servers=stage_cfg.servers)
+            dep = db.create("deployments", Deployment(
+                tenant=tenant.name, project=project.id, stage=stage.id,
+                status=DeploymentStatus.RUNNING.value,
+                services=[s.name for s in stage_cfg.resolved_services(req.flow)]))
+
+            targets = [s for s in stage_cfg.servers
+                       if state.agent_registry.is_connected(s)]
+            try:
+                if targets:
+                    # Fan out to EVERY connected stage server concurrently —
+                    # the reference routes to .first() only and defers fan-out
+                    # (handlers/deploy.rs:386-398); the placement solve makes
+                    # per-node slices explicit, so we send each agent its own.
+                    placement, rid = await asyncio.get_running_loop(
+                        ).run_in_executor(None, lambda: state.placement
+                                          .solve_stage(req.flow, req.stage_name,
+                                                       tenant=tenant.name))
+                    if not placement.feasible:
+                        raise ValueError(
+                            f"placement infeasible: {placement.violations}")
+                    results = await asyncio.gather(*[
+                        state.agent_registry.send_command(
+                            slug, "deploy.execute",
+                            {"request": DeployRequest(
+                                flow=req.flow, stage_name=req.stage_name,
+                                target_services=req.target_services,
+                                no_pull=req.no_pull, no_prune=req.no_prune,
+                                node=slug).to_dict(),
+                             "assignment": placement.assignment},
+                            timeout=DEPLOY_TIMEOUT)
+                        for slug in targets], return_exceptions=True)
+                    errors = [str(r) for r in results if isinstance(r, Exception)]
+                    if errors:
+                        if rid:
+                            state.placement.release(rid)
+                        raise ValueError("; ".join(errors))
+                    if rid:
+                        state.placement.commit(rid)
+                    log = "\n".join(str(r) for r in results
+                                    if not isinstance(r, Exception))
+                    db.update("deployments", dep.id,
+                              placement=placement.assignment)
+                else:
+                    # CP-local execution (handlers/deploy.rs:470-507)
+                    engine = DeployEngine(state.backend_factory(),
+                                          sleep=state.deploy_sleep)
+                    res = await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: engine.execute(req))
+                    if not res.ok:
+                        raise ValueError(f"failed services: {res.failed}")
+                    log = f"deployed {len(res.deployed)} containers locally"
+                for svc in (db.get("deployments", dep.id).services or []):
+                    db.upsert_service(stage.id, svc, status="deployed")
+                db.finish_deployment(dep.id, DeploymentStatus.SUCCEEDED, log=log)
+            except Exception as e:
+                db.finish_deployment(dep.id, DeploymentStatus.FAILED,
+                                     error=str(e))
+                raise
+            return {"deployment": db.get("deployments", dep.id).to_dict()}
+        raise ValueError(f"unknown method deploy.{method}")
+    return handle
+
+
+# --------------------------------------------------------------------------
+# placement channel (TPU solver surface — no reference analog)
+# --------------------------------------------------------------------------
+
+def _placement(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        if method == "solve":
+            flow = flow_from_dict(p["flow"])
+            # executor: a fleet-scale solve must not stall heartbeats and
+            # command_result traffic on the loop (PlacementService locks
+            # with threading.Lock, so it is thread-safe)
+            placement, rid = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.placement.solve_stage(
+                    flow, p["stage"], tenant=p.get("tenant", "default"),
+                    reserve=p.get("reserve", False)))
+            return {"assignment": placement.assignment,
+                    "feasible": placement.feasible,
+                    "violations": placement.violations,
+                    "source": placement.source,
+                    "solve_ms": placement.solve_ms,
+                    "reservation": rid}
+        if method == "node_event":
+            slug, online = _require(p, "slug", "online")
+            moved = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.placement.node_event(
+                    slug, online=bool(online)))
+            return {"rescheduled": [
+                {"stage": key, "assignment": pl.assignment,
+                 "feasible": pl.feasible} for key, pl in moved]}
+        if method == "commit":
+            return {"ok": state.placement.commit(p.get("reservation", ""))}
+        if method == "release":
+            return {"ok": state.placement.release(p.get("reservation", ""))}
+        raise ValueError(f"unknown method placement.{method}")
+    return handle
+
+
+# --------------------------------------------------------------------------
+# volume / build channels
+# --------------------------------------------------------------------------
+
+def _volume(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "list":
+            server = p.get("server")
+            return {"volumes": [v.to_dict() for v in db.list(
+                "volumes", lambda v: server is None or v.server == server)]}
+        if method == "adopt":
+            server, name = _require(p, "server", "name")
+            v = db.find_one("volumes",
+                            lambda r: r.server == server and r.name == name)
+            if v is None:
+                v = db.create("volumes", VolumeRecord(
+                    tenant=p.get("tenant", "default"), server=server,
+                    name=name, adopted=True))
+            else:
+                db.update("volumes", v.id, adopted=True)
+            return {"volume": db.get("volumes", v.id).to_dict()}
+        if method == "snapshot":
+            (vol_id,) = _require(p, "volume")
+            snap = db.create("volume_snapshots", VolumeSnapshot(
+                volume=vol_id, label=p.get("label", "")))
+            return {"snapshot": snap.to_dict()}
+        if method == "snapshots":
+            vol = p.get("volume")
+            return {"snapshots": [s.to_dict() for s in db.list(
+                "volume_snapshots", lambda s: vol is None or s.volume == vol)]}
+        raise ValueError(f"unknown method volume.{method}")
+    return handle
+
+
+def _build(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "submit":
+            repo, image_tag = _require(p, "repo", "image_tag")
+            job = db.create("build_jobs", BuildJob(
+                tenant=p.get("tenant", "default"), repo=repo,
+                ref=p.get("ref", "main"), dockerfile=p.get("dockerfile"),
+                context=p.get("context", "."), image_tag=image_tag,
+                push=p.get("push", False)))
+            # route to a connected build worker if any
+            workers = state.agent_registry.list_connected()
+            if workers:
+                worker = workers[0]
+                db.update("build_jobs", job.id,
+                          status=BuildStatus.RUNNING.value, worker=worker)
+                task = asyncio.ensure_future(_run_build(state, job.id, worker))
+                state.bg_tasks.add(task)   # strong ref; loop refs are weak
+                task.add_done_callback(state.bg_tasks.discard)
+            return {"job": db.get("build_jobs", job.id).to_dict()}
+        if method == "show":
+            job = db.get("build_jobs", p.get("job", ""))
+            return {"job": job.to_dict() if job else None}
+        if method == "list":
+            return {"jobs": [j.to_dict() for j in db.list("build_jobs")]}
+        if method == "logs":
+            job = db.get("build_jobs", p.get("job", ""))
+            return {"log": job.log if job else ""}
+        if method == "cancel":
+            job = db.get("build_jobs", p.get("job", ""))
+            if job and job.status in (BuildStatus.QUEUED.value,
+                                      BuildStatus.RUNNING.value):
+                db.update("build_jobs", job.id,
+                          status=BuildStatus.CANCELLED.value)
+                return {"cancelled": True}
+            return {"cancelled": False}
+        raise ValueError(f"unknown method build.{method}")
+    return handle
+
+
+async def _run_build(state: "AppState", job_id: str, worker: str) -> None:
+    db = state.store
+    job = db.get("build_jobs", job_id)
+    try:
+        result = await state.agent_registry.send_command(
+            worker, "build", {
+                "repo": job.repo, "ref": job.ref,
+                "dockerfile": job.dockerfile, "context": job.context,
+                "image_tag": job.image_tag, "push": job.push},
+            timeout=BUILD_TIMEOUT)
+        status, extra = BuildStatus.SUCCEEDED.value, {
+            "log": str(result.get("log", ""))}
+    except Exception as e:
+        status, extra = BuildStatus.FAILED.value, {"error": str(e)}
+    # a cancel that raced the build wins: don't resurrect a cancelled job
+    if db.get("build_jobs", job_id).status == BuildStatus.CANCELLED.value:
+        return
+    db.update("build_jobs", job_id, status=status, finished_at=now_ts(),
+              **extra)
+
+
+# --------------------------------------------------------------------------
+# agent channel (the duplex session, handlers/agent.rs)
+# --------------------------------------------------------------------------
+
+def _agent(state: "AppState"):
+    registered: dict[int, str] = {}   # id(conn) -> slug
+    state._agent_conn_slugs = registered
+
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        db = state.store
+        if method == "register":
+            (slug,) = _require(p, "slug")
+            state.agent_registry.register(slug, conn)
+            registered[id(conn)] = slug
+            db.register_server(slug, hostname=p.get("hostname", slug))
+            db.heartbeat(slug, version=p.get("version", ""))
+            if "capacity" in p:
+                s = db.server_by_slug(slug)
+                db.update("servers", s.id,
+                          capacity=type(s.capacity)(**p["capacity"]))
+            return {"registered": True, "server": state.name}
+        # register-first enforcement (handlers/agent.rs:28-63)
+        if id(conn) not in registered:
+            raise PermissionError("agent must register before other methods")
+        slug = registered[id(conn)]
+        if method == "heartbeat":
+            db.heartbeat(slug, version=p.get("version", ""))
+            return {"ok": True}
+        raise ValueError(f"unknown method agent.{method}")
+
+    async def events(conn: Connection, method: str, p: dict) -> None:
+        db = state.store
+        slug = registered.get(id(conn))
+        if slug is None:
+            return  # events from unregistered connections are dropped
+        if method == "heartbeat":
+            db.heartbeat(slug, version=p.get("version", ""))
+        elif method == "alert":
+            kind = p.get("kind", "unknown")
+            if p.get("resolved"):
+                db.resolve_alert(slug, p.get("container", ""), kind)
+            else:
+                db.upsert_alert(slug, p.get("container", ""), kind,
+                                p.get("message", ""))
+        elif method == "command_result":
+            rid = p.get("request_id")
+            if rid:
+                state.agent_registry.resolve_result(rid, p)
+        elif method == "log":
+            state.log_router.publish(LogEntry(
+                topic=topic_for(slug, p.get("container", "?")),
+                line=p.get("line", ""), level=p.get("level", "info")))
+        elif method == "inventory":
+            rows = [ObservedContainer(
+                server=slug, name=r.get("name", ""), image=r.get("image", ""),
+                state=r.get("state", ""), health=r.get("health"),
+                restart_count=r.get("restart_count", 0),
+                project=r.get("project"), stage=r.get("stage"),
+                service=r.get("service"), runtime=r.get("runtime", "docker"))
+                for r in p.get("containers", [])]
+            db.replace_observed(slug, rows)
+
+    return handle, events
+
+
+def _on_disconnect(state: "AppState"):
+    async def on_disconnect(conn: Connection) -> None:
+        registered: dict[int, str] = getattr(state, "_agent_conn_slugs", {})
+        slug = registered.pop(id(conn), None)
+        if slug is not None:
+            state.agent_registry.unregister(slug, conn)
+            # fast reconnect: a newer session may already own the slug
+            # (agent_registry.rs:51-53) — don't mark a live agent offline
+            if not state.agent_registry.is_connected(slug):
+                s = state.store.server_by_slug(slug)
+                if s is not None:
+                    state.store.update("servers", s.id, status="offline")
+    return on_disconnect

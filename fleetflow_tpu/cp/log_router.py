@@ -1,0 +1,110 @@
+"""Log router: pub/sub fan-out for container logs.
+
+Analog of controlplane log_router.rs: topics named
+`logs/{server}/{container}`, a retained ring buffer of 200 lines per topic
+(:31), and subscribers with topic-prefix + minimum-level filters (:48-67).
+Subscribers are asyncio queues; slow consumers drop oldest (bounded queues
+never block the publisher — same motivation as the reference's lock-scope
+discipline, agent_registry.rs:104-112).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .models import now_ts
+
+__all__ = ["LogEntry", "LogRouter", "RETAIN_LINES"]
+
+RETAIN_LINES = 200  # log_router.rs:31
+
+_LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
+
+
+@dataclass
+class LogEntry:
+    """log_router.rs:19."""
+    topic: str
+    line: str
+    level: str = "info"
+    ts: float = field(default_factory=now_ts)
+
+    def to_dict(self) -> dict:
+        return {"topic": self.topic, "line": self.line,
+                "level": self.level, "ts": self.ts}
+
+
+def topic_for(server: str, container: str) -> str:
+    return f"logs/{server}/{container}"
+
+
+@dataclass
+class _Subscriber:
+    id: int
+    prefix: str
+    min_level: int
+    queue: asyncio.Queue
+
+
+class LogRouter:
+    def __init__(self, retain: int = RETAIN_LINES, queue_size: int = 1000):
+        self._retained: dict[str, deque[LogEntry]] = {}
+        self._subs: dict[int, _Subscriber] = {}
+        self._ids = itertools.count(1)
+        self.retain = retain
+        self.queue_size = queue_size
+
+    # ------------------------------------------------------------------
+    def publish(self, entry: LogEntry) -> int:
+        """Retain + fan out; returns delivered count (log_router.rs:67)."""
+        ring = self._retained.setdefault(entry.topic,
+                                         deque(maxlen=self.retain))
+        ring.append(entry)
+        delivered = 0
+        lvl = _LEVELS.get(entry.level, 2)
+        for sub in self._subs.values():
+            if not entry.topic.startswith(sub.prefix):
+                continue
+            if lvl < sub.min_level:
+                continue
+            if sub.queue.full():        # drop oldest, never block
+                try:
+                    sub.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+            sub.queue.put_nowait(entry)
+            delivered += 1
+        return delivered
+
+    def publish_line(self, server: str, container: str, line: str,
+                     level: str = "info") -> int:
+        return self.publish(LogEntry(topic=topic_for(server, container),
+                                     line=line, level=level))
+
+    # ------------------------------------------------------------------
+    def subscribe(self, prefix: str = "logs/",
+                  min_level: str = "trace") -> tuple[int, asyncio.Queue]:
+        sid = next(self._ids)
+        sub = _Subscriber(id=sid, prefix=prefix,
+                          min_level=_LEVELS.get(min_level, 0),
+                          queue=asyncio.Queue(self.queue_size))
+        self._subs[sid] = sub
+        return sid, sub.queue
+
+    def unsubscribe(self, sid: int) -> None:
+        self._subs.pop(sid, None)
+
+    # ------------------------------------------------------------------
+    def retained(self, topic: str, limit: Optional[int] = None) -> list[LogEntry]:
+        """The cached tail served to CLI/MCP/REST without touching the agent
+        (web.rs:1074; mcp lib.rs:878)."""
+        ring = self._retained.get(topic, ())
+        rows = list(ring)
+        return rows[-limit:] if limit else rows
+
+    def topics(self, prefix: str = "logs/") -> list[str]:
+        return sorted(t for t in self._retained if t.startswith(prefix))

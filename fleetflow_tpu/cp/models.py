@@ -1,0 +1,361 @@
+"""Control-plane records.
+
+Analog of fleetflow-controlplane model.rs (SURVEY.md §2.4): tenants, users,
+projects, stages, services, servers (labels/capacity/allocation/scheduling
+state), worker pools, deployments, alerts, observed containers, volumes +
+snapshots, build jobs, cost entries, DNS records. Placement policy types are
+shared with the config layer (core.model), since this build surfaces them in
+stage config too.
+
+Records serialize with dataclasses.asdict-style plain dicts via `to_dict`/
+`from_dict` so they ride the wire protocol and the store's JSON snapshots.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import asdict, dataclass, field, fields
+from typing import Optional
+
+from ..core.model import PlacementPolicy, ResourceSpec  # noqa: F401  (re-export)
+
+__all__ = [
+    "now_ts", "new_id", "Record", "Tenant", "TenantRole", "TenantUser",
+    "Project", "StageRecord", "ServiceRecord", "SchedulingState",
+    "DesiredState", "ServerLabelsRec", "ServerCapacity", "ServerAllocated",
+    "Server", "WorkerPool", "DeploymentStatus", "Deployment", "AlertKind",
+    "Alert", "ObservedContainer", "VolumeRecord", "VolumeSnapshot",
+    "BuildStatus", "BuildJob", "CostEntry", "DnsRecord",
+]
+
+
+def now_ts() -> float:
+    return time.time()
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}_{uuid.uuid4().hex[:12]}"
+
+
+@dataclass
+class Record:
+    """Base: id + timestamps; subclasses add their fields."""
+    id: str = ""
+    created_at: float = field(default_factory=now_ts)
+    updated_at: float = field(default_factory=now_ts)
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        for k, v in list(d.items()):
+            if isinstance(v, enum.Enum):
+                d[k] = v.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        known = {f.name: f for f in fields(cls)}
+        kwargs = {}
+        for k, v in d.items():
+            if k not in known:
+                continue
+            t = known[k].type
+            # enum-typed fields round-trip from their value strings
+            kwargs[k] = v
+        obj = cls(**kwargs)
+        obj._coerce()
+        return obj
+
+    def _coerce(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Tenancy (model.rs:18,111,143)
+# --------------------------------------------------------------------------
+
+@dataclass
+class Tenant(Record):
+    name: str = ""
+    display_name: str = ""
+    secrets: dict[str, str] = field(default_factory=dict)  # name -> ciphertext
+
+
+class TenantRole(str, enum.Enum):
+    OWNER = "owner"
+    ADMIN = "admin"
+    MEMBER = "member"
+    VIEWER = "viewer"
+
+
+@dataclass
+class TenantUser(Record):
+    tenant: str = ""
+    email: str = ""
+    role: str = TenantRole.MEMBER.value
+
+    def can_write(self) -> bool:
+        return self.role in (TenantRole.OWNER.value, TenantRole.ADMIN.value,
+                             TenantRole.MEMBER.value)
+
+    def can_admin(self) -> bool:
+        return self.role in (TenantRole.OWNER.value, TenantRole.ADMIN.value)
+
+
+# --------------------------------------------------------------------------
+# Project / stage / service (model.rs:215,240,331)
+# --------------------------------------------------------------------------
+
+@dataclass
+class Project(Record):
+    tenant: str = ""
+    name: str = ""
+    description: str = ""
+
+
+@dataclass
+class StageRecord(Record):
+    project: str = ""               # project id
+    name: str = ""
+    backend: str = "docker"
+    servers: list[str] = field(default_factory=list)
+    placement: Optional[dict] = None   # serialized PlacementPolicy
+    adopted: bool = False              # stage adoption flow (db.rs:480)
+
+
+@dataclass
+class ServiceRecord(Record):
+    stage: str = ""                 # stage id
+    name: str = ""
+    image: str = ""
+    status: str = "unknown"
+    desired_replicas: int = 1
+
+
+# --------------------------------------------------------------------------
+# Servers / pools (model.rs:395-563)
+# --------------------------------------------------------------------------
+
+class SchedulingState(str, enum.Enum):
+    """model.rs:435-442."""
+    SCHEDULABLE = "schedulable"
+    CORDONED = "cordoned"
+    DRAINING = "draining"
+
+
+class DesiredState(str, enum.Enum):
+    """model.rs:446."""
+    ACTIVE = "active"
+    STOPPED = "stopped"
+    TERMINATED = "terminated"
+
+
+@dataclass
+class ServerLabelsRec:
+    """model.rs:400."""
+    tier: Optional[str] = None
+    region: Optional[str] = None
+    clazz: Optional[str] = None
+    arch: Optional[str] = None
+    extra: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServerCapacity:
+    """model.rs:415 — cpu cores, memory MiB, disk MiB."""
+    cpu: float = 2.0
+    memory: float = 4096.0
+    disk: float = 40960.0
+
+
+@dataclass
+class ServerAllocated:
+    """Two-phase commit/release of reserved resources (model.rs:421-427):
+    `reserved` holds in-flight placements until the deploy confirms, then
+    moves into `committed`. The reservation journal in placement.py is the
+    authoritative racing-re-solve guard (SURVEY.md hard part (c))."""
+    cpu: float = 0.0
+    memory: float = 0.0
+    disk: float = 0.0
+    reserved_cpu: float = 0.0
+    reserved_memory: float = 0.0
+    reserved_disk: float = 0.0
+
+
+@dataclass
+class Server(Record):
+    tenant: str = ""
+    slug: str = ""
+    hostname: str = ""
+    provider: Optional[str] = None
+    status: str = "unknown"         # online|offline|unknown
+    agent_version: str = ""
+    last_heartbeat: float = 0.0
+    labels: ServerLabelsRec = field(default_factory=ServerLabelsRec)
+    capacity: ServerCapacity = field(default_factory=ServerCapacity)
+    allocated: ServerAllocated = field(default_factory=ServerAllocated)
+    scheduling_state: str = SchedulingState.SCHEDULABLE.value
+    desired_state: str = DesiredState.ACTIVE.value
+    pool: Optional[str] = None
+
+    def _coerce(self) -> None:
+        if isinstance(self.labels, dict):
+            self.labels = ServerLabelsRec(**self.labels)
+        if isinstance(self.capacity, dict):
+            self.capacity = ServerCapacity(**self.capacity)
+        if isinstance(self.allocated, dict):
+            self.allocated = ServerAllocated(**self.allocated)
+
+    @property
+    def schedulable(self) -> bool:
+        return (self.scheduling_state == SchedulingState.SCHEDULABLE.value
+                and self.status == "online")
+
+
+@dataclass
+class WorkerPool(Record):
+    """model.rs:552-563."""
+    tenant: str = ""
+    name: str = ""
+    required_labels: dict[str, str] = field(default_factory=dict)
+    preferred_labels: dict[str, str] = field(default_factory=dict)
+    min_servers: int = 0
+    max_servers: int = 0
+
+
+# --------------------------------------------------------------------------
+# Deployments (model.rs:639)
+# --------------------------------------------------------------------------
+
+class DeploymentStatus(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclass
+class Deployment(Record):
+    tenant: str = ""
+    project: str = ""
+    stage: str = ""
+    status: str = DeploymentStatus.PENDING.value
+    services: list[str] = field(default_factory=list)
+    server: Optional[str] = None
+    log: str = ""
+    error: str = ""
+    placement: Optional[dict] = None   # assignment snapshot
+    finished_at: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Alerts / observation (model.rs:168,373)
+# --------------------------------------------------------------------------
+
+class AlertKind(str, enum.Enum):
+    RESTART_LOOP = "restart_loop"
+    UNEXPECTED_STOP = "unexpected_stop"
+    UNHEALTHY = "unhealthy"
+    NODE_OFFLINE = "node_offline"
+
+
+@dataclass
+class Alert(Record):
+    tenant: str = ""
+    server: str = ""
+    container: str = ""
+    kind: str = ""
+    message: str = ""
+    active: bool = True
+    resolved_at: float = 0.0
+
+
+@dataclass
+class ObservedContainer(Record):
+    """Desired-vs-observed reconciliation input (model.rs:373)."""
+    server: str = ""
+    name: str = ""
+    image: str = ""
+    state: str = ""
+    health: Optional[str] = None
+    restart_count: int = 0
+    project: Optional[str] = None   # fleetflow label attribution
+    stage: Optional[str] = None
+    service: Optional[str] = None
+    runtime: str = "docker"         # docker | podman | podman-rootless
+
+
+# --------------------------------------------------------------------------
+# Volumes (model.rs:743,793)
+# --------------------------------------------------------------------------
+
+@dataclass
+class VolumeRecord(Record):
+    tenant: str = ""
+    server: str = ""
+    name: str = ""
+    driver: str = "local"
+    size_mb: float = 0.0
+    adopted: bool = False
+
+
+@dataclass
+class VolumeSnapshot(Record):
+    volume: str = ""
+    label: str = ""
+    size_mb: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Builds (model.rs:881)
+# --------------------------------------------------------------------------
+
+class BuildStatus(str, enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class BuildJob(Record):
+    tenant: str = ""
+    repo: str = ""
+    ref: str = "main"
+    dockerfile: Optional[str] = None
+    context: str = "."
+    image_tag: str = ""
+    push: bool = False
+    status: str = BuildStatus.QUEUED.value
+    worker: Optional[str] = None
+    log: str = ""
+    error: str = ""
+    finished_at: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Cost / DNS (model.rs:579,611)
+# --------------------------------------------------------------------------
+
+@dataclass
+class CostEntry(Record):
+    tenant: str = ""
+    server: str = ""
+    provider: str = ""
+    month: str = ""                 # "2026-07"
+    amount: float = 0.0
+    currency: str = "USD"
+
+
+@dataclass
+class DnsRecord(Record):
+    tenant: str = ""
+    zone: str = ""
+    name: str = ""
+    type: str = "A"
+    content: str = ""
+    ttl: int = 300
+    proxied: bool = False
+    synced: bool = False

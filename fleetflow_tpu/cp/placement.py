@@ -1,0 +1,228 @@
+"""Placement service: the CP's solver front-end + reservation journal.
+
+This is the component the reference lacks (its CP picks
+`stage.servers.first`, handlers/deploy.rs:386-398, with fan-out "future
+work"). Here the CP lowers the fleet against its *live* server inventory
+(capacity minus committed+reserved allocations, label/pool eligibility,
+cordon/drain masks) and solves on-device.
+
+The reservation journal implements the 2-phase commit the reference sketches
+in `ServerAllocated` (model.rs:421-427) and solves SURVEY.md hard part (c):
+a solve RESERVES its assignment; the deploy either COMMITs (moving reserved
+-> committed) or RELEASEs on failure. Concurrent re-solves see reserved
+capacity as occupied, so racing placements can't double-book a node.
+
+Churn handling (BASELINE config 5): `node_event` flips the validity bit and
+triggers an incremental warm-start re-solve that moves only what churn
+forces (solver migration stickiness).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..core.model import Flow, ResourceSpec, ServerLabels, ServerResource
+from ..lower.tensors import ProblemTensors, lower_stage
+from ..sched import HostGreedyScheduler, Placement, TpuSolverScheduler
+from .models import Server
+from .store import Store
+
+__all__ = ["PlacementService", "Reservation"]
+
+
+@dataclass
+class Reservation:
+    id: str
+    stage_key: str                      # "{project}/{stage}"
+    demand_by_node: dict[str, np.ndarray]   # node slug -> (R,) reserved demand
+    assignment: dict[str, str]
+    committed: bool = False
+
+
+def _server_to_resource(s: Server) -> ServerResource:
+    return ServerResource(
+        name=s.slug,
+        capacity=ResourceSpec(cpu=s.capacity.cpu, memory=s.capacity.memory,
+                              disk=s.capacity.disk),
+        labels=ServerLabels(tier=s.labels.tier, region=s.labels.region,
+                            clazz=s.labels.clazz, arch=s.labels.arch,
+                            extra=dict(s.labels.extra)),
+    )
+
+
+class PlacementService:
+    def __init__(self, store: Store, *, use_tpu: bool = False,
+                 chains: int = 4, steps: int = 1500):
+        self.store = store
+        self.use_tpu = use_tpu
+        self._sched_tpu = TpuSolverScheduler(chains=chains, steps=steps)
+        self._sched_host = HostGreedyScheduler()
+        self._lock = threading.Lock()
+        self._reservations: dict[str, Reservation] = {}
+        self._ids = itertools.count(1)
+        self._last: dict[str, tuple[ProblemTensors, Placement]] = {}
+
+    # ------------------------------------------------------------------
+    # inventory lowering
+    # ------------------------------------------------------------------
+
+    def _inventory(self, tenant: str,
+                   slugs: Optional[list[str]] = None
+                   ) -> tuple[list[ServerResource], np.ndarray]:
+        """Live nodes + validity mask, with reserved+committed demand
+        subtracted from capacity."""
+        # a tenant sees its own servers plus the shared "default" pool;
+        # "default" solves never touch tenant-dedicated capacity
+        servers = self.store.list(
+            "servers", lambda s: s.tenant in (tenant, "default")
+            and (not slugs or s.slug in slugs))
+        if not servers:
+            raise ValueError(f"no servers registered for tenant {tenant!r}")
+        reserved = self._reserved_by_node()
+        nodes, valid = [], []
+        for s in servers:
+            res = _server_to_resource(s)
+            alloc = np.array([s.allocated.cpu + s.allocated.reserved_cpu,
+                              s.allocated.memory + s.allocated.reserved_memory,
+                              s.allocated.disk + s.allocated.reserved_disk])
+            alloc = alloc + reserved.get(s.slug, 0)
+            cap = np.maximum(np.array(res.capacity.as_tuple()) - alloc, 0.0)
+            res.capacity = ResourceSpec(cpu=float(cap[0]), memory=float(cap[1]),
+                                        disk=float(cap[2]))
+            nodes.append(res)
+            valid.append(s.schedulable)
+        return nodes, np.array(valid, dtype=bool)
+
+    def _reserved_by_node(self) -> dict[str, np.ndarray]:
+        out: dict[str, np.ndarray] = {}
+        for r in self._reservations.values():
+            if r.committed:
+                continue
+            for node, dem in r.demand_by_node.items():
+                out[node] = out.get(node, 0) + dem
+        return out
+
+    # ------------------------------------------------------------------
+    # solve + 2-phase reservation
+    # ------------------------------------------------------------------
+
+    def solve_stage(self, flow: Flow, stage_name: str, *,
+                    tenant: str = "default",
+                    reserve: bool = True) -> tuple[Placement, Optional[str]]:
+        """Lower the stage against live inventory and solve; optionally open
+        a reservation. Returns (placement, reservation_id)."""
+        stage = flow.stage(stage_name)
+        with self._lock:
+            nodes, valid = self._inventory(tenant, stage.servers or None)
+            pt = lower_stage(flow, stage_name, nodes=nodes)
+            pt.node_valid &= valid
+            key = f"{flow.name}/{stage_name}"
+            prev = self._last.get(key)
+            if self.use_tpu:
+                warm = (prev is not None
+                        and prev[0].S == pt.S and prev[0].N == pt.N)
+                placement = self._sched_tpu.place(pt, warm_start=warm)
+            else:
+                placement = self._sched_host.place(pt)
+            self._last[key] = (pt, placement)
+            rid = None
+            if reserve and placement.feasible:
+                rid = self._reserve(key, pt, placement)
+        return placement, rid
+
+    def _reserve(self, key: str, pt: ProblemTensors,
+                 placement: Placement) -> str:
+        rid = f"rsv_{next(self._ids)}"
+        demand_by_node: dict[str, np.ndarray] = {}
+        for i, node in enumerate(placement.raw):
+            slug = pt.node_names[int(node)]
+            demand_by_node[slug] = (demand_by_node.get(slug, 0)
+                                    + pt.demand[i].astype(np.float64))
+        self._reservations[rid] = Reservation(
+            id=rid, stage_key=key, demand_by_node=demand_by_node,
+            assignment=dict(placement.assignment))
+        return rid
+
+    def commit(self, rid: str) -> bool:
+        """Deploy succeeded: move reserved -> committed on the servers
+        (2-phase step 2, model.rs:421-427)."""
+        with self._lock:
+            r = self._reservations.get(rid)
+            if r is None or r.committed:
+                return False
+            for slug, dem in r.demand_by_node.items():
+                s = self.store.server_by_slug(slug)
+                if s is None:
+                    continue
+                self.store.update("servers", s.id, allocated=type(s.allocated)(
+                    cpu=s.allocated.cpu + float(dem[0]),
+                    memory=s.allocated.memory + float(dem[1]),
+                    disk=s.allocated.disk + float(dem[2]),
+                    reserved_cpu=s.allocated.reserved_cpu,
+                    reserved_memory=s.allocated.reserved_memory,
+                    reserved_disk=s.allocated.reserved_disk,
+                ))
+            r.committed = True
+            return True
+
+    def release(self, rid: str, *, undo_commit: bool = False) -> bool:
+        """Deploy failed or stage torn down: drop the reservation; with
+        `undo_commit`, also return committed capacity."""
+        with self._lock:
+            r = self._reservations.pop(rid, None)
+            if r is None:
+                return False
+            if r.committed and undo_commit:
+                for slug, dem in r.demand_by_node.items():
+                    s = self.store.server_by_slug(slug)
+                    if s is None:
+                        continue
+                    self.store.update("servers", s.id,
+                                      allocated=type(s.allocated)(
+                        cpu=max(s.allocated.cpu - float(dem[0]), 0.0),
+                        memory=max(s.allocated.memory - float(dem[1]), 0.0),
+                        disk=max(s.allocated.disk - float(dem[2]), 0.0),
+                        reserved_cpu=s.allocated.reserved_cpu,
+                        reserved_memory=s.allocated.reserved_memory,
+                        reserved_disk=s.allocated.reserved_disk,
+                    ))
+            return True
+
+    # ------------------------------------------------------------------
+    # streaming re-solve (BASELINE config 5)
+    # ------------------------------------------------------------------
+
+    def node_event(self, slug: str, *, online: bool) -> list[tuple[str, Placement]]:
+        """Churn: flip the node's validity and warm-start re-solve every
+        stage that had services there. Returns [(stage_key, new placement)].
+        Device masks update as a small delta; the solver's migration
+        stickiness keeps unaffected services in place."""
+        s = self.store.server_by_slug(slug)
+        if s is not None:
+            self.store.update("servers", s.id,
+                              status="online" if online else "offline")
+        moved: list[tuple[str, Placement]] = []
+        with self._lock:
+            for key, (pt, placement) in list(self._last.items()):
+                if slug not in pt.node_names:
+                    continue
+                j = pt.node_names.index(slug)
+                if bool(pt.node_valid[j]) == online:
+                    continue
+                pt.node_valid = pt.node_valid.copy()
+                pt.node_valid[j] = online
+                if not online and not np.any(
+                        np.asarray(placement.raw) == j):
+                    continue  # nothing placed there; mask change is enough
+                if self.use_tpu:
+                    new = self._sched_tpu.reschedule(pt)
+                else:
+                    new = self._sched_host.place(pt)
+                self._last[key] = (pt, new)
+                moved.append((key, new))
+        return moved

@@ -1,0 +1,135 @@
+"""Control-plane server bootstrap.
+
+Analog of controlplane server.rs:82-197: store connect -> auth select ->
+AppState{store, auth, agent_registry, log_router, placement} -> register
+channels -> mesh CA load/gen + per-boot server cert -> listen; a
+CpServerHandle supports graceful shutdown (server.rs CpServerHandle).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .agent_registry import AgentRegistry
+from .auth import Claims, NoAuth, make_provider
+from .cert import MeshCa, ensure_mesh_ca, server_ssl_context
+from .log_router import LogRouter
+from .placement import PlacementService
+from .protocol import ProtocolServer
+from .store import Store
+
+__all__ = ["ServerConfig", "AppState", "CpServerHandle", "start"]
+
+
+@dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    port: int = 0                      # 0 = ephemeral (tests)
+    name: str = "fleetflow-cp"
+    db_path: Optional[str] = None      # None = in-memory (kv-mem analog)
+    auth_kind: str = "none"            # none | token
+    auth_secret: Optional[str] = None
+    tls_dir: Optional[str] = None      # mesh-CA dir; None = plaintext
+    use_tpu_solver: bool = False
+    master_key_env: bool = False       # load SecretBox from env
+
+
+@dataclass
+class AppState:
+    """server.rs AppState:18-28 (+ the placement service)."""
+    store: Store
+    auth: object
+    agent_registry: AgentRegistry
+    log_router: LogRouter
+    placement: PlacementService
+    name: str = "fleetflow-cp"
+    secret_box: Optional[object] = None
+    dns_backend: Optional[object] = None
+    backend_factory: Callable = None       # () -> ContainerBackend
+    deploy_sleep: Callable[[float], None] = time.sleep
+    started_at: float = field(default_factory=time.time)
+    bg_tasks: set = field(default_factory=set)
+
+
+class CpServerHandle:
+    def __init__(self, server: ProtocolServer, state: AppState,
+                 host: str, port: int, ca: Optional[MeshCa]):
+        self.server = server
+        self.state = state
+        self.host = host
+        self.port = port
+        self.ca = ca
+
+    @property
+    def ca_pem(self) -> Optional[bytes]:
+        return self.ca.ca_pem if self.ca else None
+
+    async def stop(self) -> None:
+        await self.server.stop()
+        self.state.store.flush()
+
+
+def _default_backend_factory():
+    """CP-local deploys (handlers/deploy.rs:470-507) use the local docker
+    daemon when reachable, the in-memory mock otherwise (tests/dev)."""
+    from ..runtime.backend import DockerCliBackend, MockBackend
+    docker = DockerCliBackend()
+    if docker.ping():
+        return docker
+    mock = MockBackend()
+    # dev mock: images materialize on pull, so deploys succeed end-to-end
+    mock.pull = lambda image: mock.images.add(image)  # type: ignore
+    return mock
+
+
+async def start(config: ServerConfig, *,
+                backend_factory: Optional[Callable] = None,
+                deploy_sleep: Callable[[float], None] = time.sleep,
+                ) -> CpServerHandle:
+    """server.rs start:82-126."""
+    store = Store(config.db_path)
+    auth = make_provider(config.auth_kind, config.auth_secret)
+
+    secret_box = None
+    if config.master_key_env:
+        from .crypto import SecretBox
+        secret_box = SecretBox.from_env()
+
+    state = AppState(
+        store=store,
+        auth=auth,
+        agent_registry=AgentRegistry(),
+        log_router=LogRouter(),
+        placement=PlacementService(store, use_tpu=config.use_tpu_solver),
+        name=config.name,
+        secret_box=secret_box,
+        backend_factory=backend_factory or _default_backend_factory,
+        deploy_sleep=deploy_sleep,
+    )
+
+    def authenticate(identity: str, token: Optional[str]) -> bool:
+        if isinstance(auth, NoAuth):
+            return True
+        try:
+            claims: Claims = auth.verify(token)
+            return bool(claims.sub)
+        except Exception:
+            return False
+
+    ca: Optional[MeshCa] = None
+    ssl_ctx = None
+    if config.tls_dir:
+        ca = ensure_mesh_ca(config.tls_dir)
+        ssl_ctx = server_ssl_context(ca, common_name=config.name,
+                                     work_dir=config.tls_dir)
+
+    server = ProtocolServer(name=config.name, authenticate=authenticate,
+                            ssl_context=ssl_ctx)
+    from .handlers import register_all
+    register_all(server, state)
+
+    host, port = await server.start(config.host, config.port)
+    return CpServerHandle(server, state, host, port, ca)

@@ -1,0 +1,317 @@
+"""Control-plane store.
+
+Analog of the reference's SurrealDB data layer (controlplane db.rs, 3,421
+LoC of async CRUD over ~14 tables). The reference runs embedded `kv-mem`
+for tests and RocksDB-backed SurrealDB in production (db.rs:41,76); here the
+store is in-memory tables with an optional JSON snapshot file — same
+test-vs-durable split, no external database process.
+
+Thread-safe: one RLock guards all tables (handler tasks run on one asyncio
+loop, but the REST surface and background checkers may call from executor
+threads).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Callable, Optional, TypeVar
+
+from .models import (Alert, BuildJob, CostEntry, Deployment, DeploymentStatus,
+                     DnsRecord, ObservedContainer, Project, Record, Server,
+                     ServiceRecord, StageRecord, Tenant, TenantUser,
+                     VolumeRecord, VolumeSnapshot, WorkerPool, new_id, now_ts)
+
+__all__ = ["Store"]
+
+R = TypeVar("R", bound=Record)
+
+_TABLES: dict[str, type] = {
+    "tenants": Tenant, "tenant_users": TenantUser, "projects": Project,
+    "stages": StageRecord, "services": ServiceRecord, "servers": Server,
+    "worker_pools": WorkerPool, "deployments": Deployment, "alerts": Alert,
+    "observed_containers": ObservedContainer, "volumes": VolumeRecord,
+    "volume_snapshots": VolumeSnapshot, "build_jobs": BuildJob,
+    "cost_entries": CostEntry, "dns_records": DnsRecord,
+}
+
+
+class Store:
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._tables: dict[str, dict[str, Record]] = {t: {} for t in _TABLES}
+        self._path = Path(path) if path else None
+        self._batch_depth = 0
+        self._pending_flush = False
+        if self._path and self._path.exists():
+            self._load()
+
+    @classmethod
+    def connect_memory(cls) -> "Store":
+        """Test constructor (db.rs connect_memory:76)."""
+        return cls(path=None)
+
+    # ------------------------------------------------------------------
+    # generic CRUD
+    # ------------------------------------------------------------------
+
+    def create(self, table: str, rec: R) -> R:
+        with self._lock:
+            if not rec.id:
+                rec.id = new_id(table.rstrip("s"))
+            rec.created_at = rec.created_at or now_ts()
+            rec.updated_at = now_ts()
+            self._tables[table][rec.id] = rec
+            self._dirty()
+            return rec
+
+    def get(self, table: str, rec_id: str) -> Optional[Record]:
+        with self._lock:
+            return self._tables[table].get(rec_id)
+
+    def update(self, table: str, rec_id: str, **changes) -> Optional[Record]:
+        with self._lock:
+            rec = self._tables[table].get(rec_id)
+            if rec is None:
+                return None
+            for k, v in changes.items():
+                setattr(rec, k, v)
+            rec.updated_at = now_ts()
+            self._dirty()
+            return rec
+
+    def delete(self, table: str, rec_id: str) -> bool:
+        with self._lock:
+            gone = self._tables[table].pop(rec_id, None) is not None
+            if gone:
+                self._dirty()
+            return gone
+
+    def list(self, table: str,
+             where: Optional[Callable[[Record], bool]] = None) -> list[Record]:
+        with self._lock:
+            rows = list(self._tables[table].values())
+        if where is not None:
+            rows = [r for r in rows if where(r)]
+        return sorted(rows, key=lambda r: r.created_at)
+
+    def find_one(self, table: str,
+                 where: Callable[[Record], bool]) -> Optional[Record]:
+        for r in self.list(table, where):
+            return r
+        return None
+
+    # ------------------------------------------------------------------
+    # domain queries (the named fns of db.rs)
+    # ------------------------------------------------------------------
+
+    # tenants ----------------------------------------------------------
+    def tenant_by_name(self, name: str) -> Optional[Tenant]:
+        return self.find_one("tenants", lambda t: t.name == name)
+
+    def ensure_tenant(self, name: str) -> Tenant:
+        """get-or-create, the way deploy.execute resolves tenants
+        (handlers/deploy.rs tenant resolve)."""
+        t = self.tenant_by_name(name)
+        if t is None:
+            t = self.create("tenants", Tenant(name=name, display_name=name))
+        return t
+
+    def tenant_users(self, tenant: str) -> list[TenantUser]:
+        return self.list("tenant_users", lambda u: u.tenant == tenant)
+
+    def user_by_email(self, tenant: str, email: str) -> Optional[TenantUser]:
+        return self.find_one(
+            "tenant_users", lambda u: u.tenant == tenant and u.email == email)
+
+    # projects / stages / services ------------------------------------
+    def project_by_name(self, tenant: str, name: str) -> Optional[Project]:
+        return self.find_one(
+            "projects", lambda p: p.tenant == tenant and p.name == name)
+
+    def ensure_project(self, tenant: str, name: str) -> Project:
+        p = self.project_by_name(tenant, name)
+        if p is None:
+            p = self.create("projects", Project(tenant=tenant, name=name))
+        return p
+
+    def stages_of(self, project: str) -> list[StageRecord]:
+        return self.list("stages", lambda s: s.project == project)
+
+    def stage_by_name(self, project: str, name: str) -> Optional[StageRecord]:
+        return self.find_one(
+            "stages", lambda s: s.project == project and s.name == name)
+
+    def ensure_stage(self, project: str, name: str, **attrs) -> StageRecord:
+        s = self.stage_by_name(project, name)
+        if s is None:
+            s = self.create("stages",
+                            StageRecord(project=project, name=name, **attrs))
+        elif attrs:
+            self.update("stages", s.id, **attrs)
+        return s
+
+    def adopt_stage(self, stage_id: str) -> Optional[StageRecord]:
+        """Stage adoption (db.rs:480): claim an observed stage as managed."""
+        return self.update("stages", stage_id, adopted=True)
+
+    def services_of(self, stage: str) -> list[ServiceRecord]:
+        return self.list("services", lambda s: s.stage == stage)
+
+    def upsert_service(self, stage: str, name: str, **attrs) -> ServiceRecord:
+        s = self.find_one("services",
+                          lambda r: r.stage == stage and r.name == name)
+        if s is None:
+            return self.create("services",
+                               ServiceRecord(stage=stage, name=name, **attrs))
+        return self.update("services", s.id, **attrs)  # type: ignore[return-value]
+
+    # servers ----------------------------------------------------------
+    def server_by_slug(self, slug: str) -> Optional[Server]:
+        return self.find_one("servers", lambda s: s.slug == slug)
+
+    def register_server(self, slug: str, tenant: str = "default",
+                        **attrs) -> Server:
+        """Agent registration upsert (handlers/server.rs register)."""
+        s = self.server_by_slug(slug)
+        if s is None:
+            return self.create("servers",
+                               Server(slug=slug, tenant=tenant, **attrs))
+        return self.update("servers", s.id, **attrs)  # type: ignore[return-value]
+
+    def heartbeat(self, slug: str, version: str = "") -> Optional[Server]:
+        """db.rs heartbeat update (handlers/agent.rs:84-91)."""
+        s = self.server_by_slug(slug)
+        if s is None:
+            return None
+        changes: dict = {"last_heartbeat": now_ts(), "status": "online"}
+        if version:
+            changes["agent_version"] = version
+        return self.update("servers", s.id, **changes)
+
+    def bulk_server_status(self, statuses: dict[str, str]) -> int:
+        """Health-checker bulk update (db.rs:779; fleetflowd health.rs:34-69)."""
+        n = 0
+        for slug, status in statuses.items():
+            s = self.server_by_slug(slug)
+            if s is not None and s.status != status:
+                self.update("servers", s.id, status=status)
+                n += 1
+        return n
+
+    def schedulable_servers(self, tenant: Optional[str] = None) -> list[Server]:
+        return self.list("servers", lambda s: s.schedulable and
+                         (tenant is None or s.tenant == tenant))
+
+    # deployments ------------------------------------------------------
+    def deployment_history(self, stage: Optional[str] = None,
+                           limit: int = 50) -> list[Deployment]:
+        rows = self.list("deployments",
+                         (lambda d: d.stage == stage) if stage else None)
+        return list(reversed(rows))[:limit]
+
+    def finish_deployment(self, dep_id: str, status: DeploymentStatus,
+                          log: str = "", error: str = "") -> Optional[Deployment]:
+        return self.update("deployments", dep_id, status=status.value,
+                           log=log, error=error, finished_at=now_ts())
+
+    # alerts -----------------------------------------------------------
+    def upsert_alert(self, server: str, container: str, kind: str,
+                     message: str, tenant: str = "default") -> Alert:
+        """Active-alert upsert (db.rs:1052; handlers/agent.rs:203-241)."""
+        a = self.find_one("alerts", lambda r: r.server == server and
+                          r.container == container and r.kind == kind and r.active)
+        if a is not None:
+            return self.update("alerts", a.id, message=message)  # type: ignore
+        return self.create("alerts", Alert(
+            tenant=tenant, server=server, container=container,
+            kind=kind, message=message))
+
+    def resolve_alert(self, server: str, container: str, kind: str) -> bool:
+        a = self.find_one("alerts", lambda r: r.server == server and
+                          r.container == container and r.kind == kind and r.active)
+        if a is None:
+            return False
+        self.update("alerts", a.id, active=False, resolved_at=now_ts())
+        return True
+
+    def active_alerts(self, tenant: Optional[str] = None) -> list[Alert]:
+        return self.list("alerts", lambda a: a.active and
+                         (tenant is None or a.tenant == tenant))
+
+    # observed containers ---------------------------------------------
+    def replace_observed(self, server: str,
+                         rows: list[ObservedContainer]) -> None:
+        """Inventory report replaces that server's slice (db.rs:1153-1219).
+        One flush for the whole batch, not one per record."""
+        with self._lock, self.batch():
+            table = self._tables["observed_containers"]
+            for rid in [k for k, v in table.items() if v.server == server]:
+                del table[rid]
+            for rec in rows:
+                rec.server = server
+                self.create("observed_containers", rec)
+
+    def observed_on(self, server: str) -> list[ObservedContainer]:
+        return self.list("observed_containers", lambda o: o.server == server)
+
+    # cost -------------------------------------------------------------
+    def monthly_cost(self, tenant: str, month: str) -> float:
+        """db.rs:896-947 monthly summary."""
+        return sum(c.amount for c in self.list(
+            "cost_entries", lambda c: c.tenant == tenant and c.month == month))
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def batch(self):
+        """Context manager suppressing write-through for bulk mutations;
+        one flush on exit."""
+        store = self
+
+        class _Batch:
+            def __enter__(self):
+                with store._lock:
+                    store._batch_depth += 1
+                return self
+
+            def __exit__(self, *exc):
+                with store._lock:
+                    store._batch_depth -= 1
+                    pending = store._batch_depth == 0 and store._pending_flush
+                if pending:
+                    store.flush()
+                return False
+
+        return _Batch()
+
+    def _dirty(self) -> None:
+        if self._path is None:
+            return
+        with self._lock:
+            if self._batch_depth > 0:
+                self._pending_flush = True
+                return
+        self.flush()
+
+    def flush(self) -> None:
+        if self._path is None:
+            return
+        # serialize AND write under the lock: concurrent flushes from
+        # executor threads must not interleave on the shared tmp file
+        with self._lock:
+            self._pending_flush = False
+            doc = {t: [r.to_dict() for r in rows.values()]
+                   for t, rows in self._tables.items()}
+            tmp = self._path.with_suffix(f".tmp{threading.get_ident()}")
+            tmp.write_text(json.dumps(doc))
+            tmp.replace(self._path)
+
+    def _load(self) -> None:
+        doc = json.loads(self._path.read_text())
+        for table, cls in _TABLES.items():
+            for row in doc.get(table, []):
+                rec = cls.from_dict(row)
+                self._tables[table][rec.id] = rec

@@ -1,0 +1,521 @@
+"""Control-plane tests: in-process CP + real loopback + fake agent.
+
+Replicates the reference's key distributed-test pattern (SURVEY.md §4.4):
+in-memory store (kv-mem analog), a real protocol server on 127.0.0.1, a
+real ProtocolClient, and a fake agent implementing the exact wire contract
+to regression-test the request_id correlation protocol end to end
+(channel_integration.rs:24-61; agent_command_test.rs:1-55).
+"""
+
+import asyncio
+
+import pytest
+
+from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+from fleetflow_tpu.cp import ServerConfig, start
+from fleetflow_tpu.cp.auth import AuthError, NoAuth, TokenAuth
+from fleetflow_tpu.cp.log_router import LogRouter
+from fleetflow_tpu.cp.protocol import ProtocolClient, RpcError
+from fleetflow_tpu.cp.store import Store
+from fleetflow_tpu.runtime import DeployRequest, MockBackend
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def mock_backend_factory():
+    b = MockBackend()
+    b.pull = lambda image: b.images.add(image)
+    return b
+
+
+async def start_cp(**kw):
+    return await start(ServerConfig(**kw),
+                       backend_factory=mock_backend_factory,
+                       deploy_sleep=lambda d: None)
+
+
+async def connect(handle, identity="cli", token=None, **kw):
+    return await ProtocolClient.connect(
+        handle.host, handle.port, identity=identity, token=token, **kw)
+
+
+class FakeAgent:
+    """Implements the agent wire contract: register request, then
+    heartbeats/alerts/logs as events, and command_result correlation for
+    inbound commands (fleet-agent agent.rs:215-254)."""
+
+    def __init__(self, slug: str):
+        self.slug = slug
+        self.commands: list[tuple[str, dict]] = []
+        self.conn = None
+        self.task = None
+        self.respond = lambda cmd, payload: {"ok": True, "cmd": cmd}
+
+    async def connect(self, handle):
+        async def on_event(conn, method, payload):
+            rid = payload.get("request_id")
+            self.commands.append((method, payload.get("payload", {})))
+            result = self.respond(method, payload.get("payload", {}))
+            if rid:
+                await conn.send_event("agent", "command_result",
+                                      {"request_id": rid, "result": result})
+
+        self.conn, self.task = await ProtocolClient.connect(
+            handle.host, handle.port, identity=self.slug,
+            event_handlers={"agent": on_event})
+        reply = await self.conn.request("agent", "register",
+                                        {"slug": self.slug,
+                                         "version": "0.1.0",
+                                         "capacity": {"cpu": 8, "memory": 16384,
+                                                      "disk": 102400}})
+        assert reply["registered"]
+        return self
+
+
+# --------------------------------------------------------------------------
+# protocol basics
+# --------------------------------------------------------------------------
+
+class TestProtocol:
+    def test_request_response_roundtrip(self):
+        async def go():
+            handle = await start_cp()
+            conn, task = await connect(handle)
+            pong = await conn.request("health", "ping")
+            assert pong["pong"] is True
+            # unknown channel/method -> remote RpcError, connection survives
+            with pytest.raises(RpcError):
+                await conn.request("nope", "x")
+            with pytest.raises(RpcError):
+                await conn.request("health", "nope")
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_auth_rejects_bad_token(self):
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3cret")
+            token = handle.state.auth.issue("op@example.com", ["admin:all"])
+            conn, _ = await connect(handle, token=token)
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            with pytest.raises(RpcError):
+                await connect(handle, token="garbage")
+            with pytest.raises(RpcError):
+                await connect(handle, token=None)
+            await handle.stop()
+        run(go())
+
+    def test_tls_with_pinned_ca(self, tmp_path):
+        from fleetflow_tpu.cp.cert import client_ssl_context
+
+        async def go():
+            handle = await start_cp(tls_dir=str(tmp_path / "ca"))
+            ctx = client_ssl_context(handle.ca_pem)
+            conn, _ = await ProtocolClient.connect(
+                handle.host, handle.port, identity="cli", ssl_context=ctx)
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# CRUD channels
+# --------------------------------------------------------------------------
+
+class TestChannels:
+    def test_tenant_project_stage(self):
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            t = await conn.request("tenant", "create", {"name": "acme"})
+            assert t["tenant"]["name"] == "acme"
+            p = await conn.request("project", "create",
+                                   {"tenant": "acme", "name": "web"})
+            pid = p["project"]["id"]
+            s = await conn.request("stage", "ensure",
+                                   {"project": pid, "name": "live"})
+            sid = s["stage"]["id"]
+            adopted = await conn.request("stage", "adopt", {"stage": sid})
+            assert adopted["stage"]["adopted"] is True
+            listing = await conn.request("project", "list", {"tenant": "acme"})
+            assert len(listing["projects"]) == 1
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_server_lifecycle_and_cordon(self):
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            await conn.request("server", "register", {
+                "slug": "node-1", "capacity": {"cpu": 4, "memory": 8192,
+                                               "disk": 50000},
+                "labels": {"tier": "premium", "region": "tk1"}})
+            got = await conn.request("server", "get", {"slug": "node-1"})
+            assert got["server"]["capacity"]["cpu"] == 4
+            assert got["server"]["labels"]["tier"] == "premium"
+            r = await conn.request("server", "cordon", {"slug": "node-1"})
+            assert r["scheduling_state"] == "cordoned"
+            r = await conn.request("server", "uncordon", {"slug": "node-1"})
+            assert r["scheduling_state"] == "schedulable"
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_secrets_cost_dns(self, monkeypatch):
+        from fleetflow_tpu.cp.crypto import generate_master_key
+        monkeypatch.setenv("FLEETFLOW_MASTER_KEY", generate_master_key())
+
+        async def go():
+            handle = await start(ServerConfig(master_key_env=True),
+                                 backend_factory=mock_backend_factory)
+            conn, _ = await connect(handle)
+            await conn.request("tenant", "secret.set",
+                               {"name": "acme", "key": "DB_PASS",
+                                "value": "hunter2"})
+            # stored ciphertext, not plaintext
+            t = handle.state.store.tenant_by_name("acme")
+            assert t.secrets["DB_PASS"] != "hunter2"
+            got = await conn.request("tenant", "secret.get",
+                                     {"name": "acme", "key": "DB_PASS"})
+            assert got["value"] == "hunter2"
+
+            await conn.request("cost", "add", {"tenant": "acme",
+                                               "month": "2026-07",
+                                               "amount": 12.5})
+            await conn.request("cost", "add", {"tenant": "acme",
+                                               "month": "2026-07",
+                                               "amount": 7.5})
+            summary = await conn.request("cost", "summary",
+                                         {"tenant": "acme", "month": "2026-07"})
+            assert summary["total"] == 20.0
+
+            await conn.request("dns", "create",
+                               {"zone": "example.com", "name": "app",
+                                "content": "1.2.3.4"})
+            synced = await conn.request("dns", "sync", {})
+            assert synced["synced"] == 1
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# agent session + command correlation (the key regression tests)
+# --------------------------------------------------------------------------
+
+class TestAgentProtocol:
+    def test_register_heartbeat_and_command(self):
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+            assert handle.state.agent_registry.is_connected("node-1")
+            s = handle.state.store.server_by_slug("node-1")
+            assert s.status == "online" and s.capacity.cpu == 8
+
+            # CP -> agent command, correlated by request_id
+            result = await handle.state.agent_registry.send_command(
+                "node-1", "ping", {"x": 1}, timeout=5)
+            assert result == {"ok": True, "cmd": "ping"}
+            assert agent.commands[-1] == ("ping", {"x": 1})
+            await agent.conn.close()
+            await asyncio.sleep(0.05)
+            assert not handle.state.agent_registry.is_connected("node-1")
+            assert handle.state.store.server_by_slug("node-1").status == "offline"
+            await handle.stop()
+        run(go())
+
+    def test_register_first_enforced(self):
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle, identity="rogue")
+            with pytest.raises(RpcError, match="register"):
+                await conn.request("agent", "heartbeat", {})
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_alert_upsert_and_autoresolve(self):
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+            await agent.conn.send_event("agent", "alert", {
+                "container": "web", "kind": "restart_loop",
+                "message": "5 restarts"})
+            await asyncio.sleep(0.05)
+            alerts = handle.state.store.active_alerts()
+            assert len(alerts) == 1 and alerts[0].kind == "restart_loop"
+            # duplicate upserts, does not double
+            await agent.conn.send_event("agent", "alert", {
+                "container": "web", "kind": "restart_loop",
+                "message": "6 restarts"})
+            await asyncio.sleep(0.05)
+            assert len(handle.state.store.active_alerts()) == 1
+            # auto-resolve
+            await agent.conn.send_event("agent", "alert", {
+                "container": "web", "kind": "restart_loop", "resolved": True})
+            await asyncio.sleep(0.05)
+            assert handle.state.store.active_alerts() == []
+            await agent.conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_log_routing_with_retention(self):
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+            cli, _ = await connect(handle)
+            for i in range(250):
+                await agent.conn.send_event("agent", "log", {
+                    "container": "web", "line": f"line{i}"})
+            await asyncio.sleep(0.1)
+            got = await cli.request("container", "logs",
+                                    {"server": "node-1", "container": "web"})
+            lines = [e["line"] for e in got["lines"]]
+            # 200-line ring: oldest 50 dropped
+            assert len(lines) == 200 and lines[0] == "line50"
+            await agent.conn.close()
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_command_timeout_and_late_result(self):
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("slow").connect(handle)
+            agent.respond = lambda cmd, p: asyncio.sleep(0)  # never replies
+
+            async def no_reply(conn, method, payload):
+                agent.commands.append((method, payload.get("payload", {})))
+            agent.conn.event_handlers["agent"] = no_reply
+
+            from fleetflow_tpu.core.errors import ControlPlaneError
+            with pytest.raises(ControlPlaneError, match="timed out"):
+                await handle.state.agent_registry.send_command(
+                    "slow", "ping", {}, timeout=0.2)
+            # a late result for an expired id is dropped, not crashed
+            assert handle.state.agent_registry.resolve_result(
+                "req_1", {"result": {}}) is False
+            await agent.conn.close()
+            await handle.stop()
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# deploy execute routing (deploy_execute_test.rs analog)
+# --------------------------------------------------------------------------
+
+def _load_flow(project):
+    root, _ = project
+    return load_project_from_root_with_stage(str(root), "local")
+
+
+class TestDeployExecute:
+    def test_local_execution(self, project):
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await conn.request("deploy", "execute",
+                                     {"request": req.to_dict(),
+                                      "tenant": "acme"})
+            dep = out["deployment"]
+            assert dep["status"] == "succeeded"
+            assert "3 containers" in dep["log"]
+            hist = await conn.request("deploy", "history", {})
+            assert len(hist["deployments"]) == 1
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_routed_to_agent(self, project):
+        async def go():
+            flow = _load_flow(project)
+            # pin the stage to a server so execute routes via the registry
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+            agent.respond = lambda cmd, p: {"deployed": 3, "cmd": cmd}
+            conn, _ = await connect(handle)
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await conn.request("deploy", "execute",
+                                     {"request": req.to_dict()}, timeout=10)
+            assert out["deployment"]["status"] == "succeeded"
+            cmd, payload = agent.commands[-1]
+            assert cmd == "deploy.execute"
+            # the agent got its node-scoped request + the solved assignment
+            back = DeployRequest.from_dict(payload["request"])
+            assert back.node == "node-1"
+            assert set(payload["assignment"].values()) == {"node-1"}
+            await agent.conn.close()
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_agent_failure_marks_deployment_failed(self, project):
+        async def go():
+            flow = _load_flow(project)
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+
+            async def fail_event(conn, method, payload):
+                rid = payload.get("request_id")
+                if rid:
+                    await conn.send_event("agent", "command_result", {
+                        "request_id": rid, "error": "dockerd exploded"})
+            agent.conn.event_handlers["agent"] = fail_event
+
+            conn, _ = await connect(handle)
+            req = DeployRequest(flow=flow, stage_name="local")
+            with pytest.raises(RpcError, match="dockerd exploded"):
+                await conn.request("deploy", "execute",
+                                   {"request": req.to_dict()}, timeout=10)
+            deps = handle.state.store.deployment_history()
+            assert deps[0].status == "failed"
+            await agent.conn.close()
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# placement channel + reservations + churn
+# --------------------------------------------------------------------------
+
+class TestPlacementChannel:
+    def test_solve_with_live_inventory(self, project):
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            agents = [await FakeAgent(f"node-{i}").connect(handle)
+                      for i in range(2)]
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            out = await conn.request("placement", "solve",
+                                     {"flow": flow_to_dict(flow),
+                                      "stage": "local"})
+            assert out["feasible"]
+            assert set(out["assignment"]) == {"postgres", "redis", "app"}
+            assert set(out["assignment"].values()) <= {"node-0", "node-1"}
+            for a in agents:
+                await a.conn.close()
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_reservation_two_phase(self, project):
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            await FakeAgent("node-1").connect(handle)
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            out = await conn.request("placement", "solve",
+                                     {"flow": flow_to_dict(flow),
+                                      "stage": "local", "reserve": True})
+            rid = out["reservation"]
+            assert rid
+            ok = await conn.request("placement", "commit",
+                                    {"reservation": rid})
+            assert ok["ok"]
+            s = handle.state.store.server_by_slug("node-1")
+            assert s.allocated.cpu > 0     # committed capacity recorded
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_node_churn_reschedules(self, project):
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            for i in range(2):
+                await FakeAgent(f"node-{i}").connect(handle)
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            first = await conn.request("placement", "solve",
+                                       {"flow": flow_to_dict(flow),
+                                        "stage": "local"})
+            used = set(first["assignment"].values())
+            kill = sorted(used)[0]
+            out = await conn.request("placement", "node_event",
+                                     {"slug": kill, "online": False})
+            moved = out["rescheduled"]
+            assert len(moved) == 1
+            new_assign = moved[0]["assignment"]
+            assert kill not in set(new_assign.values())
+            assert moved[0]["feasible"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# store unit tests
+# --------------------------------------------------------------------------
+
+class TestStore:
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cp.json")
+        db = Store(path)
+        db.ensure_tenant("acme")
+        db.register_server("n1", hostname="host1")
+        db.upsert_alert("n1", "web", "unhealthy", "boom")
+        db2 = Store(path)
+        assert db2.tenant_by_name("acme") is not None
+        assert db2.server_by_slug("n1").hostname == "host1"
+        assert len(db2.active_alerts()) == 1
+
+    def test_observed_replacement(self):
+        from fleetflow_tpu.cp.models import ObservedContainer
+        db = Store.connect_memory()
+        db.replace_observed("n1", [ObservedContainer(name="a"),
+                                   ObservedContainer(name="b")])
+        db.replace_observed("n1", [ObservedContainer(name="c")])
+        assert [o.name for o in db.observed_on("n1")] == ["c"]
+
+
+class TestAuth:
+    def test_token_roundtrip_and_tamper(self):
+        auth = TokenAuth("secret")
+        token = auth.issue("a@b.c", ["deploy:write"], tenant="acme")
+        claims = auth.verify(token)
+        assert claims.email == "a@b.c" and claims.tenant == "acme"
+        assert claims.has("deploy:write") and not claims.has("admin:all")
+        with pytest.raises(AuthError):
+            auth.verify(token[:-4] + "AAAA")
+        with pytest.raises(AuthError):
+            TokenAuth("other").verify(token)
+        with pytest.raises(AuthError):
+            auth.verify("not.a.token")
+
+    def test_expiry(self):
+        auth = TokenAuth("secret")
+        token = auth.issue("a@b.c", [], ttl_s=-10)
+        with pytest.raises(AuthError, match="expired"):
+            auth.verify(token)
+
+    def test_noauth(self):
+        claims = NoAuth().verify(None)
+        assert claims.has("anything")
+
+
+class TestLogRouter:
+    def test_subscribe_filters(self):
+        async def go():
+            router = LogRouter()
+            sid, q = router.subscribe(prefix="logs/n1/", min_level="warn")
+            router.publish_line("n1", "web", "info line", "info")
+            router.publish_line("n1", "web", "bad", "error")
+            router.publish_line("n2", "web", "other node", "error")
+            assert q.qsize() == 1
+            entry = q.get_nowait()
+            assert entry.line == "bad"
+            router.unsubscribe(sid)
+        run(go())
