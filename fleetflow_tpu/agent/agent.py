@@ -1,0 +1,280 @@
+"""The agent session: reconnect loop, registration, heartbeat, monitor,
+command execution.
+
+Analog of fleet-agent agent.rs: an infinite reconnect loop with 5s backoff
+(:34-45), a session that registers first then runs heartbeat + monitor
+loops concurrently with the command loop (:87-128), and the command
+dispatch (deploy.execute / restart / status / build / ping, :129-208) whose
+results ride the {"request_id", ...} -> command_result envelope
+(:215-254).
+
+Deploys execute the node's OWN slice of a CP-solved placement: the CP sends
+`DeployRequest{node=slug}` plus the full assignment, and the engine filters
+to rows assigned here (this build's multi-node fan-out; the reference routed
+whole stages to one server, handlers/deploy.rs:386-394).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..runtime.backend import ContainerBackend, DockerCliBackend
+from ..runtime.engine import DeployEngine, DeployRequest
+from ..sched.base import Placement, level_schedule
+from ..lower.tensors import lower_stage
+from .guard import confine_path, validate_container_name
+from .monitor import AnomalyDetector, inventory_report, snapshot_backend
+from ..cp.protocol import Connection, ProtocolClient
+
+__all__ = ["Agent", "AgentConfig"]
+
+RECONNECT_BACKOFF_S = 5.0   # agent.rs:34-45
+
+
+@dataclass
+class AgentConfig:
+    """fleet-agent main.rs:40 flags."""
+    cp_host: str = "127.0.0.1"
+    cp_port: int = 4510
+    slug: str = "node"
+    token: Optional[str] = None
+    ca_pem: Optional[bytes] = None
+    heartbeat_interval_s: float = 30.0
+    monitor_interval_s: float = 30.0
+    restart_threshold: int = 3
+    deploy_base: str = "~/.fleetflow/deploys"
+    capacity: dict = field(default_factory=lambda: {
+        "cpu": 2.0, "memory": 4096.0, "disk": 40960.0})
+    version: str = "0.1.0"
+
+
+class Agent:
+    def __init__(self, config: AgentConfig, *,
+                 backend: Optional[ContainerBackend] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.config = config
+        self.backend = backend or DockerCliBackend()
+        self.sleep = sleep
+        self.detector = AnomalyDetector(
+            restart_threshold=config.restart_threshold)
+        self.conn: Optional[Connection] = None
+        self._stop = asyncio.Event()
+        self._session_tasks: list[asyncio.Task] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def run(self) -> None:
+        """Outer reconnect loop (agent.rs:30-45)."""
+        while not self._stop.is_set():
+            try:
+                await self.run_session()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # any session failure (refused socket, auth reject -> RpcError,
+                # garbage frame -> JSONDecodeError, register timeout) means
+                # "retry after backoff", never "die" (agent.rs:34-45)
+                pass
+            if self._stop.is_set():
+                break
+            try:
+                await asyncio.wait_for(self._stop.wait(), RECONNECT_BACKOFF_S)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def run_session(self) -> None:
+        """One connected session (agent.rs run_session:87)."""
+        ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.config.ca_pem:
+            from ..cp.cert import client_ssl_context
+            ssl_ctx = client_ssl_context(self.config.ca_pem)
+
+        conn, run_task = await ProtocolClient.connect(
+            self.config.cp_host, self.config.cp_port,
+            identity=self.config.slug, token=self.config.token,
+            ssl_context=ssl_ctx,
+            event_handlers={"agent": self._on_command})
+        self.conn = conn
+        try:
+            await conn.request("agent", "register", {
+                "slug": self.config.slug,
+                "hostname": self.config.slug,
+                "version": self.config.version,
+                "capacity": self.config.capacity,
+            })
+            hb = asyncio.ensure_future(self._heartbeat_loop())
+            mon = asyncio.ensure_future(self._monitor_loop())
+            self._session_tasks = [hb, mon]
+            stop_wait = asyncio.ensure_future(self._stop.wait())
+            try:
+                await asyncio.wait([run_task, stop_wait],
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for t in (hb, mon, stop_wait):
+                    t.cancel()
+        finally:
+            self.conn = None
+            await conn.close()
+            run_task.cancel()
+
+    # ------------------------------------------------------------------
+    # background loops
+    # ------------------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        """heartbeat.rs:10-23."""
+        while True:
+            try:
+                await self.conn.send_event("agent", "heartbeat",
+                                           {"version": self.config.version})
+            except Exception:
+                return
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+
+    async def _monitor_loop(self) -> None:
+        """monitor.rs run_loop:263: inventory + anomaly detection."""
+        while True:
+            try:
+                await self.monitor_once()
+            except Exception:
+                pass
+            await asyncio.sleep(self.config.monitor_interval_s)
+
+    async def monitor_once(self) -> None:
+        snaps = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: snapshot_backend(self.backend))
+        await self.conn.send_event("agent", "inventory",
+                                   {"containers": inventory_report(snaps)})
+        for anomaly in self.detector.observe(snaps):
+            await self.conn.send_event("agent", "alert", {
+                "container": anomaly.container,
+                "kind": anomaly.kind,
+                "message": anomaly.message,
+                "resolved": anomaly.resolved,
+            })
+
+    # ------------------------------------------------------------------
+    # command dispatch (the envelope protocol)
+    # ------------------------------------------------------------------
+
+    async def _on_command(self, conn: Connection, method: str,
+                          envelope: dict) -> None:
+        """agent.rs command loop :129-208 + envelope :215-254."""
+        request_id = envelope.get("request_id")
+        payload = envelope.get("payload", {})
+        try:
+            result = await self.execute_command(method, payload)
+            reply = {"request_id": request_id, "result": result}
+        except Exception as e:
+            reply = {"request_id": request_id,
+                     "error": f"{type(e).__name__}: {e}"}
+        if request_id:
+            try:
+                await conn.send_event("agent", "command_result", reply)
+            except Exception:
+                pass
+
+    async def execute_command(self, method: str, payload: dict) -> dict:
+        loop = asyncio.get_running_loop()
+        if method == "ping":
+            return {"pong": True, "slug": self.config.slug}
+
+        if method == "status":
+            snaps = await loop.run_in_executor(
+                None, lambda: snapshot_backend(self.backend))
+            return {"containers": inventory_report(snaps)}
+
+        if method == "restart":
+            name = validate_container_name(payload["container"])
+            await loop.run_in_executor(None, lambda: self.backend.restart(name))
+            return {"restarted": name}
+
+        if method == "deploy.execute":
+            req = DeployRequest.from_dict(payload["request"])
+            if not req.node:
+                req.node = self.config.slug
+            placement = self._placement_from(req, payload.get("assignment"))
+            engine = DeployEngine(self.backend, sleep=self.sleep)
+
+            def run_deploy():
+                events: list[str] = []
+                res = engine.execute(req, on_event=lambda e: events.append(str(e)),
+                                     placement=placement)
+                return res, events
+
+            res, events = await loop.run_in_executor(None, run_deploy)
+            if not res.ok:
+                raise RuntimeError(f"failed services: {res.failed}")
+            # stream the event log to the CP afterward (agent.rs drain-and-
+            # forward :257-333: mpsc during, drain after)
+            for line in events:
+                await self.conn.send_event("agent", "log", {
+                    "container": f"deploy/{req.stage_name}", "line": line})
+            return {"deployed": res.deployed, "removed": res.removed,
+                    "duration_s": res.duration_s}
+
+        if method == "build":
+            return await loop.run_in_executor(
+                None, lambda: self._run_build(payload))
+
+        raise ValueError(f"unknown agent command {method!r}")
+
+    def _placement_from(self, req: DeployRequest,
+                        assignment: Optional[dict]) -> Optional[Placement]:
+        """Rebuild a Placement from the CP's solved assignment so the engine
+        executes exactly the slice assigned to this node."""
+        if not assignment:
+            return None
+        # only the dependency level schedule matters here — the node set was
+        # the CP's concern — so lower against a synthetic local node rather
+        # than resolving stage.servers (which this agent can't)
+        from ..core.model import ResourceSpec, ServerResource
+        pt = lower_stage(req.flow, req.stage_name, nodes=[ServerResource(
+            name=self.config.slug,
+            capacity=ResourceSpec(cpu=1e6, memory=1e9, disk=1e9))])
+        return Placement(assignment=dict(assignment),
+                         levels=level_schedule(pt),
+                         feasible=True, source="cp-solved")
+
+    def _run_build(self, payload: dict) -> dict:
+        """Build-worker path (agent.rs:476-649): git clone -> docker build
+        -> optional push."""
+        import tempfile
+        repo, ref = payload["repo"], payload.get("ref", "main")
+        tag = payload["image_tag"]
+        with tempfile.TemporaryDirectory(prefix="ffbuild-") as tmp:
+            clone = subprocess.run(
+                ["git", "clone", "--depth", "1", "--branch", ref, repo, tmp],
+                capture_output=True, text=True)
+            if clone.returncode != 0:
+                raise RuntimeError(f"git clone failed: {clone.stderr.strip()}")
+            # CP-supplied paths are confined to the fresh clone: a payload
+            # like context="/" must not ship the host filesystem
+            context = confine_path(payload.get("context", "."), tmp)
+            args = ["docker", "build", "-t", tag]
+            if payload.get("dockerfile"):
+                args += ["-f", str(confine_path(payload["dockerfile"], tmp))]
+            args.append(str(context))
+            build = subprocess.run(args, cwd=tmp, capture_output=True, text=True)
+            if build.returncode != 0:
+                raise RuntimeError(f"docker build failed: "
+                                   f"{build.stderr[-2000:]}")
+            log = build.stdout[-4000:]
+            if payload.get("push"):
+                push = subprocess.run(["docker", "push", tag],
+                                      capture_output=True, text=True)
+                if push.returncode != 0:
+                    raise RuntimeError(f"docker push failed: "
+                                       f"{push.stderr[-2000:]}")
+                log += "\n" + push.stdout[-1000:]
+            return {"image": tag, "log": log}

@@ -313,14 +313,17 @@ def _dns(state: "AppState"):
         if method == "delete":
             return {"deleted": db.delete("dns_records", p.get("id", ""))}
         if method == "sync":
-            # push unsynced records through the cloud DNS adapter when wired
+            # push unsynced records through the cloud DNS adapter; without a
+            # backend they stay pending (never mark unsent records synced)
             pending = db.list("dns_records", lambda r: not r.synced)
+            if state.dns_backend is None:
+                return {"synced": 0, "pending": len(pending),
+                        "error": "no DNS backend configured"}
             synced = 0
             for rec in pending:
-                if state.dns_backend is not None:
-                    state.dns_backend.ensure_record(
-                        rec.zone, rec.name, rec.type, rec.content,
-                        ttl=rec.ttl, proxied=rec.proxied)
+                state.dns_backend.ensure_record(
+                    rec.zone, rec.name, rec.type, rec.content,
+                    ttl=rec.ttl, proxied=rec.proxied)
                 db.update("dns_records", rec.id, synced=True)
                 synced += 1
             return {"synced": synced}

@@ -63,7 +63,8 @@ class PlacementService:
         self._sched_tpu = TpuSolverScheduler(chains=chains, steps=steps)
         self._sched_host = HostGreedyScheduler()
         self._lock = threading.Lock()
-        self._reservations: dict[str, Reservation] = {}
+        self._reservations: dict[str, Reservation] = {}   # in-flight only
+        self._committed: dict[str, Reservation] = {}      # stage_key -> last
         self._ids = itertools.count(1)
         self._last: dict[str, tuple[ProblemTensors, Placement]] = {}
 
@@ -148,49 +149,60 @@ class PlacementService:
             assignment=dict(placement.assignment))
         return rid
 
+    def _apply_allocation(self, r: Reservation, sign: float) -> None:
+        for slug, dem in r.demand_by_node.items():
+            s = self.store.server_by_slug(slug)
+            if s is None:
+                continue
+            self.store.update("servers", s.id, allocated=type(s.allocated)(
+                cpu=max(s.allocated.cpu + sign * float(dem[0]), 0.0),
+                memory=max(s.allocated.memory + sign * float(dem[1]), 0.0),
+                disk=max(s.allocated.disk + sign * float(dem[2]), 0.0),
+                reserved_cpu=s.allocated.reserved_cpu,
+                reserved_memory=s.allocated.reserved_memory,
+                reserved_disk=s.allocated.reserved_disk,
+            ))
+
     def commit(self, rid: str) -> bool:
         """Deploy succeeded: move reserved -> committed on the servers
-        (2-phase step 2, model.rs:421-427)."""
+        (2-phase step 2, model.rs:421-427). A redeploy of the same stage
+        SUPERSEDES its previous commit — the old containers were stopped and
+        replaced, so their allocation is returned first."""
         with self._lock:
-            r = self._reservations.get(rid)
+            r = self._reservations.pop(rid, None)
             if r is None or r.committed:
                 return False
-            for slug, dem in r.demand_by_node.items():
-                s = self.store.server_by_slug(slug)
-                if s is None:
-                    continue
-                self.store.update("servers", s.id, allocated=type(s.allocated)(
-                    cpu=s.allocated.cpu + float(dem[0]),
-                    memory=s.allocated.memory + float(dem[1]),
-                    disk=s.allocated.disk + float(dem[2]),
-                    reserved_cpu=s.allocated.reserved_cpu,
-                    reserved_memory=s.allocated.reserved_memory,
-                    reserved_disk=s.allocated.reserved_disk,
-                ))
+            prev = self._committed.pop(r.stage_key, None)
+            if prev is not None:
+                self._apply_allocation(prev, -1.0)
+            self._apply_allocation(r, +1.0)
             r.committed = True
+            self._committed[r.stage_key] = r
             return True
 
     def release(self, rid: str, *, undo_commit: bool = False) -> bool:
         """Deploy failed or stage torn down: drop the reservation; with
-        `undo_commit`, also return committed capacity."""
+        `undo_commit`, also return the stage's committed capacity."""
         with self._lock:
             r = self._reservations.pop(rid, None)
-            if r is None:
+            if r is not None:
+                return True
+            if undo_commit:
+                for key, c in list(self._committed.items()):
+                    if c.id == rid:
+                        self._apply_allocation(c, -1.0)
+                        del self._committed[key]
+                        return True
+            return False
+
+    def release_stage(self, stage_key: str) -> bool:
+        """Stage torn down (`fleet down` on a remote stage): return its
+        committed capacity."""
+        with self._lock:
+            c = self._committed.pop(stage_key, None)
+            if c is None:
                 return False
-            if r.committed and undo_commit:
-                for slug, dem in r.demand_by_node.items():
-                    s = self.store.server_by_slug(slug)
-                    if s is None:
-                        continue
-                    self.store.update("servers", s.id,
-                                      allocated=type(s.allocated)(
-                        cpu=max(s.allocated.cpu - float(dem[0]), 0.0),
-                        memory=max(s.allocated.memory - float(dem[1]), 0.0),
-                        disk=max(s.allocated.disk - float(dem[2]), 0.0),
-                        reserved_cpu=s.allocated.reserved_cpu,
-                        reserved_memory=s.allocated.reserved_memory,
-                        reserved_disk=s.allocated.reserved_disk,
-                    ))
+            self._apply_allocation(c, -1.0)
             return True
 
     # ------------------------------------------------------------------

@@ -234,9 +234,13 @@ class ProtocolServer:
                           event_handlers=self.event_handlers)
         self.connections.add(conn)
         conn.on_close = self._forget
-        await conn._send({"type": "welcome", "server": self.name})
-        if self.on_connect is not None:
-            await self.on_connect(conn, hello)
+        try:
+            await conn._send({"type": "welcome", "server": self.name})
+            if self.on_connect is not None:
+                await self.on_connect(conn, hello)
+        except Exception:
+            await conn.close()   # client reset mid-welcome: don't leak
+            return
         await conn.run()
 
     async def _forget(self, conn: Connection) -> None:
@@ -270,16 +274,20 @@ class ProtocolClient:
         conn = Connection(reader=reader, writer=writer, identity=identity,
                           handlers=handlers or {},
                           event_handlers=event_handlers or {})
-        writer.write(encode_frame({
-            "type": "hello", "identity": identity, "token": token,
-            "channels": sorted((handlers or {}).keys())}))
-        await writer.drain()
-        welcome = await read_frame(reader)
-        if not welcome:
-            raise RpcError("connection closed during handshake")
-        if welcome.get("type") == "error":
-            raise RpcError(welcome.get("error", "handshake rejected"))
-        if welcome.get("type") != "welcome":
-            raise RpcError(f"unexpected handshake reply: {welcome}")
+        try:
+            writer.write(encode_frame({
+                "type": "hello", "identity": identity, "token": token,
+                "channels": sorted((handlers or {}).keys())}))
+            await writer.drain()
+            welcome = await read_frame(reader)
+            if not welcome:
+                raise RpcError("connection closed during handshake")
+            if welcome.get("type") == "error":
+                raise RpcError(welcome.get("error", "handshake rejected"))
+            if welcome.get("type") != "welcome":
+                raise RpcError(f"unexpected handshake reply: {welcome}")
+        except BaseException:
+            writer.close()   # failed handshake must not leak the socket
+            raise
         task = asyncio.ensure_future(conn.run())
         return conn, task

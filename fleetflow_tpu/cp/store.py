@@ -98,8 +98,12 @@ class Store:
 
     def find_one(self, table: str,
                  where: Callable[[Record], bool]) -> Optional[Record]:
-        for r in self.list(table, where):
-            return r
+        # hot path (server_by_slug on every heartbeat/alert/inventory):
+        # early-exit scan, no copy/sort like list()
+        with self._lock:
+            for r in self._tables[table].values():
+                if where(r):
+                    return r
         return None
 
     # ------------------------------------------------------------------

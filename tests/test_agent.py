@@ -1,0 +1,283 @@
+"""Node-agent tests: anomaly detection tables, guards, and a REAL agent
+against an in-process CP (the full distributed slice on loopback).
+
+Anomaly table tests mirror monitor.rs:642-759; the end-to-end session test
+is this build's upgrade over the reference's fake-agent-only coverage: the
+actual Agent class connects, registers, heartbeats, reports inventory, and
+executes a CP-routed deploy against a mock docker backend.
+"""
+
+import asyncio
+
+import pytest
+
+from fleetflow_tpu.agent import Agent, AgentConfig
+from fleetflow_tpu.agent.guard import (GuardError, confine_path,
+                                       validate_compose_command,
+                                       validate_container_name)
+from fleetflow_tpu.agent.monitor import (AnomalyDetector, ContainerSnapshot,
+                                         detect_anomalies, inventory_report)
+from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+from fleetflow_tpu.cp import ServerConfig, start
+from fleetflow_tpu.cp.protocol import ProtocolClient
+from fleetflow_tpu.runtime import DeployRequest, MockBackend
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def snap(name, state="running", health=None, restarts=0):
+    return ContainerSnapshot(name=name, state=state, health=health,
+                             restart_count=restarts)
+
+
+# --------------------------------------------------------------------------
+# anomaly detection tables (monitor.rs:642-759 analog)
+# --------------------------------------------------------------------------
+
+class TestDetectAnomalies:
+    def test_restart_loop_raised_at_threshold(self):
+        prev = {"web": snap("web", restarts=1)}
+        curr = {"web": snap("web", restarts=4)}
+        out = detect_anomalies(prev, curr, restart_threshold=3)
+        assert [(a.kind, a.resolved) for a in out] == [("restart_loop", False)]
+
+    def test_restart_below_threshold_ignored(self):
+        prev = {"web": snap("web", restarts=1)}
+        curr = {"web": snap("web", restarts=3)}
+        assert detect_anomalies(prev, curr, restart_threshold=3) == []
+
+    def test_unexpected_stop_and_recovery(self):
+        prev = {"db": snap("db", state="running")}
+        curr = {"db": snap("db", state="exited")}
+        out = detect_anomalies(prev, curr)
+        assert [(a.kind, a.resolved) for a in out] == [("unexpected_stop", False)]
+        out2 = detect_anomalies(curr, prev)  # came back
+        assert [(a.kind, a.resolved) for a in out2] == [("unexpected_stop", True)]
+
+    def test_unhealthy_and_recovery(self):
+        prev = {"api": snap("api", health="healthy")}
+        curr = {"api": snap("api", health="unhealthy")}
+        out = detect_anomalies(prev, curr)
+        assert [(a.kind, a.resolved) for a in out] == [("unhealthy", False)]
+        out2 = detect_anomalies(curr, prev)
+        assert [(a.kind, a.resolved) for a in out2] == [("unhealthy", True)]
+
+    def test_first_observation_no_false_positives(self):
+        # no prev snapshot: a stopped container is not an "unexpected stop"
+        curr = {"x": snap("x", state="exited")}
+        assert detect_anomalies({}, curr) == []
+
+    def test_unhealthy_on_first_sight_still_fires(self):
+        curr = {"x": snap("x", health="unhealthy")}
+        out = detect_anomalies({}, curr)
+        assert [a.kind for a in out] == ["unhealthy"]
+
+
+class TestAnomalyDetectorCooldown:
+    def test_cooldown_suppresses_repeat_alerts(self):
+        clock = [0.0]
+        det = AnomalyDetector(cooldown_s=300, clock=lambda: clock[0])
+        det.observe({"w": snap("w", health="healthy")})
+        assert [a.kind for a in det.observe({"w": snap("w", health="unhealthy")})] \
+            == ["unhealthy"]
+        clock[0] += 30   # within cooldown: suppressed
+        assert det.observe({"w": snap("w", health="unhealthy")}) == []
+        clock[0] += 300  # past cooldown: fires again
+        assert [a.kind for a in det.observe({"w": snap("w", health="unhealthy")})] \
+            == ["unhealthy"]
+
+    def test_autoresolve_on_recovery_and_removal(self):
+        det = AnomalyDetector()
+        det.observe({"w": snap("w", health="healthy")})
+        det.observe({"w": snap("w", health="unhealthy")})
+        out = det.observe({"w": snap("w", health="healthy")})
+        assert [(a.kind, a.resolved) for a in out] == [("unhealthy", True)]
+        # raise again, then the container disappears entirely
+        det.observe({"w": snap("w", health="unhealthy")})
+        # (cooldown suppressed the re-raise; force state)
+        det._active.add(("w", "unhealthy"))
+        out = det.observe({})
+        assert ("unhealthy", True) in [(a.kind, a.resolved) for a in out]
+
+    def test_inventory_attribution(self):
+        s = ContainerSnapshot(
+            name="p-s-web", state="running", image="web:1",
+            labels=(("fleetflow.project", "p"), ("fleetflow.service", "web"),
+                    ("fleetflow.stage", "s")))
+        rows = inventory_report({"p-s-web": s})
+        assert rows[0]["project"] == "p" and rows[0]["service"] == "web"
+
+
+# --------------------------------------------------------------------------
+# guards (deploy.rs:25-50,188 analog)
+# --------------------------------------------------------------------------
+
+class TestGuards:
+    def test_compose_allowlist(self):
+        assert validate_compose_command(["up", "-d"]) == ["up", "-d"]
+        with pytest.raises(GuardError):
+            validate_compose_command(["exec", "sh"])
+        with pytest.raises(GuardError):
+            validate_compose_command(["up", "-f", "/etc/evil.yaml"])
+        with pytest.raises(GuardError):
+            validate_compose_command(["up", "--file=/etc/evil.yaml"])
+        with pytest.raises(GuardError):
+            validate_compose_command(["up", "-H", "tcp://evil:2375"])
+
+    def test_path_confinement(self, tmp_path):
+        base = tmp_path / "deploys"
+        base.mkdir()
+        assert confine_path("proj/a", str(base)) == (base / "proj/a").resolve()
+        with pytest.raises(GuardError):
+            confine_path("../../etc/passwd", str(base))
+        with pytest.raises(GuardError):
+            confine_path("/etc/passwd", str(base))
+        # symlink escape
+        (base / "link").symlink_to("/etc")
+        with pytest.raises(GuardError):
+            confine_path("link/passwd", str(base))
+
+    def test_container_name(self):
+        assert validate_container_name("proj-live-db") == "proj-live-db"
+        for bad in ("a; rm -rf /", "", "-lead", "x" * 200, "has space"):
+            with pytest.raises(GuardError):
+                validate_container_name(bad)
+
+
+# --------------------------------------------------------------------------
+# real agent <-> in-process CP (the full loopback slice)
+# --------------------------------------------------------------------------
+
+def make_agent(handle, slug="node-1", **kw):
+    backend = MockBackend()
+    backend.pull = lambda image: backend.images.add(image)
+    cfg = AgentConfig(cp_host=handle.host, cp_port=handle.port, slug=slug,
+                      heartbeat_interval_s=0.05, monitor_interval_s=0.05,
+                      capacity={"cpu": 8, "memory": 16384, "disk": 100000},
+                      **kw)
+    return Agent(cfg, backend=backend, sleep=lambda d: None), backend
+
+
+class TestAgentSession:
+    def test_register_heartbeat_inventory(self, project):
+        async def go():
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if handle.state.agent_registry.is_connected("node-1"):
+                    break
+            s = handle.state.store.server_by_slug("node-1")
+            assert s is not None and s.status == "online"
+            assert s.capacity.cpu == 8
+            # monitor loop ships inventory for pre-existing containers
+            from fleetflow_tpu.runtime.converter import ContainerConfig
+            backend.images.add("x:1")
+            backend.create(ContainerConfig(
+                name="c1", image="x:1",
+                labels={"fleetflow.project": "p", "fleetflow.stage": "s",
+                        "fleetflow.service": "c"}))
+            backend.start("c1")
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if handle.state.store.observed_on("node-1"):
+                    break
+            obs = handle.state.store.observed_on("node-1")
+            assert [o.name for o in obs] == ["c1"]
+            assert obs[0].project == "p"
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await handle.stop()
+        run(go())
+
+    def test_cp_routed_deploy_executes_on_agent(self, project):
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            # the containers exist on the AGENT's backend
+            names = sorted(backend.containers)
+            assert names == ["testproj-local-app", "testproj-local-postgres",
+                             "testproj-local-redis"]
+            # deploy event log was drained into the CP log router
+            topics = handle.state.log_router.topics()
+            assert "logs/node-1/deploy/local" in topics
+            # committed allocation recorded on the server
+            s = handle.state.store.server_by_slug("node-1")
+            assert s.allocated.cpu > 0
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_restart_command_and_anomaly_alert(self, project):
+        async def go():
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            from fleetflow_tpu.runtime.converter import ContainerConfig
+            backend.images.add("x:1")
+            backend.create(ContainerConfig(name="c1", image="x:1"))
+            backend.start("c1")
+            out = await handle.state.agent_registry.send_command(
+                "node-1", "restart", {"container": "c1"}, timeout=5)
+            assert out["restarted"] == "c1"
+            assert backend.containers["c1"].restart_count == 1
+            # kill it behind the agent's back -> unexpected_stop alert
+            await agent.monitor_once()
+            backend.set_state("c1", "dead")
+            await agent.monitor_once()
+            await asyncio.sleep(0.1)
+            kinds = [a.kind for a in handle.state.store.active_alerts()]
+            assert "unexpected_stop" in kinds
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await handle.stop()
+        run(go())
+
+    def test_reconnect_after_cp_restart(self, project):
+        async def go():
+            handle = await start(ServerConfig())
+            port = handle.port
+            agent, _ = make_agent(handle)
+            # shrink backoff for the test
+            import fleetflow_tpu.agent.agent as agent_mod
+            old = agent_mod.RECONNECT_BACKOFF_S
+            agent_mod.RECONNECT_BACKOFF_S = 0.05
+            try:
+                task = asyncio.ensure_future(agent.run())
+                while not handle.state.agent_registry.is_connected("node-1"):
+                    await asyncio.sleep(0.02)
+                await handle.stop()
+                await asyncio.sleep(0.1)
+                # CP comes back on the same port
+                handle2 = await start(ServerConfig(port=port))
+                for _ in range(200):
+                    await asyncio.sleep(0.02)
+                    if handle2.state.agent_registry.is_connected("node-1"):
+                        break
+                assert handle2.state.agent_registry.is_connected("node-1")
+                agent.stop()
+                await asyncio.wait_for(task, 5)
+                await handle2.stop()
+            finally:
+                agent_mod.RECONNECT_BACKOFF_S = old
+        run(go())

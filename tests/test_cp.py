@@ -198,8 +198,19 @@ class TestChannels:
             await conn.request("dns", "create",
                                {"zone": "example.com", "name": "app",
                                 "content": "1.2.3.4"})
+            # no backend wired: records stay pending, not silently "synced"
+            synced = await conn.request("dns", "sync", {})
+            assert synced["synced"] == 0 and synced["pending"] == 1
+
+            class FakeDns:
+                calls = []
+                def ensure_record(self, zone, name, rtype, content, **kw):
+                    self.calls.append((zone, name, rtype, content))
+            handle.state.dns_backend = FakeDns()
             synced = await conn.request("dns", "sync", {})
             assert synced["synced"] == 1
+            assert handle.state.dns_backend.calls == [
+                ("example.com", "app", "A", "1.2.3.4")]
             await conn.close()
             await handle.stop()
         run(go())
@@ -427,6 +438,30 @@ class TestPlacementChannel:
             assert ok["ok"]
             s = handle.state.store.server_by_slug("node-1")
             assert s.allocated.cpu > 0     # committed capacity recorded
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_redeploy_supersedes_previous_commit(self, project):
+        """A redeploy replaces the stage's containers, so its commit must
+        not double-book capacity (review finding: monotonic allocation)."""
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            await FakeAgent("node-1").connect(handle)
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            allocs = []
+            for _ in range(3):
+                out = await conn.request("placement", "solve",
+                                         {"flow": flow_to_dict(flow),
+                                          "stage": "local", "reserve": True})
+                await conn.request("placement", "commit",
+                                   {"reservation": out["reservation"]})
+                s = handle.state.store.server_by_slug("node-1")
+                allocs.append(s.allocated.cpu)
+            assert allocs[0] > 0
+            assert allocs[0] == pytest.approx(allocs[1]) == pytest.approx(allocs[2])
             await conn.close()
             await handle.stop()
         run(go())
