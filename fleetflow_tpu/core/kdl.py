@@ -311,6 +311,8 @@ class _Parser:
             return True
         if c == "r" and (self.peek(1) == '"' or self.peek(1) == "#"):
             return True
+        if c == "#" and self.peek(1).isalpha():
+            return True   # KDL v2 keyword (#true/#false/#null/#inf/#nan)
         if c.isdigit():
             return True
         if c in "+-" and self.peek(1).isdigit():
@@ -325,6 +327,18 @@ class _Parser:
             return self.parse_raw_string()
         if c.isdigit() or (c in "+-" and self.peek(1).isdigit()):
             return self.parse_number()
+        if c == "#":
+            # KDL v2 keywords: #true / #false / #null
+            self.pos += 1
+            kw = self.parse_identifier()
+            if kw == "true":
+                return True
+            if kw == "false":
+                return False
+            if kw in ("null", "nan", "inf", "-inf"):
+                return {"null": None, "nan": float("nan"),
+                        "inf": float("inf"), "-inf": float("-inf")}[kw]
+            raise self.error(f"unknown keyword #{kw}")
         ident = self.parse_identifier()
         if ident == "true":
             return True

@@ -205,6 +205,16 @@ class PlacementService:
             self._apply_allocation(c, -1.0)
             return True
 
+    def snapshot(self) -> dict[str, dict]:
+        """Public view of the latest placement per stage (for REST/MCP)."""
+        with self._lock:
+            return {key: {"assignment": pl.assignment,
+                          "feasible": pl.feasible,
+                          "violations": pl.violations,
+                          "source": pl.source,
+                          "solve_ms": round(pl.solve_ms, 2)}
+                    for key, (_pt, pl) in self._last.items()}
+
     # ------------------------------------------------------------------
     # streaming re-solve (BASELINE config 5)
     # ------------------------------------------------------------------

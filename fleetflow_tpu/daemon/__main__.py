@@ -1,0 +1,62 @@
+"""`python -m fleetflow_tpu.daemon` — run the control-plane daemon.
+
+The fleetflowd binary analog (main.rs:40): flags mirror the reference's
+(config path, foreground run; `stop`/`status` subcommands act on the PID
+file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from .config import load_daemon_config
+from .daemon import Daemon
+from .pidfile import PidFile, PidStatus
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleetflowd",
+                                 description="fleetflow-tpu control-plane daemon")
+    ap.add_argument("command", nargs="?", default="run",
+                    choices=["run", "stop", "status"])
+    ap.add_argument("-c", "--config", help="path to fleetflowd.kdl")
+    args = ap.parse_args(argv)
+
+    cfg = load_daemon_config(args.config)
+
+    if args.command == "status":
+        st, pid = PidFile(cfg.pid_file).status()
+        print(f"{st.value}" + (f" (pid {pid})" if pid else ""))
+        return 0 if st is PidStatus.RUNNING else 1
+
+    if args.command == "stop":
+        st, pid = PidFile(cfg.pid_file).status()
+        if st is not PidStatus.RUNNING:
+            print("not running")
+            return 1
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to {pid}")
+        return 0
+
+    daemon = Daemon(cfg)
+
+    async def run():
+        await daemon.run_forever()
+
+    print(f"fleetflowd: cp on {cfg.listen_host}:{cfg.listen_port}"
+          + (f", web on http://{cfg.web_host}:{cfg.web_port}"
+             if cfg.web_enabled else "")
+          + (f", config {cfg.source}" if cfg.source else " (defaults)"))
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

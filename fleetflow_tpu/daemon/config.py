@@ -1,0 +1,102 @@
+"""Daemon configuration.
+
+Analog of fleetflowd config.rs:7-57: a `fleetflowd.kdl` file holding
+pid/log/listen/db/auth/web/health-interval settings, discovered through the
+search chain: explicit path -> ./fleetflowd.kdl -> ~/.config/fleetflow/
+fleetflowd.kdl -> /etc/fleetflow/fleetflowd.kdl.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from ..core.kdl import parse_document
+
+__all__ = ["DaemonConfig", "load_daemon_config", "config_search_paths"]
+
+
+@dataclass
+class DaemonConfig:
+    """config.rs DaemonConfig:7-18."""
+    pid_file: str = "~/.local/state/fleetflow/fleetflowd.pid"
+    log_file: Optional[str] = None
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 4510
+    web_host: str = "127.0.0.1"
+    web_port: int = 32080
+    web_enabled: bool = True
+    db_path: Optional[str] = "~/.local/state/fleetflow/cp.json"
+    auth_kind: str = "none"
+    auth_secret: Optional[str] = None
+    tls_dir: Optional[str] = "~/.local/state/fleetflow/ca"
+    health_interval_s: float = 60.0        # config.rs:33
+    heartbeat_stale_s: float = 90.0
+    use_tpu_solver: bool = False
+    source: Optional[str] = None
+
+    def expand(self) -> "DaemonConfig":
+        for attr in ("pid_file", "log_file", "db_path", "tls_dir"):
+            v = getattr(self, attr)
+            if v:
+                setattr(self, attr, os.path.expanduser(v))
+        return self
+
+
+def config_search_paths(explicit: Optional[str] = None) -> list[Path]:
+    """config.rs:43-57 search order."""
+    paths = []
+    if explicit:
+        paths.append(Path(explicit))
+    paths.append(Path("fleetflowd.kdl"))
+    paths.append(Path.home() / ".config" / "fleetflow" / "fleetflowd.kdl")
+    paths.append(Path("/etc/fleetflow/fleetflowd.kdl"))
+    return paths
+
+
+def load_daemon_config(explicit: Optional[str] = None) -> DaemonConfig:
+    # an explicitly named config that doesn't exist is an error, never a
+    # silent fall-through to defaults (a typo'd -c must not start the
+    # daemon with localhost/no-auth settings)
+    if explicit and not Path(explicit).is_file():
+        raise FileNotFoundError(f"daemon config {explicit!r} not found")
+    cfg = DaemonConfig()
+    for path in config_search_paths(explicit):
+        if path.is_file():
+            _apply_kdl(cfg, path.read_text())
+            cfg.source = str(path)
+            break
+    return cfg.expand()
+
+
+def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
+    for node in parse_document(text):
+        n, v = node.name, node.arg(0)
+        if n == "pid-file":
+            cfg.pid_file = str(v)
+        elif n == "log-file":
+            cfg.log_file = str(v)
+        elif n == "listen":
+            cfg.listen_host = str(node.prop("host", cfg.listen_host))
+            cfg.listen_port = int(node.prop("port", cfg.listen_port))
+        elif n == "web":
+            cfg.web_enabled = bool(node.prop("enabled", True))
+            cfg.web_host = str(node.prop("host", cfg.web_host))
+            cfg.web_port = int(node.prop("port", cfg.web_port))
+        elif n == "db":
+            cfg.db_path = str(v) if v not in (None, "memory") else None
+        elif n == "auth":
+            cfg.auth_kind = str(v or "none")
+            secret = node.prop("secret")
+            if secret is not None:
+                cfg.auth_secret = str(secret)
+        elif n == "tls-dir":
+            cfg.tls_dir = str(v) if v else None
+        elif n == "health-interval":
+            cfg.health_interval_s = float(v)
+        elif n == "heartbeat-stale":
+            cfg.heartbeat_stale_s = float(v)
+        elif n == "tpu-solver":
+            cfg.use_tpu_solver = bool(v)

@@ -1,0 +1,71 @@
+"""Daemon lifecycle: the fleetflowd binary.
+
+Analog of fleetflowd main.rs:40-202: load config -> PID-file check
+(Running/Stale/Stopped) -> start CP protocol server + web REST + health
+checker -> run until SIGTERM/SIGINT -> graceful stop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+from typing import Optional
+
+from ..cp.server import CpServerHandle, ServerConfig, start as cp_start
+from .config import DaemonConfig
+from .health import HealthChecker
+from .pidfile import PidFile
+from .web import WebServer
+
+__all__ = ["Daemon"]
+
+
+class Daemon:
+    def __init__(self, config: DaemonConfig):
+        self.config = config
+        self.pidfile = PidFile(config.pid_file)
+        self.cp: Optional[CpServerHandle] = None
+        self.web: Optional[WebServer] = None
+        self.health: Optional[HealthChecker] = None
+        self.web_addr: Optional[tuple[str, int]] = None
+        self._stop = asyncio.Event()
+
+    async def start(self) -> None:
+        cfg = self.config
+        self.cp = await cp_start(ServerConfig(
+            host=cfg.listen_host, port=cfg.listen_port,
+            db_path=cfg.db_path, auth_kind=cfg.auth_kind,
+            auth_secret=cfg.auth_secret, tls_dir=cfg.tls_dir,
+            use_tpu_solver=cfg.use_tpu_solver))
+        if cfg.web_enabled:
+            self.web = WebServer(self.cp.state)
+            self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)
+        self.health = HealthChecker(self.cp.state,
+                                    interval_s=cfg.health_interval_s,
+                                    stale_after_s=cfg.heartbeat_stale_s)
+        self.health.spawn()
+
+    async def stop(self) -> None:
+        if self.health:
+            self.health.stop()
+        if self.web:
+            await self.web.stop()
+        if self.cp:
+            await self.cp.stop()
+        self._stop.set()
+
+    async def run_forever(self) -> None:
+        """PID-guarded run with signal handling (main.rs:173-202)."""
+        self.pidfile.acquire()
+        try:
+            await self.start()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(
+                        sig, lambda: asyncio.ensure_future(self.stop()))
+                except NotImplementedError:
+                    pass
+            await self._stop.wait()
+        finally:
+            self.pidfile.release()

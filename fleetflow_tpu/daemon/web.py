@@ -1,0 +1,457 @@
+"""REST + dashboard web surface.
+
+Analog of fleetflowd web.rs:31-116: public `/api/health` and
+`/api/auth/config`; bearer-JWT-protected API routes over the CP AppState
+(overview, tenants, projects, servers + cordon/drain, stages + status/
+adopt/restart, deployments + log, agents, DNS + sync, tenant users,
+volumes + adopt, builds, alerts); an embedded single-file dashboard at `/`.
+
+The HTTP server is a small asyncio implementation (request line + headers +
+Content-Length body, JSON in/out) — the axum analog without a framework
+dependency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import TYPE_CHECKING, Callable, Optional
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from ..cp.auth import AuthError, NoAuth
+
+if TYPE_CHECKING:
+    from ..cp.server import AppState
+
+__all__ = ["WebServer"]
+
+MAX_BODY = 4 << 20
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _response(status: int, body, content_type="application/json") -> bytes:
+    if isinstance(body, (dict, list)):
+        payload = json.dumps(body).encode()
+    elif isinstance(body, str):
+        payload = body.encode()
+    else:
+        payload = body
+    reason = {200: "OK", 201: "Created", 400: "Bad Request",
+              401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+              405: "Method Not Allowed", 500: "Internal Server Error"}.get(
+                  status, "Unknown")
+    head = (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            "Connection: close\r\n\r\n")
+    return head.encode() + payload
+
+
+class WebServer:
+    def __init__(self, state: "AppState"):
+        self.state = state
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.routes: list[tuple[str, re.Pattern, Callable, bool]] = []
+        self._register_routes()
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def route(self, method: str, pattern: str, *, public: bool = False):
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+
+        def deco(fn):
+            self.routes.append((method, regex, fn, public))
+            return fn
+        return deco
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        sock = self._server.sockets[0].getsockname()
+        return sock[0], sock[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            out = await asyncio.wait_for(self._handle(reader), 30)
+        except HttpError as e:
+            out = _response(e.status, {"error": str(e)})
+        except asyncio.TimeoutError:
+            out = _response(400, {"error": "request timeout"})
+        except Exception as e:
+            out = _response(500, {"error": f"{type(e).__name__}: {e}"})
+        try:
+            writer.write(out)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+
+    async def _handle(self, reader: asyncio.StreamReader) -> bytes:
+        request_line = (await reader.readline()).decode("latin-1").strip()
+        if not request_line:
+            raise HttpError(400, "empty request")
+        try:
+            method, target, _version = request_line.split(" ", 2)
+        except ValueError:
+            raise HttpError(400, "malformed request line") from None
+        headers: dict[str, str] = {}
+        while True:
+            line = (await reader.readline()).decode("latin-1").strip()
+            if not line:
+                break
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        body = {}
+        length = int(headers.get("content-length", 0))
+        if length > MAX_BODY:
+            raise HttpError(400, "body too large")
+        if length:
+            raw = await reader.readexactly(length)
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                raise HttpError(400, "invalid JSON body") from None
+
+        split = urlsplit(target)
+        path = split.path
+        query = {k: v[0] for k, v in parse_qs(split.query).items()}
+
+        path_matched = False
+        for m, regex, fn, public in self.routes:
+            match = regex.match(path)
+            if match is None:
+                continue
+            if m != method:
+                path_matched = True
+                continue
+            if not public:
+                self._authorize(headers)
+            # path params arrive percent-encoded (e.g. %40 in emails)
+            params = {k: unquote(v) for k, v in match.groupdict().items()}
+            result = fn(body=body, query=query, **params)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if isinstance(result, tuple):
+                status, payload = result
+            else:
+                status, payload = 200, result
+            if isinstance(payload, str):
+                return _response(status, payload, content_type="text/html")
+            return _response(status, payload)
+        if path_matched:
+            raise HttpError(405, f"method {method} not allowed for {path}")
+        raise HttpError(404, f"no route for {method} {path}")
+
+    def _authorize(self, headers: dict[str, str]) -> None:
+        """web.rs auth middleware :140."""
+        if isinstance(self.state.auth, NoAuth):
+            return
+        auth = headers.get("authorization", "")
+        if not auth.startswith("Bearer "):
+            raise HttpError(401, "missing bearer token")
+        try:
+            self.state.auth.verify(auth[len("Bearer "):])
+        except AuthError as e:
+            raise HttpError(401, str(e)) from None
+
+    # ------------------------------------------------------------------
+    # routes (web.rs:47-116)
+    # ------------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        state = self.state
+        db = state.store
+
+        @self.route("GET", "/api/health", public=True)
+        def health(body, query):
+            return {"status": "ok", "name": state.name,
+                    "uptime_s": round(__import__("time").time()
+                                      - state.started_at, 1)}
+
+        @self.route("GET", "/api/auth/config", public=True)
+        def auth_config(body, query):
+            return {"kind": ("none" if isinstance(state.auth, NoAuth)
+                             else "token")}
+
+        @self.route("GET", "/", public=True)
+        def dashboard(body, query):
+            return 200, _DASHBOARD_HTML
+
+        @self.route("GET", "/api/overview")
+        def overview(body, query):
+            servers = db.list("servers")
+            return {
+                "servers": len(servers),
+                "online": sum(1 for s in servers if s.status == "online"),
+                "agents": state.agent_registry.list_connected(),
+                "projects": len(db.list("projects")),
+                "stages": len(db.list("stages")),
+                "deployments": len(db.list("deployments")),
+                "active_alerts": len(db.active_alerts()),
+            }
+
+        # -- tenants -----------------------------------------------------
+        @self.route("GET", "/api/tenants")
+        def tenants(body, query):
+            return {"tenants": [t.to_dict() for t in db.list("tenants")]}
+
+        @self.route("POST", "/api/tenants")
+        def tenant_create(body, query):
+            from ..cp.models import Tenant
+            t = db.create("tenants", Tenant(
+                name=body["name"],
+                display_name=body.get("display_name", body["name"])))
+            return 201, {"tenant": t.to_dict()}
+
+        @self.route("GET", "/api/tenants/{name}/overview")
+        def tenant_overview(body, query, name):
+            projects = db.list("projects", lambda p: p.tenant == name)
+            servers = db.list("servers", lambda s: s.tenant == name)
+            return {"tenant": name,
+                    "projects": [p.to_dict() for p in projects],
+                    "servers": [s.to_dict() for s in servers],
+                    "alerts": [a.to_dict() for a in db.active_alerts(name)],
+                    "cost_month": db.monthly_cost(
+                        name, query.get("month", ""))}
+
+        @self.route("GET", "/api/tenants/{name}/users")
+        def tenant_users(body, query, name):
+            return {"users": [u.to_dict() for u in db.tenant_users(name)]}
+
+        @self.route("POST", "/api/tenants/{name}/users")
+        def tenant_user_add(body, query, name):
+            from ..cp.models import TenantUser
+            u = db.create("tenant_users", TenantUser(
+                tenant=name, email=body["email"],
+                role=body.get("role", "member")))
+            return 201, {"user": u.to_dict()}
+
+        @self.route("DELETE", "/api/tenants/{name}/users/{email}")
+        def tenant_user_del(body, query, name, email):
+            u = db.user_by_email(name, email)
+            if u is None:
+                raise HttpError(404, f"no user {email} in {name}")
+            db.delete("tenant_users", u.id)
+            return {"deleted": True}
+
+        # -- projects / stages -------------------------------------------
+        @self.route("GET", "/api/projects")
+        def projects(body, query):
+            tenant = query.get("tenant")
+            return {"projects": [p.to_dict() for p in db.list(
+                "projects", lambda p: tenant is None or p.tenant == tenant)]}
+
+        @self.route("GET", "/api/stages")
+        def stages(body, query):
+            project = query.get("project")
+            return {"stages": [s.to_dict() for s in db.list(
+                "stages", lambda s: project is None or s.project == project)]}
+
+        @self.route("GET", "/api/stages/{sid}/status")
+        def stage_status(body, query, sid):
+            stage = db.get("stages", sid)
+            if stage is None:
+                raise HttpError(404, f"no stage {sid}")
+            deps = db.deployment_history(stage=sid, limit=1)
+            return {"stage": stage.to_dict(),
+                    "services": [s.to_dict() for s in db.services_of(sid)],
+                    "last_deployment": deps[0].to_dict() if deps else None,
+                    "alerts": [a.to_dict() for a in db.active_alerts()
+                               if a.server in stage.servers]}
+
+        @self.route("POST", "/api/stages/{sid}/adopt")
+        def stage_adopt(body, query, sid):
+            s = db.adopt_stage(sid)
+            if s is None:
+                raise HttpError(404, f"no stage {sid}")
+            return {"stage": s.to_dict()}
+
+        @self.route("POST", "/api/stages/{sid}/services/{name}/restart")
+        async def service_restart(body, query, sid, name):
+            stage = db.get("stages", sid)
+            if stage is None:
+                raise HttpError(404, f"no stage {sid}")
+            container = body.get("container") or name
+            results: dict = {}
+            for slug in stage.servers:
+                if not state.agent_registry.is_connected(slug):
+                    continue
+                # one failing agent must not hide the others' outcomes
+                try:
+                    results[slug] = await state.agent_registry.send_command(
+                        slug, "restart", {"container": container})
+                except Exception as e:
+                    results[slug] = {"error": str(e)}
+            if not results:
+                raise HttpError(400, "no connected agent for this stage")
+            return {"restarted": results}
+
+        # -- servers -----------------------------------------------------
+        @self.route("GET", "/api/servers")
+        def servers(body, query):
+            return {"servers": [s.to_dict() for s in db.list("servers")]}
+
+        @self.route("POST", "/api/servers/{slug}/{action}")
+        def server_action(body, query, slug, action):
+            if action not in ("cordon", "uncordon", "drain"):
+                raise HttpError(404, f"unknown action {action}")
+            s = db.server_by_slug(slug)
+            if s is None:
+                raise HttpError(404, f"no server {slug}")
+            new_state = {"cordon": "cordoned", "uncordon": "schedulable",
+                         "drain": "draining"}[action]
+            db.update("servers", s.id, scheduling_state=new_state)
+            if action == "drain":
+                state.placement.node_event(slug, online=False)
+            return {"server": slug, "scheduling_state": new_state}
+
+        @self.route("GET", "/api/agents")
+        def agents(body, query):
+            return {"agents": state.agent_registry.list_connected()}
+
+        # -- deployments / alerts ----------------------------------------
+        @self.route("GET", "/api/deployments")
+        def deployments(body, query):
+            return {"deployments": [d.to_dict() for d in db.deployment_history(
+                stage=query.get("stage"),
+                limit=int(query.get("limit", 50)))]}
+
+        @self.route("GET", "/api/deployments/{did}/log")
+        def deployment_log(body, query, did):
+            d = db.get("deployments", did)
+            if d is None:
+                raise HttpError(404, f"no deployment {did}")
+            return {"log": d.log, "error": d.error, "status": d.status}
+
+        @self.route("GET", "/api/alerts")
+        def alerts(body, query):
+            return {"alerts": [a.to_dict()
+                               for a in db.active_alerts(query.get("tenant"))]}
+
+        @self.route("GET", "/api/containers")
+        def containers(body, query):
+            server = query.get("server")
+            rows = (db.observed_on(server) if server
+                    else db.list("observed_containers"))
+            return {"containers": [r.to_dict() for r in rows]}
+
+        @self.route("GET", "/api/logs/{server}/{container}")
+        def container_logs(body, query, server, container):
+            from ..cp.log_router import topic_for
+            entries = state.log_router.retained(
+                topic_for(server, container),
+                limit=int(query["limit"]) if "limit" in query else None)
+            return {"lines": [e.to_dict() for e in entries]}
+
+        # -- dns ---------------------------------------------------------
+        @self.route("GET", "/api/dns")
+        def dns_list(body, query):
+            zone = query.get("zone")
+            return {"records": [r.to_dict() for r in db.list(
+                "dns_records", lambda r: zone is None or r.zone == zone)]}
+
+        @self.route("POST", "/api/dns")
+        def dns_create(body, query):
+            from ..cp.models import DnsRecord
+            rec = db.create("dns_records", DnsRecord(
+                tenant=body.get("tenant", "default"), zone=body["zone"],
+                name=body["name"], type=body.get("type", "A"),
+                content=body["content"], ttl=body.get("ttl", 300),
+                proxied=body.get("proxied", False)))
+            return 201, {"record": rec.to_dict()}
+
+        # -- volumes / builds --------------------------------------------
+        @self.route("GET", "/api/volumes")
+        def volumes(body, query):
+            return {"volumes": [v.to_dict() for v in db.list("volumes")]}
+
+        @self.route("POST", "/api/volumes/adopt")
+        def volume_adopt(body, query):
+            from ..cp.models import VolumeRecord
+            v = db.find_one("volumes", lambda r: r.server == body["server"]
+                            and r.name == body["name"])
+            if v is None:
+                v = db.create("volumes", VolumeRecord(
+                    tenant=body.get("tenant", "default"),
+                    server=body["server"], name=body["name"], adopted=True))
+            else:
+                db.update("volumes", v.id, adopted=True)
+            return {"volume": db.get("volumes", v.id).to_dict()}
+
+        @self.route("GET", "/api/builds")
+        def builds(body, query):
+            return {"jobs": [j.to_dict() for j in db.list("build_jobs")]}
+
+        @self.route("GET", "/api/builds/{jid}/logs")
+        def build_logs(body, query, jid):
+            j = db.get("build_jobs", jid)
+            if j is None:
+                raise HttpError(404, f"no build {jid}")
+            return {"log": j.log, "status": j.status, "error": j.error}
+
+        # -- placement ---------------------------------------------------
+        @self.route("GET", "/api/placement")
+        def placement_last(body, query):
+            return {"stages": state.placement.snapshot()}
+
+
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>fleetflow-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#0b1020;color:#e6e8ef}
+ h1{font-size:1.3rem} .card{background:#151b31;border-radius:8px;padding:1rem;
+ margin:0.5rem 0;max-width:720px} code{color:#8ab4ff} td,th{padding:2px 10px;
+ text-align:left} .ok{color:#6fd08c}.bad{color:#ff7a7a}
+</style></head>
+<body>
+<h1>fleetflow-tpu control plane</h1>
+<div class="card" id="overview">loading…</div>
+<div class="card"><table id="servers"></table></div>
+<div class="card"><table id="deployments"></table></div>
+<script>
+async function j(u){const r=await fetch(u);return r.json()}
+// stored names are tenant input: escape everything interpolated into HTML
+function esc(v){return String(v).replace(/[&<>"']/g,
+ c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+async function refresh(){
+ try{
+  const o=await j('/api/overview');
+  document.getElementById('overview').innerHTML=
+   `<b>${esc(o.online)}/${esc(o.servers)}</b> servers online · `+
+   `${esc(o.agents.length)} agents · ${esc(o.projects)} projects · `+
+   `${esc(o.deployments)} deployments · `+
+   `<span class="${o.active_alerts? 'bad':'ok'}">${esc(o.active_alerts)} alerts</span>`;
+  const s=await j('/api/servers');
+  document.getElementById('servers').innerHTML=
+   '<tr><th>server</th><th>status</th><th>sched</th><th>cpu</th><th>mem</th></tr>'+
+   s.servers.map(x=>`<tr><td>${esc(x.slug)}</td><td class="${x.status==='online'?'ok':'bad'}">`+
+    `${esc(x.status)}</td><td>${esc(x.scheduling_state)}</td>`+
+    `<td>${esc(x.allocated.cpu.toFixed(1))}/${esc(x.capacity.cpu)}</td>`+
+    `<td>${esc(x.allocated.memory.toFixed(0))}/${esc(x.capacity.memory)}</td></tr>`).join('');
+  const d=await j('/api/deployments?limit=10');
+  document.getElementById('deployments').innerHTML=
+   '<tr><th>deployment</th><th>status</th><th>services</th></tr>'+
+   d.deployments.map(x=>`<tr><td>${esc(x.id)}</td><td class="${x.status==='succeeded'?'ok':'bad'}">`+
+    `${esc(x.status)}</td><td>${esc((x.services||[]).join(', '))}</td></tr>`).join('');
+ }catch(e){document.getElementById('overview').textContent='auth required or CP down';}
+}
+refresh();setInterval(refresh,5000);
+</script></body></html>
+"""

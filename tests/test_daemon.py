@@ -1,0 +1,202 @@
+"""Daemon tests: config search chain, PID lifecycle, REST surface, health
+checker churn integration."""
+
+import asyncio
+import json
+import os
+import urllib.request
+
+import pytest
+
+from fleetflow_tpu.cp import ServerConfig, start
+from fleetflow_tpu.daemon.config import DaemonConfig, load_daemon_config
+from fleetflow_tpu.daemon.health import HealthChecker
+from fleetflow_tpu.daemon.pidfile import PidFile, PidStatus
+from fleetflow_tpu.daemon.web import WebServer
+from fleetflow_tpu.runtime import MockBackend
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def mock_backend_factory():
+    b = MockBackend()
+    b.pull = lambda image: b.images.add(image)
+    return b
+
+
+async def http_get(host, port, path, token=None):
+    def fetch():
+        req = urllib.request.Request(f"http://{host}:{port}{path}")
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+    return await asyncio.get_running_loop().run_in_executor(None, fetch)
+
+
+async def http_post(host, port, path, body=None, token=None):
+    def fetch():
+        data = json.dumps(body or {}).encode()
+        req = urllib.request.Request(f"http://{host}:{port}{path}", data=data,
+                                     method="POST")
+        req.add_header("Content-Type", "application/json")
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+    return await asyncio.get_running_loop().run_in_executor(None, fetch)
+
+
+class TestDaemonConfig:
+    def test_defaults_when_no_file(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        cfg = load_daemon_config()
+        assert cfg.listen_port == 4510
+        assert cfg.web_port == 32080
+        assert cfg.source is None
+        assert "~" not in cfg.pid_file   # expanded
+
+    def test_kdl_file(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "fleetflowd.kdl").write_text('''
+pid-file "/tmp/ff.pid"
+listen host="0.0.0.0" port=9510
+web enabled=#true host="0.0.0.0" port=8080
+db "/var/lib/ff/cp.json"
+auth "token" secret="hunter2"
+health-interval 15
+tpu-solver #true
+''')
+        cfg = load_daemon_config()
+        assert cfg.pid_file == "/tmp/ff.pid"
+        assert (cfg.listen_host, cfg.listen_port) == ("0.0.0.0", 9510)
+        assert (cfg.web_host, cfg.web_port) == ("0.0.0.0", 8080)
+        assert cfg.auth_kind == "token" and cfg.auth_secret == "hunter2"
+        assert cfg.health_interval_s == 15.0
+        assert cfg.use_tpu_solver is True
+        assert cfg.source == "fleetflowd.kdl"
+
+    def test_explicit_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_daemon_config(str(tmp_path / "nope.kdl"))
+
+
+class TestPidFile:
+    def test_lifecycle(self, tmp_path):
+        pf = PidFile(str(tmp_path / "d.pid"))
+        assert pf.status()[0] is PidStatus.STOPPED
+        pf.acquire()
+        st, pid = pf.status()
+        assert st is PidStatus.RUNNING and pid == os.getpid()
+        with pytest.raises(RuntimeError, match="already running"):
+            pf.acquire()
+        pf.release()
+        assert pf.status()[0] is PidStatus.STOPPED
+
+    def test_stale_recovery(self, tmp_path):
+        pf = PidFile(str(tmp_path / "d.pid"))
+        pf.path.write_text("999999999")  # no such pid
+        assert pf.status()[0] is PidStatus.STALE
+        pf.acquire()                      # stale overwritten (main.rs:107-110)
+        assert pf.status()[0] is PidStatus.RUNNING
+        pf.release()
+
+
+class TestWebServer:
+    def test_public_and_protected_routes(self):
+        async def go():
+            handle = await start(ServerConfig(auth_kind="token",
+                                              auth_secret="s3"),
+                                 backend_factory=mock_backend_factory)
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            # public
+            st, body = await http_get(host, port, "/api/health")
+            assert st == 200 and body["status"] == "ok"
+            st, body = await http_get(host, port, "/api/auth/config")
+            assert body["kind"] == "token"
+            # protected without token -> 401
+            st, _ = await http_get(host, port, "/api/overview")
+            assert st == 401
+            token = handle.state.auth.issue("op@x", ["admin:all"])
+            st, body = await http_get(host, port, "/api/overview", token)
+            assert st == 200 and body["servers"] == 0
+            # unknown route -> 404
+            st, _ = await http_get(host, port, "/api/nope", token)
+            assert st == 404
+            await web.stop()
+            await handle.stop()
+        run(go())
+
+    def test_crud_routes(self):
+        async def go():
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory)
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            st, body = await http_post(host, port, "/api/tenants",
+                                       {"name": "acme"})
+            assert st == 201
+            st, body = await http_post(host, port, "/api/tenants/acme/users",
+                                       {"email": "a@b.c", "role": "admin"})
+            assert st == 201 and body["user"]["role"] == "admin"
+            st, body = await http_get(host, port, "/api/tenants/acme/users")
+            assert len(body["users"]) == 1
+            st, body = await http_post(host, port, "/api/dns",
+                                       {"zone": "z.com", "name": "a",
+                                        "content": "1.1.1.1"})
+            assert st == 201
+            st, body = await http_get(host, port, "/api/dns?zone=z.com")
+            assert len(body["records"]) == 1
+            # server register + cordon via REST action route
+            handle.state.store.register_server("n1")
+            st, body = await http_post(host, port, "/api/servers/n1/cordon")
+            assert body["scheduling_state"] == "cordoned"
+            st, body = await http_get(host, port, "/api/servers")
+            assert body["servers"][0]["scheduling_state"] == "cordoned"
+            # dashboard serves html
+            st, _ = await http_get(host, port, "/api/health")
+            assert st == 200
+            await web.stop()
+            await handle.stop()
+        run(go())
+
+
+class TestHealthChecker:
+    def test_transitions_and_churn(self):
+        async def go():
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory)
+            db = handle.state.store
+            clock = [1000.0]
+            hc = HealthChecker(handle.state, interval_s=999,
+                               stale_after_s=90, clock=lambda: clock[0])
+            db.register_server("n1")
+            db.heartbeat("n1")
+            # fresh heartbeat but no connection: the heartbeat timestamp
+            # uses real time; override for determinism
+            s = db.server_by_slug("n1")
+            db.update("servers", s.id, last_heartbeat=clock[0] - 10)
+            changed = hc.run_check()
+            assert changed == ["n1"] or db.server_by_slug("n1").status == "online"
+            assert db.server_by_slug("n1").status == "online"
+            # heartbeat goes stale -> offline transition
+            clock[0] += 1000
+            changed = hc.run_check()
+            assert "n1" in changed
+            assert db.server_by_slug("n1").status == "offline"
+            # recovery
+            db.update("servers", s.id, last_heartbeat=clock[0] - 5)
+            changed = hc.run_check()
+            assert "n1" in changed
+            assert db.server_by_slug("n1").status == "online"
+            await handle.stop()
+        run(go())
