@@ -1,0 +1,206 @@
+"""AWS EC2 provider.
+
+Analog of fleetflow-cloud-aws (SURVEY.md §2.7). The reference feature-gates
+this crate to dodge 6-7 GB builds (root Cargo.toml:39-45); this build
+shells to the `aws` CLI for the same reason (no SDK dependency): instance
+CRUD + power over EC2, with the instance-type mapping the reference keeps
+in its models.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from typing import Optional
+
+from ..core.errors import CloudError
+from ..core.model import CloudProviderDecl, ServerResource
+from .action import Action, ActionType, ApplyResult, Plan
+from .provider import (CloudProvider, ServerInfo, ServerProvider,
+                       register_provider)
+from .state import ProviderState, ResourceState
+
+__all__ = ["AwsServerProvider", "AwsProvider", "instance_type_for"]
+
+# plan -> instance type mapping (aws crate instance-type models)
+_PLAN_MAP = {
+    "nano": "t3.nano", "micro": "t3.micro", "small": "t3.small",
+    "medium": "t3.medium", "large": "t3.large", "xlarge": "t3.xlarge",
+}
+
+
+def instance_type_for(plan: Optional[str], capacity_cpu: float = 2.0) -> str:
+    if plan in _PLAN_MAP:
+        return _PLAN_MAP[plan]
+    if plan:
+        return plan                    # already an instance type
+    if capacity_cpu <= 1:
+        return "t3.micro"
+    if capacity_cpu <= 2:
+        return "t3.small"
+    if capacity_cpu <= 4:
+        return "t3.xlarge"
+    return "m5.2xlarge"
+
+
+def _default_runner(args: list[str]) -> tuple[int, str]:
+    if shutil.which("aws") is None:
+        raise CloudError("aws CLI not found")
+    proc = subprocess.run(["aws", *args], capture_output=True, text=True)
+    return proc.returncode, proc.stdout if proc.returncode == 0 else proc.stderr
+
+
+class AwsServerProvider(ServerProvider):
+    name = "aws"
+
+    def __init__(self, region: str = "ap-northeast-1", runner=None):
+        self.region = region
+        self.runner = runner or _default_runner
+
+    def _json(self, *args: str) -> dict:
+        rc, out = self.runner([*args, "--region", self.region,
+                               "--output", "json"])
+        if rc != 0:
+            raise CloudError(f"aws {' '.join(args[:3])} failed: {out.strip()}")
+        try:
+            return json.loads(out or "{}")
+        except json.JSONDecodeError:
+            raise CloudError(f"aws returned non-JSON: {out[:200]}") from None
+
+    @staticmethod
+    def _info(inst: dict) -> ServerInfo:
+        name = next((t["Value"] for t in inst.get("Tags", [])
+                     if t.get("Key") == "Name"), inst.get("InstanceId", ""))
+        return ServerInfo(
+            id=inst.get("InstanceId", ""),
+            name=name,
+            status={"running": "up", "stopped": "down"}.get(
+                inst.get("State", {}).get("Name", ""), "unknown"),
+            ip=inst.get("PublicIpAddress") or inst.get("PrivateIpAddress"),
+            plan=inst.get("InstanceType"),
+            zone=inst.get("Placement", {}).get("AvailabilityZone"),
+            tags=[t["Value"] for t in inst.get("Tags", [])
+                  if t.get("Key") != "Name"])
+
+    def list_servers(self) -> list[ServerInfo]:
+        doc = self._json("ec2", "describe-instances")
+        out = []
+        for res in doc.get("Reservations", []):
+            for inst in res.get("Instances", []):
+                if inst.get("State", {}).get("Name") != "terminated":
+                    out.append(self._info(inst))
+        return out
+
+    def get_server(self, server_id: str) -> Optional[ServerInfo]:
+        for s in self.list_servers():
+            if s.id == server_id or s.name == server_id:
+                return s
+        return None
+
+    def create_server(self, spec: ServerResource) -> ServerInfo:
+        args = ["ec2", "run-instances",
+                "--instance-type", instance_type_for(spec.plan,
+                                                     spec.capacity.cpu),
+                "--tag-specifications",
+                ("ResourceType=instance,Tags=[{Key=Name,Value=%s}]"
+                 % spec.name),
+                "--count", "1"]
+        ami = spec.os
+        if ami:
+            args += ["--image-id", ami]
+        doc = self._json(*args)
+        instances = doc.get("Instances", [])
+        return (self._info(instances[0]) if instances
+                else ServerInfo(id="", name=spec.name))
+
+    def delete_server(self, server_id: str) -> bool:
+        rc, _ = self.runner(["ec2", "terminate-instances", "--instance-ids",
+                             server_id, "--region", self.region,
+                             "--output", "json"])
+        return rc == 0
+
+    def power_on(self, server_id: str) -> bool:
+        rc, _ = self.runner(["ec2", "start-instances", "--instance-ids",
+                             server_id, "--region", self.region,
+                             "--output", "json"])
+        return rc == 0
+
+    def power_off(self, server_id: str) -> bool:
+        rc, _ = self.runner(["ec2", "stop-instances", "--instance-ids",
+                             server_id, "--region", self.region,
+                             "--output", "json"])
+        return rc == 0
+
+
+class AwsProvider(CloudProvider):
+    name = "aws"
+
+    def __init__(self, region: str = "ap-northeast-1", runner=None):
+        self.servers = AwsServerProvider(region=region, runner=runner)
+
+    def check_auth(self) -> bool:
+        try:
+            rc, _ = self.servers.runner(["sts", "get-caller-identity",
+                                         "--output", "json"])
+            return rc == 0
+        except CloudError:
+            return False
+
+    def get_state(self) -> ProviderState:
+        st = ProviderState(provider=self.name)
+        for s in self.servers.list_servers():
+            st.upsert(ResourceState(id=s.id, type="server", name=s.name,
+                                    attributes={"status": s.status,
+                                                "ip": s.ip,
+                                                "type": s.plan}))
+        return st
+
+    def plan(self, decl: CloudProviderDecl,
+             servers: list[ServerResource]) -> Plan:
+        current = {r.name: r for r in self.get_state().by_type("server")}
+        plan = Plan(provider=self.name)
+        desired = set()
+        for spec in servers:
+            if spec.provider not in (None, self.name):
+                continue
+            desired.add(spec.name)
+            if spec.name in current:
+                plan.actions.append(Action(ActionType.NOOP, "server",
+                                           spec.name, "exists"))
+            else:
+                plan.actions.append(Action(
+                    ActionType.CREATE, "server", spec.name,
+                    instance_type_for(spec.plan, spec.capacity.cpu),
+                    desired={"name": spec.name}))
+        for name, res in current.items():
+            if name not in desired:
+                plan.actions.append(Action(ActionType.DELETE, "server", name,
+                                           "not in config",
+                                           current={"id": res.id}))
+        return plan
+
+    def apply(self, plan: Plan) -> ApplyResult:
+        result = ApplyResult()
+        for action in plan.changes:
+            try:
+                if action.type is ActionType.CREATE:
+                    info = self.servers.create_server(
+                        ServerResource(name=action.resource_id))
+                    if not info.id:
+                        raise CloudError(
+                            f"create of {action.resource_id} returned no id")
+                    result.outputs[action.resource_id] = {"id": info.id}
+                elif action.type is ActionType.DELETE:
+                    if not self.servers.delete_server(
+                            (action.current or {}).get("id",
+                                                       action.resource_id)):
+                        raise CloudError(
+                            f"delete of {action.resource_id} failed")
+                result.succeeded.append(action)
+            except CloudError as e:
+                result.failed.append((action, str(e)))
+        return result
+
+
+register_provider("aws", AwsProvider)

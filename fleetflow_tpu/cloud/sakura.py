@@ -1,0 +1,187 @@
+"""Sakura Cloud provider.
+
+Analog of fleetflow-cloud-sakura (SURVEY.md §2.7): server CRUD + power via
+the `usacloud` CLI (usacloud.rs:21-66), a plan/apply CloudProvider over
+declared servers (provider.rs), and startup-script support. The usacloud
+runner is injectable; with the CLI absent `check_auth` is False and every
+operation raises a clean CloudError.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from typing import Optional
+
+from ..core.errors import CloudError
+from ..core.model import CloudProviderDecl, ServerResource
+from .action import Action, ActionType, ApplyResult, Plan
+from .provider import (CloudProvider, ServerInfo, ServerProvider,
+                       register_provider)
+from .state import ProviderState, ResourceState
+
+__all__ = ["SakuraProvider", "SakuraServerProvider"]
+
+DEFAULT_ZONE = "tk1a"   # the dogfood zone (.fleetflow/fleet.kdl:14-24)
+
+
+def _default_runner(args: list[str]) -> tuple[int, str]:
+    if shutil.which("usacloud") is None:
+        raise CloudError("usacloud CLI not found (install sakura cloud CLI)")
+    proc = subprocess.run(["usacloud", *args], capture_output=True, text=True)
+    return proc.returncode, proc.stdout if proc.returncode == 0 else proc.stderr
+
+
+class SakuraServerProvider(ServerProvider):
+    """usacloud.rs:21-66 CRUD."""
+
+    name = "sakura"
+
+    def __init__(self, zone: str = DEFAULT_ZONE, runner=None):
+        self.zone = zone
+        self.runner = runner or _default_runner
+
+    def _json(self, *args: str) -> list[dict]:
+        rc, out = self.runner([*args, "--zone", self.zone, "--output-type",
+                               "json"])
+        if rc != 0:
+            raise CloudError(f"usacloud {' '.join(args)} failed: {out.strip()}")
+        try:
+            doc = json.loads(out or "[]")
+        except json.JSONDecodeError:
+            raise CloudError(f"usacloud returned non-JSON: {out[:200]}") from None
+        return doc if isinstance(doc, list) else [doc]
+
+    @staticmethod
+    def _info(row: dict) -> ServerInfo:
+        ifaces = row.get("Interfaces") or []
+        ip = ifaces[0].get("IPAddress") if ifaces else None
+        return ServerInfo(
+            id=str(row.get("ID", "")),
+            name=row.get("Name", ""),
+            status={"up": "up", "down": "down"}.get(
+                str(row.get("InstanceStatus", "")).lower(), "unknown"),
+            ip=ip,
+            plan=str(row.get("ServerPlan", {}).get("Name", "")) or None,
+            zone=self_zone(row),
+            tags=row.get("Tags") or [])
+
+    def list_servers(self) -> list[ServerInfo]:
+        return [self._info(r) for r in self._json("server", "list")]
+
+    def get_server(self, server_id: str) -> Optional[ServerInfo]:
+        for s in self.list_servers():
+            if s.id == server_id or s.name == server_id:
+                return s
+        return None
+
+    def create_server(self, spec: ServerResource) -> ServerInfo:
+        args = ["server", "create", "--name", spec.name,
+                "--cpu", str(int(max(spec.capacity.cpu, 1))),
+                "--memory", str(int(max(spec.capacity.memory / 1024, 1))),
+                "--disk-size", str(spec.disk_size or 40),
+                "--os-type", spec.os or "ubuntu2204", "-y"]
+        if spec.startup_script:
+            args += ["--note", spec.startup_script]
+        for tag in spec.tags:
+            args += ["--tags", tag]
+        rows = self._json(*args)
+        return self._info(rows[0]) if rows else ServerInfo(id="", name=spec.name)
+
+    def delete_server(self, server_id: str) -> bool:
+        rc, _ = self.runner(["server", "delete", server_id, "--zone",
+                             self.zone, "-y", "--output-type", "json"])
+        return rc == 0
+
+    def power_on(self, server_id: str) -> bool:
+        rc, _ = self.runner(["server", "boot", server_id, "--zone",
+                             self.zone, "-y"])
+        return rc == 0
+
+    def power_off(self, server_id: str) -> bool:
+        rc, _ = self.runner(["server", "shutdown", server_id, "--zone",
+                             self.zone, "-y"])
+        return rc == 0
+
+
+def self_zone(row: dict) -> Optional[str]:
+    z = row.get("Zone")
+    if isinstance(z, dict):
+        return z.get("Name")
+    return z
+
+
+class SakuraProvider(CloudProvider):
+    """Declarative plan/apply over declared servers (provider.rs, 875L)."""
+
+    name = "sakura"
+
+    def __init__(self, zone: str = DEFAULT_ZONE, runner=None):
+        self.servers = SakuraServerProvider(zone=zone, runner=runner)
+
+    def check_auth(self) -> bool:
+        try:
+            rc, _ = self.servers.runner(["auth-status"])
+            return rc == 0
+        except CloudError:
+            return False
+
+    def get_state(self) -> ProviderState:
+        st = ProviderState(provider=self.name)
+        for s in self.servers.list_servers():
+            st.upsert(ResourceState(id=s.id, type="server", name=s.name,
+                                    attributes={"status": s.status,
+                                                "ip": s.ip, "plan": s.plan,
+                                                "tags": s.tags}))
+        return st
+
+    def plan(self, decl: CloudProviderDecl,
+             servers: list[ServerResource]) -> Plan:
+        current = {r.name: r for r in self.get_state().by_type("server")}
+        plan = Plan(provider=self.name)
+        desired_names = set()
+        for spec in servers:
+            if spec.provider not in (None, self.name):
+                continue
+            desired_names.add(spec.name)
+            if spec.name in current:
+                plan.actions.append(Action(
+                    ActionType.NOOP, "server", spec.name, "exists"))
+            else:
+                plan.actions.append(Action(
+                    ActionType.CREATE, "server", spec.name,
+                    f"plan={spec.plan or 'default'}",
+                    desired={"name": spec.name}))
+        for name in current:
+            if name not in desired_names:
+                plan.actions.append(Action(
+                    ActionType.DELETE, "server", name, "not in config",
+                    current={"id": current[name].id}))
+        return plan
+
+    def apply(self, plan: Plan) -> ApplyResult:
+        result = ApplyResult()
+        for action in plan.changes:
+            try:
+                if action.type is ActionType.CREATE:
+                    info = self.servers.create_server(
+                        ServerResource(name=action.resource_id))
+                    if not info.id:
+                        raise CloudError(
+                            f"create of {action.resource_id} returned no id")
+                    result.outputs[action.resource_id] = {"id": info.id,
+                                                          "ip": info.ip}
+                elif action.type is ActionType.DELETE:
+                    if not self.servers.delete_server(
+                            (action.current or {}).get("id",
+                                                       action.resource_id)):
+                        raise CloudError(
+                            f"delete of {action.resource_id} failed")
+                result.succeeded.append(action)
+            except CloudError as e:
+                result.failed.append((action, str(e)))
+        return result
+
+
+register_provider("sakura", SakuraProvider)

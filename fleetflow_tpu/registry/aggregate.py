@@ -1,0 +1,134 @@
+"""Multi-fleet aggregation: the batch axis of the placement problem.
+
+The reference's registry routes each (fleet, stage) to a single server and
+defers real fan-out (SURVEY.md §2.10 "multi-fleet aggregation" row). Here
+aggregation is what produces the solver's fleet-scale instances (BASELINE
+config 4: 10k services x 1k nodes "multi-tenant via registry aggregation"):
+
+  1. every registered fleet's stage is loaded and its services renamed
+     into a `fleet.stage.service` namespace (dependencies rewritten),
+  2. one combined Flow over the registry's shared server pool is lowered
+     to a single ProblemTensors — host-port and volume conflicts unify
+     across fleets automatically because conflict identity is the
+     (ip, port, proto) / host-path key, not the fleet,
+  3. deployment routes become per-row eligibility pins (a routed stage may
+     only land on its routed server), the device-side analog of the
+     reference's route resolution.
+
+The result solves as ONE device-resident instance; the assignment maps back
+through `AggregateIndex` to per-fleet, per-node deploy slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.loader import load_project_from_root_with_stage
+from ..core.model import Flow, Service, Stage
+from ..lower.tensors import ProblemTensors, lower_stage
+from .model import Registry
+
+__all__ = ["AggregateIndex", "aggregate_fleets"]
+
+
+@dataclass
+class AggregateIndex:
+    """Maps combined-instance rows back to their origin."""
+    rows: list[tuple[str, str, str]] = field(default_factory=list)
+    # (fleet, stage, service) per row, replica rows repeat the base name
+
+    def slices_for_node(self, pt: ProblemTensors,
+                        assignment: np.ndarray,
+                        node: str) -> dict[tuple[str, str], list[str]]:
+        """(fleet, stage) -> [service...] assigned to `node`."""
+        j = pt.node_names.index(node)
+        out: dict[tuple[str, str], list[str]] = {}
+        for i in np.flatnonzero(np.asarray(assignment) == j):
+            fleet, stage, svc = self.rows[int(i)]
+            out.setdefault((fleet, stage), []).append(svc)
+        return out
+
+
+def _namespace(fleet: str, stage: str, name: str) -> str:
+    return f"{fleet}.{stage}.{name}"
+
+
+def aggregate_fleets(
+        registry: Registry,
+        stages: Optional[dict[str, list[str]]] = None,
+        loader: Callable[[str, str], Flow] = None,
+) -> tuple[ProblemTensors, AggregateIndex]:
+    """Build one placement instance from every registered fleet.
+
+    `stages` restricts which stages per fleet (default: every stage named in
+    the fleet's routes, else every stage in its config). `loader` is
+    injectable for tests (defaults to the real project loader).
+    """
+    loader = loader or (lambda path, stage:
+                        load_project_from_root_with_stage(path, stage))
+
+    combined = Flow(name="registry")
+    combined.servers = dict(registry.servers)
+    combined_stage = Stage(name="aggregate")
+    pins: dict[str, str] = {}          # namespaced service -> pinned server
+
+    for fleet_name, entry in sorted(registry.fleets.items()):
+        routed = {r.stage: r.server
+                  for r in registry.routes_for_fleet(fleet_name)}
+        if stages and fleet_name in stages:
+            wanted = stages[fleet_name]
+        elif routed:
+            wanted = sorted(routed)
+        else:
+            wanted = None              # resolved after load
+
+        if wanted is None:
+            # discover the fleet's stages with a stage-neutral load
+            wanted = sorted(loader(entry.path, None).stages)
+        for stage_name in wanted:
+            # load PER STAGE: stage-scoped variables, .env.{stage}, and
+            # flow.{stage}.kdl overlays only apply when the loader knows
+            # which stage it is building
+            flow = loader(entry.path, stage_name)
+            stage = flow.stage(stage_name)
+            rename = {s: _namespace(fleet_name, stage_name, s)
+                      for s in stage.services}
+            for svc in stage.resolved_services(flow):
+                new_name = rename[svc.name]
+                nsvc: Service = replace(
+                    svc, name=new_name,
+                    depends_on=[rename[d] for d in svc.depends_on
+                                if d in rename],
+                    colocate_with=[_namespace(fleet_name, stage_name, c)
+                                   for c in svc.colocate_with],
+                    anti_affinity=[_namespace(fleet_name, stage_name, a)
+                                   for a in svc.anti_affinity])
+                combined.services[new_name] = nsvc
+                combined_stage.services.append(new_name)
+                if stage_name in routed:
+                    pins[new_name] = routed[stage_name]
+
+    combined.stages = {"aggregate": combined_stage}
+    pt = lower_stage(combined, "aggregate",
+                     nodes=list(registry.servers.values()))
+
+    # deployment routes -> per-row eligibility pins
+    if pins:
+        node_idx = {n: j for j, n in enumerate(pt.node_names)}
+        eligible = pt.eligible.copy()
+        for i, row in enumerate(pt.service_names):
+            base = row.split("#", 1)[0]
+            server = pins.get(base)
+            if server is not None:
+                mask = np.zeros(pt.N, dtype=bool)
+                mask[node_idx[server]] = True
+                eligible[i] = mask
+        pt.eligible = eligible
+
+    index = AggregateIndex(rows=[
+        tuple(row.split("#", 1)[0].split(".", 2))   # type: ignore[misc]
+        for row in pt.service_names])
+    return pt, index

@@ -1,0 +1,328 @@
+"""Tests for the registry (L5), build (L1b), and cloud (L2) layers."""
+
+import json
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core.model import (BuildConfig, Flow, Port, ResourceSpec,
+                                      ServerResource, Service, Stage)
+from fleetflow_tpu.registry import (aggregate_fleets, find_registry,
+                                    parse_registry_string)
+from fleetflow_tpu.sched import HostGreedyScheduler
+from fleetflow_tpu.solver.repair import verify
+
+
+REGISTRY_KDL = '''
+fleet "blog" path="/tmp/fleets/blog" description="the blog"
+fleet "shop" path="/tmp/fleets/shop" tenant="acme"
+
+server "web-1" {
+    capacity { cpu 8; memory 16384; disk 100000 }
+    labels { tier "standard" }
+}
+server "web-2" {
+    capacity { cpu 8; memory 16384; disk 100000 }
+}
+
+route fleet="blog" stage="live" server="web-1"
+route fleet="shop" stage="live" server="web-2"
+'''
+
+
+class TestRegistryParser:
+    def test_parse_and_queries(self):
+        reg = parse_registry_string(REGISTRY_KDL)
+        assert set(reg.fleets) == {"blog", "shop"}
+        assert reg.fleets["shop"].tenant == "acme"
+        assert set(reg.servers) == {"web-1", "web-2"}
+        assert reg.servers["web-1"].capacity.cpu == 8
+        r = reg.resolve_route("blog", "live")
+        assert r is not None and r.server == "web-1"
+        assert reg.resolve_route("blog", "nope") is None
+        assert [r.fleet for r in reg.routes_for_server("web-2")] == ["shop"]
+
+    def test_route_integrity(self):
+        bad = REGISTRY_KDL + '\nroute fleet="ghost" stage="live" server="web-1"'
+        with pytest.raises(ValueError, match="unknown.*fleet"):
+            parse_registry_string(bad)
+        bad2 = REGISTRY_KDL + '\nroute fleet="blog" stage="x" server="ghost"'
+        with pytest.raises(ValueError, match="unknown.*server"):
+            parse_registry_string(bad2)
+
+    def test_discovery_walk_up(self, tmp_path, monkeypatch):
+        deep = tmp_path / "a" / "b" / "c"
+        deep.mkdir(parents=True)
+        (tmp_path / "fleet-registry.kdl").write_text("")
+        found = find_registry(str(deep))
+        assert found == tmp_path / "fleet-registry.kdl"
+        monkeypatch.setenv("FLEET_REGISTRY", str(tmp_path / "nope.kdl"))
+        assert find_registry(str(deep)) is None
+
+
+def make_fleet(name: str, n_services: int, base_port: int) -> Flow:
+    flow = Flow(name=name)
+    names = [f"svc{i}" for i in range(n_services)]
+    for i, sname in enumerate(names):
+        flow.services[sname] = Service(
+            name=sname, image=f"{name}-{sname}",
+            ports=[Port(host=base_port + i, container=80)] if i == 0 else [],
+            depends_on=[names[i - 1]] if i else [],
+            resources=ResourceSpec(cpu=0.2, memory=128), _resources_set=True)
+    flow.stages["live"] = Stage(name="live", services=names)
+    return flow
+
+
+class TestAggregate:
+    def test_multi_fleet_single_instance(self):
+        reg = parse_registry_string(REGISTRY_KDL)
+        fleets = {"blog": make_fleet("blog", 3, 18000),
+                  "shop": make_fleet("shop", 4, 18000)}   # same host ports!
+        pt, index = aggregate_fleets(
+            reg, loader=lambda path, stage: fleets[path.rsplit("/", 1)[-1]])
+        assert pt.S == 7
+        assert pt.node_names == ["web-1", "web-2"]
+        # namespaced rows with origin mapping
+        assert ("blog", "live", "svc0") in index.rows
+        # route pins: blog rows only eligible on web-1
+        i_blog = index.rows.index(("blog", "live", "svc0"))
+        assert pt.eligible[i_blog].tolist() == [True, False]
+        # solve it: pins + shared host port 18000 must both hold
+        placement = HostGreedyScheduler().place(pt)
+        assert placement.feasible
+        assert verify(pt, placement.raw)["total"] == 0
+        slices = index.slices_for_node(pt, placement.raw, "web-1")
+        assert ("blog", "live") in slices
+        assert sorted(slices[("blog", "live")]) == ["svc0", "svc1", "svc2"]
+        # dependency chains survive namespacing
+        assert pt.dep_depth.max() >= 2
+
+    def test_port_conflict_across_fleets(self):
+        """Two fleets publishing the same host port must not share a node —
+        conflict identity unifies across fleets."""
+        reg = parse_registry_string('''
+fleet "a" path="/f/a"
+fleet "b" path="/f/b"
+server "n1" { capacity { cpu 8; memory 16384; disk 100000 } }
+server "n2" { capacity { cpu 8; memory 16384; disk 100000 } }
+''')
+        fleets = {"a": make_fleet("a", 1, 9000), "b": make_fleet("b", 1, 9000)}
+        pt, index = aggregate_fleets(
+            reg, loader=lambda path, stage: fleets[path.rsplit("/", 1)[-1]])
+        placement = HostGreedyScheduler().place(pt)
+        assert placement.feasible
+        nodes = set(placement.assignment.values())
+        assert len(nodes) == 2   # forced apart by the shared port
+
+
+class TestBuild:
+    def test_resolver(self, tmp_path):
+        from fleetflow_tpu.build import BuildResolver
+        ctx = tmp_path / "app"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text("FROM scratch\n")
+        svc = Service(name="app", image="app", version="2",
+                      build=BuildConfig(context="app",
+                                        args={"A": "1"}))
+        r = BuildResolver(str(tmp_path), registry="reg.example.com",
+                          env={"FLEET_BUILD_B": "2", "OTHER": "x"})
+        resolved = r.resolve(svc)
+        assert resolved.dockerfile == ctx / "Dockerfile"
+        assert resolved.context == ctx
+        assert resolved.args == {"A": "1", "B": "2"}
+        assert resolved.tag == "reg.example.com/app:2"
+
+    def test_resolver_missing_context(self, tmp_path):
+        from fleetflow_tpu.build import BuildResolver
+        from fleetflow_tpu.build.resolver import BuildError
+        svc = Service(name="x", build=BuildConfig(context="nope"))
+        with pytest.raises(BuildError, match="context"):
+            BuildResolver(str(tmp_path)).resolve(svc)
+
+    def test_context_packing_with_dockerignore(self, tmp_path):
+        import io
+        import tarfile
+        from fleetflow_tpu.build.context import create_context
+        ctx = tmp_path
+        (ctx / "Dockerfile").write_text("FROM scratch")
+        (ctx / "app.py").write_text("print(1)")
+        (ctx / "node_modules").mkdir()
+        (ctx / "node_modules" / "big.js").write_text("x" * 1000)
+        (ctx / "keep.log").write_text("keep")
+        (ctx / "skip.log").write_text("skip")
+        (ctx / ".dockerignore").write_text(
+            "node_modules\n*.log\n!keep.log\n")
+        blob = create_context(ctx)
+        with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+            names = sorted(tar.getnames())
+        assert "Dockerfile" in names and "app.py" in names
+        assert "keep.log" in names
+        assert not any("node_modules" in n for n in names)
+        assert "skip.log" not in names
+
+    def test_builder_argv(self, tmp_path):
+        from fleetflow_tpu.build import ImageBuilder
+        from fleetflow_tpu.build.resolver import ResolvedBuild
+        calls = []
+
+        def runner(args, on_line=None):
+            calls.append(args)
+            return 0, "ok"
+
+        (tmp_path / "Dockerfile").write_text("FROM scratch")
+        rb = ResolvedBuild(dockerfile=tmp_path / "Dockerfile",
+                           context=tmp_path, args={"V": "9"},
+                           tag="app:1", target="prod", no_cache=True)
+        tag = ImageBuilder(runner).build(rb)
+        assert tag == "app:1"
+        argv = calls[0]
+        assert argv[:2] == ["docker", "build"]
+        assert "--build-arg" in argv and "V=9" in argv
+        assert "--target" in argv and "--no-cache" in argv
+
+    def test_registry_auth(self, tmp_path):
+        import base64
+        from fleetflow_tpu.build.auth import (auth_for_registry,
+                                              registry_for_image)
+        assert registry_for_image("redis:7") == "docker.io"
+        assert registry_for_image("ghcr.io/me/app:1") == "ghcr.io"
+        assert registry_for_image("localhost:5000/app") == "localhost:5000"
+        cfg = {"auths": {"ghcr.io": {
+            "auth": base64.b64encode(b"me:tok").decode()}}}
+        auth = auth_for_registry("ghcr.io", cfg)
+        assert auth.username == "me" and auth.password == "tok"
+        assert auth.resolved
+        assert not auth_for_registry("other.io", cfg).resolved
+
+
+class TestCloud:
+    def test_plan_diff_and_apply(self):
+        from fleetflow_tpu.cloud.sakura import SakuraProvider
+        listing = [{"ID": "100", "Name": "web-1",
+                    "InstanceStatus": "up", "Interfaces": [],
+                    "Tags": []}]
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["server", "list"]:
+                return 0, json.dumps(listing)
+            if args[:2] == ["server", "create"]:
+                return 0, json.dumps([{"ID": "200",
+                                       "Name": args[args.index("--name") + 1],
+                                       "InstanceStatus": "up"}])
+            if args[:2] == ["server", "delete"]:
+                return 0, "{}"
+            return 0, "{}"
+
+        from fleetflow_tpu.core.model import CloudProviderDecl
+        provider = SakuraProvider(runner=runner)
+        decl = CloudProviderDecl(name="sakura")
+        desired = [ServerResource(name="web-1"), ServerResource(name="web-2")]
+        plan = provider.plan(decl, desired)
+        kinds = {(a.type.value, a.resource_id) for a in plan.actions}
+        assert ("noop", "web-1") in kinds
+        assert ("create", "web-2") in kinds
+        assert plan.summary() == "1 to create"
+        result = provider.apply(plan)
+        assert result.ok
+        assert result.outputs["web-2"]["id"] == "200"
+        # removal: server present remotely but not declared
+        plan2 = provider.plan(decl, [ServerResource(name="web-2")])
+        assert ("delete", "web-1") in {(a.type.value, a.resource_id)
+                                       for a in plan2.actions}
+
+    def test_state_tree_persistence(self, tmp_path):
+        from fleetflow_tpu.cloud import GlobalState, ResourceState
+        st = GlobalState.load(str(tmp_path))
+        st.provider("sakura").upsert(ResourceState(
+            id="100", type="server", name="web-1",
+            attributes={"ip": "10.0.0.1"}))
+        st.save()
+        st2 = GlobalState.load(str(tmp_path))
+        assert st2.provider("sakura").resources["100"].attributes["ip"] == \
+            "10.0.0.1"
+        assert st2.provider("sakura").by_type("server")[0].name == "web-1"
+
+    def test_cloudflare_ensure_record(self):
+        from fleetflow_tpu.cloud.cloudflare import CloudflareDns
+        records: dict[str, dict] = {}
+        counter = [0]
+
+        def transport(method, path, body):
+            if method == "GET" and path.startswith("/zones?"):
+                return {"success": True, "result": [{"id": "z1"}]}
+            if method == "GET" and "dns_records" in path:
+                name = path.split("name=")[1].split("&")[0]
+                hits = [r for r in records.values() if r["name"] == name]
+                return {"success": True, "result": hits}
+            if method == "POST":
+                counter[0] += 1
+                rec = dict(body, id=f"r{counter[0]}")
+                records[rec["id"]] = rec
+                return {"success": True, "result": rec}
+            if method == "PATCH":
+                rid = path.rsplit("/", 1)[1]
+                records[rid].update(body)
+                return {"success": True, "result": records[rid]}
+            return {"success": True, "result": None}
+
+        dns = CloudflareDns(token="t", transport=transport)
+        r1 = dns.ensure_record("example.com", "app.example.com", "A", "1.1.1.1")
+        assert r1["content"] == "1.1.1.1"
+        # idempotent
+        r2 = dns.ensure_record("example.com", "app.example.com", "A", "1.1.1.1",
+                               ttl=r1.get("ttl", 300),
+                               proxied=r1.get("proxied", False))
+        assert r2["id"] == r1["id"] and counter[0] == 1
+        # update on change
+        r3 = dns.ensure_record("example.com", "app.example.com", "A", "2.2.2.2")
+        assert r3["id"] == r1["id"] and r3["content"] == "2.2.2.2"
+
+    def test_tailscale_peer_status(self):
+        from fleetflow_tpu.cloud.tailscale import (Peer, get_peers,
+                                                   resolve_peer_status)
+        status_json = json.dumps({"Peer": {
+            "k1": {"HostName": "Web-1", "TailscaleIPs": ["100.1.1.1"],
+                   "Online": True},
+            "k2": {"HostName": "web-2", "Online": False,
+                   "LastSeen": "2026-07-29T00:00:00Z"},
+        }})
+        peers = get_peers(runner=lambda args: (0, status_json))
+        assert [p.hostname for p in peers] == ["web-1", "web-2"]
+        assert resolve_peer_status(peers[0]) == "online"
+        import datetime
+        seen = datetime.datetime(2026, 7, 29,
+                                 tzinfo=datetime.timezone.utc).timestamp()
+        assert resolve_peer_status(peers[1], now=seen + 100) == "online"
+        assert resolve_peer_status(peers[1], now=seen + 10000) == "offline"
+        assert resolve_peer_status(Peer(hostname="x"), now=0) == "offline"
+
+    def test_provider_registry(self):
+        from fleetflow_tpu.cloud import get_provider, provider_names
+        from fleetflow_tpu.core.errors import CloudError
+        assert {"sakura", "cloudflare", "aws"} <= set(provider_names())
+        with pytest.raises(CloudError, match="unknown cloud provider"):
+            get_provider("digitalocean")
+
+    def test_aws_instance_mapping(self):
+        from fleetflow_tpu.cloud.aws import instance_type_for
+        assert instance_type_for("micro") == "t3.micro"
+        assert instance_type_for("c5.large") == "c5.large"
+        assert instance_type_for(None, 1) == "t3.micro"
+        assert instance_type_for(None, 16) == "m5.2xlarge"
+
+    def test_ssh_argv(self):
+        from fleetflow_tpu.cloud.ssh import SshTarget, exec
+        calls = []
+
+        def runner(args, timeout):
+            calls.append(args)
+            return 0, "out", ""
+
+        out = exec(SshTarget(host="1.2.3.4", user="ubuntu", key_path="/k"),
+                   "docker ps", runner=runner)
+        assert out == "out"
+        argv = calls[0]
+        assert argv[0] == "ssh" and "ubuntu@1.2.3.4" in argv
+        assert "-i" in argv and "BatchMode=yes" in " ".join(argv)
