@@ -1,0 +1,11 @@
+"""User surfaces (L6): the `fleet` CLI.
+
+Analog of crates/fleetflow (SURVEY.md §2.3): the clap command tree becomes
+an argparse tree with the same groups — Daily (up/down/restart/ps/logs/
+exec), Ship (build/deploy), Admin (cp subgroups), Util (validate/solve/
+init/mcp) — plus the TPU-native addition: `fleet solve` placement preview.
+"""
+
+from .main import main
+
+__all__ = ["main"]

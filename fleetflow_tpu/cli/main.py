@@ -1,0 +1,742 @@
+"""The `fleet` command tree.
+
+Analog of fleetflow main.rs:33-296 (clap Commands/CpCommands) + commands/*:
+Daily `up/down/restart/ps/logs/exec`, Ship `build/deploy`, Admin `cp`
+subgroups (login/logout/daemon/tenant/project/server/cost/dns/registry/
+volume/build/stage), Util `validate/solve/init/mcp`. Stage comes from the
+positional arg, `-s`, or FLEET_STAGE (main.rs:40-47). When no config is
+found, `fleet init` writes a starter (the reference launches its ratatui
+wizard, tui/init.rs:123).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from ..core.errors import ConfigNotFound, ControlPlaneError, FlowError
+from ..core.loader import load_project
+from ..core.model import Backend, Flow
+from ..lower.tensors import lower_stage
+from ..runtime.backend import DockerCliBackend, MockBackend
+from ..runtime.engine import DeployEngine, DeployRequest
+from ..sched import pick_scheduler
+from .client import CpClient, CredentialStore, default_endpoint
+from .utils import determine_stage_name, filter_services, mask_env
+
+__all__ = ["main", "build_parser"]
+
+
+# --------------------------------------------------------------------------
+# plumbing
+# --------------------------------------------------------------------------
+
+def _load(args) -> Flow:
+    try:
+        return load_project(stage=_stage(args),
+                            start=getattr(args, "project_root", None))
+    except ConfigNotFound:
+        print("no fleet config found (.fleetflow/fleet.kdl). "
+              "run `fleet init` to create one.", file=sys.stderr)
+        raise SystemExit(2) from None
+
+
+def _stage(args) -> str:
+    return determine_stage_name(getattr(args, "stage", None),
+                                getattr(args, "stage_flag", None))
+
+
+def _backend(args):
+    import os
+    if os.environ.get("FLEET_BACKEND") == "mock" or getattr(args, "mock", False):
+        b = MockBackend(auto_pull=True)
+        return b
+    b = DockerCliBackend()
+    if not b.ping():
+        print("docker daemon unreachable. start docker, or set "
+              "FLEET_BACKEND=mock for a dry environment.", file=sys.stderr)
+        raise SystemExit(3)
+    return b
+
+
+def _print_plan(flow: Flow, stage_name: str,
+                services: list[str]) -> None:
+    """Dry-run plan printer with secret masking (up.rs:57-136)."""
+    stage = flow.stage(stage_name)
+    print(f"plan: project {flow.name!r} stage {stage_name!r} "
+          f"backend {stage.backend.value}")
+    for svc in stage.resolved_services(flow):
+        if services and svc.name not in services:
+            continue
+        print(f"  service {svc.name}  image {svc.image_name()}")
+        for p in svc.ports:
+            print(f"    port {p.host} -> {p.container}/{p.protocol.value}")
+        for v in svc.volumes:
+            ro = " (ro)" if v.read_only else ""
+            print(f"    volume {v.host} -> {v.container}{ro}")
+        for k, v in sorted(mask_env(svc.environment).items()):
+            print(f"    env {k}={v}")
+        if svc.depends_on:
+            print(f"    depends_on {', '.join(svc.depends_on)}")
+
+
+def _event_printer(event) -> None:
+    print(f"  {event}")
+
+
+# --------------------------------------------------------------------------
+# Daily commands
+# --------------------------------------------------------------------------
+
+def cmd_up(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    stage = flow.stage(stage_name)
+    services = filter_services(stage.services, args.services or [])
+    if args.dry_run:
+        _print_plan(flow, stage_name, services)
+        return 0
+    if stage.backend in (Backend.QUADLET, Backend.COMPOSE) and (
+            args.services or args.no_pull):
+        print("warning: -n/--no-pull are not supported on the "
+              f"{stage.backend.value} backend; applying the whole stage",
+              file=sys.stderr)
+    if stage.backend is Backend.QUADLET:
+        from ..runtime.quadlet import apply_stage
+        outcome = apply_stage(flow, stage_name)
+        for u in outcome.started:
+            print(f"  started {u}")
+        for u, err in outcome.errors.items():
+            print(f"  FAILED {u}: {err}", file=sys.stderr)
+        return 0 if outcome.ok else 1
+    if stage.backend is Backend.COMPOSE:
+        from ..runtime.compose import compose_up
+        rc, out = compose_up(flow, stage_name,
+                             getattr(args, "project_root", None) or ".")
+        print(out)
+        return rc
+    engine = DeployEngine(_backend(args), scheduler=pick_scheduler(
+        len(services), 1, prefer_tpu=False))
+    res = engine.execute(
+        DeployRequest(flow=flow, stage_name=stage_name,
+                      target_services=args.services or [],
+                      no_pull=args.no_pull),
+        on_event=_event_printer)
+    return 0 if res.ok else 1
+
+
+def cmd_down(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    stage = flow.stage(stage_name)
+    if stage.backend is Backend.COMPOSE:
+        if args.services:
+            print("warning: -n is not supported on the compose backend; "
+                  "taking the whole stage down", file=sys.stderr)
+        from ..runtime.compose import compose_down
+        rc, out = compose_down(flow, stage_name,
+                               getattr(args, "project_root", None) or ".")
+        print(out)
+        return rc
+    engine = DeployEngine(_backend(args))
+    res = engine.down(flow, stage_name, args.services or None,
+                      on_event=_event_printer)
+    print(f"removed {len(res.removed)} containers")
+    return 0
+
+
+def cmd_restart(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    backend = _backend(args)
+    from ..runtime.converter import container_name
+    names = filter_services(flow.stage(stage_name).services,
+                            args.services or [])
+    for svc in names:
+        cname = container_name(flow.name, stage_name, svc)
+        try:
+            backend.restart(cname)
+            print(f"  restarted {cname}")
+        except FlowError as e:
+            print(f"  {cname}: {e}", file=sys.stderr)
+    return 0
+
+
+def cmd_ps(args) -> int:
+    if args.global_ or args.project:
+        with CpClient(args.cp) as cp:
+            payload = {}
+            out = cp.request("container", "ps", payload)
+            rows = out["containers"]
+            if args.project:
+                rows = [r for r in rows if r.get("project") == args.project]
+            _print_ps_rows(rows)
+        return 0
+    flow = _load(args)
+    stage_name = _stage(args)
+    backend = _backend(args)
+    infos = backend.list(label_filter={"fleetflow.project": flow.name,
+                                       "fleetflow.stage": stage_name})
+    rows = [{"name": i.name, "state": i.state, "health": i.health,
+             "image": i.image, "service": i.labels.get("fleetflow.service")}
+            for i in infos]
+    _print_ps_rows(rows)
+    return 0
+
+
+def _print_ps_rows(rows: list[dict]) -> None:
+    if not rows:
+        print("(no containers)")
+        return
+    w = max(len(r.get("name", "")) for r in rows) + 2
+    print(f"{'NAME':<{w}}{'STATE':<12}{'HEALTH':<12}IMAGE")
+    for r in rows:
+        print(f"{r.get('name', ''):<{w}}{r.get('state', ''):<12}"
+              f"{r.get('health') or '-':<12}{r.get('image', '')}")
+
+
+def cmd_logs(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    from ..runtime.converter import container_name
+    backend = _backend(args)
+    cname = container_name(flow.name, stage_name, args.service)
+    print(backend.logs(cname, tail=args.tail), end="")
+    return 0
+
+
+def cmd_exec(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    from ..runtime.converter import container_name
+    import subprocess
+    cname = container_name(flow.name, stage_name, args.service)
+    argv = ["docker", "exec"]
+    if sys.stdin.isatty():
+        argv.append("-it")
+    argv.append(cname)
+    argv += args.cmd or ["/bin/sh"]
+    return subprocess.call(argv)
+
+
+# --------------------------------------------------------------------------
+# Ship commands
+# --------------------------------------------------------------------------
+
+def cmd_build(args) -> int:
+    flow = _load(args)
+    from ..build import BuildResolver, ImageBuilder, ImagePusher
+    registry = flow.registry.url if flow.registry else None
+    resolver = BuildResolver(getattr(args, "project_root", None) or ".",
+                             registry=args.registry or registry)
+    names = [args.name] if args.name else [
+        n for n, s in flow.services.items() if s.build is not None]
+    if not names:
+        print("no services with build{} config", file=sys.stderr)
+        return 1
+    for name in names:
+        svc = flow.services.get(name)
+        if svc is None or svc.build is None:
+            print(f"service {name!r} has no build config", file=sys.stderr)
+            return 1
+        resolved = resolver.resolve(svc)
+        print(f"building {resolved.tag} from {resolved.context}")
+        ImageBuilder().build(resolved, on_line=lambda l: print(f"  {l}"))
+        if args.push:
+            print(f"pushing {resolved.tag}")
+            ImagePusher().push(resolved.tag, on_line=lambda l: print(f"  {l}"))
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    flow = _load(args)
+    stage_name = _stage(args)
+    stage = flow.stage(stage_name)
+    services = filter_services(stage.services, args.services or [])
+    if args.dry_run:
+        _print_plan(flow, stage_name, services)
+        return 0
+    # confirmation gate (deploy.rs:208-216)
+    if not args.yes:
+        targets = (f"servers {stage.servers}" if stage.servers else "local")
+        reply = input(f"deploy {flow.name}/{stage_name} "
+                      f"({len(services)} services) to {targets}? [y/N] ")
+        if reply.strip().lower() not in ("y", "yes"):
+            print("aborted")
+            return 1
+    req = DeployRequest(flow=flow, stage_name=stage_name,
+                        target_services=args.services or [],
+                        no_pull=args.no_pull)
+    if stage.servers:
+        # remote path (deploy.rs:377+): route through the CP
+        with CpClient(args.cp) as cp:
+            out = cp.request("deploy", "execute",
+                             {"request": req.to_dict(),
+                              "tenant": args.tenant or
+                              (flow.tenant.name if flow.tenant else "default")},
+                             timeout=600)
+        dep = out["deployment"]
+        print(f"deployment {dep['id']}: {dep['status']}")
+        if dep.get("placement"):
+            for svc, node in sorted(dep["placement"].items()):
+                print(f"  {svc} -> {node}")
+        return 0 if dep["status"] == "succeeded" else 1
+    # local path (deploy.rs:354-375)
+    engine = DeployEngine(_backend(args))
+    res = engine.execute(req, on_event=_event_printer)
+    return 0 if res.ok else 1
+
+
+# --------------------------------------------------------------------------
+# Util commands
+# --------------------------------------------------------------------------
+
+def cmd_validate(args) -> int:
+    flow = _load(args)
+    issues = []
+    for stage_name in sorted(flow.stages):
+        try:
+            pt = lower_stage(flow, stage_name)
+            sched = pick_scheduler(pt.S, pt.N, prefer_tpu=False)
+            placement = sched.place(pt)
+            status = ("ok" if placement.feasible
+                      else f"INFEASIBLE ({placement.violations} violations)")
+            if not placement.feasible:
+                issues.append(stage_name)
+            print(f"  stage {stage_name}: {pt.S} services, {pt.N} nodes, "
+                  f"{status}")
+        except FlowError as e:
+            issues.append(stage_name)
+            print(f"  stage {stage_name}: ERROR {e}")
+    print("config valid" if not issues else
+          f"issues in stages: {issues}")
+    return 0 if not issues else 1
+
+
+def cmd_solve(args) -> int:
+    """TPU placement preview (no reference analog)."""
+    flow = _load(args)
+    stage_name = _stage(args)
+    pt = lower_stage(flow, stage_name)
+    sched = pick_scheduler(pt.S, pt.N, prefer_tpu=not args.host)
+    placement = sched.place(pt)
+    print(f"solved {pt.S} services x {pt.N} nodes via {placement.source} "
+          f"in {placement.solve_ms:.1f}ms "
+          f"(feasible={placement.feasible}, "
+          f"violations={placement.violations})")
+    if args.json:
+        print(json.dumps(placement.assignment, indent=2))
+    else:
+        by_node: dict[str, list[str]] = {}
+        for svc, node in placement.assignment.items():
+            by_node.setdefault(node, []).append(svc)
+        for node in sorted(by_node):
+            print(f"  {node}: {', '.join(sorted(by_node[node]))}")
+    return 0 if placement.feasible else 1
+
+
+STARTER_KDL = '''// fleet.kdl — created by `fleet init`
+project "{name}"
+
+service "app" {{
+    image "nginx"
+    version "alpine"
+    ports {{ port host=8080 container=80 }}
+}}
+
+stage "local" {{
+    service "app"
+}}
+'''
+
+
+def cmd_init(args) -> int:
+    """Starter config writer (the reference's TUI wizard, tui/init.rs:123)."""
+    import os
+    from pathlib import Path
+    root = Path(getattr(args, "project_root", None) or ".")
+    target = root / ".fleetflow" / "fleet.kdl"
+    if target.exists() and not args.force:
+        print(f"{target} already exists (use --force to overwrite)",
+              file=sys.stderr)
+        return 1
+    name = args.name or os.path.basename(root.resolve()) or "myproject"
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(STARTER_KDL.format(name=name))
+    print(f"wrote {target}\ntry: fleet up --dry-run")
+    return 0
+
+
+def cmd_mcp(args) -> int:
+    from ..mcp.server import serve_stdio
+    serve_stdio(project_root=getattr(args, "project_root", None),
+                cp_endpoint=args.cp)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Admin: fleet cp ...
+# --------------------------------------------------------------------------
+
+def cmd_cp(args) -> int:
+    sub = args.cp_command
+    if sub == "login":
+        creds = CredentialStore()
+        endpoint = args.cp or default_endpoint()
+        token = args.token
+        if not token and args.secret:
+            # mint locally from a shared secret (stand-in for the
+            # reference's Auth0 device flow, auth.rs:68)
+            from ..cp.auth import TokenAuth
+            token = TokenAuth(args.secret).issue(
+                args.email or "operator@local", ["admin:all"],
+                tenant=args.tenant or "default")
+        if not token:
+            print("provide --token or --secret", file=sys.stderr)
+            return 1
+        creds.save_token(endpoint, token, email=args.email or "")
+        print(f"credentials saved for {endpoint}")
+        return 0
+    if sub == "logout":
+        ok = CredentialStore().forget(args.cp or default_endpoint())
+        print("logged out" if ok else "no stored credentials")
+        return 0
+    if sub == "daemon":
+        from ..daemon.__main__ import main as daemon_main
+        argv = [args.daemon_command]
+        if args.config:
+            argv += ["-c", args.config]
+        return daemon_main(argv)
+
+    # everything else talks to the CP
+    with CpClient(args.cp) as cp:
+        return _cp_dispatch(cp, args)
+
+
+def _need(value, what: str):
+    """nargs='?' positionals must not reach the CP as None."""
+    if value in (None, ""):
+        raise ValueError(f"missing required argument: {what}")
+    return value
+
+
+def _cp_dispatch(cp: CpClient, args) -> int:
+    sub = args.cp_command
+
+    def show(obj) -> int:
+        print(json.dumps(obj, indent=2, default=str))
+        return 0
+
+    if sub == "status":
+        return show(cp.request("health", "overview"))
+    if sub == "tenant":
+        verb = args.verb
+        if verb == "list":
+            return show(cp.request("tenant", "list")["tenants"])
+        if verb == "create":
+            return show(cp.request("tenant", "create",
+                                   {"name": _need(args.name, "tenant name")}))
+        if verb == "delete":
+            return show(cp.request("tenant", "delete",
+                                   {"name": _need(args.name, "tenant name")}))
+        if verb == "users":
+            return show(cp.request("tenant", "user.list",
+                                   {"tenant": _need(args.name, "tenant name")})["users"])
+    if sub == "project":
+        if args.verb == "list":
+            return show(cp.request("project", "list",
+                                   {"tenant": args.tenant})["projects"])
+        if args.verb == "create":
+            return show(cp.request("project", "create",
+                                   {"name": _need(args.name, "project name"),
+                                    "tenant": args.tenant or "default"}))
+    if sub == "server":
+        verb = args.verb
+        if verb == "list":
+            rows = cp.request("server", "list")["servers"]
+            for s in rows:
+                print(f"  {s['slug']:<20} {s['status']:<10} "
+                      f"{s['scheduling_state']:<12} "
+                      f"cpu {s['allocated']['cpu']:.1f}/{s['capacity']['cpu']}")
+            return 0
+        if verb in ("cordon", "uncordon", "drain"):
+            return show(cp.request("server", verb,
+                                   {"slug": _need(args.name, "server slug")}))
+        if verb == "register":
+            return show(cp.request("server", "register",
+                                   {"slug": _need(args.name, "server slug")}))
+        if verb == "delete":
+            return show(cp.request("server", "delete",
+                                   {"slug": _need(args.name, "server slug")}))
+    if sub == "agents":
+        return show(cp.request("health", "overview")["agents"])
+    if sub == "alerts":
+        return show(cp.request("health", "overview"))
+    if sub == "cost":
+        if args.verb == "summary":
+            return show(cp.request("cost", "summary",
+                                   {"tenant": args.tenant or "default",
+                                    "month": args.month}))
+        if args.verb == "add":
+            return show(cp.request("cost", "add",
+                                   {"tenant": args.tenant or "default",
+                                    "month": args.month,
+                                    "amount": _need(args.amount, "--amount"),
+                                    "server": args.name or ""}))
+    if sub == "dns":
+        if args.verb == "list":
+            return show(cp.request("dns", "list",
+                                   {"zone": args.zone})["records"])
+        if args.verb == "create":
+            return show(cp.request("dns", "create",
+                                   {"zone": _need(args.zone, "--zone"),
+                                    "name": _need(args.name, "--name"),
+                                    "content": _need(args.content, "--content"),
+                                    "record_type": args.type}))
+        if args.verb == "sync":
+            return show(cp.request("dns", "sync", {}))
+    if sub == "volume":
+        if args.verb == "list":
+            return show(cp.request("volume", "list", {})["volumes"])
+        if args.verb == "adopt":
+            return show(cp.request("volume", "adopt",
+                                   {"server": _need(args.server, "--server"),
+                                    "name": _need(args.name, "--name")}))
+    if sub == "build":
+        if args.verb == "submit":
+            return show(cp.request("build", "submit",
+                                   {"repo": _need(args.repo, "--repo"),
+                                    "image_tag": _need(args.tag, "--tag"),
+                                    "ref": args.ref,
+                                    "push": args.push}))
+        if args.verb == "list":
+            return show(cp.request("build", "list")["jobs"])
+        if args.verb == "logs":
+            return show(cp.request("build", "logs",
+                                   {"job": _need(args.name, "job id")}))
+        if args.verb == "cancel":
+            return show(cp.request("build", "cancel",
+                                   {"job": _need(args.name, "job id")}))
+    if sub == "stage":
+        if args.verb == "status":
+            return show(cp.request("stage", "status",
+                                   {"stage": _need(args.name, "stage id")}))
+        if args.verb == "adopt":
+            return show(cp.request("stage", "adopt",
+                                   {"stage": _need(args.name, "stage id")}))
+    if sub == "registry":
+        return _cmd_cp_registry(cp, args)
+    print(f"unknown cp command {sub!r}", file=sys.stderr)
+    return 2
+
+
+def _cmd_cp_registry(cp: CpClient, args) -> int:
+    """Multi-fleet ops (commands/registry.rs:250-417)."""
+    from ..registry import find_registry, parse_registry_file
+    path = find_registry()
+    if path is None:
+        print("no fleet-registry.kdl found", file=sys.stderr)
+        return 1
+    reg = parse_registry_file(str(path))
+    if args.verb == "list":
+        for name, entry in sorted(reg.fleets.items()):
+            routes = reg.routes_for_fleet(name)
+            print(f"  {name:<16} {entry.path}  "
+                  f"[{', '.join(f'{r.stage}->{r.server}' for r in routes)}]")
+        return 0
+    if args.verb == "status":
+        out = cp.request("health", "overview")
+        print(f"registry {path}: {len(reg.fleets)} fleets, "
+              f"{len(reg.servers)} servers; CP sees "
+              f"{out['online']}/{out['servers']} online")
+        return 0
+    if args.verb == "solve":
+        from ..registry import aggregate_fleets
+        from ..sched import pick_scheduler
+        pt, index = aggregate_fleets(reg)
+        placement = pick_scheduler(pt.S, pt.N).place(pt)
+        print(f"aggregate: {pt.S} services x {pt.N} nodes "
+              f"feasible={placement.feasible} via {placement.source}")
+        return 0 if placement.feasible else 1
+    print(f"unknown registry verb {args.verb!r}", file=sys.stderr)
+    return 2
+
+
+# --------------------------------------------------------------------------
+# parser
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="fleet",
+        description="fleetflow-tpu: TPU-native container-fleet orchestration")
+    ap.add_argument("--project-root", help="project directory (default: walk up)")
+    ap.add_argument("--mock", action="store_true",
+                    help="use the in-memory container backend")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def stage_args(p, positional=True):
+        if positional:
+            p.add_argument("stage", nargs="?", help="stage name")
+        p.add_argument("-s", dest="stage_flag", help="stage (or FLEET_STAGE)")
+
+    # Daily
+    p = sub.add_parser("up", help="start a stage's services")
+    stage_args(p)
+    p.add_argument("-n", "--service", dest="services", action="append")
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("--no-pull", action="store_true")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="stop a stage")
+    stage_args(p)
+    p.add_argument("-n", "--service", dest="services", action="append")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("restart", help="restart services")
+    stage_args(p)
+    p.add_argument("-n", "--service", dest="services", action="append")
+    p.set_defaults(fn=cmd_restart)
+
+    p = sub.add_parser("ps", help="list containers")
+    stage_args(p)
+    p.add_argument("--global", dest="global_", action="store_true",
+                   help="all containers known to the CP")
+    p.add_argument("--project", help="filter CP view by project")
+    p.add_argument("--cp", help="CP endpoint host:port")
+    p.set_defaults(fn=cmd_ps)
+
+    p = sub.add_parser("logs", help="container logs")
+    p.add_argument("service")
+    stage_args(p, positional=False)
+    p.add_argument("--tail", type=int, default=100)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("exec", help="exec into a service container")
+    p.add_argument("service")
+    p.add_argument("cmd", nargs="*")
+    stage_args(p, positional=False)
+    p.set_defaults(fn=cmd_exec)
+
+    # Ship
+    p = sub.add_parser("build", help="build service images")
+    stage_args(p)
+    p.add_argument("-n", "--name", help="one service (default: all with build{})")
+    p.add_argument("--push", action="store_true")
+    p.add_argument("--registry")
+    p.set_defaults(fn=cmd_build)
+
+    p = sub.add_parser("deploy", help="deploy a stage (local or via CP)")
+    stage_args(p)
+    p.add_argument("-n", "--service", dest="services", action="append")
+    p.add_argument("-y", "--yes", action="store_true")
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("--no-pull", action="store_true")
+    p.add_argument("--tenant")
+    p.add_argument("--cp", help="CP endpoint host:port")
+    p.set_defaults(fn=cmd_deploy)
+
+    # Util
+    p = sub.add_parser("validate", help="load config + check placements")
+    stage_args(p, positional=False)
+    p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser("solve", help="TPU placement preview")
+    stage_args(p)
+    p.add_argument("--host", action="store_true", help="force host greedy")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_solve)
+
+    p = sub.add_parser("init", help="write a starter fleet.kdl")
+    p.add_argument("--name")
+    p.add_argument("--force", action="store_true")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("mcp", help="run the MCP server on stdio")
+    p.add_argument("--cp", help="CP endpoint host:port")
+    p.set_defaults(fn=cmd_mcp)
+
+    # Admin
+    p = sub.add_parser("cp", help="control-plane administration")
+    p.add_argument("--cp", dest="cp", help="CP endpoint host:port")
+    cps = p.add_subparsers(dest="cp_command", required=True)
+
+    q = cps.add_parser("login")
+    q.add_argument("--token")
+    q.add_argument("--secret", help="shared secret to mint a token")
+    q.add_argument("--email")
+    q.add_argument("--tenant")
+    q = cps.add_parser("logout")
+    q = cps.add_parser("status")
+    q = cps.add_parser("daemon")
+    q.add_argument("daemon_command", choices=["run", "stop", "status"])
+    q.add_argument("-c", "--config")
+    q = cps.add_parser("agents")
+    q = cps.add_parser("alerts")
+
+    for group, verbs in [
+        ("tenant", ["list", "create", "delete", "users"]),
+        ("project", ["list", "create"]),
+        ("server", ["list", "register", "cordon", "uncordon", "drain",
+                    "delete"]),
+        ("stage", ["status", "adopt"]),
+    ]:
+        q = cps.add_parser(group)
+        q.add_argument("verb", choices=verbs)
+        q.add_argument("name", nargs="?")
+        q.add_argument("--tenant")
+
+    q = cps.add_parser("cost")
+    q.add_argument("verb", choices=["summary", "add"])
+    q.add_argument("--month", required=True)
+    q.add_argument("--amount", type=float)
+    q.add_argument("--tenant")
+    q.add_argument("--name")
+
+    q = cps.add_parser("dns")
+    q.add_argument("verb", choices=["list", "create", "sync"])
+    q.add_argument("--zone")
+    q.add_argument("--name")
+    q.add_argument("--content")
+    q.add_argument("--type", default="A")
+
+    q = cps.add_parser("volume")
+    q.add_argument("verb", choices=["list", "adopt"])
+    q.add_argument("--server")
+    q.add_argument("--name")
+
+    q = cps.add_parser("build")
+    q.add_argument("verb", choices=["submit", "list", "logs", "cancel"])
+    q.add_argument("--repo")
+    q.add_argument("--tag")
+    q.add_argument("--ref", default="main")
+    q.add_argument("--push", action="store_true")
+    q.add_argument("name", nargs="?")
+
+    q = cps.add_parser("registry")
+    q.add_argument("verb", choices=["list", "status", "solve"])
+
+    p.set_defaults(fn=cmd_cp)
+    return ap
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (FlowError, ControlPlaneError, ValueError) as e:
+        # FlowError covers config/runtime; ControlPlaneError covers RpcError
+        # (unreachable CP); ValueError covers bad service/verb arguments
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except KeyError as e:
+        print(f"error: {e.args[0] if e.args else e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,10 +79,8 @@ def _default_backend_factory():
     docker = DockerCliBackend()
     if docker.ping():
         return docker
-    mock = MockBackend()
     # dev mock: images materialize on pull, so deploys succeed end-to-end
-    mock.pull = lambda image: mock.images.add(image)  # type: ignore
-    return mock
+    return MockBackend(auto_pull=True)
 
 
 async def start(config: ServerConfig, *,

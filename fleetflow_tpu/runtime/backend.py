@@ -80,7 +80,7 @@ class MockBackend:
     enabling retry-path tests (the 409/404 recovery logic of up.rs:329-441).
     """
 
-    def __init__(self):
+    def __init__(self, auto_pull: bool = False):
         self.containers: dict[str, ContainerInfo] = {}
         self.networks: set[str] = set()
         self.images: set[str] = set()
@@ -88,6 +88,7 @@ class MockBackend:
         self.fail_on: dict[str, int] = {}
         self._next_id = 0
         self.pruned = 0
+        self.auto_pull = auto_pull   # dev mode: any pull "succeeds"
 
     # -- helpers ------------------------------------------------------------
     def _maybe_fail(self, op: str, name: str) -> None:
@@ -109,7 +110,8 @@ class MockBackend:
 
     def pull(self, image: str) -> None:
         self.calls.append(("pull", image))
-        self._maybe_fail("pull", image)
+        if not self.auto_pull:
+            self._maybe_fail("pull", image)
         self.images.add(image)
 
     def ensure_network(self, name: str) -> None:

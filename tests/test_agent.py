@@ -151,8 +151,7 @@ class TestGuards:
 # --------------------------------------------------------------------------
 
 def make_agent(handle, slug="node-1", **kw):
-    backend = MockBackend()
-    backend.pull = lambda image: backend.images.add(image)
+    backend = MockBackend(auto_pull=True)
     cfg = AgentConfig(cp_host=handle.host, cp_port=handle.port, slug=slug,
                       heartbeat_interval_s=0.05, monitor_interval_s=0.05,
                       capacity={"cpu": 8, "memory": 16384, "disk": 100000},

@@ -1,0 +1,216 @@
+"""CLI + MCP tests.
+
+CLI tests run main() in-process with the mock backend (the reference's
+assert_cmd pattern, fleetflow/tests/cli_test.rs:8-118: help/arg-matrix plus
+behavioral flows); MCP tests drive the JSON-RPC handler directly.
+"""
+
+import io
+import json
+
+import pytest
+
+from fleetflow_tpu.cli.main import main
+from fleetflow_tpu.cli.utils import (determine_stage_name, filter_services,
+                                     mask_sensitive, parse_duration)
+from fleetflow_tpu.mcp.server import FleetMcpServer, serve_stdio
+
+
+class TestUtils:
+    def test_stage_precedence(self):
+        assert determine_stage_name("live", "flagged", {"FLEET_STAGE": "env"}) == "live"
+        assert determine_stage_name(None, "flagged", {"FLEET_STAGE": "env"}) == "flagged"
+        assert determine_stage_name(None, None, {"FLEET_STAGE": "env"}) == "env"
+        assert determine_stage_name(None, None, {}) == "local"
+
+    def test_filter_services(self):
+        assert filter_services(["a", "b", "c"], []) == ["a", "b", "c"]
+        assert filter_services(["a", "b", "c"], ["c", "a"]) == ["a", "c"]
+        with pytest.raises(ValueError, match="unknown services"):
+            filter_services(["a"], ["nope"])
+
+    def test_masking(self):
+        assert mask_sensitive("DB_PASSWORD", "hunter2secret") == "hu********et"
+        assert mask_sensitive("API_KEY", "abc") == "****"
+        assert mask_sensitive("PLAIN", "visible") == "visible"
+
+    def test_duration(self):
+        assert parse_duration("30s") == 30
+        assert parse_duration("5m") == 300
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("2h") == 7200
+        with pytest.raises(ValueError):
+            parse_duration("abc")
+
+
+class TestCliParser:
+    def test_help_and_missing_command(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            main(["--help"])
+        assert e.value.code == 0
+        assert "fleetflow-tpu" in capsys.readouterr().out
+        with pytest.raises(SystemExit):
+            main([])
+
+    def test_subcommand_help(self, capsys):
+        for cmd in ("up", "deploy", "cp"):
+            with pytest.raises(SystemExit) as e:
+                main([cmd, "--help"])
+            assert e.value.code == 0
+
+
+class TestCliFlows:
+    def test_init_then_up_dry_run(self, tmp_path, capsys):
+        rc = main(["--project-root", str(tmp_path), "init", "--name", "demo"])
+        assert rc == 0
+        assert (tmp_path / ".fleetflow" / "fleet.kdl").exists()
+        # re-init without --force refuses
+        assert main(["--project-root", str(tmp_path), "init"]) == 1
+        capsys.readouterr()
+        rc = main(["--project-root", str(tmp_path), "up", "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "demo" in out and "nginx:alpine" in out
+
+    def test_up_ps_down_with_mock(self, project, capsys):
+        root, _ = project
+        base = ["--project-root", str(root), "--mock"]
+        assert main([*base, "up", "local"]) == 0
+        out = capsys.readouterr().out
+        assert "[done]" in out and "3 deployed" in out
+        assert main([*base, "ps", "local"]) == 0
+
+    def test_dry_run_masks_secrets(self, project, capsys):
+        root, write = project
+        write("services/secret.kdl", '''
+service "vault" {
+    image "vault"
+    env { VAULT_TOKEN "super-secret-token-value" }
+}
+stage "sec" { service "vault" }
+''')
+        rc = main(["--project-root", str(root), "up", "sec", "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "super-secret-token-value" not in out
+        assert "VAULT_TOKEN=su" in out
+
+    def test_validate(self, project, capsys):
+        root, _ = project
+        assert main(["--project-root", str(root), "validate"]) == 0
+        assert "config valid" in capsys.readouterr().out
+
+    def test_solve_host(self, project, capsys):
+        root, _ = project
+        rc = main(["--project-root", str(root), "solve", "local", "--host",
+                   "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert '"postgres"' in out and "host-greedy" in out
+
+    def test_missing_config_exit_code(self, tmp_path, capsys):
+        with pytest.raises(SystemExit) as e:
+            main(["--project-root", str(tmp_path), "up"])
+        assert e.value.code == 2
+
+
+class TestCredentials:
+    def test_store_roundtrip(self, tmp_path):
+        from fleetflow_tpu.cli.client import CredentialStore
+        store = CredentialStore(path=str(tmp_path / "creds.json"))
+        assert store.token_for("h:1") is None
+        store.save_token("h:1", "tok123", email="a@b.c")
+        assert store.token_for("h:1") == "tok123"
+        assert store.forget("h:1") is True
+        assert store.token_for("h:1") is None
+        assert store.forget("h:1") is False
+
+
+class TestMcp:
+    def make(self, project):
+        root, _ = project
+        from fleetflow_tpu.runtime import MockBackend
+        b = MockBackend(auto_pull=True)
+        return FleetMcpServer(project_root=str(root), backend=b), b
+
+    def test_initialize_and_list(self, project):
+        server, _ = self.make(project)
+        resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                              "method": "initialize", "params": {}})
+        assert resp["result"]["serverInfo"]["name"] == "fleetflow-tpu-mcp"
+        resp = server.handle({"jsonrpc": "2.0", "id": 2,
+                              "method": "tools/list"})
+        names = {t["name"] for t in resp["result"]["tools"]}
+        assert len(names) >= 20
+        assert {"project_analyze", "fleet_up", "fleet_solve",
+                "cp_overview", "cp_placement_solve"} <= names
+        # notification -> no response
+        assert server.handle({"jsonrpc": "2.0",
+                              "method": "notifications/initialized"}) is None
+
+    def test_analyze_up_ps_solve(self, project):
+        server, backend = self.make(project)
+
+        def call(name, **kw):
+            resp = server.handle({"jsonrpc": "2.0", "id": 9,
+                                  "method": "tools/call",
+                                  "params": {"name": name, "arguments": kw}})
+            assert not resp["result"].get("isError"), resp
+            return json.loads(resp["result"]["content"][0]["text"])
+
+        doc = call("project_analyze")
+        assert doc["project"] == "testproj"
+        assert doc["services"]["app"]["depends_on"] == ["postgres", "redis"]
+        up = call("fleet_up", stage="local")
+        assert up["ok"] and len(up["deployed"]) == 3
+        ps = call("fleet_ps", stage="local")
+        assert {r["state"] for r in ps} == {"running"}
+        solved = call("fleet_solve", stage="local", host_only=True)
+        assert solved["feasible"] and solved["source"] == "host-greedy"
+        down = call("fleet_down", stage="local")
+        assert len(down["removed"]) == 3
+
+    def test_tool_error_shape(self, project):
+        server, _ = self.make(project)
+        resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                              "method": "tools/call",
+                              "params": {"name": "nope"}})
+        assert resp["result"]["isError"]
+        resp = server.handle({"jsonrpc": "2.0", "id": 2,
+                              "method": "bogus/method"})
+        assert resp["error"]["code"] == -32601
+
+    def test_stdio_transport(self, project):
+        root, _ = project
+        lines = [
+            json.dumps({"jsonrpc": "2.0", "id": 1, "method": "initialize",
+                        "params": {}}),
+            "not json at all",
+            json.dumps({"jsonrpc": "2.0", "id": 2, "method": "tools/list"}),
+        ]
+        out = io.StringIO()
+        serve_stdio(project_root=str(root),
+                    stdin=io.StringIO("\n".join(lines) + "\n"), stdout=out)
+        replies = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert [r["id"] for r in replies] == [1, 2]
+        assert "tools" in replies[1]["result"]
+
+    def test_cp_tools_with_fake_client(self, project):
+        class FakeCp:
+            def request(self, channel, method, payload=None, timeout=60.0):
+                return {"health.ping": {"pong": True},
+                        "health.overview": {"agents": ["n1"], "servers": 1},
+                        "server.list": {"servers": [{"slug": "n1"}]},
+                        }.get(f"{channel}.{method}", {})
+        root, _ = project
+        server = FleetMcpServer(project_root=str(root), cp_client=FakeCp())
+        resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                              "method": "tools/call",
+                              "params": {"name": "cp_overview"}})
+        doc = json.loads(resp["result"]["content"][0]["text"])
+        assert doc["agents"] == ["n1"]
+        resp = server.handle({"jsonrpc": "2.0", "id": 2,
+                              "method": "tools/call",
+                              "params": {"name": "cp_servers"}})
+        assert json.loads(resp["result"]["content"][0]["text"]) == [
+            {"slug": "n1"}]

@@ -25,8 +25,7 @@ def run(coro):
 
 
 def mock_backend_factory():
-    b = MockBackend()
-    b.pull = lambda image: b.images.add(image)
+    b = MockBackend(auto_pull=True)
     return b
 
 
