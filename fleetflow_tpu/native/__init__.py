@@ -1,0 +1,13 @@
+"""ctypes bindings for the native components (native/placer.cpp).
+
+Loads libffnative.so, auto-building it with the repo Makefile the first
+time when g++ is available; everything degrades to the pure-Python
+implementations when the library can't be built, so the package never hard-
+requires a toolchain.
+"""
+
+from .lib import available, native_dep_depths, native_place
+from .sched import NativeGreedyScheduler
+
+__all__ = ["available", "native_place", "native_dep_depths",
+           "NativeGreedyScheduler"]

@@ -1,0 +1,146 @@
+"""libffnative loader + array marshalling."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["available", "native_place", "native_dep_depths", "load"]
+
+_REPO_NATIVE = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libffnative.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[Path]:
+    target = _REPO_NATIVE / _LIB_NAME
+    if target.is_file():
+        return target
+    if (shutil.which(os.environ.get("CXX", "g++")) is None
+            or shutil.which("make") is None):
+        return None
+    try:
+        proc = subprocess.run(["make", "-C", str(_REPO_NATIVE)],
+                              capture_output=True, text=True)
+    except OSError:
+        return None
+    return target if proc.returncode == 0 and target.is_file() else None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        # any failure here (no toolchain, corrupt .so from a racing build)
+        # must degrade to the Python placer, never crash the caller
+        try:
+            path = _build()
+            if path is None:
+                return None
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            return None
+        lib.ff_place.restype = ctypes.c_int64
+        lib.ff_place.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ff_dep_depths.restype = ctypes.c_int64
+        lib.ff_dep_depths.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+_STRATEGY_CODE = {"spread_across_pool": 0, "pack_into_dedicated": 1,
+                  "fill_lowest": 2}
+
+
+def native_place(demand: np.ndarray, capacity: np.ndarray,
+                 eligible: np.ndarray, node_valid: np.ndarray,
+                 dep_depth: np.ndarray,
+                 port_ids: np.ndarray, volume_ids: np.ndarray,
+                 anti_ids: np.ndarray,
+                 strategy: str = "spread_across_pool"
+                 ) -> tuple[np.ndarray, int]:
+    """(assignment (S,), violations) via ff_place. Raises RuntimeError when
+    the library isn't available — callers gate on available()."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("libffnative.so not available")
+    S, R = demand.shape
+    N = capacity.shape[0]
+    demand = np.ascontiguousarray(demand, dtype=np.float64)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float64)
+    eligible = np.ascontiguousarray(eligible, dtype=np.uint8)
+    node_valid = np.ascontiguousarray(node_valid, dtype=np.uint8)
+    dep_depth = np.ascontiguousarray(dep_depth, dtype=np.int32)
+    port_ids = np.ascontiguousarray(port_ids, dtype=np.int32)
+    volume_ids = np.ascontiguousarray(volume_ids, dtype=np.int32)
+    anti_ids = np.ascontiguousarray(anti_ids, dtype=np.int32)
+    out = np.zeros(S, dtype=np.int32)
+
+    violations = lib.ff_place(
+        S, N, R,
+        _ptr(demand, ctypes.c_double), _ptr(capacity, ctypes.c_double),
+        _ptr(eligible, ctypes.c_uint8), _ptr(node_valid, ctypes.c_uint8),
+        _ptr(dep_depth, ctypes.c_int32),
+        _ptr(port_ids, ctypes.c_int32), port_ids.shape[1],
+        _ptr(volume_ids, ctypes.c_int32), volume_ids.shape[1],
+        _ptr(anti_ids, ctypes.c_int32), anti_ids.shape[1],
+        _STRATEGY_CODE[strategy],
+        _ptr(out, ctypes.c_int32))
+    return out, int(violations)
+
+
+def native_dep_depths(dep_adj: np.ndarray) -> np.ndarray:
+    """Kahn levels via ff_dep_depths over a CSR of the boolean adjacency.
+    Raises ValueError on cycles (same contract as tensors.dependency_depths)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("libffnative.so not available")
+    S = dep_adj.shape[0]
+    # one vectorized CSR build — np.nonzero iterates rows in order
+    rows, cols = np.nonzero(dep_adj)
+    indptr = np.zeros(S + 1, dtype=np.int32)
+    indptr[1:] = np.cumsum(np.bincount(rows, minlength=S))
+    indices = np.ascontiguousarray(cols, dtype=np.int32)
+    if indices.size == 0:
+        indices = np.zeros(1, dtype=np.int32)  # valid pointer for ctypes
+    out = np.zeros(S, dtype=np.int32)
+    rc = lib.ff_dep_depths(S, _ptr(indptr, ctypes.c_int32),
+                           _ptr(indices, ctypes.c_int32),
+                           _ptr(out, ctypes.c_int32))
+    if rc < 0:
+        raise ValueError("dependency cycle")
+    return out

@@ -1,0 +1,30 @@
+"""Native scheduler backend."""
+
+from __future__ import annotations
+
+import time
+
+from ..lower.tensors import ProblemTensors
+from ..sched.base import Placement, assemble_placement
+
+__all__ = ["NativeGreedyScheduler"]
+
+
+class NativeGreedyScheduler:
+    """C++ FFD via ctypes; semantics identical to HostGreedyScheduler
+    (property-tested in tests/test_native.py). Falls back to the Python
+    placer when the library can't be built."""
+
+    def place(self, pt: ProblemTensors) -> Placement:
+        from .lib import available, native_place
+        if not available():
+            from ..sched.host import HostGreedyScheduler
+            return HostGreedyScheduler().place(pt)
+        t0 = time.perf_counter()
+        assignment, violations = native_place(
+            pt.demand, pt.capacity, pt.eligible, pt.node_valid,
+            pt.dep_depth, pt.port_ids, pt.volume_ids, pt.anti_ids,
+            strategy=pt.strategy.value)
+        ms = (time.perf_counter() - t0) * 1e3
+        return assemble_placement(pt, assignment, violations,
+                                  "cpp-greedy", ms)

@@ -26,8 +26,11 @@ __all__ = ["Placement", "Scheduler", "level_schedule",
 
 def pick_scheduler(S: int, N: int, *, prefer_tpu: bool = True) -> Scheduler:
     """Default backend policy: single-node or tiny instances run the host
-    greedy placer (placement degenerates to ordering); fleet-scale instances
-    go to the TPU solver."""
+    greedy placer (placement degenerates to ordering); fleet-scale host
+    instances use the C++ placer when built; the TPU solver owns the rest."""
     if not prefer_tpu or N <= 1 or S * N < 512:
+        if S * N >= 50_000:
+            from ..native import NativeGreedyScheduler
+            return NativeGreedyScheduler()   # falls back to host-greedy
         return HostGreedyScheduler()
     return TpuSolverScheduler()

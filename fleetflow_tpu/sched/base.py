@@ -50,6 +50,22 @@ class Placement:
                 if any(s in mine for s in lvl)]
 
 
+def assemble_placement(pt: ProblemTensors, assignment: np.ndarray,
+                       violations: int, source: str,
+                       solve_ms: float) -> Placement:
+    """Shared Placement assembly for greedy backends (host + native)."""
+    return Placement(
+        assignment={pt.service_names[i]: pt.node_names[int(assignment[i])]
+                    for i in range(pt.S)},
+        levels=level_schedule(pt),
+        feasible=violations == 0,
+        violations=violations,
+        source=source,
+        solve_ms=solve_ms,
+        raw=assignment,
+    )
+
+
 class Scheduler(Protocol):
     """Placement backend: ProblemTensors in, Placement out."""
 

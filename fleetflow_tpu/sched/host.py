@@ -20,7 +20,7 @@ import time
 
 import numpy as np
 
-from .base import Placement, level_schedule
+from .base import Placement, assemble_placement, level_schedule
 from ..core.model import PlacementStrategy
 from ..lower.tensors import ProblemTensors
 
@@ -94,13 +94,5 @@ class HostGreedyScheduler:
         t0 = time.perf_counter()
         assignment, violations = greedy_host_place(pt)
         ms = (time.perf_counter() - t0) * 1e3
-        return Placement(
-            assignment={pt.service_names[i]: pt.node_names[int(assignment[i])]
-                        for i in range(pt.S)},
-            levels=level_schedule(pt),
-            feasible=violations == 0,
-            violations=violations,
-            source="host-greedy",
-            solve_ms=ms,
-            raw=assignment,
-        )
+        return assemble_placement(pt, assignment, violations,
+                                  "host-greedy", ms)

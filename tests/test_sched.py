@@ -84,7 +84,12 @@ class TestTpuScheduler:
 
 class TestPick:
     def test_policy(self):
+        from fleetflow_tpu.native import NativeGreedyScheduler
         assert isinstance(pick_scheduler(3, 1), HostGreedyScheduler)
         assert isinstance(pick_scheduler(1000, 100), TpuSolverScheduler)
+        # fleet-scale host path routes to the C++ placer (which itself
+        # falls back to host-greedy when the library isn't built)
         assert isinstance(pick_scheduler(1000, 100, prefer_tpu=False),
+                          NativeGreedyScheduler)
+        assert isinstance(pick_scheduler(100, 4, prefer_tpu=False),
                           HostGreedyScheduler)
