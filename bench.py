@@ -31,7 +31,7 @@ def main() -> None:
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (1000, 100) if small else (10000, 1000)
     chains = int(os.environ.get("BENCH_CHAINS", "4"))
-    steps = int(os.environ.get("BENCH_STEPS", "2000"))
+    steps = int(os.environ.get("BENCH_STEPS", "128"))
 
     from fleetflow_tpu.lower import synthetic_problem
     from fleetflow_tpu.solver import prepare_problem, solve

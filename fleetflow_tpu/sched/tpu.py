@@ -20,7 +20,7 @@ __all__ = ["TpuSolverScheduler"]
 
 
 class TpuSolverScheduler:
-    def __init__(self, *, chains: int = 8, steps: int = 2000, seed: int = 0,
+    def __init__(self, *, chains: int = 8, steps: int = 128, seed: int = 0,
                  mesh=None):
         self.chains = chains
         self.steps = steps

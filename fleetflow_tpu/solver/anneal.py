@@ -89,14 +89,10 @@ def _soft_rows(prob: DeviceProblem, load_rows: jax.Array,
     return jnp.float32(0.0)
 
 
-def _propose_and_apply(prob: DeviceProblem, state: ChainState,
-                       key: jax.Array, temp: jax.Array) -> ChainState:
-    """One Metropolis step: move a random service to a random node."""
-    k1, k2, k3 = jax.random.split(key, 3)
-    s = jax.random.randint(k1, (), 0, prob.S)
-    b = jax.random.randint(k2, (), 0, prob.N)
+def _proposal_delta(prob: DeviceProblem, state: ChainState,
+                    s: jax.Array, b: jax.Array) -> jax.Array:
+    """Annealing-cost delta of moving service s to node b (no apply)."""
     a = state.assignment[s]
-
     d = prob.demand[s]
     ids = prob.conflict_ids[s]
     valid = (ids >= 0)
@@ -142,39 +138,106 @@ def _propose_and_apply(prob: DeviceProblem, state: ChainState,
     col_b = (state.coloc[b, csafe] * cvalid).sum()
     d_coloc = (col_a - col_b).astype(jnp.float32) / max(prob.S, 1)
 
-    delta = (d_cap + d_conf + d_elig + d_skew
-             + (soft_after - soft_before) + d_pref + d_coloc)
-
-    accept = (delta < 0) | (jax.random.uniform(k3, ()) < jnp.exp(
-        -delta / jnp.maximum(temp, 1e-8)))
-    accept = accept & (a != b)
-
-    def apply(st: ChainState) -> ChainState:
-        return ChainState(
-            assignment=st.assignment.at[s].set(b.astype(jnp.int32)),
-            load=st.load.at[a].add(-d).at[b].add(d),
-            used=st.used.at[a, safe].add(-valid.astype(jnp.int32))
-                        .at[b, safe].add(valid.astype(jnp.int32)),
-            coloc=st.coloc.at[a, csafe].add(-cvalid.astype(jnp.int32))
-                          .at[b, csafe].add(cvalid.astype(jnp.int32)),
-            topo=topo2,
-        )
-
-    return jax.tree.map(lambda new, old: jnp.where(accept, new, old),
-                        apply(state), state)
+    return (d_cap + d_conf + d_elig + d_skew
+            + (soft_after - soft_before) + d_pref + d_coloc)
 
 
-@partial(jax.jit, static_argnames=("steps",))
+def _batched_step(prob: DeviceProblem, state: ChainState,
+                  key: jax.Array, temp: jax.Array, M: int) -> ChainState:
+    """One parallel-Metropolis step: M simultaneous proposals.
+
+    Deltas are evaluated against the shared pre-step state, so accepted
+    moves that touch the same node interact slightly — the standard
+    accelerator-SA approximation; the exact kernels re-rank chains and the
+    repair backstop guards the zero-violation contract. Duplicate proposals
+    for one service are resolved winner-takes-first so the scatter state
+    update stays exact for the chosen move set.
+    """
+    ks, kb, ka = jax.random.split(key, 3)
+    s_idx = jax.random.randint(ks, (M,), 0, prob.S)
+    b_idx = jax.random.randint(kb, (M,), 0, prob.N)
+    a_idx = state.assignment[s_idx]
+
+    delta = jax.vmap(lambda s, b: _proposal_delta(prob, state, s, b))(
+        s_idx, b_idx)
+    u = jax.random.uniform(ka, (M,))
+    accept = ((delta < 0) | (u < jnp.exp(-delta / jnp.maximum(temp, 1e-8)))) \
+        & (a_idx != b_idx)
+
+    # winner-per-service: the lowest proposal index with accept wins
+    order = jnp.arange(M, dtype=jnp.int32)
+    winner = jnp.full((prob.S,), M, dtype=jnp.int32).at[s_idx].min(
+        jnp.where(accept, order, M))
+    applied = accept & (winner[s_idx] == order)
+    # winner-per-TARGET-node: at most one move lands on any node per sweep.
+    # This makes the sweep feasibility-preserving despite stale deltas: the
+    # single entrant was evaluated against the pre-sweep node state, and
+    # every other change to that node is a departure (which only frees
+    # capacity and conflict groups). A feasible chain therefore stays
+    # feasible through the whole anneal.
+    tgt_winner = jnp.full((prob.N,), M, dtype=jnp.int32).at[b_idx].min(
+        jnp.where(applied, order, M))
+    applied = applied & (tgt_winner[b_idx] == order)
+    w = applied.astype(jnp.float32)
+    wi = applied.astype(jnp.int32)
+
+    d = prob.demand[s_idx]                                       # (M, R)
+    load = (state.load.at[a_idx].add(-d * w[:, None])
+            .at[b_idx].add(d * w[:, None]))
+
+    ids = prob.conflict_ids[s_idx]                               # (M, K)
+    valid = (ids >= 0).astype(jnp.int32) * wi[:, None]
+    safe = jnp.where(ids >= 0, ids, 0)
+    a_rows = jnp.broadcast_to(a_idx[:, None], safe.shape)
+    b_rows = jnp.broadcast_to(b_idx[:, None], safe.shape)
+    used = (state.used.at[a_rows, safe].add(-valid)
+            .at[b_rows, safe].add(valid))
+
+    cids = prob.coloc_ids[s_idx]
+    cvalid = (cids >= 0).astype(jnp.int32) * wi[:, None]
+    csafe = jnp.where(cids >= 0, cids, 0)
+    coloc = (state.coloc.at[a_rows[:, : csafe.shape[1]], csafe].add(-cvalid)
+             .at[b_rows[:, : csafe.shape[1]], csafe].add(cvalid))
+
+    topo = (state.topo.at[prob.node_topology[a_idx]].add(-wi)
+            .at[prob.node_topology[b_idx]].add(wi))
+
+    # .set scatters route non-applied writes to a dump row (value writes
+    # from losers must not race the winner's)
+    dump = prob.S
+    tgt = jnp.where(applied, s_idx, dump)
+    assignment = jnp.zeros((prob.S + 1,), jnp.int32).at[:prob.S].set(
+        state.assignment).at[tgt].set(b_idx.astype(jnp.int32))[:prob.S]
+
+    return ChainState(assignment, load, used, coloc, topo)
+
+
+def default_proposals_per_step(S: int) -> int:
+    """Batch width: enough parallel proposals to keep the device busy,
+    capped so tiny instances don't over-propose. 256 is the measured knee
+    on v5e — below it a sweep costs the same fixed overhead, above it the
+    sweep goes bandwidth-bound (and winner-per-target wastes the surplus)."""
+    return max(1, min(256, S // 2))
+
+
+@partial(jax.jit, static_argnames=("steps", "proposals_per_step"))
 def anneal(prob: DeviceProblem, init_assignments: jax.Array, key: jax.Array,
-           steps: int = 2000, t0: float = 1.0, t1: float = 1e-3) -> jax.Array:
-    """Run `steps` Metropolis steps on C independent chains.
+           steps: int = 2000, t0: float = 1.0, t1: float = 1e-3,
+           proposals_per_step: int | None = None) -> jax.Array:
+    """Run `steps` batched-Metropolis sweeps on C independent chains.
 
     init_assignments: (C, S) int32; returns refined assignments (C, S).
+    Each sweep evaluates `proposals_per_step` moves per chain in parallel
+    (one device dispatch), so total proposals = steps x M x C while the
+    sequential depth stays `steps` — the shape that keeps a TPU fed, vs the
+    classic one-move-per-step SA whose wall-clock is all dispatch latency.
     Temperature decays geometrically t0 → t1 (in units of soft-score; hard
     violation weights are orders of magnitude above t0, so hard-violating
     moves are only ever accepted to escape an existing violation).
     """
-    C = init_assignments.shape[0]
+    C, S = init_assignments.shape
+    M = (proposals_per_step if proposals_per_step is not None
+         else default_proposals_per_step(S))
     states = jax.vmap(partial(chain_states_from_assignment, prob))(init_assignments)
     keys = jax.random.split(key, C)
 
@@ -185,7 +248,7 @@ def anneal(prob: DeviceProblem, init_assignments: jax.Array, key: jax.Array,
         temp = t0 * decay ** i.astype(jnp.float32)
         keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
         states = jax.vmap(
-            lambda st, k: _propose_and_apply(prob, st, k, temp))(states, keys)
+            lambda st, k: _batched_step(prob, st, k, temp, M))(states, keys)
         return (states, keys), None
 
     (states, _), _ = jax.lax.scan(sweep, (states, keys),
