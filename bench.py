@@ -1,6 +1,6 @@
 """North-star benchmark: 10k services x 1k nodes placed on one device.
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout (diagnostics go to stderr):
   {"metric": "placements_per_sec_10kx1k", "value": N, "unit": "services/s",
    "vs_baseline": N, ...}
 
@@ -15,16 +15,18 @@ exact device verification + host repair backstop, with the problem tensors
 already staged (the steady-state reschedule path). Compile time is excluded
 by a warm-up solve on identical shapes.
 
-BENCH_SMALL=1 drops to 1k x 100 for CPU smoke runs.
+Platform handling (VERDICT round 1, item 1): the inherited platform is
+probed out-of-process before any device use; a broken or hanging backend
+falls back to virtual CPU instead of rc=1. FLEET_FORCE_CPU=1 skips straight
+to CPU. BENCH_SMALL=1 drops to 1k x 100 for CPU smoke runs.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
-
-import numpy as np
 
 
 def main() -> None:
@@ -32,6 +34,11 @@ def main() -> None:
     S, N = (1000, 100) if small else (10000, 1000)
     chains = int(os.environ.get("BENCH_CHAINS", "4"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
+
+    # Decide the platform BEFORE any jax device use; never hang, never die
+    # on a broken tunnel (round-1 failure mode: rc=1 inside device_put).
+    from fleetflow_tpu.platform import ensure_platform
+    backend = ensure_platform(min_devices=1, probe_timeout=240.0)
 
     from fleetflow_tpu.lower import synthetic_problem
     from fleetflow_tpu.solver import prepare_problem, solve
@@ -41,7 +48,10 @@ def main() -> None:
     prob = prepare_problem(pt)
 
     # warm-up: compile every kernel on the final shapes
+    t_warm = time.perf_counter()
     solve(pt, prob=prob, chains=chains, steps=steps, seed=0)
+    print(f"[bench] warm-up (compile) {time.perf_counter() - t_warm:.1f}s "
+          f"on backend={backend}", file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
     res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1)
@@ -58,6 +68,10 @@ def main() -> None:
         "solve_ms": round(elapsed * 1e3, 1),
         "violations": res.violations,
         "feasible": res.feasible,
+        # honesty metrics (VERDICT item 4): what the device solver produced
+        # before the host repair backstop — 0/0 means the TPU did the work.
+        "pre_repair_violations": res.pre_repair_violations,
+        "moves_repaired": res.moves_repaired,
         "chains": chains,
         "steps": steps,
         "backend": jax.default_backend(),

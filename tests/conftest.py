@@ -6,21 +6,13 @@ forced onto a virtual 8-device CPU platform so mesh/sharding paths are
 exercised on any machine. Real-TPU runs are the gated Tier 2 (bench.py).
 """
 
-import os
-
 # Under the axon tunnel, sitecustomize imports jax at interpreter start with
-# JAX_PLATFORMS=axon already consumed — env mutation alone is too late. Force
-# the CPU platform through jax.config (effective until the backend
-# initializes) and set XLA_FLAGS, which the CPU client reads lazily.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# JAX_PLATFORMS=axon already consumed — env mutation alone is too late, the
+# platform must be pinned through jax.config before first backend use.
+# force_cpu does exactly that plus the 8-device XLA flag.
+from fleetflow_tpu.platform import force_cpu
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
 
 import pytest  # noqa: E402
 

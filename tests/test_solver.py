@@ -134,6 +134,10 @@ class TestSolve:
         res = solve(pt, chains=4, steps=300, seed=0)
         assert res.feasible, res.stats
         assert res.assignment.shape == (100,)
+        # the DEVICE solver must produce the feasible assignment itself —
+        # the host repair backstop may not silently become the real solver
+        assert res.pre_repair_violations == 0
+        assert res.moves_repaired == 0
 
     def test_config3_anti_affinity(self):
         # BASELINE config 3 shape (scaled down for CPU): port/volume
@@ -142,12 +146,14 @@ class TestSolve:
                                volume_fraction=0.2)
         res = solve(pt, chains=4, steps=300, seed=1)
         assert res.feasible, res.stats
+        assert res.moves_repaired == 0, "repair backstop did the real work"
 
     def test_multi_tenant(self):
         # BASELINE config 4 shape (scaled): tenancy eligibility blocks
         pt = synthetic_problem(150, 15, seed=2, n_tenants=4)
         res = solve(pt, chains=4, steps=300, seed=2)
         assert res.feasible, res.stats
+        assert res.moves_repaired == 0, "repair backstop did the real work"
 
     def test_warm_start_reschedule(self):
         # BASELINE config 5 shape: node churn → warm re-solve
