@@ -147,18 +147,31 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
         except ValueError:
             log(f"ignoring invalid FLEET_PROBE_TIMEOUT={env_timeout!r}")
 
+    def decide_cpu() -> str:
+        # CPU init cannot hang, so verify what the process actually got:
+        # if a backend initialized before us, force_cpu was a silent no-op
+        # and claiming min_devices would re-enable the silent mesh shrink.
+        import jax
+
+        actual = jax.device_count()
+        if actual < min_devices:
+            log(f"WARNING: CPU backend has {actual} device(s), "
+                f"{min_devices} requested — a backend initialized before "
+                f"ensure_platform ran; run in a fresh process")
+        return decide("cpu", actual)
+
     if os.environ.get("FLEET_FORCE_CPU", "").lower() not in ("", "0", "false"):
         log(f"FLEET_FORCE_CPU set; using virtual-CPU platform "
             f"({min_devices} devices)")
         force_cpu(min_devices)
-        return decide("cpu", min_devices)
+        return decide_cpu()
 
     want = os.environ.get("JAX_PLATFORMS", "")
     if want == "cpu":
-        # Nothing exotic to probe: CPU init cannot hang. Just make sure the
-        # virtual device count is large enough for the requested mesh.
+        # Nothing exotic to probe: make sure the virtual device count is
+        # large enough for the requested mesh, then verify.
         force_cpu(min_devices)
-        return decide("cpu", min_devices)
+        return decide_cpu()
 
     # want == "" means "whatever the install default is" — on a real TPU host
     # that is the TPU backend, so it must be probed, not assumed CPU.
@@ -169,7 +182,7 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
         log(f"platform {want or 'default'!r} failed to initialize or hung; "
             f"falling back to virtual-CPU platform ({min_devices} devices)")
         force_cpu(min_devices)
-        return decide("cpu", min_devices)
+        return decide_cpu()
 
     backend, ndev = res
     if ndev < min_devices:
@@ -179,7 +192,7 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
         log(f"platform {backend!r} has {ndev} device(s) < {min_devices} "
             f"required; using virtual-CPU platform ({min_devices} devices)")
         force_cpu(min_devices)
-        return decide("cpu", min_devices)
+        return decide_cpu()
 
     log(f"using inherited platform {backend!r} ({ndev} devices)")
     if want:

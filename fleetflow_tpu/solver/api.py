@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .anneal import anneal
-from .greedy import greedy_place, placement_order
+from .greedy import greedy_place, greedy_place_batched, placement_order
 from .kernels import soft_score, total_cost, violation_stats
 from .problem import DeviceProblem, prepare_problem
 from .repair import RepairResult, repair, verify
@@ -82,7 +82,8 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
           prob: Optional[DeviceProblem] = None,
           init_assignment: Optional[np.ndarray] = None,
           t0: float = 1.0, t1: float = 1e-3,
-          migration_weight: float = 0.5) -> SolveResult:
+          migration_weight: float = 0.5,
+          seed_impl: Optional[str] = None) -> SolveResult:
     """Solve a placement instance end to end.
 
     `init_assignment` warm-starts from a previous solve (streaming reschedule
@@ -92,6 +93,12 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
     churn forces (the analog of not restarting healthy containers on an
     unrelated node failure). `prob` reuses an already-staged DeviceProblem
     across re-solves.
+
+    `seed_impl` picks the greedy seed: "scan" (one lax.scan step per service
+    — exact FFD, best on CPU where the loop body is cheap), "batched"
+    (ceil(S/256)-deep batch placement — the accelerator shape: sequential
+    depth is what a TPU pays for, per-step width is nearly free), or None to
+    choose by backend.
     """
     timings: dict[str, float] = {}
     t = time.perf_counter
@@ -121,7 +128,13 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
     else:
         order = jnp.asarray(placement_order(pt.demand, pt.dep_depth,
                                             np.asarray(prob.conflict_ids)))
-        seed_assignment = greedy_place(prob, order)
+        if seed_impl is None:
+            seed_impl = "scan" if jax.default_backend() == "cpu" else "batched"
+        if seed_impl not in ("scan", "batched"):
+            raise ValueError(f"seed_impl must be 'scan', 'batched' or None, "
+                             f"got {seed_impl!r}")
+        seed_fn = greedy_place if seed_impl == "scan" else greedy_place_batched
+        seed_assignment = seed_fn(prob, order)
     key = jax.random.PRNGKey(seed)
     k_init, k_anneal = jax.random.split(key)
     inits = make_chain_inits(prob, seed_assignment, chains, k_init)

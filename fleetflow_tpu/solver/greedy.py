@@ -1,16 +1,27 @@
-"""Device greedy placer: vectorized first-fit-decreasing via lax.scan.
+"""Device greedy placer: vectorized first-fit-decreasing.
 
 The seed stage of the solve pipeline (SURVEY.md section 7 phase 2: "greedy
-seed (vectorized topo-order by dependency depth)"). One scan step places one
-service: score every node at once (capacity fit, conflict freedom,
-eligibility, strategy preference) and pick the best — O(N·(R+K)) per step,
-S steps, no data-dependent shapes. Replaces the reference's sequential
-`order_by_dependencies` partition + per-service Docker round-trip
+seed (vectorized topo-order by dependency depth)"). Replaces the reference's
+sequential `order_by_dependencies` partition + per-service Docker round-trip
 (engine.rs:67-85,157-167) as the placement front-end.
 
-When no node is feasible the service is placed best-effort (least overflow,
-fewest conflicts) and the annealer repairs it — matching the reference's
-FallbackPolicy relax-order semantics (model.rs:49) in spirit.
+Two implementations:
+
+- `greedy_place`: one lax.scan step per service — exact FFD, but S sequential
+  iterations. At 10k services the loop is latency-bound even on-device
+  (round-1 VERDICT: seed_ms 181 at 10k×1k dwarfed the anneal).
+- `greedy_place_batched` (default in solve()): scan over batches of M
+  services. Each batch scores all M×N (service, node) pairs in one shot,
+  services pick their best node, and within-batch collisions are resolved
+  with pairwise masks — service m may land on its chosen node only if the
+  demand of earlier same-node batch-mates still fits and none of them shares
+  a conflict group. Losers retry against the updated state in a second round;
+  the rare still-losers are committed best-effort (the annealer repairs
+  them, matching the reference's FallbackPolicy relax-order semantics,
+  model.rs:49, in spirit). Sequential depth drops from S to ~2·S/M.
+
+When no node is feasible a service is placed best-effort (least overflow,
+fewest conflicts) and the annealer repairs it.
 """
 
 from __future__ import annotations
@@ -23,7 +34,7 @@ import numpy as np
 
 from .problem import DeviceProblem
 
-__all__ = ["greedy_place", "placement_order"]
+__all__ = ["greedy_place", "greedy_place_batched", "placement_order"]
 
 _NEG = -1e30
 
@@ -98,3 +109,176 @@ def greedy_place(prob: DeviceProblem, order: jax.Array,
     # ~40% wall-clock at 10k services
     (_, _, assignment), _ = jax.lax.scan(step, init, order, unroll=8)
     return assignment
+
+
+def _node_scores(prob: DeviceProblem, load: jax.Array, svc: jax.Array):
+    """Score all nodes for a batch of services against shared state.
+
+    Returns (score (M,N), fits (M,N), new_load (M,N,R)-free util term reused
+    by callers is not returned — only what the batch step needs)."""
+    d = prob.demand[svc]                                    # (M, R)
+    new_load = load[None, :, :] + d[:, None, :]             # (M, N, R)
+    fits = (new_load <= prob.capacity[None] + 1e-6).all(-1)  # (M, N)
+
+    u_after = new_load / jnp.maximum(prob.capacity[None], 1e-6)
+    usq = (u_after * u_after).sum(-1)                       # (M, N)
+    if prob.strategy == 0:       # spread: lowest resulting util²
+        score = -usq
+    elif prob.strategy == 1:     # pack: highest resulting util²
+        score = usq
+    else:                        # fill_lowest: low node index first
+        score = jnp.broadcast_to(-jnp.arange(prob.N, dtype=jnp.float32),
+                                 usq.shape)
+    score = score + prob.preferred[svc] * 0.5
+    overflow = jnp.maximum(new_load - prob.capacity[None], 0.0).sum(-1)
+    return score, fits, overflow
+
+
+def _conflict_rows(prob: DeviceProblem, used: jax.Array, svc: jax.Array):
+    """(M, N) bool: node already occupied by a conflicting service."""
+    ids = prob.conflict_ids[svc]                            # (M, K)
+    valid = ids >= 0
+    safe = jnp.where(valid, ids, 0)
+    occ = used[:, safe]                                     # (N, M, K)
+    return ((occ * valid[None, :, :]).sum(-1) > 0).T        # (M, N)
+
+
+def _pairwise_ok(prob: DeviceProblem, load: jax.Array, svc: jax.Array,
+                 choice: jax.Array, live: jax.Array) -> jax.Array:
+    """Within-batch resolution: may service m commit to choice[m] given the
+    *earlier* live batch-mates that chose the same node? (M,) bool."""
+    M = svc.shape[0]
+    d = prob.demand[svc] * live[:, None]                    # (M, R)
+    same = (choice[:, None] == choice[None, :]) & live[:, None] & live[None, :]
+    earlier = jnp.tril(jnp.ones((M, M), bool), k=-1)
+    mates = same & earlier                                  # (M, M)
+
+    # capacity: earlier same-node mates' demand must still leave room
+    prefix = mates.astype(jnp.float32) @ d                  # (M, R)
+    cap_c = prob.capacity[choice]                           # (M, R)
+    cap_ok = (load[choice] + prefix + prob.demand[svc]
+              <= cap_c + 1e-6).all(-1)
+
+    # conflicts: no earlier same-node mate shares a conflict id
+    ids = prob.conflict_ids[svc]                            # (M, K)
+    v = ids >= 0
+    share = ((ids[:, None, :, None] == ids[None, :, None, :])
+             & v[:, None, :, None] & v[None, :, None, :]).any((-1, -2))
+    conf_ok = ~(mates & share).any(-1)
+    return cap_ok & conf_ok
+
+
+def _commit(prob: DeviceProblem, load, used, assignment, svc, choice, mask):
+    """Scatter a masked batch of placements into the shared state."""
+    w = mask.astype(jnp.float32)
+    wi = mask.astype(jnp.int32)
+    load = load.at[choice].add(prob.demand[svc] * w[:, None])
+
+    ids = prob.conflict_ids[svc]
+    valid = (ids >= 0).astype(jnp.int32) * wi[:, None]
+    safe = jnp.where(ids >= 0, ids, 0)
+    rows = jnp.broadcast_to(choice[:, None], safe.shape)
+    used = used.at[rows, safe].add(valid)
+
+    # dump-row trick: non-committed writes land on a scratch row
+    tgt = jnp.where(mask, svc, prob.S)
+    assignment = assignment.at[tgt].set(choice.astype(jnp.int32))
+    return load, used, assignment
+
+
+@partial(jax.jit, static_argnames=("batch",))
+def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
+                         batch: int = 256) -> jax.Array:
+    """Place services in `order`, `batch` at a time; returns (S,) int32.
+
+    Semantics match greedy_place's FFD-with-fallback except that services in
+    one batch cannot see each other's *soft* influence (they do see each
+    other's capacity/conflict footprint through the pairwise resolution).
+    Sequential depth is ceil(S/batch) scan steps instead of S.
+    """
+    S, N = prob.S, prob.N
+    M = min(batch, S)
+    n_batches = -(-S // M)
+    pad = n_batches * M - S
+    order_p = jnp.concatenate(
+        [order.astype(jnp.int32), jnp.full((pad,), -1, jnp.int32)])
+    batches = order_p.reshape(n_batches, M)
+
+    # spread strategy fans each batch over the top-W near-equal nodes
+    # (without this, all M batch-mates herd onto the same lowest-util node
+    # and the pairwise gate rejects most of them every round)
+    W = min(M, N)
+
+    def step(carry, svc_raw):
+        load, used, assignment = carry
+        live0 = svc_raw >= 0
+        svc = jnp.where(live0, svc_raw, 0)
+
+        def choose(load, used, live):
+            score, fits, overflow = _node_scores(prob, load, svc)
+            conflict = _conflict_rows(prob, used, svc)
+            hard_ok = (fits & prob.eligible[svc] & prob.node_valid[None]
+                       & ~conflict)
+            masked = jnp.where(hard_ok, score, _NEG)
+            # Anti-herding ranks: a plain argmax sends every batch-mate to
+            # the same node; the pairwise gate then admits only one node's
+            # worth per round and the rest tail-commit with violations.
+            _, topk = jax.lax.top_k(masked, W)                # (M, W)
+            count_ok = jnp.minimum(hard_ok.sum(-1), W)        # only W columns
+            if prob.strategy == 0:
+                # spread: batch-mate m takes a rank spread over its OWN
+                # feasible list ((m mod W) mapped proportionally onto
+                # [0, count_ok)). Proportional mapping matters: tenant pools
+                # give same-tenant services identical ~count_ok-node feasible
+                # lists, and a clamped rank would pile every high-m
+                # batch-mate onto one node.
+                r = jnp.arange(M, dtype=jnp.int32) % W
+                r_eff = jnp.minimum((r * count_ok) // W,
+                                    jnp.maximum(count_ok - 1, 0))
+            else:
+                # pack / fill_lowest: fill nodes in score order, about one
+                # node's capacity worth of batch-mates per rank — herding
+                # onto a single node per round would strand the rest on the
+                # best-effort tail.
+                mean_d = jnp.maximum(prob.demand[svc].mean(0), 1e-6)  # (R,)
+                med_cap = jnp.median(prob.capacity, axis=0)           # (R,)
+                est = jnp.clip((med_cap / mean_d).min().astype(jnp.int32),
+                               1, M)
+                r = jnp.arange(M, dtype=jnp.int32) // est
+                r_eff = jnp.minimum(r, jnp.maximum(count_ok - 1, 0))
+            best_ok = jnp.take_along_axis(topk, r_eff[:, None], 1)[:, 0]
+            # fallback: least overflow / fewest conflicts among eligible
+            fb_score = score - overflow * 1e3 - conflict * 1e3
+            fb_ok = prob.eligible[svc] & prob.node_valid[None]
+            best_fb = jnp.argmax(jnp.where(fb_ok, fb_score, fb_score - 1e15),
+                                 axis=-1)
+            has_ok = hard_ok.any(-1)
+            choice = jnp.where(has_ok, best_ok, best_fb).astype(jnp.int32)
+            pair_ok = _pairwise_ok(prob, load, svc, choice, live)
+            return choice, has_ok, live & pair_ok & has_ok
+
+        # round 1: everyone proposes; winners commit
+        c1, _, ok1 = choose(load, used, live0)
+        load, used, assignment = _commit(prob, load, used, assignment,
+                                         svc, c1, ok1)
+        # round 2: losers re-propose against the updated state
+        rest = live0 & ~ok1
+        c2, has2, ok2 = choose(load, used, rest)
+        load, used, assignment = _commit(prob, load, used, assignment,
+                                         svc, c2, ok2)
+        # best-effort tail: anything still unplaced (no feasible node at all,
+        # or twice collision-rejected) commits at its round-2 choice; the
+        # annealer repairs (FallbackPolicy relax-order in spirit)
+        tail = rest & ~ok2
+        load, used, assignment = _commit(prob, load, used, assignment,
+                                         svc, c2, tail)
+        return (load, used, assignment), None
+
+    R = prob.demand.shape[1]
+    init = (
+        jnp.zeros((N, R), jnp.float32),
+        jnp.zeros((N, prob.G), jnp.int32),
+        jnp.full((S + 1,), -1, jnp.int32),   # +1 dump row
+    )
+    (_, _, assignment), _ = jax.lax.scan(step, init, batches)
+    return assignment[:S]
