@@ -16,7 +16,8 @@ import json
 import sys
 from typing import Optional
 
-from ..core.errors import ConfigNotFound, ControlPlaneError, FlowError
+from ..core.errors import (CloudError, ConfigNotFound, ControlPlaneError,
+                           FlowError)
 from ..core.loader import load_project
 from ..core.model import Backend, Flow
 from ..lower.tensors import lower_stage
@@ -86,6 +87,33 @@ def _event_printer(event) -> None:
     print(f"  {event}")
 
 
+def _split_stage(flow: Flow, stage, services: list[str]):
+    """(static, container) resolved services of a stage, honoring the -n
+    service filter."""
+    from ..runtime.static_site import split_static_services
+    resolved = [s for s in stage.resolved_services(flow)
+                if not services or s.name in services]
+    return split_static_services(resolved)
+
+
+def _wait_procs(dev_procs) -> int:
+    """Foreground-wait on static dev servers (up.rs:190-194)."""
+    for name, proc in dev_procs:
+        print(f"  {name}: dev server PID {proc.pid} (Ctrl+C to stop)")
+    for _, proc in dev_procs:
+        proc.wait()
+    return 0
+
+
+def _stop_procs(dev_procs) -> None:
+    """Tear down dev servers when the rest of the up failed."""
+    for _, proc in dev_procs:
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+
+
 # --------------------------------------------------------------------------
 # Daily commands
 # --------------------------------------------------------------------------
@@ -98,6 +126,27 @@ def cmd_up(args) -> int:
     if args.dry_run:
         _print_plan(flow, stage_name, services)
         return 0
+    # static services: build + wrangler pages dev, before the container
+    # loop (up.rs:139-195); each dev server gets its own port
+    from ..runtime.static_site import up_static
+    static, container = _split_stage(flow, stage, services)
+    dev_procs = []
+    for i, svc in enumerate(static):
+        print(f"▶ {svc.name} — static site dev server")
+        try:
+            proc = up_static(svc, getattr(args, "project_root", None) or ".",
+                             on_line=lambda line: print(f"  {line}"),
+                             port=8788 + i)
+        except (FlowError, CloudError) as e:
+            print(f"  {svc.name}: {e}", file=sys.stderr)
+            _stop_procs(dev_procs)
+            return 1
+        if proc is not None:
+            dev_procs.append((svc.name, proc))
+    if static and not container:
+        # nothing but static services: wait in the foreground like the
+        # reference (Ctrl+C stops the dev servers)
+        return _wait_procs(dev_procs)
     if stage.backend in (Backend.QUADLET, Backend.COMPOSE) and (
             args.services or args.no_pull):
         print("warning: -n/--no-pull are not supported on the "
@@ -110,21 +159,36 @@ def cmd_up(args) -> int:
             print(f"  started {u}")
         for u, err in outcome.errors.items():
             print(f"  FAILED {u}: {err}", file=sys.stderr)
-        return 0 if outcome.ok else 1
+        rc = 0 if outcome.ok else 1
+        if rc != 0:
+            _stop_procs(dev_procs)
+            return rc
+        return _wait_procs(dev_procs)
     if stage.backend is Backend.COMPOSE:
         from ..runtime.compose import compose_up
         rc, out = compose_up(flow, stage_name,
                              getattr(args, "project_root", None) or ".")
         print(out)
-        return rc
+        if rc != 0:
+            _stop_procs(dev_procs)
+            return rc
+        return _wait_procs(dev_procs)
+    target = args.services or []
+    if static:
+        # static services never reach the container engine
+        target = [s.name for s in container]
     engine = DeployEngine(_backend(args), scheduler=pick_scheduler(
         len(services), 1, prefer_tpu=False))
     res = engine.execute(
         DeployRequest(flow=flow, stage_name=stage_name,
-                      target_services=args.services or [],
+                      target_services=target,
                       no_pull=args.no_pull),
         on_event=_event_printer)
-    return 0 if res.ok else 1
+    if not res.ok:
+        _stop_procs(dev_procs)
+        return 1
+    # keep the dev servers in the foreground alongside the containers
+    return _wait_procs(dev_procs)
 
 
 def cmd_down(args) -> int:
@@ -212,12 +276,25 @@ def cmd_exec(args) -> int:
     stage_name = _stage(args)
     from ..runtime.converter import container_name
     import subprocess
+    if args.service not in flow.services:
+        print(f"service {args.service!r} not found. available: "
+              f"{', '.join(sorted(flow.services))}", file=sys.stderr)
+        return 1
     cname = container_name(flow.name, stage_name, args.service)
+    cmd = args.cmd or ["/bin/sh"]
+    # shells auto-enable interactive+tty (exec.rs:40-43); explicit -i/-t
+    # add them for anything else, gated on an actual terminal
+    is_shell = len(cmd) == 1 and cmd[0] in ("/bin/sh", "/bin/bash",
+                                            "sh", "bash")
+    interactive = args.interactive or is_shell
+    tty = (args.tty or is_shell) and sys.stdin.isatty()
     argv = ["docker", "exec"]
-    if sys.stdin.isatty():
-        argv.append("-it")
+    if interactive:
+        argv.append("-i")
+    if tty:
+        argv.append("-t")
     argv.append(cname)
-    argv += args.cmd or ["/bin/sh"]
+    argv += cmd
     return subprocess.call(argv)
 
 
@@ -266,8 +343,27 @@ def cmd_deploy(args) -> int:
         if reply.strip().lower() not in ("y", "yes"):
             print("aborted")
             return 1
+    # static services ship through the Pages path, not the engine/CP
+    # (deploy.rs:265-352)
+    from ..runtime.static_site import deploy_static
+    static, container = _split_stage(flow, stage, services)
+    for svc in static:
+        print(f"■ {svc.name} — static site deploy")
+        try:
+            result = deploy_static(svc,
+                                   getattr(args, "project_root", None) or ".",
+                                   on_line=lambda line: print(f"  {line}"))
+        except (FlowError, CloudError) as e:
+            print(f"  {svc.name}: {e}", file=sys.stderr)
+            return 1
+        print(f"  ✓ deployed" + (f": {result.url}" if result.url else ""))
+    if static and not container:
+        return 0
+    target = args.services or []
+    if static:
+        target = [s.name for s in container]
     req = DeployRequest(flow=flow, stage_name=stage_name,
-                        target_services=args.services or [],
+                        target_services=target,
                         no_pull=args.no_pull)
     if stage.servers:
         # remote path (deploy.rs:377+): route through the CP
@@ -353,20 +449,36 @@ stage "local" {{
 
 
 def cmd_init(args) -> int:
-    """Starter config writer (the reference's TUI wizard, tui/init.rs:123)."""
+    """Starter config writer. Interactive wizard on a TTY (the reference's
+    ratatui wizard, tui/init.rs:123); direct write with --name or when
+    stdin is not a terminal."""
     import os
     from pathlib import Path
     root = Path(getattr(args, "project_root", None) or ".")
+    default_name = os.path.basename(root.resolve()) or "myproject"
+    interactive = (args.name is None and not args.no_wizard
+                   and sys.stdin.isatty())
+    if interactive:
+        from .wizard import run_wizard
+        target = run_wizard(project_root=str(root),
+                            default_name=default_name, force=args.force)
+        return 0 if target is not None else 1
     target = root / ".fleetflow" / "fleet.kdl"
     if target.exists() and not args.force:
         print(f"{target} already exists (use --force to overwrite)",
               file=sys.stderr)
         return 1
-    name = args.name or os.path.basename(root.resolve()) or "myproject"
+    name = args.name or default_name
     target.parent.mkdir(parents=True, exist_ok=True)
     target.write_text(STARTER_KDL.format(name=name))
     print(f"wrote {target}\ntry: fleet up --dry-run")
     return 0
+
+
+def cmd_self_update(args) -> int:
+    """GitHub-release self-update (the reference's self_update.rs:4)."""
+    from .self_update import self_update
+    return self_update(dry_run=args.dry_run)
 
 
 def cmd_mcp(args) -> int:
@@ -615,9 +727,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("exec", help="exec into a service container")
-    p.add_argument("service")
-    p.add_argument("cmd", nargs="*")
+    p.add_argument("-i", "--interactive", action="store_true",
+                   help="keep stdin attached")
+    p.add_argument("-t", "--tty", action="store_true",
+                   help="allocate a pseudo-TTY")
     stage_args(p, positional=False)
+    p.add_argument("service")
+    # REMAINDER: the command may carry its own flags (`fleet exec web ls -la`)
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_exec)
 
     # Ship
@@ -652,7 +769,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("init", help="write a starter fleet.kdl")
     p.add_argument("--name")
     p.add_argument("--force", action="store_true")
+    p.add_argument("--no-wizard", action="store_true",
+                   help="skip the interactive wizard even on a TTY")
     p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("self-update",
+                       help="update fleet from GitHub releases")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_self_update)
 
     p = sub.add_parser("mcp", help="run the MCP server on stdio")
     p.add_argument("--cp", help="CP endpoint host:port")

@@ -13,7 +13,7 @@ import subprocess
 from pathlib import Path
 from typing import Optional
 
-from ..core.model import Flow, Stage
+from ..core.model import Flow, ServiceType, Stage
 
 __all__ = ["generate_compose_yaml", "write_compose_file",
            "compose_up", "compose_down"]
@@ -48,6 +48,8 @@ def generate_compose_yaml(flow: Flow, stage: Stage) -> str:
     net = f"{flow.name}-{stage.name}"
     lines = [f"name: {_yaml_escape(net)}", "services:"]
     for svc in stage.resolved_services(flow):
+        if svc.service_type is ServiceType.STATIC:
+            continue  # static sites ship via wrangler, not compose
         lines.append(f"  {svc.name}:")
         lines.append(f"    image: {_yaml_escape(svc.image_name())}")
         lines.append(f"    container_name: {_yaml_escape(f'{flow.name}-{stage.name}-{svc.name}')}")

@@ -117,6 +117,8 @@ def build_stage_units(flow: Flow, stage: Stage) -> dict[str, str]:
     units = {_network_unit_name(flow.name, stage.name):
              generate_network_unit(flow.name, stage.name)}
     for svc in stage.resolved_services(flow):
+        if svc.service_type is ServiceType.STATIC:
+            continue  # static sites ship via wrangler, not systemd units
         units[_unit_name(flow.name, stage.name, svc.name)] = \
             generate_container_unit(svc, flow.name, stage.name)
     return units

@@ -1,0 +1,215 @@
+"""Static-site service execution tests (runtime/static_site.py + CLI).
+
+The reference runs static services through wrangler in `fleet up`
+(up.rs:139-195) and `fleet deploy` (deploy.rs:265-352); these tests drive
+the same paths with injected runners / patched wrangler wrappers (the
+MockRunner pattern VERDICT item 5 asks for).
+"""
+
+import pytest
+
+from fleetflow_tpu.cli.main import main
+from fleetflow_tpu.core.errors import FlowError
+from fleetflow_tpu.core.model import DeployConfig, Service, ServiceType
+from fleetflow_tpu.core.parser import parse_kdl_string
+from fleetflow_tpu.runtime import static_site
+from fleetflow_tpu.runtime.static_site import (build_static, deploy_static,
+                                               split_static_services,
+                                               up_static)
+
+
+def make_runner(log, rc=0, out="ok"):
+    def runner(argv, cwd):
+        log.append((argv, cwd))
+        return rc, out
+    return runner
+
+
+def static_svc(name="site", command="npm run build", output="public",
+               project="my-pages"):
+    return Service(name=name, service_type=ServiceType.STATIC,
+                   command=command,
+                   deploy=DeployConfig(type="cloudflare-pages",
+                                       output=output, project=project))
+
+
+class TestSplit:
+    def test_partition(self):
+        svcs = [Service(name="db"), static_svc(), Service(name="app")]
+        static, container = split_static_services(svcs)
+        assert [s.name for s in static] == ["site"]
+        assert [s.name for s in container] == ["db", "app"]
+
+
+class TestBuild:
+    def test_runs_command_via_sh(self, tmp_path):
+        log = []
+        build_static(static_svc(), str(tmp_path), runner=make_runner(log))
+        assert log == [(["sh", "-c", "npm run build"], str(tmp_path))]
+
+    def test_real_shell_build(self, tmp_path):
+        # the build command is a real `sh -c` in the project root
+        svc = static_svc(command="mkdir -p public && echo hi > public/index.html")
+        build_static(svc, str(tmp_path))
+        assert (tmp_path / "public" / "index.html").read_text() == "hi\n"
+
+    def test_no_command_is_noop(self, tmp_path):
+        log = []
+        svc = static_svc(command=None)
+        svc.deploy.command = None
+        build_static(svc, str(tmp_path), runner=make_runner(log))
+        assert log == []
+
+    def test_build_failure_raises(self, tmp_path):
+        with pytest.raises(FlowError, match="build command failed"):
+            build_static(static_svc(), str(tmp_path),
+                         runner=make_runner([], rc=1, out="boom"))
+
+
+class TestUpStatic:
+    def test_build_then_dev_server(self, tmp_path):
+        log = []
+        assert up_static(static_svc(), str(tmp_path),
+                         runner=make_runner(log)) is None
+        assert log[0][0] == ["sh", "-c", "npm run build"]
+        assert log[1][0][:3] == ["wrangler", "pages", "dev"]
+        assert log[1][0][3].endswith("public")
+
+    def test_default_output_dir_dist(self, tmp_path):
+        log = []
+        svc = static_svc()
+        svc.deploy.output = None
+        up_static(svc, str(tmp_path), runner=make_runner(log))
+        assert log[1][0][3].endswith("dist")
+
+
+class TestDeployStatic:
+    def test_build_then_pages_deploy(self, tmp_path):
+        log = []
+        res = deploy_static(static_svc(), str(tmp_path),
+                            runner=make_runner(
+                                log, out="done https://my.pages.dev deployed"))
+        assert log[0][0] == ["sh", "-c", "npm run build"]
+        assert log[1][0][:3] == ["wrangler", "pages", "deploy"]
+        assert "--project-name" in log[1][0] and "my-pages" in log[1][0]
+        assert res.url == "https://my.pages.dev"
+
+    def test_requires_deploy_config(self, tmp_path):
+        svc = Service(name="s", service_type=ServiceType.STATIC)
+        with pytest.raises(FlowError, match="no deploy"):
+            deploy_static(svc, str(tmp_path))
+
+    def test_unknown_provider_rejected(self, tmp_path):
+        svc = static_svc()
+        svc.deploy.type = "netlify"
+        with pytest.raises(FlowError, match="unsupported"):
+            deploy_static(svc, str(tmp_path), runner=make_runner([]))
+
+    def test_requires_project(self, tmp_path):
+        svc = static_svc(project=None)
+        with pytest.raises(FlowError, match="deploy.project"):
+            deploy_static(svc, str(tmp_path), runner=make_runner([]))
+
+
+STATIC_KDL = '''
+project "webproj"
+
+service "site" {
+    type "static"
+    command "mkdir -p public && echo hello > public/index.html"
+    deploy {
+        type "cloudflare-pages"
+        output "public"
+        project "my-pages"
+    }
+}
+
+service "api" {
+    image "myapi"
+    version "latest"
+}
+
+stage "web" {
+    service "site"
+}
+
+stage "full" {
+    service "site"
+    service "api"
+}
+'''
+
+
+@pytest.fixture
+def web_project(tmp_path):
+    cfg = tmp_path / ".fleetflow"
+    cfg.mkdir()
+    (cfg / "fleet.kdl").write_text(STATIC_KDL)
+    return tmp_path
+
+
+class FakeProc:
+    pid = 4242
+
+    def __init__(self):
+        self.waited = False
+
+    def wait(self):
+        self.waited = True
+
+
+class TestCliStatic:
+    def test_up_static_only_stage(self, web_project, monkeypatch, capsys):
+        started = []
+
+        def fake_dev(output_dir, *, port=8788, cwd=None):
+            started.append((output_dir, cwd))
+            return FakeProc()
+
+        import fleetflow_tpu.cloud.cloudflare as cf
+        monkeypatch.setattr(cf, "wrangler_pages_dev", fake_dev)
+        rc = main(["--project-root", str(web_project), "--mock",
+                   "up", "web"])
+        assert rc == 0
+        assert len(started) == 1 and started[0][0].endswith("public")
+        # the real sh build ran
+        assert (web_project / "public" / "index.html").exists()
+        out = capsys.readouterr().out
+        assert "dev server" in out
+
+    def test_up_mixed_stage_routes_containers_to_engine(
+            self, web_project, monkeypatch, capsys):
+        import fleetflow_tpu.cloud.cloudflare as cf
+        monkeypatch.setattr(cf, "wrangler_pages_dev",
+                            lambda *a, **k: FakeProc())
+        rc = main(["--project-root", str(web_project), "--mock",
+                   "up", "full"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "site" in out          # static path ran
+        assert "api" in out           # container path ran via mock engine
+
+    def test_deploy_static_only_stage(self, web_project, monkeypatch, capsys):
+        calls = []
+
+        def fake_deploy(output_dir, project, *, cwd=None, runner=None):
+            calls.append((output_dir, project))
+            return "https://my-pages.pages.dev ok"
+
+        import fleetflow_tpu.cloud.cloudflare as cf
+        monkeypatch.setattr(static_site, "wrangler_pages_deploy", fake_deploy,
+                            raising=False)
+        monkeypatch.setattr(cf, "wrangler_pages_deploy", fake_deploy)
+        rc = main(["--project-root", str(web_project), "--mock",
+                   "deploy", "web", "--yes"])
+        assert rc == 0
+        assert calls and calls[0][1] == "my-pages"
+        assert "pages.dev" in capsys.readouterr().out
+
+    def test_deploy_static_missing_project_fails(self, web_project, capsys):
+        bad = STATIC_KDL.replace('project "my-pages"', "")
+        (web_project / ".fleetflow" / "fleet.kdl").write_text(bad)
+        rc = main(["--project-root", str(web_project), "--mock",
+                   "deploy", "web", "--yes"])
+        assert rc == 1
+        assert "deploy.project" in capsys.readouterr().err
