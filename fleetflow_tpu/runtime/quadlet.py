@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
 
-from ..core.model import Flow, RestartPolicy, Service, Stage
+from ..core.model import Flow, RestartPolicy, Service, ServiceType, Stage
 from .converter import container_name, network_name
 
 __all__ = ["generate_container_unit", "generate_network_unit",
