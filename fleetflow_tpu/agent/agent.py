@@ -30,8 +30,11 @@ from ..lower.tensors import lower_stage
 from .guard import confine_path, validate_container_name
 from .monitor import AnomalyDetector, inventory_report, snapshot_backend
 from ..cp.protocol import Connection, ProtocolClient
+from ..obs import get_logger, kv
 
 __all__ = ["Agent", "AgentConfig"]
+
+log = get_logger("agent")
 
 RECONNECT_BACKOFF_S = 5.0   # agent.rs:34-45
 
@@ -77,11 +80,13 @@ class Agent:
                 await self.run_session()
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as e:
                 # any session failure (refused socket, auth reject -> RpcError,
                 # garbage frame -> JSONDecodeError, register timeout) means
                 # "retry after backoff", never "die" (agent.rs:34-45)
-                pass
+                log.warning("session lost %s", kv(
+                    slug=self.config.slug, error=e,
+                    retry_in_s=RECONNECT_BACKOFF_S))
             if self._stop.is_set():
                 break
             try:
@@ -112,6 +117,9 @@ class Agent:
                 "version": self.config.version,
                 "capacity": self.config.capacity,
             })
+            log.info("registered %s", kv(
+                slug=self.config.slug,
+                cp=f"{self.config.cp_host}:{self.config.cp_port}"))
             hb = asyncio.ensure_future(self._heartbeat_loop())
             mon = asyncio.ensure_future(self._monitor_loop())
             self._session_tasks = [hb, mon]
@@ -172,10 +180,14 @@ class Agent:
         """agent.rs command loop :129-208 + envelope :215-254."""
         request_id = envelope.get("request_id")
         payload = envelope.get("payload", {})
+        log.debug("command %s", kv(method=method, request_id=request_id,
+                                   slug=self.config.slug))
         try:
             result = await self.execute_command(method, payload)
             reply = {"request_id": request_id, "result": result}
         except Exception as e:
+            log.error("command failed %s", kv(method=method,
+                                              request_id=request_id, error=e))
             reply = {"request_id": request_id,
                      "error": f"{type(e).__name__}: {e}"}
         if request_id:

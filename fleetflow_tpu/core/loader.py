@@ -28,6 +28,9 @@ from .errors import FlowError
 from .model import Flow
 from .parser import parse_kdl_string, read_kdl_with_includes
 from .template import TemplateProcessor, extract_variables_with_stage, parse_dotenv
+from ..obs import get_logger, span
+
+log = get_logger("loader")
 
 __all__ = ["load_project", "load_project_from_root_with_stage",
            "prepare_template_processor", "expand_all_files", "LoadDebug"]
@@ -125,17 +128,24 @@ def load_project_from_root_with_stage(root: str, stage: Optional[str] = None,
                                       environ: Optional[dict[str, str]] = None,
                                       resolve_secrets: bool = True,
                                       debug: Optional[LoadDebug] = None) -> Flow:
-    """Full pipeline from a known project root (reference: loader.rs:42-74)."""
-    files = discover_files_with_stage(root, stage)
-    if files.main_file is None:
-        raise FlowError(f"no {files.config_dir}/fleet.kdl")
-    tp = prepare_template_processor(files, stage, environ, resolve_secrets)
-    text = expand_all_files(files, tp, debug)
-    flow = parse_kdl_string(text)
-    # expose the final variable context on the flow
-    merged = dict(tp.variables)
-    merged.update(flow.variables)
-    flow.variables = merged
+    """Full pipeline from a known project root (reference: loader.rs:42-74,
+    `#[instrument]` on load_*: loader.rs:24-41)."""
+    with span(log, "load_project", root=root, stage=stage) as sp:
+        files = discover_files_with_stage(root, stage)
+        if files.main_file is None:
+            raise FlowError(f"no {files.config_dir}/fleet.kdl")
+        log.debug("discovered files=%d main=%s", len(files.all_files()),
+                  files.main_file)
+        tp = prepare_template_processor(files, stage, environ, resolve_secrets)
+        log.debug("variable context: %d variables", len(tp.variables))
+        text = expand_all_files(files, tp, debug)
+        flow = parse_kdl_string(text)
+        # expose the final variable context on the flow
+        merged = dict(tp.variables)
+        merged.update(flow.variables)
+        flow.variables = merged
+        sp.update(project=flow.name, services=len(flow.services),
+                  stages=len(flow.stages))
     return flow
 
 

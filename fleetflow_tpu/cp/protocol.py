@@ -30,6 +30,9 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Optional
 
 from ..core.errors import ControlPlaneError
+from ..obs import get_logger, kv
+
+log = get_logger("cp.protocol")
 
 __all__ = ["Connection", "ProtocolServer", "ProtocolClient", "RpcError",
            "MAX_FRAME"]
@@ -225,10 +228,14 @@ class ProtocolServer:
             return
         identity = str(hello.get("identity", "?"))
         if self.authenticate and not self.authenticate(identity, hello.get("token")):
+            log.warning("rejected %s", kv(identity=identity,
+                                          reason="unauthorized"))
             writer.write(encode_frame({"type": "error", "error": "unauthorized"}))
             await writer.drain()
             writer.close()
             return
+        log.info("connected %s", kv(identity=identity,
+                                    peers=len(self.connections) + 1))
         conn = Connection(reader=reader, writer=writer, identity=identity,
                           handlers=self.handlers,
                           event_handlers=self.event_handlers)
@@ -245,6 +252,8 @@ class ProtocolServer:
 
     async def _forget(self, conn: Connection) -> None:
         self.connections.discard(conn)
+        log.info("disconnected %s", kv(identity=conn.identity,
+                                       peers=len(self.connections)))
         if self.on_disconnect is not None:
             await self.on_disconnect(conn)
 

@@ -20,6 +20,9 @@ from .log_router import LogRouter
 from .placement import PlacementService
 from .protocol import ProtocolServer
 from .store import Store
+from ..obs import get_logger, kv
+
+log = get_logger("cp.server")
 
 __all__ = ["ServerConfig", "AppState", "CpServerHandle", "start"]
 
@@ -130,4 +133,8 @@ async def start(config: ServerConfig, *,
     register_all(server, state)
 
     host, port = await server.start(config.host, config.port)
+    log.info("listening %s", kv(
+        host=host, port=port, name=config.name,
+        tls=bool(config.tls_dir), auth=config.auth_kind,
+        db=config.db_path or ":memory:"))
     return CpServerHandle(server, state, host, port, ca)

@@ -1,0 +1,148 @@
+"""Structured logging + solve profiling (the `#[instrument]` analog).
+
+The reference instruments its whole load/deploy pipeline with tracing spans
+(fleetflow-core loader.rs:24-41 `#[instrument]`, fleetflowd main.rs tracing
+subscriber configured from env). This module is the Python analog:
+
+- `get_logger("engine")` returns a named logger under the `fleetflow.`
+  namespace, configured once from the `FLEET_LOG` environment variable.
+- `span(log, "deploy", stage="live")` is a context manager that logs
+  entry at DEBUG, exit at the span's level with a duration, and failures
+  at ERROR with the exception — one line per event, `key=value` fields.
+- `profile_trace()` wraps a block in `jax.profiler.trace` when
+  `FLEET_PROFILE_DIR` is set (opt-in, zero cost otherwise); point
+  TensorBoard or `xprof` at the directory to see the solve timeline.
+
+`FLEET_LOG` grammar (tracing-subscriber EnvFilter analog, simplified):
+    FLEET_LOG=debug                    # everything under fleetflow.* at DEBUG
+    FLEET_LOG=info,solver=debug        # default INFO, fleetflow.solver DEBUG
+    FLEET_LOG=engine=debug,cp=warning  # per-module levels, rest untouched
+Unset/empty leaves the `fleetflow` logger un-configured (library mode: the
+host application owns logging config, handlers propagate as usual).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+__all__ = ["get_logger", "span", "configure", "profile_trace", "kv"]
+
+_ROOT = "fleetflow"
+_configured = False
+
+_LEVELS = {
+    "trace": logging.DEBUG,  # no TRACE in stdlib; map down
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "off": logging.CRITICAL + 10,
+}
+
+
+def kv(**fields) -> str:
+    """Render key=value fields the way the reference's tracing output does.
+    Values containing whitespace are quoted; None fields are dropped."""
+    parts = []
+    for k, v in fields.items():
+        if v is None:
+            continue
+        s = str(v)
+        if any(c.isspace() for c in s) or s == "":
+            s = repr(s)
+        parts.append(f"{k}={s}")
+    return " ".join(parts)
+
+
+def configure(spec: Optional[str] = None, *, force: bool = False,
+              stream=None) -> None:
+    """Apply a FLEET_LOG spec to the `fleetflow` logger tree. Called lazily
+    by get_logger(); call directly (force=True) to re-apply after mutating
+    the environment (tests do this)."""
+    global _configured
+    if _configured and not force:
+        return
+    _configured = True
+    if spec is None:
+        spec = os.environ.get("FLEET_LOG", "")
+    spec = (spec or "").strip()
+    if not spec:
+        return
+
+    root = logging.getLogger(_ROOT)
+    if force:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+    handler = logging.StreamHandler(stream)  # None -> stderr
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)-5s %(name)s: %(message)s",
+        datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.propagate = False
+
+    default_level = None
+    per_module: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            mod, _, lvl = part.partition("=")
+            level = _LEVELS.get(lvl.strip().lower())
+            if level is not None:
+                per_module[mod.strip()] = level
+        else:
+            default_level = _LEVELS.get(part.lower())
+    root.setLevel(default_level if default_level is not None else logging.INFO)
+    for mod, level in per_module.items():
+        logging.getLogger(f"{_ROOT}.{mod}").setLevel(level)
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Named logger under the fleetflow namespace: get_logger('engine') ->
+    `fleetflow.engine`. First call applies FLEET_LOG."""
+    configure()
+    return logging.getLogger(f"{_ROOT}.{name}")
+
+
+@contextlib.contextmanager
+def span(log: logging.Logger, name: str, level: int = logging.INFO,
+         **fields) -> Iterator[dict]:
+    """Timed span: DEBUG on entry, `level` with duration_ms on exit, ERROR
+    with the exception on failure. The yielded dict collects extra fields to
+    report at exit (span['placed'] = 12)."""
+    extra: dict = {}
+    head = kv(**fields)
+    log.debug("%s started%s", name, f" {head}" if head else "")
+    t0 = time.perf_counter()
+    try:
+        yield extra
+    except Exception as e:
+        ms = (time.perf_counter() - t0) * 1e3
+        log.error("%s failed %s", name,
+                  kv(duration_ms=f"{ms:.1f}", error=e, **fields, **extra))
+        raise
+    ms = (time.perf_counter() - t0) * 1e3
+    log.log(level, "%s %s", name,
+            kv(duration_ms=f"{ms:.1f}", **fields, **extra))
+
+
+@contextlib.contextmanager
+def profile_trace(label: str = "solve") -> Iterator[None]:
+    """Opt-in jax.profiler trace: active only when FLEET_PROFILE_DIR is set.
+    Import of jax.profiler is deferred so non-solver callers never pay it."""
+    prof_dir = os.environ.get("FLEET_PROFILE_DIR", "")
+    if not prof_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(prof_dir, exist_ok=True)
+    with jax.profiler.trace(prof_dir):
+        with jax.profiler.TraceAnnotation(label):
+            yield

@@ -27,6 +27,7 @@ from typing import Callable, Optional
 from ..core.errors import FlowError
 from ..core.model import Flow
 from ..core.serialize import flow_from_dict, flow_to_dict
+from ..obs import get_logger, kv, span
 from ..lower.tensors import LOCAL_NODE_NAME, lower_stage
 from ..sched import HostGreedyScheduler, Placement, Scheduler
 from .backend import BackendError, ContainerBackend
@@ -99,6 +100,8 @@ class DeployResult:
 
 EventCb = Callable[[DeployEvent], None]
 
+log = get_logger("engine")
+
 
 class DeployEngine:
     def __init__(self, backend: ContainerBackend, *,
@@ -117,7 +120,17 @@ class DeployEngine:
         """Run the 5-step pipeline. `placement` lets a control plane hand a
         pre-solved plan to node agents so each agent executes only its slice
         (req.node) without re-solving."""
-        emit = on_event or (lambda e: None)
+        cb = on_event or (lambda e: None)
+
+        def emit(e: DeployEvent) -> None:
+            # every progress event also lands in the structured log, so a
+            # deploy is traceable without a callback (ref: engine.rs events
+            # mirrored through #[instrument]-ed tracing)
+            (log.error if e.step == "error" else log.debug)(
+                "%s %s", e.step, kv(service=e.service, level=e.level,
+                                    msg=e.message or None))
+            cb(e)
+
         t0 = time.perf_counter()
         flow, stage = req.flow, req.flow.stage(req.stage_name)
         services = stage_services(flow, stage, req.target_services or None)
@@ -228,6 +241,11 @@ class DeployEngine:
         emit(DeployEvent("done", message=(
             f"{len(result.deployed)} deployed, {len(result.removed)} removed, "
             f"{len(result.failed)} failed in {result.duration_s:.2f}s")))
+        log.info("deploy %s", kv(
+            project=flow.name, stage=stage.name, node=my_node,
+            deployed=len(result.deployed), removed=len(result.removed),
+            failed=len(result.failed) or None,
+            duration_ms=f"{result.duration_s * 1e3:.1f}"))
         return result
 
     # ------------------------------------------------------------------

@@ -30,6 +30,9 @@ from .kernels import soft_score, total_cost, violation_stats
 from .problem import DeviceProblem, prepare_problem
 from .repair import RepairResult, repair, verify
 from ..lower.tensors import ProblemTensors
+from ..obs import get_logger, kv, profile_trace
+
+log = get_logger("solver")
 
 DEFAULT_STEPS = 128   # batched sweeps (anneal.default_proposals_per_step wide)
 
@@ -76,14 +79,22 @@ def make_chain_inits(prob: DeviceProblem, seed_assignment: jax.Array,
     return inits.at[0].set(seed_assignment)
 
 
-def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
-          seed: int = 0, do_repair: bool = True,
-          mesh: Optional[Mesh] = None,
-          prob: Optional[DeviceProblem] = None,
-          init_assignment: Optional[np.ndarray] = None,
-          t0: float = 1.0, t1: float = 1e-3,
-          migration_weight: float = 0.5,
-          seed_impl: Optional[str] = None) -> SolveResult:
+def solve(pt: ProblemTensors, **kw) -> SolveResult:
+    """Solve a placement instance end to end (see _solve for parameters).
+    When FLEET_PROFILE_DIR is set the whole solve is captured as a
+    jax.profiler trace (obs.profile_trace)."""
+    with profile_trace("solve"):
+        return _solve(pt, **kw)
+
+
+def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
+           seed: int = 0, do_repair: bool = True,
+           mesh: Optional[Mesh] = None,
+           prob: Optional[DeviceProblem] = None,
+           init_assignment: Optional[np.ndarray] = None,
+           t0: float = 1.0, t1: float = 1e-3,
+           migration_weight: float = 0.5,
+           seed_impl: Optional[str] = None) -> SolveResult:
     """Solve a placement instance end to end.
 
     `init_assignment` warm-starts from a previous solve (streaming reschedule
@@ -172,6 +183,11 @@ def solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
     timings["total_ms"] = (t() - t_start) * 1e3
 
     soft = float(jax.device_get(soft_score(orig_prob, jnp.asarray(assignment))))
+    log.info("solve %s", kv(
+        S=prob.S, N=prob.N, chains=chains, steps=steps,
+        violations=int(stats["total"]), pre_repair=pre_repair,
+        repaired=moves or None, warm=init_assignment is not None or None,
+        **{k: f"{v:.1f}" for k, v in timings.items()}))
     return SolveResult(
         assignment=assignment, stats=stats, soft=soft,
         feasible=stats["total"] == 0, moves_repaired=moves,

@@ -424,7 +424,7 @@ class TestPlacementChannel:
         async def go():
             flow = _load_flow(project)
             handle = await start_cp()
-            await FakeAgent("node-1").connect(handle)
+            agent = await FakeAgent("node-1").connect(handle)  # noqa: F841 — keep alive
             conn, _ = await connect(handle)
             from fleetflow_tpu.core.serialize import flow_to_dict
             out = await conn.request("placement", "solve",
@@ -447,7 +447,7 @@ class TestPlacementChannel:
         async def go():
             flow = _load_flow(project)
             handle = await start_cp()
-            await FakeAgent("node-1").connect(handle)
+            agent = await FakeAgent("node-1").connect(handle)  # noqa: F841 — keep alive
             conn, _ = await connect(handle)
             from fleetflow_tpu.core.serialize import flow_to_dict
             allocs = []
@@ -469,8 +469,9 @@ class TestPlacementChannel:
         async def go():
             flow = _load_flow(project)
             handle = await start_cp()
+            agents = []
             for i in range(2):
-                await FakeAgent(f"node-{i}").connect(handle)
+                agents.append(await FakeAgent(f"node-{i}").connect(handle))
             conn, _ = await connect(handle)
             from fleetflow_tpu.core.serialize import flow_to_dict
             first = await conn.request("placement", "solve",

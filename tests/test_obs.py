@@ -1,0 +1,147 @@
+"""Observability tests: FLEET_LOG config, spans, and the deploy trace.
+
+The done-criterion from round 1: FLEET_LOG=debug must produce a coherent
+deploy trace through the engine (the reference's #[instrument] discipline,
+fleetflow-core loader.rs:24-41).
+"""
+
+import io
+import logging
+
+import pytest
+
+from fleetflow_tpu import obs
+from fleetflow_tpu.obs import configure, get_logger, kv, span
+
+
+@pytest.fixture(autouse=True)
+def reset_logging():
+    """Each test configures the fleetflow logger tree from scratch."""
+    yield
+    root = logging.getLogger("fleetflow")
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.setLevel(logging.NOTSET)
+    root.propagate = True
+    for name in list(logging.Logger.manager.loggerDict):
+        if name.startswith("fleetflow."):
+            logging.getLogger(name).setLevel(logging.NOTSET)
+    obs._configured = False
+
+
+def capture(spec: str) -> io.StringIO:
+    buf = io.StringIO()
+    configure(spec, force=True, stream=buf)
+    return buf
+
+
+class TestKv:
+    def test_basic(self):
+        assert kv(a=1, b="x") == "a=1 b=x"
+
+    def test_drops_none_quotes_spaces(self):
+        assert kv(a=None, msg="two words") == "msg='two words'"
+
+    def test_empty_value_quoted(self):
+        assert kv(a="") == "a=''"
+
+
+class TestConfigure:
+    def test_unset_leaves_library_mode(self):
+        configure("", force=True)
+        assert not logging.getLogger("fleetflow").handlers
+
+    def test_default_level(self):
+        capture("debug")
+        assert logging.getLogger("fleetflow").level == logging.DEBUG
+
+    def test_per_module(self):
+        capture("info,solver=debug")
+        assert logging.getLogger("fleetflow").level == logging.INFO
+        assert (logging.getLogger("fleetflow.solver").getEffectiveLevel()
+                == logging.DEBUG)
+        assert (logging.getLogger("fleetflow.engine").getEffectiveLevel()
+                == logging.INFO)
+
+    def test_bad_spec_ignored(self):
+        capture("bogus=nope,debug")
+        assert logging.getLogger("fleetflow").level == logging.DEBUG
+
+
+class TestSpan:
+    def test_success_logs_duration_and_fields(self):
+        buf = capture("debug")
+        log = get_logger("t")
+        with span(log, "work", stage="live") as sp:
+            sp["placed"] = 3
+        out = buf.getvalue()
+        assert "work started stage=live" in out
+        assert "duration_ms=" in out and "placed=3" in out
+
+    def test_failure_logs_error_and_reraises(self):
+        buf = capture("debug")
+        log = get_logger("t")
+        with pytest.raises(ValueError):
+            with span(log, "work"):
+                raise ValueError("boom")
+        assert "work failed" in buf.getvalue()
+        assert "boom" in buf.getvalue()
+
+
+class TestDeployTrace:
+    def test_fleet_log_debug_yields_coherent_deploy_trace(self, tmp_path):
+        """A MockBackend deploy at FLEET_LOG=debug logs every engine step in
+        order: place -> pull -> network -> start -> done, plus the final
+        summary line with counts."""
+        buf = capture("debug")
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.runtime import (DeployEngine, DeployRequest,
+                                           MockBackend)
+
+        flow = parse_kdl_string("""
+project "obsdemo"
+service "db" { image "postgres:16" }
+service "app" { image "app:1"; depends_on "db" }
+stage "live" { service "db"; service "app" }
+""")
+        engine = DeployEngine(MockBackend(auto_pull=True), sleep=lambda s: None)
+        res = engine.execute(DeployRequest(flow=flow, stage_name="live"))
+        assert res.ok
+        out = buf.getvalue()
+        steps = [l.split("fleetflow.engine: ")[1].split()[0]
+                 for l in out.splitlines() if "fleetflow.engine: " in l]
+        for needed in ("place", "pull", "network", "start", "done", "deploy"):
+            assert needed in steps, f"missing {needed} in {steps}"
+        # dependency order: db starts before app
+        starts = [l for l in out.splitlines() if " start " in l]
+        assert "db" in starts[0] and "app" in starts[-1]
+        summary = [l for l in out.splitlines() if " deploy " in l][-1]
+        assert "deployed=2" in summary and "project=obsdemo" in summary
+
+    def test_solver_logs_solve_line(self):
+        buf = capture("info")
+        from fleetflow_tpu.lower import synthetic_problem
+        from fleetflow_tpu.solver import solve
+
+        pt = synthetic_problem(16, 4, seed=0)
+        res = solve(pt, chains=2, steps=8)
+        assert res.feasible
+        line = [l for l in buf.getvalue().splitlines()
+                if "fleetflow.solver" in l][-1]
+        assert "S=16" in line and "violations=0" in line
+        assert "total_ms=" in line
+
+
+class TestProfileTrace:
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv("FLEET_PROFILE_DIR", raising=False)
+        with obs.profile_trace("x"):
+            pass
+
+    def test_writes_trace_when_enabled(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_PROFILE_DIR", str(tmp_path / "prof"))
+        import jax.numpy as jnp
+        with obs.profile_trace("tiny"):
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        files = list((tmp_path / "prof").rglob("*"))
+        assert files, "profiler produced no output"
