@@ -34,6 +34,9 @@ def main() -> None:
     S, N = (1000, 100) if small else (10000, 1000)
     chains = int(os.environ.get("BENCH_CHAINS", "4"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
+    seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
+    block = int(os.environ.get("BENCH_BLOCK", "16"))
+    proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
 
     # Decide the platform BEFORE any jax device use; never hang, never die
     # on a broken tunnel (round-1 failure mode: rc=1 inside device_put).
@@ -49,12 +52,16 @@ def main() -> None:
 
     # warm-up: compile every kernel on the final shapes
     t_warm = time.perf_counter()
-    solve(pt, prob=prob, chains=chains, steps=steps, seed=0)
+    solve(pt, prob=prob, chains=chains, steps=steps, seed=0,
+          seed_batch=seed_batch, anneal_block=block,
+          proposals_per_step=proposals)
     print(f"[bench] warm-up (compile) {time.perf_counter() - t_warm:.1f}s "
           f"on backend={backend}", file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
-    res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1)
+    res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1,
+                seed_batch=seed_batch, anneal_block=block,
+                proposals_per_step=proposals)
     elapsed = time.perf_counter() - t0
 
     pps = S / elapsed
@@ -74,6 +81,10 @@ def main() -> None:
         "moves_repaired": res.moves_repaired,
         "chains": chains,
         "steps": steps,
+        "seed_batch": seed_batch,
+        "sweeps_run": res.steps,
+        "anneal_block": block,
+        "proposals_per_step": proposals,
         "backend": jax.default_backend(),
         "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},
     }))

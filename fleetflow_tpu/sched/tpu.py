@@ -8,6 +8,7 @@ per-reschedule path).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional
 
@@ -26,18 +27,41 @@ class TpuSolverScheduler:
         self.steps = steps
         self.seed = seed
         self.mesh = mesh
-        self._staged = None          # (pt id, DeviceProblem)
+        self._staged = None   # (pt identity, DeviceProblem, valid fingerprint)
         self._last_assignment: Optional[np.ndarray] = None
+
+    def _stage(self, pt: ProblemTensors):
+        """Staged DeviceProblem for pt, reusing the device copy across
+        re-solves. Identity alone is NOT enough: the CP's node_event mutates
+        pt.node_valid in place (churn), so the mask is fingerprinted and
+        pushed as a small device-side delta when it drifts — the round-2 bug
+        where a dead node kept its services because the device still saw the
+        stale mask."""
+        from ..solver import prepare_problem
+        import jax.numpy as jnp
+
+        if self._staged is None or self._staged[0] is not pt:
+            self._staged = (pt, prepare_problem(pt), pt.node_valid.copy())
+        elif not np.array_equal(self._staged[2], pt.node_valid):
+            prob = dataclasses.replace(
+                self._staged[1], node_valid=jnp.asarray(pt.node_valid))
+            self._staged = (pt, prob, pt.node_valid.copy())
+        return self._staged[1]
 
     def place(self, pt: ProblemTensors, *,
               warm_start: bool = False) -> Placement:
+        # First device use on the CP path: bootstrap the platform the same
+        # way bench/__graft_entry__ do (probe the inherited platform
+        # out-of-process, fall back to virtual CPU) — a control plane must
+        # degrade to CPU solves, not die, when the accelerator is absent or
+        # its runtime is broken (round-1 failure mode).
+        from ..platform import ensure_platform
+        ensure_platform(min_devices=1)
         # imported lazily so the host path never pays JAX startup
-        from ..solver import prepare_problem, solve
+        from ..solver import solve
 
         t0 = time.perf_counter()
-        if self._staged is None or self._staged[0] is not pt:
-            self._staged = (pt, prepare_problem(pt))
-        prob = self._staged[1]
+        prob = self._stage(pt)
 
         init = self._last_assignment if warm_start else None
         res = solve(pt, prob=prob, chains=self.chains, steps=self.steps,

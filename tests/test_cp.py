@@ -465,10 +465,11 @@ class TestPlacementChannel:
             await handle.stop()
         run(go())
 
-    def test_node_churn_reschedules(self, project):
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_node_churn_reschedules(self, project, use_tpu):
         async def go():
             flow = _load_flow(project)
-            handle = await start_cp()
+            handle = await start_cp(use_tpu_solver=use_tpu)
             agents = []
             for i in range(2):
                 agents.append(await FakeAgent(f"node-{i}").connect(handle))

@@ -93,3 +93,24 @@ class TestPick:
                           NativeGreedyScheduler)
         assert isinstance(pick_scheduler(100, 4, prefer_tpu=False),
                           HostGreedyScheduler)
+
+
+class TestStagedCacheInvalidation:
+    def test_in_place_node_valid_mutation_is_seen(self):
+        """Regression: the CP's node_event mutates pt.node_valid IN PLACE on
+        the same ProblemTensors object; the staged DeviceProblem must pick up
+        the new mask (round-2 bug: the device kept the stale mask and left
+        services on a dead node while reporting feasible)."""
+        from dataclasses import replace
+        pt = synthetic_problem(40, 8, seed=11)
+        sched = TpuSolverScheduler(chains=2, steps=128)
+        first = sched.place(pt)
+        assert first.feasible
+        victims = np.flatnonzero(np.asarray(first.raw) == 0)
+        assert victims.size, "nothing on node 0; pick another seed"
+        pt.node_valid = pt.node_valid.copy()
+        pt.node_valid[0] = False          # same pt object, mutated in place
+        second = sched.reschedule(pt)
+        assert second.feasible
+        assert not np.any(np.asarray(second.raw) == 0), (
+            "dead node still occupied: staged mask is stale")

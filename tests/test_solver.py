@@ -264,3 +264,52 @@ class TestBatchedGreedy:
         pt = synthetic_problem(50, 5, seed=8)
         with pytest.raises(ValueError, match="seed_impl"):
             solve(pt, chains=2, steps=10, seed=8, seed_impl="ffd")
+
+
+class TestCarriedStateInvariants:
+    """The adaptive exit + chain ranking trust the anneal's incrementally
+    carried ChainState. These tests pin the invariant: after any number of
+    sweeps, the carried load/used/coloc/topo equal a from-scratch rebuild,
+    and state_violation_stats/state_soft_score equal the exact kernels."""
+
+    def test_state_matches_rebuild_and_kernels(self):
+        import jax
+        from fleetflow_tpu.solver.anneal import (
+            anneal_states, chain_states_from_assignment,
+            state_soft_score, state_violation_stats)
+        from fleetflow_tpu.solver.api import make_chain_inits
+        from fleetflow_tpu.solver.kernels import soft_score, violation_stats
+
+        pt = synthetic_problem(120, 12, seed=3, n_tenants=3,
+                               port_fraction=0.3, volume_fraction=0.2)
+        prob = prepare_problem(pt)
+        key = jax.random.PRNGKey(0)
+        inits = make_chain_inits(
+            prob, jnp.zeros((pt.S,), jnp.int32), 3, key)
+        states = anneal_states(prob, inits, key, steps=40)
+
+        for c in range(3):
+            st = jax.tree.map(lambda x: x[c], states)
+            rebuilt = chain_states_from_assignment(prob, st.assignment)
+            for name, a, b in zip(st._fields, st, rebuilt):
+                assert np.allclose(np.asarray(a), np.asarray(b)), (c, name)
+            ks = violation_stats(prob, st.assignment)
+            ss = state_violation_stats(prob, st)
+            for k in ks:
+                assert float(ks[k]) == pytest.approx(float(ss[k])), (c, k)
+            assert float(soft_score(prob, st.assignment)) == pytest.approx(
+                float(state_soft_score(prob, st)), abs=1e-4), c
+
+    def test_adaptive_exits_early_on_easy_instance(self):
+        pt = synthetic_problem(80, 20, seed=4)
+        res = solve(pt, chains=2, steps=128, seed=4)
+        assert res.feasible
+        assert res.steps <= 64, f"expected early exit, ran {res.steps} sweeps"
+
+    def test_adaptive_matches_fixed_on_violations(self):
+        pt = synthetic_problem(200, 20, seed=5, n_tenants=4,
+                               port_fraction=0.3)
+        r_fixed = solve(pt, chains=4, steps=128, seed=5, adaptive=False)
+        r_adapt = solve(pt, chains=4, steps=128, seed=5, adaptive=True)
+        assert r_fixed.feasible == r_adapt.feasible
+        assert r_adapt.violations == 0
