@@ -1,0 +1,124 @@
+"""Multi-host device mesh: the jax.distributed entry point.
+
+SURVEY §2.10: the reference scales its control fan-out over QUIC to many
+agents; the solver's analog of "more machines" is more chips. Single-host
+multi-chip needs nothing special (jax.devices() sees them all); MULTI-host
+(e.g. a v5e-256 pod slice, or several hosts with a few chips each) requires
+every process to call `jax.distributed.initialize` before first device use,
+after which `jax.devices()` is the GLOBAL device list and collectives ride
+ICI/DCN transparently.
+
+Usage (same program on every host):
+
+    from fleetflow_tpu import parallel
+    parallel.init_multihost()                  # env-driven (TPU pods: no args)
+    mesh = parallel.chain_mesh()               # all global devices, 1-D
+    res = solve(pt, mesh=mesh, chains=mesh.size)
+
+On TPU pods `initialize()` auto-discovers coordinator/rank from the TPU
+metadata; elsewhere pass coordinator/process counts explicitly or via the
+FLEET_COORD / FLEET_NUM_PROCS / FLEET_PROC_ID environment variables
+(loopback CPU test: tests/test_multihost.py runs 2 processes on one host).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from .obs import get_logger, kv
+
+__all__ = ["init_multihost", "chain_mesh", "mesh_info", "is_initialized"]
+
+log = get_logger("parallel")
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   local_device_ids: Optional[Sequence[int]] = None) -> bool:
+    """Initialize jax.distributed for multi-host execution. Must run before
+    first device use in every participating process.
+
+    With no arguments: TPU-pod auto-discovery when available, else the
+    FLEET_COORD / FLEET_NUM_PROCS / FLEET_PROC_ID env triple, else a no-op
+    (single-process mode). Returns True when distributed mode is active.
+    Idempotent: a second call is a no-op."""
+    global _initialized
+    if _initialized:
+        return True
+
+    coordinator = coordinator or os.environ.get("FLEET_COORD")
+    if num_processes is None and os.environ.get("FLEET_NUM_PROCS"):
+        num_processes = int(os.environ["FLEET_NUM_PROCS"])
+    if process_id is None and os.environ.get("FLEET_PROC_ID"):
+        process_id = int(os.environ["FLEET_PROC_ID"])
+
+    import jax
+
+    if coordinator is None and num_processes is None:
+        # TPU pod slices self-discover through the TPU runtime; only attempt
+        # when that runtime is present, otherwise stay single-process.
+        if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()
+            _initialized = True
+            log.info("initialized %s", kv(
+                mode="tpu-pod", process=jax.process_index(),
+                processes=jax.process_count(),
+                local_devices=jax.local_device_count(),
+                global_devices=jax.device_count()))
+            return True
+        log.debug("single-process mode (no coordinator configured)")
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    log.info("initialized %s", kv(
+        coordinator=coordinator, process=jax.process_index(),
+        processes=jax.process_count(),
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count()))
+    return True
+
+
+def chain_mesh(n_devices: Optional[int] = None, axis: str = "chains"):
+    """1-D mesh over the GLOBAL device list (all processes' devices after
+    init_multihost; local devices otherwise). The solver shards its chain
+    axis over it (solver/api.py CHAIN_AXIS)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"chain_mesh({n_devices}) but only {len(devices)} global "
+                f"devices exist (did init_multihost run on every process?)")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def mesh_info() -> dict:
+    """Shape of the distributed world, for logs/REST surfaces."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "backend": jax.default_backend(),
+        "distributed": _initialized,
+    }
