@@ -17,6 +17,14 @@ force_cpu(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "docker: tier-2 tests needing a real docker daemon "
+        "(self-skip when absent; CI runs them serialized)")
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-compile tests")
+
+
 @pytest.fixture
 def project(tmp_path):
     """Write a minimal .fleetflow project into tmp_path (the analog of the
