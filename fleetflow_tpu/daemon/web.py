@@ -415,43 +415,199 @@ class WebServer:
 _DASHBOARD_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>fleetflow-tpu</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:2rem;background:#0b1020;color:#e6e8ef}
- h1{font-size:1.3rem} .card{background:#151b31;border-radius:8px;padding:1rem;
- margin:0.5rem 0;max-width:720px} code{color:#8ab4ff} td,th{padding:2px 10px;
- text-align:left} .ok{color:#6fd08c}.bad{color:#ff7a7a}
+ :root{--bg:#0b1020;--card:#151b31;--line:#27304f;--fg:#e6e8ef;--dim:#8b93ad;
+  --acc:#8ab4ff;--ok:#6fd08c;--bad:#ff7a7a;--warn:#ffc66d}
+ body{font-family:system-ui,sans-serif;margin:0;background:var(--bg);color:var(--fg)}
+ header{display:flex;align-items:center;gap:1.2rem;padding:.8rem 1.4rem;
+  border-bottom:1px solid var(--line);position:sticky;top:0;background:var(--bg)}
+ h1{font-size:1.05rem;margin:0} nav{display:flex;gap:.2rem;flex-wrap:wrap}
+ nav a{color:var(--dim);text-decoration:none;padding:.3rem .7rem;border-radius:6px}
+ nav a.active,nav a:hover{color:var(--fg);background:var(--card)}
+ main{padding:1.2rem 1.4rem;max-width:1080px}
+ .card{background:var(--card);border:1px solid var(--line);border-radius:8px;
+  padding:1rem;margin:.6rem 0}
+ .cards{display:grid;grid-template-columns:repeat(auto-fill,minmax(160px,1fr));gap:.6rem}
+ .stat{text-align:center}.stat b{font-size:1.5rem;display:block}
+ .stat span{color:var(--dim);font-size:.8rem}
+ table{border-collapse:collapse;width:100%}
+ td,th{padding:4px 10px;text-align:left;border-bottom:1px solid var(--line)}
+ th{color:var(--dim);font-weight:500;font-size:.8rem;text-transform:uppercase}
+ .ok{color:var(--ok)}.bad{color:var(--bad)}.warn{color:var(--warn)}
+ code,pre{color:var(--acc)} pre{background:#0d1226;padding:.8rem;border-radius:6px;
+  overflow-x:auto;max-height:360px}
+ button{background:#1d2747;color:var(--fg);border:1px solid var(--line);
+  border-radius:6px;padding:.25rem .7rem;cursor:pointer;margin-right:.3rem}
+ button:hover{border-color:var(--acc)}
+ input{background:#0d1226;color:var(--fg);border:1px solid var(--line);
+  border-radius:6px;padding:.3rem .6rem}
+ .crumb{color:var(--dim);font-size:.85rem;margin-bottom:.4rem}
+ .crumb a{color:var(--acc);text-decoration:none}
+ .muted{color:var(--dim)}
 </style></head>
 <body>
-<h1>fleetflow-tpu control plane</h1>
-<div class="card" id="overview">loading…</div>
-<div class="card"><table id="servers"></table></div>
-<div class="card"><table id="deployments"></table></div>
+<header>
+ <h1>fleetflow-tpu</h1>
+ <nav id="nav"></nav>
+ <span style="flex:1"></span>
+ <input id="token" placeholder="API token" size="14" style="display:none">
+</header>
+<main id="main"><div class="card">loading…</div></main>
 <script>
-async function j(u){const r=await fetch(u);return r.json()}
-// stored names are tenant input: escape everything interpolated into HTML
-function esc(v){return String(v).replace(/[&<>"']/g,
+'use strict';
+// -- tiny SPA over the CP REST surface (web.rs:47-116 SPA analog) ---------
+const VIEWS=['overview','servers','stages','deployments','alerts',
+             'placement','agents','dns','volumes','builds'];
+function esc(v){return String(v??'').replace(/[&<>"']/g,
  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
-async function refresh(){
+function token(){return localStorage.getItem('fleet_token')||''}
+async function api(path,opts){
+ const h={'Content-Type':'application/json'};
+ if(token())h['Authorization']='Bearer '+token();
+ const r=await fetch(path,Object.assign({headers:h},opts||{}));
+ if(r.status===401){authRequired();throw new Error('unauthorized')}
+ if(!r.ok)throw new Error((await r.json()).error||r.status);
+ return r.json()}
+const post=(p,b)=>api(p,{method:'POST',body:JSON.stringify(b||{})});
+function authRequired(){const t=document.getElementById('token');
+ t.style.display='inline-block';
+ t.onchange=()=>{localStorage.setItem('fleet_token',t.value);route()}}
+function statusCls(s){return {online:'ok',succeeded:'ok',running:'ok',
+ schedulable:'ok',failed:'bad',offline:'bad',error:'bad',draining:'warn',
+ cordoned:'warn',pending:'warn'}[s]||''}
+function badge(s){return `<span class="${statusCls(s)}">${esc(s)}</span>`}
+function table(heads,rows){return '<table><tr>'+heads.map(h=>`<th>${esc(h)}</th>`)
+ .join('')+'</tr>'+rows.map(r=>'<tr>'+r.map(c=>`<td>${c}</td>`).join('')+'</tr>')
+ .join('')+'</table>'}
+const main=()=>document.getElementById('main');
+function card(html){return `<div class="card">${html}</div>`}
+
+// -- views ----------------------------------------------------------------
+const views={
+ async overview(){
+  const o=await api('/api/overview');
+  main().innerHTML=`<div class="cards">
+   <div class="card stat"><b>${esc(o.online)}/${esc(o.servers)}</b><span>servers online</span></div>
+   <div class="card stat"><b>${esc(o.agents.length)}</b><span>agents connected</span></div>
+   <div class="card stat"><b>${esc(o.projects)}</b><span>projects</span></div>
+   <div class="card stat"><b>${esc(o.stages)}</b><span>stages</span></div>
+   <div class="card stat"><b>${esc(o.deployments)}</b><span>deployments</span></div>
+   <div class="card stat"><b class="${o.active_alerts?'bad':'ok'}">${esc(o.active_alerts)}</b><span>active alerts</span></div>
+  </div>`},
+ async servers(){
+  const s=await api('/api/servers');
+  main().innerHTML=card(table(
+   ['server','status','scheduling','cpu','memory','disk','actions'],
+   s.servers.map(x=>[
+    `<code>${esc(x.slug)}</code>`,badge(x.status),badge(x.scheduling_state),
+    `${esc(x.allocated.cpu.toFixed(1))}/${esc(x.capacity.cpu)}`,
+    `${esc(x.allocated.memory.toFixed(0))}/${esc(x.capacity.memory)}`,
+    `${esc(x.allocated.disk.toFixed(0))}/${esc(x.capacity.disk)}`,
+    ['cordon','uncordon','drain'].map(a=>
+     `<button data-act="${a}" data-slug="${esc(x.slug)}">${a}</button>`)
+     .join('')])))},
+ async stages(){
+  const s=await api('/api/stages');
+  main().innerHTML=card(table(['stage','project','adopted','servers',''],
+   s.stages.map(x=>[`<code>${esc(x.name)}</code>`,esc(x.project),
+    x.adopted?'<span class="ok">yes</span>':'<span class="muted">no</span>',
+    esc((x.servers||[]).join(', ')),
+    `<a href="#stage/${esc(x.id)}">detail →</a>`])))},
+ async stage(sid){
+  const st=await api('/api/stages/'+encodeURIComponent(sid)+'/status');
+  const d=st.last_deployment;
+  main().innerHTML=
+   `<div class="crumb"><a href="#stages">stages</a> / ${esc(st.stage.name)}</div>`+
+   card(`<b>${esc(st.stage.name)}</b> · project ${esc(st.stage.project)} · `+
+    (st.stage.adopted?'<span class="ok">adopted</span>':
+     `<button data-adopt data-sid="${esc(sid)}">adopt</button>`))+
+   card('<h3>services</h3>'+table(['service','image','status','actions'],
+    st.services.map(x=>[`<code>${esc(x.name)}</code>`,esc(x.image),
+     badge(x.status||'unknown'),
+     `<button data-restart data-sid="${esc(sid)}" data-svc="${esc(x.name)}">restart</button>`])))+
+   card('<h3>last deployment</h3>'+(d?table(['id','status','services','error'],
+    [[`<a href="#deployment/${esc(d.id)}">${esc(d.id)}</a>`,badge(d.status),
+      esc((d.services||[]).join(', ')),esc(d.error||'—')]]):
+    '<span class="muted">none</span>'))+
+   card('<h3>alerts</h3>'+(st.alerts.length?table(['server','kind','message'],
+    st.alerts.map(a=>[esc(a.server),esc(a.kind),esc(a.message)])):
+    '<span class="ok">none</span>'))},
+ async deployments(){
+  const d=await api('/api/deployments?limit=50');
+  main().innerHTML=card(table(['deployment','stage','status','services',''],
+   d.deployments.map(x=>[`<code>${esc(x.id)}</code>`,esc(x.stage),
+    badge(x.status),esc((x.services||[]).join(', ')),
+    `<a href="#deployment/${esc(x.id)}">log →</a>`])))},
+ async deployment(did){
+  const d=await api('/api/deployments/'+encodeURIComponent(did)+'/log');
+  main().innerHTML=
+   `<div class="crumb"><a href="#deployments">deployments</a> / ${esc(did)}</div>`+
+   card(`status ${badge(d.status)}`+(d.error?` · <span class="bad">${esc(d.error)}</span>`:''))+
+   card('<pre>'+esc(Array.isArray(d.log)?d.log.join('\\n'):(d.log||'(empty)'))+'</pre>')},
+ async alerts(){
+  const a=await api('/api/alerts');
+  main().innerHTML=card(a.alerts.length?table(
+   ['server','kind','message','since'],
+   a.alerts.map(x=>[esc(x.server),esc(x.kind),esc(x.message),
+    esc(new Date(x.created_at*1000).toLocaleString())])):
+   '<span class="ok">no active alerts</span>')},
+ async placement(){
+  const p=await api('/api/placement');
+  const entries=Object.entries(p.stages);
+  main().innerHTML=entries.length?entries.map(([k,v])=>
+   card(`<b>${esc(k)}</b> · ${badge(v.feasible?'feasible':'infeasible')} · `+
+    `${esc(v.source)} · ${esc(v.solve_ms)}ms · violations ${esc(v.violations)}`+
+    table(['service','node'],Object.entries(v.assignment).map(
+     ([s,n])=>[`<code>${esc(s)}</code>`,`<code>${esc(n)}</code>`])))).join(''):
+   card('<span class="muted">no placements solved yet</span>')},
+ async agents(){
+  const a=await api('/api/agents');
+  main().innerHTML=card(a.agents.length?table(['agent'],
+   a.agents.map(x=>[`<code>${esc(x)}</code>`])):
+   '<span class="muted">no agents connected</span>')},
+ async dns(){
+  const d=await api('/api/dns');
+  main().innerHTML=card(table(['zone','name','type','content','ttl','proxied'],
+   d.records.map(x=>[esc(x.zone),`<code>${esc(x.name)}</code>`,esc(x.type),
+    esc(x.content),esc(x.ttl),x.proxied?'yes':'no'])))},
+ async volumes(){
+  const v=await api('/api/volumes');
+  main().innerHTML=card(table(['server','volume','adopted'],
+   v.volumes.map(x=>[esc(x.server),`<code>${esc(x.name)}</code>`,
+    x.adopted?'<span class="ok">yes</span>':'no'])))},
+ async builds(){
+  const b=await api('/api/builds');
+  main().innerHTML=card(table(['job','repo','image','status'],
+   b.jobs.map(x=>[`<code>${esc(x.id)}</code>`,esc(x.repo),
+    esc(x.image_tag),badge(x.status)])))},
+};
+
+// -- actions --------------------------------------------------------------
+// Delegated clicks on data-attributes: tenant-controlled names never appear
+// inside inline JS string literals (esc() covers the HTML context only —
+// the attribute parser would decode &#39; back into a quote inside onclick).
+const enc=encodeURIComponent;
+document.addEventListener('click',async ev=>{
+ const b=ev.target.closest('button');if(!b)return;
  try{
-  const o=await j('/api/overview');
-  document.getElementById('overview').innerHTML=
-   `<b>${esc(o.online)}/${esc(o.servers)}</b> servers online · `+
-   `${esc(o.agents.length)} agents · ${esc(o.projects)} projects · `+
-   `${esc(o.deployments)} deployments · `+
-   `<span class="${o.active_alerts? 'bad':'ok'}">${esc(o.active_alerts)} alerts</span>`;
-  const s=await j('/api/servers');
-  document.getElementById('servers').innerHTML=
-   '<tr><th>server</th><th>status</th><th>sched</th><th>cpu</th><th>mem</th></tr>'+
-   s.servers.map(x=>`<tr><td>${esc(x.slug)}</td><td class="${x.status==='online'?'ok':'bad'}">`+
-    `${esc(x.status)}</td><td>${esc(x.scheduling_state)}</td>`+
-    `<td>${esc(x.allocated.cpu.toFixed(1))}/${esc(x.capacity.cpu)}</td>`+
-    `<td>${esc(x.allocated.memory.toFixed(0))}/${esc(x.capacity.memory)}</td></tr>`).join('');
-  const d=await j('/api/deployments?limit=10');
-  document.getElementById('deployments').innerHTML=
-   '<tr><th>deployment</th><th>status</th><th>services</th></tr>'+
-   d.deployments.map(x=>`<tr><td>${esc(x.id)}</td><td class="${x.status==='succeeded'?'ok':'bad'}">`+
-    `${esc(x.status)}</td><td>${esc((x.services||[]).join(', '))}</td></tr>`).join('');
- }catch(e){document.getElementById('overview').textContent='auth required or CP down';}
+  if(b.dataset.act!==undefined&&b.dataset.slug!==undefined){
+   await post(`/api/servers/${enc(b.dataset.slug)}/${enc(b.dataset.act)}`);route()}
+  else if(b.dataset.adopt!==undefined){
+   await post(`/api/stages/${enc(b.dataset.sid)}/adopt`);route()}
+  else if(b.dataset.restart!==undefined){
+   const r=await post(`/api/stages/${enc(b.dataset.sid)}/services/${enc(b.dataset.svc)}/restart`);
+   alert('restarted: '+JSON.stringify(r.restarted))}
+ }catch(e){alert('action failed: '+e.message)}});
+
+// -- router ---------------------------------------------------------------
+function nav(){document.getElementById('nav').innerHTML=VIEWS.map(v=>
+ `<a href="#${v}" class="${location.hash.slice(1).split('/')[0]===v?'active':''}">${v}</a>`).join('')}
+async function route(){
+ const [view,arg]=(location.hash.slice(1)||'overview').split('/');
+ nav();
+ try{await (views[view]||views.overview)(arg)}
+ catch(e){main().innerHTML=card(`<span class="bad">${esc(e.message)}</span>`)}
 }
-refresh();setInterval(refresh,5000);
+window.addEventListener('hashchange',route);
+route();setInterval(()=>{if(!location.hash.includes('/'))route()},5000);
 </script></body></html>
 """

@@ -199,3 +199,88 @@ class TestHealthChecker:
             assert db.server_by_slug("n1").status == "online"
             await handle.stop()
         run(go())
+
+
+async def http_get_raw(host, port, path):
+    def fetch():
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    return await asyncio.get_running_loop().run_in_executor(None, fetch)
+
+
+class TestDashboard:
+    """The embedded SPA (web.rs:2796-LoC dashboard analog): every view the
+    nav exposes must exist in the served HTML, and every API route the SPA
+    fetches must answer with live CP state."""
+
+    def test_dashboard_html_has_all_views_and_actions(self):
+        async def go():
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory)
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            st, html = await http_get_raw(host, port, "/")
+            assert st == 200
+            for view in ("overview", "servers", "stages", "deployments",
+                         "alerts", "placement", "agents", "dns", "volumes",
+                         "builds"):
+                assert f"async {view}(" in html, f"view {view} missing"
+            # per-stage detail view + actions (VERDICT round 1 item 10)
+            assert "async stage(" in html and "async deployment(" in html
+            for action in ("data-restart", "data-adopt", "data-act",
+                           "'cordon'", "'drain'"):
+                assert action in html, f"action {action} missing"
+            # interpolation is escaped (stored names are tenant input), and
+            # no tenant-controlled string is interpolated into inline JS
+            assert "function esc(" in html
+            assert "onclick=" not in html
+            # bearer token wiring for auth_kind=token CPs
+            assert "Authorization" in html
+            await web.stop()
+            await handle.stop()
+        run(go())
+
+    def test_spa_api_routes_serve_live_state(self):
+        async def go():
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory)
+            db = handle.state.store
+            web = WebServer(handle.state)
+            host, port = await web.start()
+
+            db.register_server("n1")
+            from fleetflow_tpu.cp.models import (Alert, BuildJob, Project,
+                                                 StageRecord, VolumeRecord)
+            db.create("projects", Project(tenant="default", name="web"))
+            stage = db.create("stages", StageRecord(project="web",
+                                                    name="live",
+                                                    servers=["n1"]))
+            db.create("alerts", Alert(server="n1", kind="unhealthy",
+                                      message="container flapping"))
+            db.create("volumes", VolumeRecord(tenant="default", server="n1",
+                                              name="pgdata"))
+            db.create("build_jobs", BuildJob(repo="git@x:app", image_tag="app:1",
+                                             status="running"))
+
+            st, body = await http_get(host, port, "/api/alerts")
+            assert st == 200 and len(body["alerts"]) == 1
+            st, body = await http_get(host, port, "/api/volumes")
+            assert body["volumes"][0]["name"] == "pgdata"
+            st, body = await http_get(host, port, "/api/builds")
+            assert body["jobs"][0]["image_tag"] == "app:1"
+            st, body = await http_get(host, port, "/api/agents")
+            assert body["agents"] == []
+            st, body = await http_get(host, port, "/api/placement")
+            assert body["stages"] == {}
+            st, body = await http_get(host, port,
+                                      f"/api/stages/{stage.id}/status")
+            assert st == 200 and body["stage"]["name"] == "live"
+            assert len(body["alerts"]) == 1
+            # restart with no connected agent -> clean 400, not a crash
+            st, body = await http_post(
+                host, port, f"/api/stages/{stage.id}/services/app/restart")
+            assert st == 400
+            await web.stop()
+            await handle.stop()
+        run(go())
