@@ -261,10 +261,16 @@ class Agent:
     def _run_build(self, payload: dict) -> dict:
         """Build-worker path (agent.rs:476-649): git clone -> docker build
         -> optional push."""
+        import os
         import tempfile
         repo, ref = payload["repo"], payload.get("ref", "main")
         tag = payload["image_tag"]
-        with tempfile.TemporaryDirectory(prefix="ffbuild-") as tmp:
+        # build workspaces live under deploy_base (agent.rs deploy_base
+        # flag): big clone/build contexts land on the disk the operator
+        # chose, not the root tmpfs
+        base = os.path.expanduser(self.config.deploy_base)
+        os.makedirs(base, exist_ok=True)
+        with tempfile.TemporaryDirectory(prefix="ffbuild-", dir=base) as tmp:
             clone = subprocess.run(
                 ["git", "clone", "--depth", "1", "--branch", ref, repo, tmp],
                 capture_output=True, text=True)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
 
@@ -488,6 +489,41 @@ def cmd_mcp(args) -> int:
     return 0
 
 
+def cmd_agent(args) -> int:
+    """Run the node agent in the foreground (the reference ships this as
+    the separate `fleet-agent` binary, fleet-agent/src/main.rs:40)."""
+    import asyncio
+
+    from ..agent import Agent, AgentConfig
+
+    ca_pem = None
+    if args.ca:
+        with open(args.ca, "rb") as f:
+            ca_pem = f.read()
+    import socket
+    slug = args.slug or socket.gethostname().split(".")[0]
+    cfg = AgentConfig(
+        cp_host=args.cp_host, cp_port=args.cp_port, slug=slug,
+        token=args.token, ca_pem=ca_pem,
+        heartbeat_interval_s=args.heartbeat_interval,
+        monitor_interval_s=args.monitor_interval,
+        restart_threshold=args.restart_threshold,
+        deploy_base=args.deploy_base,
+        capacity={"cpu": args.cpu, "memory": args.memory, "disk": args.disk},
+    )
+    # same backend selection as `fleet up` (_backend): FLEET_BACKEND=mock
+    # honored, and a dead docker daemon fails fast instead of registering a
+    # node that cannot execute anything
+    agent = Agent(cfg, backend=_backend(args))
+    print(f"fleet-agent {cfg.slug} -> {cfg.cp_host}:{cfg.cp_port} "
+          f"(Ctrl+C to stop)")
+    try:
+        asyncio.run(agent.run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 # --------------------------------------------------------------------------
 # Admin: fleet cp ...
 # --------------------------------------------------------------------------
@@ -765,6 +801,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", action="store_true", help="force host greedy")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_solve)
+
+    p = sub.add_parser("agent", help="run the node agent (foreground)")
+    p.add_argument("--cp-host", default="127.0.0.1")
+    p.add_argument("--cp-port", type=int, default=4510)
+    p.add_argument("--slug", default=None,
+                   help="node slug (default: hostname)")
+    p.add_argument("--token", help="CP auth token")
+    p.add_argument("--ca", help="path to the mesh-CA public cert (TLS)")
+    p.add_argument("--cpu", type=float, default=2.0)
+    p.add_argument("--memory", type=float, default=4096.0)
+    p.add_argument("--disk", type=float, default=40960.0)
+    p.add_argument("--heartbeat-interval", type=float, default=30.0)
+    p.add_argument("--monitor-interval", type=float, default=30.0)
+    p.add_argument("--restart-threshold", type=int, default=3)
+    p.add_argument("--deploy-base", default="~/.fleetflow/deploys")
+    p.set_defaults(fn=cmd_agent)
 
     p = sub.add_parser("init", help="write a starter fleet.kdl")
     p.add_argument("--name")

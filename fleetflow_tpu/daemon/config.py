@@ -79,12 +79,13 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
         elif n == "log-file":
             cfg.log_file = str(v)
         elif n == "listen":
-            cfg.listen_host = str(node.prop("host", cfg.listen_host))
-            cfg.listen_port = int(node.prop("port", cfg.listen_port))
+            # `listen "0.0.0.0" 4510` or `listen host="0.0.0.0" port=4510`
+            cfg.listen_host = str(node.prop("host", node.arg(0, cfg.listen_host)))
+            cfg.listen_port = int(node.prop("port", node.arg(1, cfg.listen_port)))
         elif n == "web":
             cfg.web_enabled = bool(node.prop("enabled", True))
-            cfg.web_host = str(node.prop("host", cfg.web_host))
-            cfg.web_port = int(node.prop("port", cfg.web_port))
+            cfg.web_host = str(node.prop("host", node.arg(0, cfg.web_host)))
+            cfg.web_port = int(node.prop("port", node.arg(1, cfg.web_port)))
         elif n == "db":
             cfg.db_path = str(v) if v not in (None, "memory") else None
         elif n == "auth":

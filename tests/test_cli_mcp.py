@@ -214,3 +214,12 @@ class TestMcp:
                               "params": {"name": "cp_servers"}})
         assert json.loads(resp["result"]["content"][0]["text"]) == [
             {"slug": "n1"}]
+
+
+class TestAgentCommand:
+    def test_agent_parser_defaults(self):
+        from fleetflow_tpu.cli.main import build_parser
+        args = build_parser().parse_args(["agent", "--slug", "n1",
+                                          "--cp-port", "4517"])
+        assert args.slug == "n1" and args.cp_port == 4517
+        assert args.cpu == 2.0 and args.fn.__name__ == "cmd_agent"

@@ -88,6 +88,21 @@ tpu-solver #true
             load_daemon_config(str(tmp_path / "nope.kdl"))
 
 
+class TestConfigPositional:
+    def test_listen_and_web_positional_args(self, tmp_path, monkeypatch):
+        p = tmp_path / "fleetflowd.kdl"
+        p.write_text('listen "0.0.0.0" 4517\nweb "127.0.0.1" 9090\n')
+        cfg = load_daemon_config(str(p))
+        assert (cfg.listen_host, cfg.listen_port) == ("0.0.0.0", 4517)
+        assert (cfg.web_host, cfg.web_port) == ("127.0.0.1", 9090)
+
+    def test_listen_props_still_work(self, tmp_path):
+        p = tmp_path / "fleetflowd.kdl"
+        p.write_text('listen host="10.0.0.1" port=4444\n')
+        cfg = load_daemon_config(str(p))
+        assert (cfg.listen_host, cfg.listen_port) == ("10.0.0.1", 4444)
+
+
 class TestPidFile:
     def test_lifecycle(self, tmp_path):
         pf = PidFile(str(tmp_path / "d.pid"))
