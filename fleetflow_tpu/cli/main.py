@@ -558,6 +558,11 @@ def cmd_cp(args) -> int:
             argv += ["-c", args.config]
         return daemon_main(argv)
 
+    # registry verbs that only read the local fleet-registry.kdl must not
+    # demand a live CP (status/sync do)
+    if sub == "registry" and args.verb in ("list", "solve", "deploy"):
+        return _cmd_cp_registry(None, args)
+
     # everything else talks to the CP
     with CpClient(args.cp) as cp:
         return _cp_dispatch(cp, args)
@@ -618,6 +623,17 @@ def _cp_dispatch(cp: CpClient, args) -> int:
         if verb == "delete":
             return show(cp.request("server", "delete",
                                    {"slug": _need(args.name, "server slug")}))
+        if verb == "provision":
+            return show(cp.request("server", "provision", {
+                "slug": _need(args.name, "server slug"),
+                "provider": _need(getattr(args, "provider", None),
+                                  "--provider"),
+                "tenant": args.tenant or "default",
+            }, timeout=600))
+        if verb == "deprovision":
+            return show(cp.request("server", "deprovision",
+                                   {"slug": _need(args.name, "server slug")},
+                                   timeout=600))
     if sub == "agents":
         return show(cp.request("health", "overview")["agents"])
     if sub == "alerts":
@@ -708,6 +724,24 @@ def _cmd_cp_registry(cp: CpClient, args) -> int:
         print(f"aggregate: {pt.S} services x {pt.N} nodes "
               f"feasible={placement.feasible} via {placement.source}")
         return 0 if placement.feasible else 1
+    if args.verb == "sync":
+        from ..registry import sync_servers_payloads
+        for payload in sync_servers_payloads(reg):
+            out = cp.request("server", "register", payload)
+            print(f"  synced {payload['slug']}")
+        return 0
+    if args.verb == "deploy":
+        from ..registry import deploy_routes
+        results = deploy_routes(reg, fleet=args.name,
+                                stage=getattr(args, "stage", None),
+                                dry_run=args.dry_run, on_line=print)
+        bad = [r for r in results if not r.ok]
+        for r in bad:
+            print(f"  FAILED {r.route.fleet}/{r.route.stage}: {r.error}",
+                  file=sys.stderr)
+        if not results:
+            print("no matching routes", file=sys.stderr)
+        return 0 if results and not bad else 1
     print(f"unknown registry verb {args.verb!r}", file=sys.stderr)
     return 2
 
@@ -856,13 +890,16 @@ def build_parser() -> argparse.ArgumentParser:
         ("tenant", ["list", "create", "delete", "users"]),
         ("project", ["list", "create"]),
         ("server", ["list", "register", "cordon", "uncordon", "drain",
-                    "delete"]),
+                    "delete", "provision", "deprovision"]),
         ("stage", ["status", "adopt"]),
     ]:
         q = cps.add_parser(group)
         q.add_argument("verb", choices=verbs)
         q.add_argument("name", nargs="?")
         q.add_argument("--tenant")
+        if group == "server":
+            q.add_argument("--provider",
+                           help="cloud provider for provision (sakura|aws)")
 
     q = cps.add_parser("cost")
     q.add_argument("verb", choices=["summary", "add"])
@@ -892,7 +929,11 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("name", nargs="?")
 
     q = cps.add_parser("registry")
-    q.add_argument("verb", choices=["list", "status", "solve"])
+    q.add_argument("verb", choices=["list", "status", "solve", "sync",
+                                    "deploy"])
+    q.add_argument("name", nargs="?", help="fleet filter for deploy")
+    q.add_argument("--stage", help="stage filter for deploy")
+    q.add_argument("--dry-run", action="store_true")
 
     p.set_defaults(fn=cmd_cp)
     return ap

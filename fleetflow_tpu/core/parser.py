@@ -433,7 +433,7 @@ def parse_server(node: KdlNode) -> ServerResource:
             s.os = c.first_string()
         elif n in ("ssh-key", "ssh-keys"):
             s.ssh_keys.extend(_str_args(c))
-        elif n == "ssh-host":
+        elif n in ("ssh-host", "host"):
             s.ssh_host = c.first_string()
         elif n == "ssh-user":
             s.ssh_user = c.first_string()

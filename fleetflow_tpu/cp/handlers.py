@@ -20,8 +20,8 @@ from .agent_registry import BUILD_TIMEOUT, DEPLOY_TIMEOUT
 from .log_router import LogEntry, topic_for
 from .models import (Alert, BuildJob, BuildStatus, CostEntry, Deployment,
                      DeploymentStatus, DnsRecord, ObservedContainer, Project,
-                     Server, StageRecord, Tenant, TenantUser, VolumeRecord,
-                     VolumeSnapshot, WorkerPool, now_ts)
+                     Server, ServerCapacity, StageRecord, Tenant, TenantUser,
+                     VolumeRecord, VolumeSnapshot, WorkerPool, now_ts)
 from .protocol import Connection, ProtocolServer
 
 if TYPE_CHECKING:
@@ -214,7 +214,12 @@ def _server(state: "AppState"):
                 cap = type(rec.capacity)(**p["capacity"])
                 db.update("servers", rec.id, capacity=cap)
             if "labels" in p:
-                lbl = type(rec.labels)(**p["labels"])
+                # wire payloads say "class" (the to_dict form); the record
+                # field is clazz (keyword-safe)
+                raw = dict(p["labels"])
+                if "class" in raw:
+                    raw["clazz"] = raw.pop("class")
+                lbl = type(rec.labels)(**raw)
                 db.update("servers", rec.id, labels=lbl)
             return {"server": db.get("servers", rec.id).to_dict()}
         if method == "list":
@@ -244,6 +249,70 @@ def _server(state: "AppState"):
                         for s in db.list("servers")}
             n = db.bulk_server_status(statuses)
             return {"updated": n, "statuses": statuses}
+        if method == "provision":
+            # server.rs provision: create the machine through the cloud
+            # ServerProvider, then register it (status provisioning until
+            # its agent connects). CLI shellouts run off-loop.
+            slug, provider_name = _require(p, "slug", "provider")
+            if db.server_by_slug(slug) is not None:
+                raise ValueError(f"server {slug!r} already exists")
+            from ..core.model import ResourceSpec, ServerResource
+            cap = p.get("capacity", {})
+            spec = ServerResource(
+                name=slug,
+                capacity=ResourceSpec(cpu=float(cap.get("cpu", 2)),
+                                      memory=float(cap.get("memory", 4096)),
+                                      disk=float(cap.get("disk", 40960))),
+                plan=p.get("plan"))
+            sp = state.server_provider_factory(
+                provider_name, **p.get("provider_args", {}))
+            # the record is created BEFORE the (slow, off-loop) cloud call:
+            # it reserves the slug so a concurrent provision of the same
+            # slug fails the exists-check above instead of double-creating
+            # a billed instance; rolled back if the provider call fails
+            rec = db.create("servers", Server(
+                tenant=p.get("tenant", "default"), slug=slug,
+                provider=provider_name, status="provisioning",
+                capacity=ServerCapacity(cpu=spec.capacity.cpu,
+                                        memory=spec.capacity.memory,
+                                        disk=spec.capacity.disk)))
+            loop = asyncio.get_running_loop()
+            try:
+                info = await loop.run_in_executor(
+                    None, lambda: sp.create_server(spec))
+            except Exception:
+                db.delete("servers", rec.id)
+                raise
+            db.update("servers", rec.id, hostname=info.ip or "")
+            return {"server": db.get("servers", rec.id).to_dict(),
+                    "instance": {"id": info.id, "status": info.status,
+                                 "ip": info.ip}}
+        if method == "deprovision":
+            (slug,) = _require(p, "slug")
+            s = db.server_by_slug(slug)
+            if s is None:
+                return {"ok": False, "error": f"no server {slug}"}
+            loop = asyncio.get_running_loop()
+            if s.provider:
+                sp = state.server_provider_factory(
+                    s.provider, **p.get("provider_args", {}))
+                infos = await loop.run_in_executor(None, sp.list_servers)
+                match = next((i for i in infos if i.name == slug), None)
+                if match is not None:
+                    deleted = await loop.run_in_executor(
+                        None, lambda: sp.delete_server(match.id))
+                    if not deleted:
+                        # keep the record: the cloud instance is still
+                        # running (and billing); the operator can retry
+                        return {"ok": False,
+                                "error": f"provider failed to delete "
+                                         f"{match.id}; server record kept"}
+            db.delete("servers", s.id)
+            # warm re-solve of affected stages runs off-loop (the JAX solve
+            # would otherwise block every heartbeat/RPC for its duration)
+            await loop.run_in_executor(
+                None, lambda: state.placement.node_event(slug, online=False))
+            return {"ok": True}
         if method == "pool.create":
             (name,) = _require(p, "name")
             pool = db.create("worker_pools", WorkerPool(

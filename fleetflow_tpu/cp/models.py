@@ -199,8 +199,19 @@ class Server(Record):
     desired_state: str = DesiredState.ACTIVE.value
     pool: Optional[str] = None
 
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        # wire parity with the reference model.rs ("class", a Rust keyword
+        # there and a Python keyword here — stored as clazz on both sides)
+        lbl = d.get("labels") or {}
+        if "clazz" in lbl:
+            lbl["class"] = lbl.pop("clazz")
+        return d
+
     def _coerce(self) -> None:
         if isinstance(self.labels, dict):
+            if "class" in self.labels:
+                self.labels["clazz"] = self.labels.pop("class")
             self.labels = ServerLabelsRec(**self.labels)
         if isinstance(self.capacity, dict):
             self.capacity = ServerCapacity(**self.capacity)

@@ -52,6 +52,9 @@ class AppState:
     secret_box: Optional[object] = None
     dns_backend: Optional[object] = None
     backend_factory: Callable = None       # () -> ContainerBackend
+    # name -> cloud ServerProvider (server.rs provision path; injectable
+    # for tests, shells out to usacloud/aws otherwise)
+    server_provider_factory: Callable = None
     deploy_sleep: Callable[[float], None] = time.sleep
     started_at: float = field(default_factory=time.time)
     bg_tasks: set = field(default_factory=set)
@@ -75,6 +78,18 @@ class CpServerHandle:
         self.state.store.flush()
 
 
+def _default_server_provider_factory(name: str, **kw):
+    """Resolve a cloud ServerProvider by name (server_provider.rs enum
+    dispatch). Shells out to the provider CLI; raises on unknown names."""
+    if name == "sakura":
+        from ..cloud.sakura import SakuraServerProvider
+        return SakuraServerProvider(**kw)
+    if name == "aws":
+        from ..cloud.aws import AwsServerProvider
+        return AwsServerProvider(**kw)
+    raise ValueError(f"unknown server provider {name!r}")
+
+
 def _default_backend_factory():
     """CP-local deploys (handlers/deploy.rs:470-507) use the local docker
     daemon when reachable, the in-memory mock otherwise (tests/dev)."""
@@ -88,6 +103,7 @@ def _default_backend_factory():
 
 async def start(config: ServerConfig, *,
                 backend_factory: Optional[Callable] = None,
+                server_provider_factory: Optional[Callable] = None,
                 deploy_sleep: Callable[[float], None] = time.sleep,
                 ) -> CpServerHandle:
     """server.rs start:82-126."""
@@ -108,6 +124,8 @@ async def start(config: ServerConfig, *,
         name=config.name,
         secret_box=secret_box,
         backend_factory=backend_factory or _default_backend_factory,
+        server_provider_factory=(server_provider_factory
+                                 or _default_server_provider_factory),
         deploy_sleep=deploy_sleep,
     )
 

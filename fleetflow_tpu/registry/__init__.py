@@ -11,7 +11,9 @@ from .model import DeploymentRoute, FleetEntry, Registry
 from .parser import parse_registry_file, parse_registry_string
 from .discovery import find_registry
 from .aggregate import aggregate_fleets
+from .deploy import RouteResult, deploy_routes, sync_servers_payloads
 
 __all__ = ["Registry", "FleetEntry", "DeploymentRoute",
            "parse_registry_file", "parse_registry_string", "find_registry",
-           "aggregate_fleets"]
+           "aggregate_fleets", "RouteResult", "deploy_routes",
+           "sync_servers_payloads"]

@@ -166,6 +166,7 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
            migration_weight: float = 0.5,
            seed_impl: Optional[str] = None,
            seed_batch: int = 256,
+           seed_rounds: int = 2,
            adaptive: bool = True,
            anneal_block: int = 16,
            proposals_per_step: Optional[int] = None) -> SolveResult:
@@ -211,7 +212,8 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
             seed_assignment = greedy_place(prob, order)
         else:
             seed_assignment = greedy_place_batched(prob, order,
-                                                   batch=seed_batch)
+                                                   batch=seed_batch,
+                                                   rounds=seed_rounds)
         # no block here: the refine dispatch queues behind the seed on-device,
         # so seed_ms is dispatch time only and the device runs back-to-back
     timings["seed_ms"] = (t() - t_seed) * 1e3

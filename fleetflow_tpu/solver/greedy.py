@@ -186,16 +186,22 @@ def _commit(prob: DeviceProblem, load, used, assignment, svc, choice, mask):
     return load, used, assignment
 
 
-@partial(jax.jit, static_argnames=("batch",))
+@partial(jax.jit, static_argnames=("batch", "rounds"))
 def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
-                         batch: int = 256) -> jax.Array:
+                         batch: int = 256, rounds: int = 2) -> jax.Array:
     """Place services in `order`, `batch` at a time; returns (S,) int32.
 
     Semantics match greedy_place's FFD-with-fallback except that services in
     one batch cannot see each other's *soft* influence (they do see each
     other's capacity/conflict footprint through the pairwise resolution).
     Sequential depth is ceil(S/batch) scan steps instead of S.
+
+    `rounds=1` skips the loser-retry round: collision losers tail-commit
+    immediately, leaving more seed violations for the annealer's targeted
+    proposals to fix — cheaper per step, worth it when an annealer follows.
     """
+    if rounds not in (1, 2):
+        raise ValueError(f"rounds must be 1 or 2, got {rounds}")
     S, N = prob.S, prob.N
     M = min(batch, S)
     n_batches = -(-S // M)
@@ -261,17 +267,20 @@ def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
         c1, _, ok1 = choose(load, used, live0)
         load, used, assignment = _commit(prob, load, used, assignment,
                                          svc, c1, ok1)
-        # round 2: losers re-propose against the updated state
         rest = live0 & ~ok1
-        c2, has2, ok2 = choose(load, used, rest)
-        load, used, assignment = _commit(prob, load, used, assignment,
-                                         svc, c2, ok2)
+        if rounds > 1:
+            # round 2: losers re-propose against the updated state
+            c2, _, ok2 = choose(load, used, rest)
+            load, used, assignment = _commit(prob, load, used, assignment,
+                                             svc, c2, ok2)
+            rest, c_tail = rest & ~ok2, c2
+        else:
+            c_tail = c1
         # best-effort tail: anything still unplaced (no feasible node at all,
-        # or twice collision-rejected) commits at its round-2 choice; the
-        # annealer repairs (FallbackPolicy relax-order in spirit)
-        tail = rest & ~ok2
+        # or collision-rejected in every round) commits at its last choice;
+        # the annealer repairs (FallbackPolicy relax-order in spirit)
         load, used, assignment = _commit(prob, load, used, assignment,
-                                         svc, c2, tail)
+                                         svc, c_tail, rest)
         return (load, used, assignment), None
 
     R = prob.demand.shape[1]

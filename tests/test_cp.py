@@ -555,3 +555,87 @@ class TestLogRouter:
             assert entry.line == "bad"
             router.unsubscribe(sid)
         run(go())
+
+
+class TestProvision:
+    """server.provision/deprovision through an injected fake ServerProvider
+    (reference server.rs provision path via ServerProviderKind)."""
+
+    def _fake_factory(self, created, deleted):
+        from fleetflow_tpu.cloud.provider import ServerInfo, ServerProvider
+
+        class FakeProvider(ServerProvider):
+            name = "fake"
+
+            def list_servers(self):
+                return [ServerInfo(id=f"srv-{n}", name=n, status="up")
+                        for n in created]
+
+            def get_server(self, server_id):
+                return None
+
+            def create_server(self, spec):
+                created.append(spec.name)
+                return ServerInfo(id=f"srv-{spec.name}", name=spec.name,
+                                  status="up", ip="198.51.100.7")
+
+            def delete_server(self, server_id):
+                deleted.append(server_id)
+                return True
+
+            def power_on(self, server_id):
+                return True
+
+            def power_off(self, server_id):
+                return True
+
+        return lambda name, **kw: FakeProvider()
+
+    def test_provision_and_deprovision(self):
+        created, deleted = [], []
+
+        async def go():
+            from fleetflow_tpu.cp import ServerConfig, start
+            handle = await start(
+                ServerConfig(), backend_factory=mock_backend_factory,
+                server_provider_factory=self._fake_factory(created, deleted))
+            conn, _ = await connect(handle)
+            out = await conn.request("server", "provision", {
+                "slug": "auto-1", "provider": "fake",
+                "capacity": {"cpu": 4, "memory": 8192, "disk": 50000}})
+            assert out["server"]["status"] == "provisioning"
+            assert out["server"]["hostname"] == "198.51.100.7"
+            assert out["instance"]["id"] == "srv-auto-1"
+            assert created == ["auto-1"]
+            s = handle.state.store.server_by_slug("auto-1")
+            assert s.capacity.cpu == 4
+
+            # duplicate slug is rejected
+            with pytest.raises(RpcError):
+                await conn.request("server", "provision",
+                                   {"slug": "auto-1", "provider": "fake"})
+
+            out = await conn.request("server", "deprovision",
+                                     {"slug": "auto-1"})
+            assert out["ok"] is True
+            assert deleted == ["srv-auto-1"]
+            assert handle.state.store.server_by_slug("auto-1") is None
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+class TestServerRegisterLabels:
+    def test_register_accepts_wire_class_label(self):
+        """Wire payloads carry "class" (the to_dict form); the record field
+        is clazz — registry sync payloads must round-trip."""
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            out = await conn.request("server", "register", {
+                "slug": "web-1",
+                "labels": {"tier": "std", "class": "general"}})
+            assert out["server"]["labels"]["class"] == "general"
+            await conn.close()
+            await handle.stop()
+        run(go())

@@ -326,3 +326,64 @@ class TestCloud:
         argv = calls[0]
         assert argv[0] == "ssh" and "ubuntu@1.2.3.4" in argv
         assert "-i" in argv and "BatchMode=yes" in " ".join(argv)
+
+
+class TestRegistryDeploy:
+    """Cross-fleet routed deploy over ssh with an injected runner
+    (commands/registry.rs:250-417 analog)."""
+
+    def _registry(self):
+        from fleetflow_tpu.registry import parse_registry_string
+        return parse_registry_string("""
+registry "prod"
+fleet "shop" path="/srv/shop"
+fleet "blog" path="/srv/blog"
+server "tokyo-1" { host "203.0.113.5"; ssh-user "deploy" }
+server "osaka-1" { host "203.0.113.9" }
+route fleet="shop" stage="live" server="tokyo-1"
+route fleet="blog" stage="live" server="osaka-1"
+""")
+
+    def test_deploy_all_routes(self):
+        from fleetflow_tpu.registry import deploy_routes
+        calls = []
+
+        def runner(args, timeout):
+            calls.append(args)
+            return 0, "deployment ok\n", ""
+
+        reg = self._registry()
+        results = deploy_routes(reg, runner=runner)
+        assert [r.ok for r in results] == [True, True]
+        assert len(calls) == 2
+        # ssh target + remote command shape
+        assert "deploy@203.0.113.5" in calls[0]
+        assert calls[0][-1] == "cd /srv/shop && fleet deploy live -y"
+
+    def test_deploy_filter_and_failure(self):
+        from fleetflow_tpu.registry import deploy_routes
+
+        def runner(args, timeout):
+            return 1, "", "remote fleet not installed"
+
+        reg = self._registry()
+        results = deploy_routes(reg, fleet="shop", runner=runner)
+        assert len(results) == 1 and not results[0].ok
+        assert "remote fleet not installed" in results[0].error
+
+    def test_dry_run_runs_nothing(self):
+        from fleetflow_tpu.registry import deploy_routes
+        lines = []
+        reg = self._registry()
+        results = deploy_routes(reg, dry_run=True,
+                                runner=lambda a, t: (_ for _ in ()).throw(
+                                    AssertionError("must not run")),
+                                on_line=lines.append)
+        assert all(r.ok for r in results) and len(lines) == 2
+
+    def test_sync_payloads(self):
+        from fleetflow_tpu.registry import sync_servers_payloads
+        reg = self._registry()
+        payloads = sync_servers_payloads(reg)
+        assert [p["slug"] for p in payloads] == ["osaka-1", "tokyo-1"]
+        assert payloads[1]["hostname"] == "203.0.113.5"
