@@ -410,6 +410,43 @@ def _deploy(state: "AppState"):
         if method == "history":
             return {"deployments": [d.to_dict() for d in db.deployment_history(
                 stage=p.get("stage"), limit=p.get("limit", 50))]}
+        if method == "run":
+            # legacy SSH remote-exec path (handlers/deploy.rs:24-252):
+            # record the deployment, ssh to the stage's server, run a
+            # remote `fleet deploy`, record the outcome. Kept for servers
+            # that have no agent (the reference's Tailscale-SSH deploys);
+            # agent-routed `execute` is the primary path.
+            slug, project_path, stage_name = _require(
+                p, "server", "path", "stage")
+            srv = db.server_by_slug(slug)
+            if srv is None:
+                raise ValueError(f"no server {slug!r}")
+            tenant = db.ensure_tenant(p.get("tenant", "default"))
+            project = db.ensure_project(tenant.name,
+                                        p.get("project", project_path))
+            stage = db.ensure_stage(project.id, stage_name)
+            dep = db.create("deployments", Deployment(
+                tenant=tenant.name, project=project.id, stage=stage.id,
+                status=DeploymentStatus.RUNNING.value))
+            from ..cloud.ssh import SshTarget, exec_with_timeout
+            from ..registry.deploy import remote_deploy_cmd
+            cmd = remote_deploy_cmd(project_path, stage_name,
+                                    p.get("fleet_bin", "fleet"))
+            target = SshTarget(host=srv.hostname or slug,
+                               user=p.get("ssh_user"))
+            loop = asyncio.get_running_loop()
+            try:
+                out = await loop.run_in_executor(
+                    None, lambda: exec_with_timeout(
+                        target, cmd, timeout=DEPLOY_TIMEOUT,
+                        runner=getattr(state, "ssh_runner", None)))
+                db.finish_deployment(dep.id, DeploymentStatus.SUCCEEDED,
+                                     log=out)
+            except Exception as e:
+                db.finish_deployment(dep.id, DeploymentStatus.FAILED,
+                                     error=str(e))
+                raise
+            return {"deployment": db.get("deployments", dep.id).to_dict()}
         if method == "execute":
             req = DeployRequest.from_dict(p["request"])
             tenant_name = p.get("tenant", "default")

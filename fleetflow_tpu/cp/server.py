@@ -55,6 +55,7 @@ class AppState:
     # name -> cloud ServerProvider (server.rs provision path; injectable
     # for tests, shells out to usacloud/aws otherwise)
     server_provider_factory: Callable = None
+    ssh_runner: Callable = None            # injectable for deploy.run tests
     deploy_sleep: Callable[[float], None] = time.sleep
     started_at: float = field(default_factory=time.time)
     bg_tasks: set = field(default_factory=set)
@@ -104,6 +105,7 @@ def _default_backend_factory():
 async def start(config: ServerConfig, *,
                 backend_factory: Optional[Callable] = None,
                 server_provider_factory: Optional[Callable] = None,
+                ssh_runner: Optional[Callable] = None,
                 deploy_sleep: Callable[[float], None] = time.sleep,
                 ) -> CpServerHandle:
     """server.rs start:82-126."""
@@ -126,6 +128,7 @@ async def start(config: ServerConfig, *,
         backend_factory=backend_factory or _default_backend_factory,
         server_provider_factory=(server_provider_factory
                                  or _default_server_provider_factory),
+        ssh_runner=ssh_runner,
         deploy_sleep=deploy_sleep,
     )
 

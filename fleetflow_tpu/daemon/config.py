@@ -34,6 +34,7 @@ class DaemonConfig:
     tls_dir: Optional[str] = "~/.local/state/fleetflow/ca"
     health_interval_s: float = 60.0        # config.rs:33
     heartbeat_stale_s: float = 90.0
+    autoscale_interval_s: float = 0.0      # 0 = autoscaler off
     use_tpu_solver: bool = False
     source: Optional[str] = None
 
@@ -99,5 +100,7 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.health_interval_s = float(v)
         elif n == "heartbeat-stale":
             cfg.heartbeat_stale_s = float(v)
+        elif n == "autoscale-interval":
+            cfg.autoscale_interval_s = float(v)
         elif n == "tpu-solver":
             cfg.use_tpu_solver = bool(v)

@@ -14,6 +14,7 @@ from typing import Optional
 from ..cp.server import CpServerHandle, ServerConfig, start as cp_start
 from .config import DaemonConfig
 from .health import HealthChecker
+from ..cp.autoscaler import Autoscaler
 from .pidfile import PidFile
 from .web import WebServer
 
@@ -27,6 +28,7 @@ class Daemon:
         self.cp: Optional[CpServerHandle] = None
         self.web: Optional[WebServer] = None
         self.health: Optional[HealthChecker] = None
+        self.autoscaler: Optional[Autoscaler] = None
         self.web_addr: Optional[tuple[str, int]] = None
         self._stop = asyncio.Event()
 
@@ -44,8 +46,14 @@ class Daemon:
                                     interval_s=cfg.health_interval_s,
                                     stale_after_s=cfg.heartbeat_stale_s)
         self.health.spawn()
+        if cfg.autoscale_interval_s > 0:
+            self.autoscaler = Autoscaler(
+                self.cp.state, interval_s=cfg.autoscale_interval_s)
+            self.autoscaler.spawn()
 
     async def stop(self) -> None:
+        if self.autoscaler:
+            self.autoscaler.stop()
         if self.health:
             self.health.stop()
         if self.web:

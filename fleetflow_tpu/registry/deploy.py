@@ -18,7 +18,8 @@ from ..core.errors import CloudError
 from ..obs import get_logger, kv
 from .model import DeploymentRoute, Registry
 
-__all__ = ["RouteResult", "deploy_routes", "sync_servers_payloads"]
+__all__ = ["RouteResult", "deploy_routes", "sync_servers_payloads",
+           "remote_deploy_cmd"]
 
 log = get_logger("registry")
 
@@ -31,6 +32,13 @@ class RouteResult:
     ok: bool
     output: str = ""
     error: str = ""
+
+
+def remote_deploy_cmd(path: str, stage: str, fleet_bin: str = "fleet") -> str:
+    """The remote `fleet deploy` invocation — shared by registry routes and
+    the CP's deploy.run SSH path so the two cannot drift."""
+    return (f"cd {shlex.quote(path)} && "
+            f"{fleet_bin} deploy {shlex.quote(stage)} -y")
 
 
 def _target_for(reg: Registry, server_name: str) -> SshTarget:
@@ -58,8 +66,7 @@ def deploy_routes(reg: Registry, *, fleet: Optional[str] = None,
             results.append(RouteResult(route, False,
                                        error=f"unknown fleet {route.fleet!r}"))
             continue
-        cmd = (f"cd {shlex.quote(entry.path)} && "
-               f"{fleet_bin} deploy {shlex.quote(route.stage)} -y")
+        cmd = remote_deploy_cmd(entry.path, route.stage, fleet_bin)
         if dry_run:
             on_line(f"would run on {route.server}: {cmd}")
             results.append(RouteResult(route, True, output=cmd))

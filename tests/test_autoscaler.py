@@ -1,0 +1,167 @@
+"""Worker-pool autoscaler tests: scale-up to min, idle scale-down, caps,
+provider-failure handling — the elastic worker lifecycle
+(scripts/spawn-build-worker.sh + idle-shutdown.sh analog)."""
+
+import asyncio
+
+import pytest
+
+from fleetflow_tpu.cloud.provider import ServerInfo, ServerProvider
+from fleetflow_tpu.cp import ServerConfig, start
+from fleetflow_tpu.cp.autoscaler import Autoscaler
+from fleetflow_tpu.cp.models import WorkerPool
+from fleetflow_tpu.runtime import MockBackend
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+class FakeProvider(ServerProvider):
+    name = "fake"
+
+    def __init__(self, log):
+        self.log = log
+
+    def list_servers(self):
+        return [ServerInfo(id=f"srv-{n}", name=n, status="up")
+                for n in self.log["created"]]
+
+    def get_server(self, server_id):
+        return None
+
+    def create_server(self, spec):
+        if self.log.get("fail_create"):
+            raise RuntimeError("quota exceeded")
+        self.log["created"].append(spec.name)
+        return ServerInfo(id=f"srv-{spec.name}", name=spec.name,
+                          status="up", ip="203.0.113.50")
+
+    def delete_server(self, server_id):
+        self.log["deleted"].append(server_id)
+        return True
+
+    def power_on(self, server_id):
+        return True
+
+    def power_off(self, server_id):
+        return True
+
+
+async def _cp(log):
+    return await start(
+        ServerConfig(),
+        backend_factory=lambda: MockBackend(auto_pull=True),
+        server_provider_factory=lambda name, **kw: FakeProvider(log))
+
+
+class TestAutoscaler:
+    def test_scales_up_to_min(self):
+        log = {"created": [], "deleted": []}
+
+        async def go():
+            handle = await _cp(log)
+            handle.state.store.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=2,
+                preferred_labels={"provider": "fake"}))
+            scaler = Autoscaler(handle.state)
+            actions = scaler.run_sweep()
+            assert [a.kind for a in actions] == ["provision", "provision"]
+            assert all(a.ok for a in actions)
+            servers = handle.state.store.list(
+                "servers", lambda s: s.pool == "builders")
+            assert len(servers) == 2
+            assert all(s.hostname == "203.0.113.50" for s in servers)
+            # second sweep: already at min, nothing to do
+            assert scaler.run_sweep() == []
+            await handle.stop()
+        run(go())
+
+    def test_respects_max_cap(self):
+        log = {"created": [], "deleted": []}
+
+        async def go():
+            handle = await _cp(log)
+            handle.state.store.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=5,
+                max_servers=2, preferred_labels={"provider": "fake"}))
+            actions = Autoscaler(handle.state).run_sweep()
+            assert len([a for a in actions if a.kind == "provision"]) == 2
+            await handle.stop()
+        run(go())
+
+    def test_idle_scale_down_newest_first_after_grace(self):
+        import time as _time
+        log = {"created": [], "deleted": []}
+        now = [_time.time()]
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            db.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=1,
+                preferred_labels={"provider": "fake"}))
+            scaler = Autoscaler(handle.state, clock=lambda: now[0])
+            # bring up 1, then manually add 2 extra idle workers
+            scaler.run_sweep()
+            for i in range(2):
+                now[0] += 1
+                s = db.register_server(f"builders-extra{i}")
+                db.update("servers", s.id, pool="builders", status="online",
+                          provider="fake")
+                log["created"].append(f"builders-extra{i}")
+            # within the grace period nothing is reaped
+            assert scaler.run_sweep() == []
+            now[0] += 10000
+            actions = scaler.run_sweep()
+            downs = [a for a in actions if a.kind == "deprovision"]
+            # the first worker never came online -> reaped as a provisioning
+            # zombie; one surplus idle extra goes too (newest first), and
+            # min_servers=1 keeps the older extra
+            assert len(downs) == 2 and all(a.ok for a in downs)
+            assert downs[0].slug == "builders-w1"
+            assert downs[1].slug == "builders-extra1"
+            remaining = db.list("servers", lambda s: s.pool == "builders")
+            assert [s.slug for s in remaining] == ["builders-extra0"]
+            assert log["deleted"] == ["srv-builders-w1",
+                                      "srv-builders-extra1"]
+            await handle.stop()
+        run(go())
+
+    def test_busy_workers_never_reaped(self):
+        import time as _time
+        log = {"created": [], "deleted": []}
+        now = [_time.time()]
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            db.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=0,
+                preferred_labels={"provider": "fake"}))
+            s = db.register_server("builders-busy")
+            db.update("servers", s.id, pool="builders", status="online",
+                      provider="fake")
+            db.update("servers", s.id, allocated=type(s.allocated)(cpu=2.0))
+            now[0] += 10000
+            scaler = Autoscaler(handle.state, clock=lambda: now[0])
+            assert scaler.run_sweep() == []
+            assert db.server_by_slug("builders-busy") is not None
+            await handle.stop()
+        run(go())
+
+    def test_provider_failure_rolls_back_record(self):
+        log = {"created": [], "deleted": [], "fail_create": True}
+
+        async def go():
+            handle = await _cp(log)
+            handle.state.store.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=1,
+                preferred_labels={"provider": "fake"}))
+            actions = Autoscaler(handle.state).run_sweep()
+            assert len(actions) == 1 and not actions[0].ok
+            assert "quota exceeded" in actions[0].error
+            assert handle.state.store.list(
+                "servers", lambda s: s.pool == "builders") == []
+            await handle.stop()
+        run(go())

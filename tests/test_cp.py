@@ -639,3 +639,52 @@ class TestServerRegisterLabels:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestDeployRunSsh:
+    """deploy.run: the legacy SSH remote-exec path (handlers/deploy.rs:24-252)
+    with an injected ssh runner."""
+
+    def test_run_records_deployment(self):
+        calls = []
+
+        async def go():
+            from fleetflow_tpu.cp import ServerConfig, start
+
+            def runner(args, timeout):
+                calls.append(args)
+                return 0, "remote: 3 deployed\n", ""
+
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory,
+                                 ssh_runner=runner)
+            handle.state.store.register_server("tokyo-1", hostname="203.0.113.4")
+            conn, _ = await connect(handle)
+            out = await conn.request("deploy", "run", {
+                "server": "tokyo-1", "path": "/srv/shop", "stage": "live",
+                "ssh_user": "deploy"})
+            assert out["deployment"]["status"] == "succeeded"
+            assert "remote: 3 deployed" in out["deployment"]["log"]
+            assert calls and "deploy@203.0.113.4" in calls[0]
+            assert calls[0][-1] == "cd /srv/shop && fleet deploy live -y"
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_run_failure_marks_failed(self):
+        async def go():
+            from fleetflow_tpu.cp import ServerConfig, start
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory,
+                                 ssh_runner=lambda a, t: (255, "", "unreachable"))
+            handle.state.store.register_server("tokyo-1")
+            conn, _ = await connect(handle)
+            with pytest.raises(RpcError):
+                await conn.request("deploy", "run", {
+                    "server": "tokyo-1", "path": "/srv/x", "stage": "live"})
+            deps = handle.state.store.deployment_history()
+            assert deps and deps[0].status == "failed"
+            assert "unreachable" in deps[0].error
+            await conn.close()
+            await handle.stop()
+        run(go())
