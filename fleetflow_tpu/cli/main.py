@@ -634,6 +634,26 @@ def _cp_dispatch(cp: CpClient, args) -> int:
             return show(cp.request("server", "deprovision",
                                    {"slug": _need(args.name, "server slug")},
                                    timeout=600))
+        if verb == "pool-create":
+            payload = {"name": _need(args.name, "pool name"),
+                       "tenant": args.tenant or "default"}
+            labels = {}
+            if getattr(args, "provider", None):
+                labels["provider"] = args.provider
+            if labels:
+                payload["preferred_labels"] = labels
+            if getattr(args, "min", None) is not None:
+                payload["min_servers"] = args.min
+            if getattr(args, "max", None) is not None:
+                payload["max_servers"] = args.max
+            return show(cp.request("server", "pool.create", payload))
+        if verb == "pool-list":
+            rows = cp.request("server", "pool.list")["pools"]
+            for w in rows:
+                print(f"  {w['name']:<16} min={w['min_servers']} "
+                      f"max={w['max_servers']} "
+                      f"labels={w['preferred_labels']}")
+            return 0
     if sub == "agents":
         return show(cp.request("health", "overview")["agents"])
     if sub == "alerts":
@@ -890,7 +910,8 @@ def build_parser() -> argparse.ArgumentParser:
         ("tenant", ["list", "create", "delete", "users"]),
         ("project", ["list", "create"]),
         ("server", ["list", "register", "cordon", "uncordon", "drain",
-                    "delete", "provision", "deprovision"]),
+                    "delete", "provision", "deprovision", "pool-create",
+                    "pool-list"]),
         ("stage", ["status", "adopt"]),
     ]:
         q = cps.add_parser(group)
@@ -900,6 +921,8 @@ def build_parser() -> argparse.ArgumentParser:
         if group == "server":
             q.add_argument("--provider",
                            help="cloud provider for provision (sakura|aws)")
+            q.add_argument("--min", type=int, help="pool min servers")
+            q.add_argument("--max", type=int, help="pool max servers")
 
     q = cps.add_parser("cost")
     q.add_argument("verb", choices=["summary", "add"])

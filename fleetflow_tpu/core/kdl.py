@@ -86,11 +86,16 @@ _WS = set(" \t\ufeff\u00a0\u1680\u2000\u2001\u2002\u2003\u2004\u2005\u2006"
 _NEWLINES = set("\r\n\x0c\u0085\u2028\u2029")
 
 
+MAX_DEPTH = 128    # a document nested deeper is hostile or broken — fail
+                   # with a parse error, not a Python RecursionError
+
+
 class _Parser:
     def __init__(self, text: str):
         self.text = text
         self.pos = 0
         self.n = len(text)
+        self.depth = 0
 
     # -- error helpers ------------------------------------------------------
 
@@ -397,7 +402,12 @@ class _Parser:
                 # as a sibling node, so `capacity { cpu 4 } labels { ... }`
                 # reads naturally.
                 self.pos += 1
+                self.depth += 1
+                if self.depth > MAX_DEPTH:
+                    raise self.error(f"children nested deeper than "
+                                     f"{MAX_DEPTH} levels")
                 node.children = self.parse_nodes(until_brace=True)
+                self.depth -= 1
                 break
             if c == "}":
                 break  # let caller consume the closing brace
@@ -409,7 +419,12 @@ class _Parser:
                 self.skip_ws(newlines=False)
                 if self.peek() == "{":
                     self.pos += 1
+                    self.depth += 1
+                    if self.depth > MAX_DEPTH:
+                        raise self.error(f"children nested deeper than "
+                                         f"{MAX_DEPTH} levels")
                     self.parse_nodes(until_brace=True)  # discard
+                    self.depth -= 1
                     continue
 
             if c == "(":

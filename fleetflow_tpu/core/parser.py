@@ -92,17 +92,35 @@ def _duration(v: Any, default: float) -> float:
 # --------------------------------------------------------------------------
 
 def parse_port(node: KdlNode) -> Port:
-    """`port host=8080 container=80 protocol="udp" host-ip="127.0.0.1"`
-    or positional `port 8080 80` (reference: parser/port.rs)."""
+    """`port host=8080 container=80 protocol="udp" host-ip="127.0.0.1"`,
+    positional `port 8080 80`, or the compose-style string
+    `port "8080:80[/udp]"` / `port "127.0.0.1:8080:80"`
+    (reference: parser/port.rs)."""
     host = node.prop("host", node.arg(0))
     container = node.prop("container", node.arg(1, host))
-    if host is None:
-        raise FlowError(f"port node missing host port: {node}")
     proto = node.prop("protocol", node.prop("proto", "tcp"))
     host_ip = node.prop("host-ip", node.prop("host_ip"))
-    return Port(host=int(host), container=int(container),
-                protocol=Protocol.parse(_as_str(proto)),
-                host_ip=host_ip if host_ip is None else _as_str(host_ip))
+    if isinstance(host, str) and ":" in host:
+        # docker-compose shorthand in one string
+        spec = host
+        if "/" in spec:
+            spec, proto = spec.rsplit("/", 1)
+        parts = spec.split(":")
+        if len(parts) == 2:
+            host, container = parts
+        elif len(parts) == 3:
+            host_ip, host, container = parts
+        else:
+            raise FlowError(f"cannot parse port spec {host!r} "
+                            f"(want host:container[/proto])")
+    if host is None:
+        raise FlowError(f"port node missing host port: {node}")
+    try:
+        return Port(host=int(host), container=int(container),
+                    protocol=Protocol.parse(_as_str(proto)),
+                    host_ip=host_ip if host_ip is None else _as_str(host_ip))
+    except (TypeError, ValueError) as e:
+        raise FlowError(f"invalid port node {node}: {e}") from None
 
 
 def parse_volume(node: KdlNode) -> Volume:

@@ -37,6 +37,9 @@ log = get_logger("cp.autoscaler")
 
 IDLE_GRACE_S = 600.0     # idle-shutdown.sh waits ~10 min before poweroff
 PROVISION_TIMEOUT_S = 900.0   # a machine that never came up is a zombie
+OFFLINE_REAP_S = 900.0   # a worker offline this long is a corpse: reap the
+                         # record (and any surviving VM) so the pool can
+                         # replace it instead of counting it against max
 
 
 @dataclass
@@ -98,20 +101,25 @@ class Autoscaler:
         zombies = [s for s in servers
                    if s.status == "provisioning"
                    and now - s.created_at >= PROVISION_TIMEOUT_S]
+        corpses = [s for s in servers
+                   if s.status == "offline"
+                   and now - max(s.last_heartbeat, s.updated_at) >= OFFLINE_REAP_S]
+        dead = zombies + corpses
         alive = [s for s in servers
                  if s.status == "online"
                  or (s.status == "provisioning" and s not in zombies)]
         need = max(pool.min_servers - len(alive), 0)
-        victims: list[Server] = list(zombies)
+        victims: list[Server] = list(dead)
         if need == 0 and len(alive) > pool.min_servers:
             idle = [s for s in alive if self._is_idle(s)]
             # newest first: long-lived workers keep caches warm
             idle.sort(key=lambda s: s.created_at, reverse=True)
             surplus = len(alive) - pool.min_servers
             victims += idle[:surplus]
-        # max_servers is a hard cap on provisioning (0 = uncapped)
+        # max_servers is a hard cap on provisioning (0 = uncapped); dead
+        # records being reaped this sweep do not count against it
         if pool.max_servers > 0:
-            room = max(pool.max_servers - (len(servers) - len(zombies)), 0)
+            room = max(pool.max_servers - (len(servers) - len(dead)), 0)
             need = min(need, room)
         return need, victims
 
@@ -135,14 +143,16 @@ class Autoscaler:
             need, victims = self.plan(pool)
             inventory = None
             if victims:
-                # one provider listing per pool, not per victim
+                # one provider listing per pool, not per victim; a failed
+                # listing SKIPS the deprovisions (deleting records without
+                # deleting VMs would leak running, billing machines)
                 try:
                     sp = self.state.server_provider_factory(provider_name)
                     inventory = {i.name: i for i in sp.list_servers()}
                 except Exception as e:
-                    log.error("provider list failed %s",
+                    log.error("provider list failed; deferring scale-down %s",
                               kv(pool=pool.name, error=e))
-                    inventory = {}
+                    victims = []
             for _ in range(need):
                 actions.append(self._provision(pool, provider_name))
             for s in victims:

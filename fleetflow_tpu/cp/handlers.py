@@ -318,7 +318,9 @@ def _server(state: "AppState"):
             pool = db.create("worker_pools", WorkerPool(
                 tenant=p.get("tenant", "default"), name=name,
                 required_labels=p.get("required_labels", {}),
-                preferred_labels=p.get("preferred_labels", {})))
+                preferred_labels=p.get("preferred_labels", {}),
+                min_servers=int(p.get("min_servers", 0)),
+                max_servers=int(p.get("max_servers", 0))))
             return {"pool": pool.to_dict()}
         if method == "pool.list":
             return {"pools": [w.to_dict() for w in db.list("worker_pools")]}

@@ -165,3 +165,65 @@ class TestAutoscaler:
                 "servers", lambda s: s.pool == "builders") == []
             await handle.stop()
         run(go())
+
+
+class TestDeadWorkerReplacement:
+    def test_offline_corpse_reaped_and_replaced_under_cap(self):
+        import time as _time
+        log = {"created": [], "deleted": []}
+        now = [_time.time()]
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            db.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=2,
+                max_servers=2, preferred_labels={"provider": "fake"}))
+            scaler = Autoscaler(handle.state, clock=lambda: now[0])
+            scaler.run_sweep()                       # brings up w1, w2
+            # both die: health checker marks them offline
+            for s in db.list("servers", lambda s: s.pool == "builders"):
+                db.update("servers", s.id, status="offline")
+            # not yet past the reap window: nothing happens
+            assert scaler.run_sweep() == []
+            now[0] += 10000
+            actions = scaler.run_sweep()
+            kinds = sorted(a.kind for a in actions)
+            # corpses reaped AND replacements provisioned despite max=2
+            assert kinds == ["deprovision", "deprovision",
+                             "provision", "provision"]
+            alive = db.list("servers", lambda s: s.pool == "builders")
+            assert len(alive) == 2
+            assert all(s.status == "provisioning" for s in alive)
+            await handle.stop()
+        run(go())
+
+    def test_list_failure_defers_scale_down(self):
+        import time as _time
+        log = {"created": [], "deleted": [], }
+        now = [_time.time()]
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+
+            class FailingList(FakeProvider):
+                def list_servers(self):
+                    raise RuntimeError("cloud API down")
+
+            handle.state.server_provider_factory = \
+                lambda name, **kw: FailingList(log)
+            db.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=0,
+                preferred_labels={"provider": "fake"}))
+            s = db.register_server("builders-old")
+            db.update("servers", s.id, pool="builders", status="online",
+                      provider="fake")
+            now[0] += 10000
+            scaler = Autoscaler(handle.state, clock=lambda: now[0])
+            actions = scaler.run_sweep()
+            # no deprovision happened: the record survives for a later sweep
+            assert [a for a in actions if a.kind == "deprovision"] == []
+            assert db.server_by_slug("builders-old") is not None
+            await handle.stop()
+        run(go())

@@ -205,3 +205,75 @@ stage "live" {
         nodes2 = parse_document(text2)
         assert nodes2[0].child("image").args == ["postgres"]
         assert nodes2[0].child("ports").children[0].props == {"host": 1, "container": 2}
+
+
+class TestEdgeCorpus:
+    """Adversarial/edge fixtures (parser/tests.rs corpus discipline)."""
+
+    def test_raw_string_with_quotes(self):
+        (n,) = parse_document('cmd r#"echo "hi""#')
+        assert n.arg(0) == 'echo "hi"'
+
+    def test_escaped_quotes_newlines_tabs(self):
+        (n,) = parse_document(r'cmd "say \"hi\"\n\tdone"')
+        assert n.arg(0) == 'say "hi"\n\tdone'
+
+    def test_unicode_names_and_values(self):
+        (n,) = parse_document('サービス "値" key="日本語"')
+        assert n.name == "サービス" and n.arg(0) == "値"
+        assert n.prop("key") == "日本語"
+
+    def test_type_annotations_are_transparent(self):
+        (n,) = parse_document('port (u16)8080 (string)"x"')
+        assert n.args == [8080, "x"]
+
+    def test_slashdash_forms(self):
+        doc = parse_document(
+            '/-dead "node"\nlive "a" /-"dead-arg" "keep" /-{ gone "x" }')
+        assert len(doc) == 1
+        assert doc[0].args == ["a", "keep"] and doc[0].children == []
+
+    def test_line_continuation(self):
+        (n,) = parse_document('node \\\n  "arg"')
+        assert n.arg(0) == "arg"
+
+    def test_crlf_and_tabs(self):
+        (n,) = parse_document('node\t"a"\t{\r\n\tchild "x"\r\n}\r\n')
+        assert n.children[0].arg(0) == "x"
+
+    def test_comment_styles(self):
+        doc = parse_document(
+            '// line\na "1" /* inline */ "2"\n/* multi\nline */\nb "3"')
+        assert [n.name for n in doc] == ["a", "b"]
+        assert doc[0].args == ["1", "2"]
+
+    def test_hash_and_braces_inside_strings(self):
+        (n,) = parse_document('env url="http://x#frag" tmpl="{not-a-block}"')
+        assert n.prop("url") == "http://x#frag"
+        assert n.prop("tmpl") == "{not-a-block}"
+
+    def test_scalar_types(self):
+        (n,) = parse_document('vals true false null 42 -5 3.14 1e3')
+        assert n.args == [True, False, None, 42, -5, 3.14, 1000.0]
+
+    def test_siblings_after_children_block(self):
+        (n,) = parse_document('server "a" { capacity { cpu 4 } labels { t "x" } }')
+        assert [c.name for c in n.children] == ["capacity", "labels"]
+
+    def test_deep_nesting_is_a_parse_error_not_recursion(self):
+        import pytest
+        from fleetflow_tpu.core.kdl import KdlError
+        with pytest.raises(KdlError, match="nested deeper"):
+            parse_document("a {" * 2000 + "}" * 2000)
+
+    def test_nesting_under_limit_ok(self):
+        doc = parse_document("a {" * 100 + "}" * 100)
+        assert doc[0].name == "a"
+
+    def test_malformed_inputs_raise_cleanly(self):
+        import pytest
+        from fleetflow_tpu.core.kdl import KdlError
+        for bad in ('svc "a', 'svc r#"abc', 'svc "a" {', '}',
+                    '/* foo', 'port host='):
+            with pytest.raises(KdlError):
+                parse_document(bad)

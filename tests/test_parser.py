@@ -304,3 +304,34 @@ service "w" { replicas 1 }
         from fleetflow_tpu.core.kdl import parse_document
         n = parse_document('port (u16)8080')[0]
         assert n.args == [8080]
+
+
+class TestPortForms:
+    def test_compose_string_forms(self):
+        flow = parse_kdl_string("""
+project "p"
+service "a" {
+    ports {
+        port "8080:80"
+        port "9090:90/udp"
+        port "127.0.0.1:7070:70"
+    }
+}
+""")
+        ports = flow.services["a"].ports
+        assert [(p.host, p.container) for p in ports] == [
+            (8080, 80), (9090, 90), (7070, 70)]
+        assert ports[1].protocol.value == "udp"
+        assert ports[2].host_ip == "127.0.0.1"
+
+    def test_bad_port_spec_is_flow_error(self):
+        from fleetflow_tpu.core.errors import FlowError
+        with pytest.raises(FlowError, match="port"):
+            parse_kdl_string(
+                'project "p"\nservice "a" { ports { port "a:b:c:d" } }')
+
+    def test_non_numeric_port_is_flow_error(self):
+        from fleetflow_tpu.core.errors import FlowError
+        with pytest.raises(FlowError):
+            parse_kdl_string(
+                'project "p"\nservice "a" { ports { port "eighty:80" } }')
