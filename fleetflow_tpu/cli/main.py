@@ -657,7 +657,9 @@ def _cp_dispatch(cp: CpClient, args) -> int:
     if sub == "agents":
         return show(cp.request("health", "overview")["agents"])
     if sub == "alerts":
-        return show(cp.request("health", "overview"))
+        return show(cp.request("health", "alerts",
+                               {"tenant": getattr(args, "tenant", None)})
+                    ["alerts"])
     if sub == "cost":
         if args.verb == "summary":
             return show(cp.request("cost", "summary",
@@ -905,6 +907,7 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("-c", "--config")
     q = cps.add_parser("agents")
     q = cps.add_parser("alerts")
+    q.add_argument("--tenant")
 
     for group, verbs in [
         ("tenant", ["list", "create", "delete", "users"]),

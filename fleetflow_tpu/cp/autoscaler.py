@@ -103,7 +103,10 @@ class Autoscaler:
                    and now - s.created_at >= PROVISION_TIMEOUT_S]
         corpses = [s for s in servers
                    if s.status == "offline"
-                   and now - max(s.last_heartbeat, s.updated_at) >= OFFLINE_REAP_S]
+                   and now - max(s.last_heartbeat, s.updated_at) >= OFFLINE_REAP_S
+                   # a partitioned-but-working node still carries workload
+                   # state (allocations / observed containers): never reap it
+                   and not self._is_busy(s)]
         dead = zombies + corpses
         alive = [s for s in servers
                  if s.status == "online"
@@ -153,6 +156,12 @@ class Autoscaler:
                     log.error("provider list failed; deferring scale-down %s",
                               kv(pool=pool.name, error=e))
                     victims = []
+                    # the plan assumed those victims were being reaped; with
+                    # reaping deferred, re-clamp provisioning against the
+                    # FULL record count so a capped pool cannot overshoot
+                    if pool.max_servers > 0:
+                        servers_now = len(self._pool_servers(pool))
+                        need = min(need, max(pool.max_servers - servers_now, 0))
             for _ in range(need):
                 actions.append(self._provision(pool, provider_name))
             for s in victims:

@@ -315,12 +315,17 @@ def _server(state: "AppState"):
             return {"ok": True}
         if method == "pool.create":
             (name,) = _require(p, "name")
+            mn = int(p.get("min_servers", 0))
+            mx = int(p.get("max_servers", 0))
+            if mn < 0 or mx < 0:
+                raise ValueError("pool min/max must be >= 0")
+            if mx and mn > mx:
+                raise ValueError(f"pool min_servers {mn} > max_servers {mx}")
             pool = db.create("worker_pools", WorkerPool(
                 tenant=p.get("tenant", "default"), name=name,
                 required_labels=p.get("required_labels", {}),
                 preferred_labels=p.get("preferred_labels", {}),
-                min_servers=int(p.get("min_servers", 0)),
-                max_servers=int(p.get("max_servers", 0))))
+                min_servers=mn, max_servers=mx))
             return {"pool": pool.to_dict()}
         if method == "pool.list":
             return {"pools": [w.to_dict() for w in db.list("worker_pools")]}
@@ -344,6 +349,9 @@ def _health(state: "AppState"):
                 "deployments": len(db.list("deployments")),
                 "active_alerts": len(db.active_alerts()),
             }
+        if method == "alerts":
+            return {"alerts": [a.to_dict()
+                               for a in db.active_alerts(p.get("tenant"))]}
         raise ValueError(f"unknown method health.{method}")
     return handle
 

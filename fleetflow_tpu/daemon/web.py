@@ -326,6 +326,20 @@ class WebServer:
         def agents(body, query):
             return {"agents": state.agent_registry.list_connected()}
 
+        @self.route("GET", "/api/pools")
+        def pools(body, query):
+            by_pool: dict = {}
+            for s in db.list("servers"):       # one scan, grouped
+                if s.pool:
+                    by_pool.setdefault(s.pool, []).append(
+                        {"slug": s.slug, "status": s.status})
+            out = []
+            for w in db.list("worker_pools"):
+                d = w.to_dict()
+                d["servers"] = by_pool.get(w.name, [])
+                out.append(d)
+            return {"pools": out}
+
         # -- deployments / alerts ----------------------------------------
         @self.route("GET", "/api/deployments")
         def deployments(body, query):
@@ -456,7 +470,7 @@ _DASHBOARD_HTML = """<!doctype html>
 'use strict';
 // -- tiny SPA over the CP REST surface (web.rs:47-116 SPA analog) ---------
 const VIEWS=['overview','servers','stages','deployments','alerts',
-             'placement','agents','dns','volumes','builds'];
+             'placement','agents','pools','dns','volumes','builds'];
 function esc(v){return String(v??'').replace(/[&<>"']/g,
  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function token(){return localStorage.getItem('fleet_token')||''}
@@ -564,6 +578,15 @@ const views={
   main().innerHTML=card(a.agents.length?table(['agent'],
    a.agents.map(x=>[`<code>${esc(x)}</code>`])):
    '<span class="muted">no agents connected</span>')},
+ async pools(){
+  const p=await api('/api/pools');
+  main().innerHTML=card(p.pools.length?table(
+   ['pool','min','max','workers','members'],
+   p.pools.map(x=>[`<code>${esc(x.name)}</code>`,esc(x.min_servers),
+    esc(x.max_servers||'∞'),esc(x.servers.length),
+    x.servers.map(s=>`${badge(s.status)} <code>${esc(s.slug)}</code>`)
+     .join(' · ')])):
+   '<span class="muted">no worker pools</span>')},
  async dns(){
   const d=await api('/api/dns');
   main().innerHTML=card(table(['zone','name','type','content','ttl','proxied'],

@@ -253,6 +253,17 @@ class FleetMcpServer:
     def cp_servers(self) -> dict:
         return _text(self.cp().request("server", "list")["servers"])
 
+    @_tool("cp_alerts", "Active alerts (restart loops, unexpected stops, "
+           "unhealthy containers, offline nodes)",
+           {"type": "object", "properties": {"tenant": {"type": "string"}}})
+    def cp_alerts(self, tenant: Optional[str] = None) -> dict:
+        return _text(self.cp().request("health", "alerts",
+                                       {"tenant": tenant})["alerts"])
+
+    @_tool("cp_pools", "Worker pools with min/max and member servers")
+    def cp_pools(self) -> dict:
+        return _text(self.cp().request("server", "pool.list")["pools"])
+
     @_tool("cp_tenant_overview", "One tenant's projects/servers/alerts",
            {"type": "object", "properties": {"tenant": {"type": "string"}},
             "required": ["tenant"]})

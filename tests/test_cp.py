@@ -688,3 +688,19 @@ class TestDeployRunSsh:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestHealthAlerts:
+    def test_health_alerts_method(self):
+        async def go():
+            handle = await start_cp()
+            from fleetflow_tpu.cp.models import Alert
+            handle.state.store.create("alerts", Alert(
+                server="n1", kind="unhealthy", message="api flapping"))
+            conn, _ = await connect(handle)
+            out = await conn.request("health", "alerts", {})
+            assert len(out["alerts"]) == 1
+            assert out["alerts"][0]["kind"] == "unhealthy"
+            await conn.close()
+            await handle.stop()
+        run(go())
