@@ -40,6 +40,11 @@ PROVISION_TIMEOUT_S = 900.0   # a machine that never came up is a zombie
 OFFLINE_REAP_S = 900.0   # a worker offline this long is a corpse: reap the
                          # record (and any surviving VM) so the pool can
                          # replace it instead of counting it against max
+# an offline node that still shows workload is given 4x the window for its
+# stage to be redeployed elsewhere (which releases the allocations); past
+# that the "workload" is bookkeeping residue on a dead machine and keeping
+# the record would starve a capped pool below min forever
+OFFLINE_BUSY_REAP_S = 4 * OFFLINE_REAP_S
 
 
 @dataclass
@@ -71,8 +76,10 @@ class Autoscaler:
     # ------------------------------------------------------------------
 
     def _pool_servers(self, pool: WorkerPool) -> list[Server]:
+        # pool names are only unique per tenant
         return self.state.store.list(
-            "servers", lambda s: s.pool == pool.name)
+            "servers", lambda s: s.pool == pool.name
+            and s.tenant == pool.tenant)
 
     def _is_busy(self, s: Server) -> bool:
         alloc = s.allocated
@@ -101,12 +108,16 @@ class Autoscaler:
         zombies = [s for s in servers
                    if s.status == "provisioning"
                    and now - s.created_at >= PROVISION_TIMEOUT_S]
+        def offline_age(s):
+            return now - max(s.last_heartbeat, s.updated_at)
+
         corpses = [s for s in servers
                    if s.status == "offline"
-                   and now - max(s.last_heartbeat, s.updated_at) >= OFFLINE_REAP_S
-                   # a partitioned-but-working node still carries workload
-                   # state (allocations / observed containers): never reap it
-                   and not self._is_busy(s)]
+                   and (offline_age(s) >= OFFLINE_BUSY_REAP_S
+                        # a partitioned-but-working node still carries
+                        # workload state: give its stages the longer window
+                        or (offline_age(s) >= OFFLINE_REAP_S
+                            and not self._is_busy(s)))]
         dead = zombies + corpses
         alive = [s for s in servers
                  if s.status == "online"

@@ -330,13 +330,13 @@ class WebServer:
         def pools(body, query):
             by_pool: dict = {}
             for s in db.list("servers"):       # one scan, grouped
-                if s.pool:
-                    by_pool.setdefault(s.pool, []).append(
+                if s.pool:                     # pool names unique per tenant
+                    by_pool.setdefault((s.tenant, s.pool), []).append(
                         {"slug": s.slug, "status": s.status})
             out = []
             for w in db.list("worker_pools"):
                 d = w.to_dict()
-                d["servers"] = by_pool.get(w.name, [])
+                d["servers"] = by_pool.get((w.tenant, w.name), [])
                 out.append(d)
             return {"pools": out}
 

@@ -1,0 +1,279 @@
+"""Service-axis sharded annealing: the SPMD mega-solve.
+
+Chain sharding (solver/api.py `mesh=`) is data parallelism — every device
+holds the WHOLE problem. This module shards the PROBLEM itself over the
+`svc` mesh axis (the domain analog of sequence/context parallelism): each
+device owns S/D services — its slice of demand, conflict ids, eligibility
+and preference matrices — while the per-node state (load, conflict-group
+occupancy, colocation occupancy, topology counts) is replicated and kept
+identical on every device by all-reducing each sweep's applied deltas.
+
+Why it matters: the (S, N) eligibility/preference matrices dominate memory
+— at 100k services x 10k nodes they are ~1 GB each in bool/f32, past a
+single chip's budget once chain state is added. Sharding S divides them by
+the mesh size; the sweep's hot path then needs two collective patterns,
+both riding ICI:
+
+  1. a `pmin` over the svc axis electing ONE winning move per target node
+     globally (the feasibility-preserving winner-per-target rule must hold
+     across shards, not per shard);
+  2. `psum`s of the four applied state deltas (load, conflict occupancy,
+     colocation occupancy, topology counts) so every device's replicated
+     node state stays bit-identical.
+
+Service ownership is disjoint, so the winner-per-service rule needs no
+communication. The per-move cost delta mirrors anneal._proposal_delta term
+for term (capacity overflow mass, conflicts, eligibility/validity, skew,
+strategy soft rows, preference, colocation), so a legal sweep here is a
+legal sweep there: a feasible chain stays feasible.
+
+Entry points: `anneal_sharded(prob, init, key, mesh=...)` (hands back the
+refined (S,) assignment; callers verify exactly on the host as
+tests/test_sharded.py and __graft_entry__ do), and `shard_problem` to
+pre-place a DeviceProblem's tensors on the mesh so repeated calls skip the
+implicit reshard.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.8
+except ImportError:                                  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed across jax versions
+_SM_KW = ("check_rep" if "check_rep" in inspect.signature(_shard_map).parameters
+          else "check_vma" if "check_vma" in inspect.signature(_shard_map).parameters
+          else None)
+
+
+def shard_map(*args, **kw):
+    if _SM_KW is not None:
+        kw[_SM_KW] = False
+    return _shard_map(*args, **kw)
+
+from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
+                     _soft_rows)
+from .problem import DeviceProblem
+
+__all__ = ["anneal_sharded", "shard_problem", "SVC_AXIS"]
+
+SVC_AXIS = "svc"
+
+
+def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
+    """Pre-place the service-axis tensors over the mesh (S must divide
+    evenly) and replicate the node-axis tensors, so repeated anneal_sharded
+    calls on one problem skip the implicit reshard."""
+    import dataclasses
+
+    svc2 = NamedSharding(mesh, P(SVC_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    return dataclasses.replace(
+        prob,
+        demand=jax.device_put(prob.demand, svc2),
+        conflict_ids=jax.device_put(prob.conflict_ids, svc2),
+        coloc_ids=jax.device_put(prob.coloc_ids, svc2),
+        eligible=jax.device_put(prob.eligible, svc2),
+        preferred=jax.device_put(prob.preferred, svc2),
+        capacity=jax.device_put(prob.capacity, rep),
+        node_valid=jax.device_put(prob.node_valid, rep),
+        node_topology=jax.device_put(prob.node_topology, rep),
+    )
+
+
+@partial(jax.jit, static_argnames=("steps", "proposals_per_step", "mesh"))
+def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
+                   key: jax.Array, steps: int = 64,
+                   t0: float = 1.0, t1: float = 1e-3,
+                   proposals_per_step: Optional[int] = None,
+                   *, mesh: Mesh) -> jax.Array:
+    """One annealing chain with the service axis sharded over `mesh`.
+
+    init_assignment: (S,) int32 (replicated input; resharded internally).
+    Returns the refined (S,) assignment. S must be divisible by the mesh
+    size (pad upstream)."""
+    D = mesh.shape[SVC_AXIS]
+    S, N = prob.S, prob.N
+    R = prob.demand.shape[1]
+    Gc = max(prob.Gc, 1)
+    T = prob.T
+    assert S % D == 0, f"S={S} must divide over {D} devices (pad upstream)"
+    M = proposals_per_step or max(8, min(256, (S // D) // 2))
+    decay = (t1 / t0) ** (1.0 / max(steps - 1, 1))
+
+    def body(demand, conflict_ids, coloc_ids, eligible, preferred,
+             capacity, node_valid, node_topology, assign, key):
+        # shapes inside: demand (S/D, R), assign (S/D,), key replicated;
+        # axis_index distinguishes the shard
+        me = jax.lax.axis_index(SVC_AXIS)
+        S_loc = assign.shape[0]
+
+        # replicated node state built from ALL shards' assignments
+        def build_state(assign):
+            load = jnp.zeros((N, R), jnp.float32).at[assign].add(demand)
+            cvalid = conflict_ids >= 0
+            csafe = jnp.where(cvalid, conflict_ids, 0)
+            used = jnp.zeros((N, prob.G), jnp.int32).at[
+                jnp.broadcast_to(assign[:, None], csafe.shape), csafe].add(
+                    cvalid.astype(jnp.int32))
+            lvalid = coloc_ids >= 0
+            lsafe = jnp.where(lvalid, coloc_ids, 0)
+            coloc = jnp.zeros((N, Gc), jnp.int32).at[
+                jnp.broadcast_to(assign[:, None], lsafe.shape), lsafe].add(
+                    lvalid.astype(jnp.int32))
+            topo = jnp.zeros((T,), jnp.int32).at[node_topology[assign]].add(1)
+            return tuple(jax.lax.psum(x, SVC_AXIS)
+                         for x in (load, used, coloc, topo))
+
+        load0, used0, coloc0, topo0 = build_state(assign)
+
+        def proposal_delta(load, used, coloc, topo, assign, s, b):
+            """anneal._proposal_delta term for term, on shard-local gathers
+            against the replicated node state."""
+            a = assign[s]
+            d = demand[s]
+            ids = conflict_ids[s]
+            valid = ids >= 0
+            safe = jnp.where(valid, ids, 0)
+            cids = coloc_ids[s]
+            lvalid = cids >= 0
+            lsafe = jnp.where(lvalid, cids, 0)
+
+            cap_a, cap_b = capacity[a], capacity[b]
+            load_a, load_b = load[a], load[b]
+
+            load_a2, load_b2 = load_a - d, load_b + d
+            d_cap = (_overflow_mass(prob, load_a2, cap_a)
+                     + _overflow_mass(prob, load_b2, cap_b)
+                     - _overflow_mass(prob, load_a, cap_a)
+                     - _overflow_mass(prob, load_b, cap_b)) * W_CAP
+
+            conf_a = ((used[a, safe] - 1) * valid).sum()
+            conf_b = (used[b, safe] * valid).sum()
+            d_conf = (conf_b - conf_a).astype(jnp.float32) * W_CONF
+
+            elig_a = eligible[s, a] & node_valid[a]
+            elig_b = eligible[s, b] & node_valid[b]
+            d_elig = (elig_a.astype(jnp.float32)
+                      - elig_b.astype(jnp.float32)) * W_ELIG
+
+            ta, tb = node_topology[a], node_topology[b]
+            topo2 = topo.at[ta].add(-1).at[tb].add(1)
+            d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, topo)
+
+            soft_before = _soft_rows(prob, jnp.stack([load_a, load_b]),
+                                     jnp.stack([cap_a, cap_b]))
+            soft_after = _soft_rows(prob, jnp.stack([load_a2, load_b2]),
+                                    jnp.stack([cap_a, cap_b]))
+            d_pref = (preferred[s, a] - preferred[s, b]) / S
+            col_a = ((coloc[a, lsafe] - 1) * lvalid).sum()
+            col_b = (coloc[b, lsafe] * lvalid).sum()
+            d_coloc = (col_a - col_b).astype(jnp.float32) / max(S, 1)
+
+            return (d_cap + d_conf + d_elig + d_skew
+                    + (soft_after - soft_before) + d_pref + d_coloc)
+
+        def sweep(carry, i):
+            assign, load, used, coloc, topo, key = carry
+            temp = t0 * decay ** i.astype(jnp.float32)
+            key = jax.random.fold_in(key, i)
+            kk = jax.random.fold_in(key, me)   # decorrelate shards
+            ks, kb, ka, kt = jax.random.split(kk, 4)
+
+            # targeted half: this shard's services on violating/invalid nodes
+            over_node = (load > capacity * (1 + 1e-6)).any(-1)
+            conf_node = ((used * (used - 1)).sum(-1) > 0)
+            hot_node = over_node | conf_node
+            svc_bad = (~eligible[jnp.arange(S_loc), assign]
+                       | ~node_valid[assign])
+            hot = hot_node[assign] | svc_bad
+            logits = jnp.where(hot, 0.0, -30.0)
+            s_tgt = jax.random.categorical(kt, logits, shape=(M,))
+            s_uni = jax.random.randint(ks, (M,), 0, S_loc)
+            half = M // 2
+            s_idx = jnp.where(jnp.arange(M) < half, s_tgt, s_uni)
+            b_idx = jax.random.randint(kb, (M,), 0, N)
+            a_idx = assign[s_idx]
+
+            delta = jax.vmap(lambda s, b: proposal_delta(
+                load, used, coloc, topo, assign, s, b))(s_idx, b_idx)
+            u = jax.random.uniform(ka, (M,))
+            accept = ((delta < 0)
+                      | (u < jnp.exp(-delta / jnp.maximum(temp, 1e-8)))) \
+                & (a_idx != b_idx)
+
+            order = jnp.arange(M, dtype=jnp.int32)
+            winner = jnp.full((S_loc,), M, dtype=jnp.int32).at[s_idx].min(
+                jnp.where(accept, order, M))
+            cand = accept & (winner[s_idx] == order)
+
+            # -- global winner-per-target-node election (collective #1) ----
+            # rank = order + M * my_shard_index  (unique across the mesh)
+            rank = jnp.where(cand, order + M * me, M * D)
+            node_best = jnp.full((N,), M * D, jnp.int32).at[b_idx].min(rank)
+            node_best = jax.lax.pmin(node_best, SVC_AXIS)
+            applied = cand & (node_best[b_idx] == rank)
+
+            w = applied.astype(jnp.float32)
+            wi = applied.astype(jnp.int32)
+            d = demand[s_idx]
+            ids = conflict_ids[s_idx]
+            vv = (ids >= 0).astype(jnp.int32) * wi[:, None]
+            safe = jnp.where(ids >= 0, ids, 0)
+            cids = coloc_ids[s_idx]
+            lv = (cids >= 0).astype(jnp.int32) * wi[:, None]
+            lsafe = jnp.where(cids >= 0, cids, 0)
+
+            # -- replicated state update via psum of deltas (collective #2)
+            dload = (jnp.zeros((N, R), jnp.float32)
+                     .at[a_idx].add(-d * w[:, None])
+                     .at[b_idx].add(d * w[:, None]))
+            load = load + jax.lax.psum(dload, SVC_AXIS)
+            a_rows = jnp.broadcast_to(a_idx[:, None], safe.shape)
+            b_rows = jnp.broadcast_to(b_idx[:, None], safe.shape)
+            dused = (jnp.zeros((N, prob.G), jnp.int32)
+                     .at[a_rows, safe].add(-vv)
+                     .at[b_rows, safe].add(vv))
+            used = used + jax.lax.psum(dused, SVC_AXIS)
+            al_rows = jnp.broadcast_to(a_idx[:, None], lsafe.shape)
+            bl_rows = jnp.broadcast_to(b_idx[:, None], lsafe.shape)
+            dcoloc = (jnp.zeros((N, Gc), jnp.int32)
+                      .at[al_rows, lsafe].add(-lv)
+                      .at[bl_rows, lsafe].add(lv))
+            coloc = coloc + jax.lax.psum(dcoloc, SVC_AXIS)
+            dtopo = (jnp.zeros((T,), jnp.int32)
+                     .at[node_topology[a_idx]].add(-wi)
+                     .at[node_topology[b_idx]].add(wi))
+            topo = topo + jax.lax.psum(dtopo, SVC_AXIS)
+
+            # local assignment update (dump-row trick for losers)
+            tgt = jnp.where(applied, s_idx, S_loc)
+            assign = jnp.zeros((S_loc + 1,), jnp.int32).at[:S_loc].set(
+                assign).at[tgt].set(b_idx.astype(jnp.int32))[:S_loc]
+            return (assign, load, used, coloc, topo, key), None
+
+        (assign, *_), _ = jax.lax.scan(
+            sweep, (assign, load0, used0, coloc0, topo0, key),
+            jnp.arange(steps, dtype=jnp.int32))
+        return assign
+
+    sharded = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None), P(SVC_AXIS, None),
+                  P(SVC_AXIS, None), P(SVC_AXIS, None),
+                  P(), P(), P(), P(SVC_AXIS), P()),
+        out_specs=P(SVC_AXIS))
+    return sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
+                   prob.eligible, prob.preferred, prob.capacity,
+                   prob.node_valid, prob.node_topology,
+                   init_assignment.astype(jnp.int32), key)
