@@ -52,11 +52,32 @@ total = jax.jit(lambda x: x.sum(), out_shardings=None)(arr)
 expect = sum(range(1, 5)) * 8.0
 assert float(total) == expect, (float(total), expect)
 
+# the real solver across processes: a tiny service-axis sharded anneal
+# whose pmin/psum collectives now ride the inter-process transport
+from fleetflow_tpu.lower import synthetic_problem
+from fleetflow_tpu.solver import prepare_problem
+from fleetflow_tpu.solver.repair import verify
+from fleetflow_tpu.solver.sharded import SVC_AXIS, anneal_sharded
+from jax.sharding import Mesh
+import numpy as np
+
+pt = synthetic_problem(32, 8, seed=5)
+prob = prepare_problem(pt)
+svc_mesh = Mesh(np.array(jax.devices()), (SVC_AXIS,))
+refined = anneal_sharded(prob, jnp.zeros((pt.S,), jnp.int32),
+                         jax.random.PRNGKey(0), steps=200, mesh=svc_mesh)
+# gather the sharded result to every host for the exact check
+from jax.experimental import multihost_utils
+host_assign = np.asarray(
+    multihost_utils.process_allgather(refined, tiled=True)).reshape(-1)[:pt.S]
+stats_total = int(verify(pt, host_assign)["total"])
+
 if jax.process_index() == 0:
     print("MULTIHOST_OK " + json.dumps({
         "total": float(total),
         "processes": info["process_count"],
         "global_devices": info["global_devices"],
+        "sharded_anneal_violations": stats_total,
     }), flush=True)
 """
 
@@ -105,3 +126,4 @@ def test_two_process_chain_mesh(tmp_path):
     res = json.loads(marker[0][len("MULTIHOST_OK "):])
     assert res["processes"] == 2
     assert res["global_devices"] == 4
+    assert res["sharded_anneal_violations"] == 0, res
