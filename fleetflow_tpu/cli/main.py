@@ -712,6 +712,30 @@ def _cp_dispatch(cp: CpClient, args) -> int:
         if args.verb == "adopt":
             return show(cp.request("stage", "adopt",
                                    {"stage": _need(args.name, "stage id")}))
+    if sub == "remote":
+        # SSH remote-exec deploys for agent-less servers (reference
+        # RemoteCommands: deploy + history)
+        if args.verb == "deploy":
+            payload = {
+                "server": _need(args.server, "--server"),
+                "path": _need(args.path, "--path"),
+                "stage": _need(args.stage_name, "--stage"),
+                "tenant": args.tenant or "default",
+                "ssh_user": args.ssh_user,
+            }
+            if args.project:   # else the handler defaults to the path
+                payload["project"] = args.project
+            out = cp.request("deploy", "run", payload, timeout=600)
+            dep = out["deployment"]
+            print(f"deployment {dep['id']}: {dep['status']}")
+            return 0 if dep["status"] == "succeeded" else 1
+        if args.verb == "history":
+            rows = cp.request("deploy", "history",
+                              {"limit": args.limit})["deployments"]
+            for d in rows:
+                print(f"  {d['id']:<28} {d['status']:<10} "
+                      f"{', '.join(d.get('services') or [])}")
+            return 0
     if sub == "registry":
         return _cmd_cp_registry(cp, args)
     print(f"unknown cp command {sub!r}", file=sys.stderr)
@@ -953,6 +977,16 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--ref", default="main")
     q.add_argument("--push", action="store_true")
     q.add_argument("name", nargs="?")
+
+    q = cps.add_parser("remote")
+    q.add_argument("verb", choices=["deploy", "history"])
+    q.add_argument("--server")
+    q.add_argument("--path", help="project path on the remote server")
+    q.add_argument("--stage", dest="stage_name")
+    q.add_argument("--project")
+    q.add_argument("--tenant")
+    q.add_argument("--ssh-user")
+    q.add_argument("--limit", type=int, default=20)
 
     q = cps.add_parser("registry")
     q.add_argument("verb", choices=["list", "status", "solve", "sync",
