@@ -6,7 +6,7 @@ mesh-sharded simulated annealing over dense constraint tensors.
 """
 
 from .anneal import anneal, chain_states_from_assignment
-from .sharded import SVC_AXIS, anneal_sharded, shard_problem
+from .sharded import SVC_AXIS, anneal_sharded, pad_problem, shard_problem
 from .api import CHAIN_AXIS, SolveResult, make_chain_inits, solve
 from .greedy import greedy_place, greedy_place_batched, placement_order
 from .kernels import (node_loads, soft_score, total_cost, total_violations,

@@ -36,6 +36,7 @@ implicit reshard.
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Optional
 
@@ -43,12 +44,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-import inspect
-
 try:
     from jax import shard_map as _shard_map          # jax >= 0.8
 except ImportError:                                  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
+
+from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
+                     _soft_rows)
+from .problem import DeviceProblem
 
 # the replication-check kwarg was renamed across jax versions
 _SM_KW = ("check_rep" if "check_rep" in inspect.signature(_shard_map).parameters
@@ -61,13 +64,38 @@ def shard_map(*args, **kw):
         kw[_SM_KW] = False
     return _shard_map(*args, **kw)
 
-from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
-                     _soft_rows)
-from .problem import DeviceProblem
-
-__all__ = ["anneal_sharded", "shard_problem", "SVC_AXIS"]
+__all__ = ["anneal_sharded", "pad_problem", "shard_problem", "SVC_AXIS"]
 
 SVC_AXIS = "svc"
+
+
+def pad_problem(prob: DeviceProblem, multiple: int
+                ) -> tuple[DeviceProblem, int]:
+    """Pad the service axis up to a multiple of `multiple` with phantom
+    services (zero demand, no conflict/coloc ids, eligible everywhere, zero
+    preference): they sit wherever the annealer leaves them without
+    touching any constraint or score. Returns (padded problem, original S)
+    — slice the returned assignment back to [:orig_S]."""
+    import dataclasses
+
+    S = prob.S
+    pad = (-S) % multiple
+    if pad == 0:
+        return prob, S
+
+    def pad_rows(a, fill):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=fill)
+
+    return dataclasses.replace(
+        prob,
+        demand=pad_rows(prob.demand, 0.0),
+        conflict_ids=pad_rows(prob.conflict_ids, -1),
+        coloc_ids=pad_rows(prob.coloc_ids, -1),
+        eligible=pad_rows(prob.eligible, True),
+        preferred=pad_rows(prob.preferred, 0.0),
+        S=S + pad,
+    ), S
 
 
 def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
@@ -101,13 +129,14 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
 
     init_assignment: (S,) int32 (replicated input; resharded internally).
     Returns the refined (S,) assignment. S must be divisible by the mesh
-    size (pad upstream)."""
+    size (pad_problem handles ragged S)."""
     D = mesh.shape[SVC_AXIS]
     S, N = prob.S, prob.N
     R = prob.demand.shape[1]
     Gc = max(prob.Gc, 1)
     T = prob.T
-    assert S % D == 0, f"S={S} must divide over {D} devices (pad upstream)"
+    assert S % D == 0, (f"S={S} must divide over {D} devices "
+                        f"(use pad_problem first)")
     M = proposals_per_step or max(8, min(256, (S // D) // 2))
     decay = (t1 / t0) ** (1.0 / max(steps - 1, 1))
 

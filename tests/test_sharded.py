@@ -99,3 +99,27 @@ class TestShardedParity:
         stats = verify(pt, out)
         assert stats["skew"] == 0, stats
         assert stats["total"] == 0, stats
+
+
+class TestPadding:
+    def test_ragged_s_pads_and_solves(self):
+        """S=100 on 8 devices: pad_problem adds 4 phantom services that
+        cannot affect feasibility; the real prefix verifies exactly."""
+        from fleetflow_tpu.solver.sharded import pad_problem
+        pt = synthetic_problem(100, 10, seed=9)
+        prob = prepare_problem(pt)
+        padded, orig_s = pad_problem(prob, 8)
+        assert padded.S == 104 and orig_s == 100
+        mesh = _mesh()
+        out = np.asarray(anneal_sharded(padded,
+                                        jnp.zeros((padded.S,), jnp.int32),
+                                        jax.random.PRNGKey(5), steps=500,
+                                        mesh=mesh))[:orig_s]
+        assert verify(pt, out)["total"] == 0
+
+    def test_no_pad_needed_is_identity(self):
+        from fleetflow_tpu.solver.sharded import pad_problem
+        pt = synthetic_problem(64, 8, seed=9)
+        prob = prepare_problem(pt)
+        padded, orig_s = pad_problem(prob, 8)
+        assert padded is prob and orig_s == 64
