@@ -18,7 +18,7 @@ import sys
 from typing import Optional
 
 from ..core.errors import (CloudError, ConfigNotFound, ControlPlaneError,
-                           FlowError)
+                           FlowError, SolverError)
 from ..core.loader import load_project
 from ..core.model import Backend, Flow
 from ..lower.tensors import lower_stage
@@ -404,7 +404,7 @@ def cmd_validate(args) -> int:
                 issues.append(stage_name)
             print(f"  stage {stage_name}: {pt.S} services, {pt.N} nodes, "
                   f"{status}")
-        except FlowError as e:
+        except (FlowError, SolverError) as e:
             issues.append(stage_name)
             print(f"  stage {stage_name}: ERROR {e}")
     print("config valid" if not issues else
