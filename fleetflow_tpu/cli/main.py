@@ -1003,7 +1003,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)
-    except (FlowError, ControlPlaneError, ValueError) as e:
+    except (FlowError, ControlPlaneError, SolverError, ValueError) as e:
         # FlowError covers config/runtime; ControlPlaneError covers RpcError
         # (unreachable CP); ValueError covers bad service/verb arguments
         print(f"error: {e}", file=sys.stderr)

@@ -96,6 +96,25 @@ def state_violation_stats(prob: DeviceProblem, st: ChainState) -> dict:
     }
 
 
+def violation_total_from_parts(prob: DeviceProblem, load: jax.Array,
+                               used: jax.Array, topo: jax.Array,
+                               inelig_count: jax.Array) -> jax.Array:
+    """Total hard violations from node-state components + a precomputed
+    ineligibility count. Shared by the carried-state stats above and the
+    sharded adaptive exit (which psums its shard-local inelig counts) so
+    the feasibility definition cannot drift between them."""
+    cap_cells = (load > prob.capacity * (1 + 1e-6)).sum().astype(jnp.float32)
+    c = used.astype(jnp.float32)
+    conflict_pairs = (c * (c - 1.0) / 2.0).sum()
+    if prob.max_skew > 0:
+        skew = jnp.maximum(
+            (topo.max() - topo.min()) - prob.max_skew, 0).astype(jnp.float32)
+    else:
+        skew = jnp.float32(0.0)
+    return (cap_cells + conflict_pairs + skew
+            + inelig_count.astype(jnp.float32))
+
+
 def state_soft_score(prob: DeviceProblem, st: ChainState) -> jax.Array:
     """kernels.soft_score evaluated from the carried state (same formulas,
     no group_counts rebuild). Pass the ORIGINAL problem to report without a

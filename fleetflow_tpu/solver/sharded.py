@@ -50,7 +50,7 @@ except ImportError:                                  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
-                     _soft_rows)
+                     _soft_rows, violation_total_from_parts)
 from .problem import DeviceProblem
 
 # the replication-check kwarg was renamed across jax versions
@@ -119,17 +119,30 @@ def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
     )
 
 
-@partial(jax.jit, static_argnames=("steps", "proposals_per_step", "mesh"))
+@partial(jax.jit, static_argnames=("steps", "proposals_per_step", "mesh",
+                                   "adaptive", "block", "n_real"))
 def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                    key: jax.Array, steps: int = 64,
                    t0: float = 1.0, t1: float = 1e-3,
                    proposals_per_step: Optional[int] = None,
-                   *, mesh: Mesh) -> jax.Array:
+                   *, mesh: Mesh, adaptive: bool = False,
+                   block: int = 16,
+                   n_real: Optional[int] = None) -> jax.Array:
     """One annealing chain with the service axis sharded over `mesh`.
 
     init_assignment: (S,) int32 (replicated input; resharded internally).
     Returns the refined (S,) assignment. S must be divisible by the mesh
-    size (pad_problem handles ragged S)."""
+    size (pad_problem handles ragged S).
+
+    `adaptive=True` runs in `block`-sweep chunks inside a lax.while_loop
+    and exits as soon as the placement is exactly feasible (same contract
+    as anneal.anneal_adaptive). The check is nearly free: load/used/topo
+    are replicated so capacity/conflict/skew violations are local math;
+    only the eligibility count needs one scalar psum per block.
+
+    `n_real` (static) marks rows >= n_real as pad_problem phantoms: they
+    are excluded from topology counts, skew deltas, and the feasibility
+    check, so padding cannot distort a spread constraint."""
     D = mesh.shape[SVC_AXIS]
     S, N = prob.S, prob.N
     R = prob.demand.shape[1]
@@ -138,6 +151,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
     assert S % D == 0, (f"S={S} must divide over {D} devices "
                         f"(use pad_problem first)")
     M = proposals_per_step or max(8, min(256, (S // D) // 2))
+    real_s = S if n_real is None else n_real
     decay = (t1 / t0) ** (1.0 / max(steps - 1, 1))
 
     def body(demand, conflict_ids, coloc_ids, eligible, preferred,
@@ -146,6 +160,10 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
         # axis_index distinguishes the shard
         me = jax.lax.axis_index(SVC_AXIS)
         S_loc = assign.shape[0]
+        # pad_problem phantoms (global row >= real_s) carry no topology
+        # weight: a parked phantom must not relax or tighten a spread
+        # constraint for the real services
+        real = (me * S_loc + jnp.arange(S_loc)) < real_s
 
         # replicated node state built from ALL shards' assignments
         def build_state(assign):
@@ -160,7 +178,8 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             coloc = jnp.zeros((N, Gc), jnp.int32).at[
                 jnp.broadcast_to(assign[:, None], lsafe.shape), lsafe].add(
                     lvalid.astype(jnp.int32))
-            topo = jnp.zeros((T,), jnp.int32).at[node_topology[assign]].add(1)
+            topo = jnp.zeros((T,), jnp.int32).at[node_topology[assign]].add(
+                real.astype(jnp.int32))
             return tuple(jax.lax.psum(x, SVC_AXIS)
                          for x in (load, used, coloc, topo))
 
@@ -197,7 +216,8 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                       - elig_b.astype(jnp.float32)) * W_ELIG
 
             ta, tb = node_topology[a], node_topology[b]
-            topo2 = topo.at[ta].add(-1).at[tb].add(1)
+            r = real[s].astype(jnp.int32)
+            topo2 = topo.at[ta].add(-r).at[tb].add(r)
             d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, topo)
 
             soft_before = _soft_rows(prob, jnp.stack([load_a, load_b]),
@@ -280,9 +300,10 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                       .at[al_rows, lsafe].add(-lv)
                       .at[bl_rows, lsafe].add(lv))
             coloc = coloc + jax.lax.psum(dcoloc, SVC_AXIS)
+            wr = wi * real[s_idx].astype(jnp.int32)
             dtopo = (jnp.zeros((T,), jnp.int32)
-                     .at[node_topology[a_idx]].add(-wi)
-                     .at[node_topology[b_idx]].add(wi))
+                     .at[node_topology[a_idx]].add(-wr)
+                     .at[node_topology[b_idx]].add(wr))
             topo = topo + jax.lax.psum(dtopo, SVC_AXIS)
 
             # local assignment update (dump-row trick for losers)
@@ -291,9 +312,40 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                 assign).at[tgt].set(b_idx.astype(jnp.int32))[:S_loc]
             return (assign, load, used, coloc, topo, key), None
 
-        (assign, *_), _ = jax.lax.scan(
-            sweep, (assign, load0, used0, coloc0, topo0, key),
-            jnp.arange(steps, dtype=jnp.int32))
+        def feasible(assign, load, used, topo):
+            # eligibility is shard-local: one scalar psum (phantoms are
+            # eligible everywhere so the mask is belt-and-braces)
+            inel = ((~eligible[jnp.arange(S_loc), assign]
+                     | ~node_valid[assign]) & real).sum()
+            inel = jax.lax.psum(inel, SVC_AXIS)
+            return violation_total_from_parts(prob, load, used, topo,
+                                              inel) == 0
+
+        if not adaptive:
+            (assign, *_), _ = jax.lax.scan(
+                sweep, (assign, load0, used0, coloc0, topo0, key),
+                jnp.arange(steps, dtype=jnp.int32))
+            return assign
+
+        n_blocks = -(-steps // block)
+
+        def cond(carry):
+            _assign, _l, _u, _c, _t, _k, b, done = carry
+            return (~done) & (b < n_blocks)
+
+        def blk(carry):
+            assign, load, used, coloc, topo, key, b, _done = carry
+            offsets = b * block + jnp.arange(block, dtype=jnp.int32)
+            offsets = jnp.minimum(offsets, steps - 1)   # clamp temp schedule
+            (assign, load, used, coloc, topo, key), _ = jax.lax.scan(
+                sweep, (assign, load, used, coloc, topo, key), offsets)
+            return (assign, load, used, coloc, topo, key, b + 1,
+                    feasible(assign, load, used, topo))
+
+        assign, *_ = jax.lax.while_loop(
+            cond, blk,
+            (assign, load0, used0, coloc0, topo0, key,
+             jnp.int32(0), jnp.bool_(False)))
         return assign
 
     sharded = shard_map(

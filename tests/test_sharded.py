@@ -114,8 +114,28 @@ class TestPadding:
         out = np.asarray(anneal_sharded(padded,
                                         jnp.zeros((padded.S,), jnp.int32),
                                         jax.random.PRNGKey(5), steps=500,
-                                        mesh=mesh))[:orig_s]
+                                        mesh=mesh, n_real=orig_s))[:orig_s]
         assert verify(pt, out)["total"] == 0
+
+    def test_padded_adaptive_respects_skew_of_real_services(self):
+        """Phantoms carry no topology weight: an adaptive padded run must
+        not exit 'feasible' while the REAL services violate max_skew."""
+        import dataclasses
+        from fleetflow_tpu.solver.sharded import pad_problem
+        pt = synthetic_problem(100, 10, seed=12)
+        pt = dataclasses.replace(
+            pt, node_topology=np.arange(10, dtype=np.int32) % 2,
+            max_skew=20)
+        prob = prepare_problem(pt)
+        padded, orig_s = pad_problem(prob, 8)
+        mesh = _mesh()
+        out = np.asarray(anneal_sharded(
+            padded, jnp.zeros((padded.S,), jnp.int32),
+            jax.random.PRNGKey(8), steps=600, mesh=mesh,
+            adaptive=True, block=50, n_real=orig_s))[:orig_s]
+        stats = verify(pt, out)
+        assert stats["skew"] == 0, stats
+        assert stats["total"] == 0, stats
 
     def test_no_pad_needed_is_identity(self):
         from fleetflow_tpu.solver.sharded import pad_problem
@@ -123,3 +143,27 @@ class TestPadding:
         prob = prepare_problem(pt)
         padded, orig_s = pad_problem(prob, 8)
         assert padded is prob and orig_s == 64
+
+
+class TestShardedAdaptive:
+    def test_adaptive_reaches_feasibility(self):
+        pt = synthetic_problem(128, 16, seed=10)
+        prob = prepare_problem(pt)
+        mesh = _mesh()
+        out = np.asarray(anneal_sharded(
+            prob, jnp.zeros((pt.S,), jnp.int32), jax.random.PRNGKey(6),
+            steps=600, mesh=mesh, adaptive=True, block=50))
+        assert verify(pt, out)["total"] == 0
+
+    def test_adaptive_matches_fixed_contract(self):
+        pt = synthetic_problem(64, 8, seed=11)
+        prob = prepare_problem(pt)
+        mesh = _mesh()
+        fixed = np.asarray(anneal_sharded(
+            prob, jnp.zeros((pt.S,), jnp.int32), jax.random.PRNGKey(7),
+            steps=400, mesh=mesh))
+        adapt = np.asarray(anneal_sharded(
+            prob, jnp.zeros((pt.S,), jnp.int32), jax.random.PRNGKey(7),
+            steps=400, mesh=mesh, adaptive=True, block=50))
+        assert verify(pt, fixed)["total"] == 0
+        assert verify(pt, adapt)["total"] == 0
