@@ -280,3 +280,74 @@ class TestAgentSession:
             finally:
                 agent_mod.RECONNECT_BACKOFF_S = old
         run(go())
+
+
+class TestAgentBuild:
+    """_run_build: git clone -> docker build -> optional push, with paths
+    confined to the fresh clone (agent.rs:476-649). Docker is faked with a
+    PATH shim; git is real."""
+
+    def _agent(self, tmp_path):
+        from fleetflow_tpu.agent import Agent, AgentConfig
+        from fleetflow_tpu.runtime.backend import MockBackend
+        cfg = AgentConfig(slug="builder",
+                          deploy_base=str(tmp_path / "deploys"))
+        return Agent(cfg, backend=MockBackend(auto_pull=True))
+
+    def _repo(self, tmp_path):
+        import subprocess
+        repo = tmp_path / "src"
+        repo.mkdir()
+        (repo / "Dockerfile").write_text("FROM scratch\n")
+        (repo / "app.txt").write_text("hello\n")
+        for cmd in (["git", "init", "-q", "-b", "main"],
+                    ["git", "add", "."],
+                    ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                     "commit", "-q", "-m", "init"]):
+            subprocess.run(cmd, cwd=repo, check=True, capture_output=True)
+        return str(repo)
+
+    def _fake_docker(self, tmp_path, monkeypatch, rc=0):
+        import os
+        bindir = tmp_path / "bin"
+        bindir.mkdir(exist_ok=True)
+        log = tmp_path / "docker.log"
+        sh = bindir / "docker"
+        sh.write_text(f"#!/bin/sh\necho \"$@\" >> {log}\n"
+                      f"echo built-layer-ok\nexit {rc}\n")
+        sh.chmod(0o755)
+        monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+        return log
+
+    def test_build_clone_and_docker_invocation(self, tmp_path, monkeypatch):
+        import asyncio
+        log = self._fake_docker(tmp_path, monkeypatch)
+        agent = self._agent(tmp_path)
+        out = asyncio.run(agent.execute_command("build", {
+            "repo": self._repo(tmp_path), "image_tag": "acme/app:1",
+            "push": True}))
+        assert out["image"] == "acme/app:1"
+        assert "built-layer-ok" in out["log"]
+        calls = log.read_text().splitlines()
+        assert calls[0].startswith("build -t acme/app:1")
+        assert calls[1] == "push acme/app:1"
+        # workspace landed under deploy_base and was cleaned up
+        base = tmp_path / "deploys"
+        assert base.is_dir() and list(base.iterdir()) == []
+
+    def test_build_confines_context_to_clone(self, tmp_path, monkeypatch):
+        import asyncio
+        self._fake_docker(tmp_path, monkeypatch)
+        agent = self._agent(tmp_path)
+        with pytest.raises(Exception, match="escapes|confine|outside"):
+            asyncio.run(agent.execute_command("build", {
+                "repo": self._repo(tmp_path), "image_tag": "x:1",
+                "context": "../../etc"}))
+
+    def test_build_failure_surfaces_stderr(self, tmp_path, monkeypatch):
+        import asyncio
+        self._fake_docker(tmp_path, monkeypatch, rc=1)
+        agent = self._agent(tmp_path)
+        with pytest.raises(RuntimeError, match="docker build failed"):
+            asyncio.run(agent.execute_command("build", {
+                "repo": self._repo(tmp_path), "image_tag": "x:1"}))
