@@ -24,7 +24,7 @@ from ..core.model import Backend, Flow
 from ..lower.tensors import lower_stage
 from ..runtime.backend import DockerCliBackend, MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
-from ..sched import pick_scheduler
+from ..sched import pick_scheduler, place_with_fallback
 from .client import CpClient, CredentialStore, default_endpoint
 from .utils import determine_stage_name, filter_services, mask_env
 
@@ -397,9 +397,11 @@ def cmd_validate(args) -> int:
         try:
             pt = lower_stage(flow, stage_name)
             sched = pick_scheduler(pt.S, pt.N, prefer_tpu=False)
-            placement = sched.place(pt)
+            placement, relaxed = place_with_fallback(sched, pt)
             status = ("ok" if placement.feasible
                       else f"INFEASIBLE ({placement.violations} violations)")
+            if relaxed:
+                status += f" (relaxed: {', '.join(relaxed)})"
             if not placement.feasible:
                 issues.append(stage_name)
             print(f"  stage {stage_name}: {pt.S} services, {pt.N} nodes, "
@@ -418,7 +420,7 @@ def cmd_solve(args) -> int:
     stage_name = _stage(args)
     pt = lower_stage(flow, stage_name)
     sched = pick_scheduler(pt.S, pt.N, prefer_tpu=not args.host)
-    placement = sched.place(pt)
+    placement, _relaxed = place_with_fallback(sched, pt)
     print(f"solved {pt.S} services x {pt.N} nodes via {placement.source} "
           f"in {placement.solve_ms:.1f}ms "
           f"(feasible={placement.feasible}, "
@@ -764,9 +766,9 @@ def _cmd_cp_registry(cp: CpClient, args) -> int:
         return 0
     if args.verb == "solve":
         from ..registry import aggregate_fleets
-        from ..sched import pick_scheduler
+        from ..sched import pick_scheduler, place_with_fallback
         pt, index = aggregate_fleets(reg)
-        placement = pick_scheduler(pt.S, pt.N).place(pt)
+        placement, _ = place_with_fallback(pick_scheduler(pt.S, pt.N), pt)
         print(f"aggregate: {pt.S} services x {pt.N} nodes "
               f"feasible={placement.feasible} via {placement.source}")
         return 0 if placement.feasible else 1

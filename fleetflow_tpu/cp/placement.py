@@ -28,7 +28,8 @@ import numpy as np
 
 from ..core.model import Flow, ResourceSpec, ServerLabels, ServerResource
 from ..lower.tensors import ProblemTensors, lower_stage
-from ..sched import HostGreedyScheduler, Placement, TpuSolverScheduler
+from ..sched import (HostGreedyScheduler, Placement, TpuSolverScheduler,
+                     place_with_fallback)
 from .models import Server
 from .store import Store
 
@@ -128,8 +129,11 @@ class PlacementService:
                 warm = (prev is not None
                         and prev[0].S == pt.S and prev[0].N == pt.N)
                 placement = self._sched_tpu.place(pt, warm_start=warm)
+                if not placement.feasible and pt.relax_order:
+                    placement, _ = place_with_fallback(
+                        self._sched_tpu, pt, initial=placement)
             else:
-                placement = self._sched_host.place(pt)
+                placement, _ = place_with_fallback(self._sched_host, pt)
             self._last[key] = (pt, placement)
             rid = None
             if reserve and placement.feasible:
@@ -245,6 +249,11 @@ class PlacementService:
                     new = self._sched_tpu.reschedule(pt)
                 else:
                     new = self._sched_host.place(pt)
+                if not new.feasible and pt.relax_order:
+                    # a stage placed via declared relaxation must keep its
+                    # relaxation through churn re-solves
+                    sched = self._sched_tpu if self.use_tpu else self._sched_host
+                    new, _ = place_with_fallback(sched, pt, initial=new)
                 self._last[key] = (pt, new)
                 moved.append((key, new))
         return moved

@@ -42,6 +42,13 @@ __all__ = ["ProblemTensors", "lower_stage", "dependency_depths",
            "LOCAL_NODE_NAME", "synthetic_problem"]
 
 LOCAL_NODE_NAME = "local"
+
+# fallback-policy constraint-class aliases that relax the eligibility mask
+# (single source; sched/fallback.py imports these)
+ELIGIBILITY_RELAX_CLASSES = ("tier", "required_labels", "labels",
+                             "eligibility")
+SPREAD_RELAX_CLASSES = ("spread", "spread_constraint")
+PREF_RELAX_CLASSES = ("preferred_labels", "preferred")
 _R = len(ResourceSpec.axes())  # cpu, memory, disk
 
 
@@ -64,6 +71,9 @@ class ProblemTensors:
     max_skew: int = 0           # 0 = no spread constraint
     preferred: Optional[np.ndarray] = None  # (S, N) f32 soft preference, or None
     replica_of: list[str] = field(default_factory=list)  # base service per row
+    # constraint classes to relax, in order, when infeasible (stage
+    # placement fallback{}; reference model.rs:49 FallbackPolicy)
+    relax_order: list[str] = field(default_factory=list)
 
     @property
     def S(self) -> int:
@@ -243,10 +253,18 @@ def lower_stage(flow: Flow, stage_name: str,
         for i in range(S):
             eligible[i, j] = ok
             preferred[i, j] = pref
+    relax_order = list(policy.fallback_policy.relax_order) \
+        if policy and policy.fallback_policy else []
     if not eligible.any(axis=1).all():
-        bad = [row_names[i] for i in np.flatnonzero(~eligible.any(axis=1))[:5]]
-        raise SolverError(
-            f"services {bad} have no eligible node under the placement policy")
+        # with an eligibility-class fallback declared, the solve pipeline
+        # relaxes the mask instead of lowering failing outright
+        can_relax = any(w in ELIGIBILITY_RELAX_CLASSES for w in relax_order)
+        if not can_relax:
+            bad = [row_names[i]
+                   for i in np.flatnonzero(~eligible.any(axis=1))[:5]]
+            raise SolverError(
+                f"services {bad} have no eligible node under the placement "
+                f"policy (declare a fallback{{}} to relax)")
     node_valid = np.ones(N, dtype=bool)
 
     topo_key = (policy.spread_constraint.topology_key
@@ -278,6 +296,7 @@ def lower_stage(flow: Flow, stage_name: str,
         max_skew=(policy.spread_constraint.max_skew
                   if policy and policy.spread_constraint else 0),
         preferred=preferred if preferred.any() else None,
+        relax_order=relax_order,
         replica_of=replica_of,
     )
     pt.validate()

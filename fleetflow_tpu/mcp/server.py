@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 from ..core.errors import FlowError
 from ..core.loader import load_project
 from ..lower.tensors import lower_stage
-from ..sched import pick_scheduler
+from ..sched import pick_scheduler, place_with_fallback
 
 __all__ = ["FleetMcpServer", "serve_stdio"]
 
@@ -193,7 +193,8 @@ class FleetMcpServer:
         for stage_name in sorted(flow.stages):
             try:
                 pt = lower_stage(flow, stage_name)
-                pl = pick_scheduler(pt.S, pt.N, prefer_tpu=False).place(pt)
+                pl, _ = place_with_fallback(
+                    pick_scheduler(pt.S, pt.N, prefer_tpu=False), pt)
                 out[stage_name] = {"services": pt.S, "nodes": pt.N,
                                    "feasible": pl.feasible,
                                    "violations": pl.violations}
@@ -222,7 +223,8 @@ class FleetMcpServer:
                     host_only: bool = False) -> dict:
         flow = self._flow(stage)
         pt = lower_stage(flow, stage)
-        pl = pick_scheduler(pt.S, pt.N, prefer_tpu=not host_only).place(pt)
+        pl, _ = place_with_fallback(
+            pick_scheduler(pt.S, pt.N, prefer_tpu=not host_only), pt)
         return _text({"assignment": pl.assignment, "feasible": pl.feasible,
                       "violations": pl.violations, "source": pl.source,
                       "solve_ms": round(pl.solve_ms, 1)})

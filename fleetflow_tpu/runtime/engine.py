@@ -29,7 +29,8 @@ from ..core.model import Flow
 from ..core.serialize import flow_from_dict, flow_to_dict
 from ..obs import get_logger, kv, span
 from ..lower.tensors import LOCAL_NODE_NAME, lower_stage
-from ..sched import HostGreedyScheduler, Placement, Scheduler
+from ..sched import (HostGreedyScheduler, Placement, Scheduler,
+                     place_with_fallback)
 from .backend import BackendError, ContainerBackend
 from .converter import (container_name, network_name,
                         service_to_container_config, stage_services)
@@ -140,7 +141,7 @@ class DeployEngine:
         # ---- step 0: placement (replaces order_by_dependencies) ----------
         if placement is None:
             pt = lower_stage(flow, req.stage_name)
-            placement = self.scheduler.place(pt)
+            placement, _relaxed = place_with_fallback(self.scheduler, pt)
         emit(DeployEvent("place", message=(
             f"{len(placement.assignment)} rows -> "
             f"{len(set(placement.assignment.values()))} nodes "

@@ -16,11 +16,13 @@ dependency level schedule that replaces the reference's sequential ordering
 with concurrent per-level waves.
 """
 
+from .fallback import place_with_fallback, relax_problem
 from .base import Placement, Scheduler, level_schedule
 from .host import HostGreedyScheduler
 from .tpu import TpuSolverScheduler
 
 __all__ = ["Placement", "Scheduler", "level_schedule",
+           "place_with_fallback", "relax_problem",
            "HostGreedyScheduler", "TpuSolverScheduler", "pick_scheduler"]
 
 

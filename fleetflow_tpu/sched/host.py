@@ -55,10 +55,16 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
 
     for s in order:
         cands = np.flatnonzero(eligible[s] & valid)
+        # falling back to ineligible/invalid nodes places the service but
+        # IS a hard violation (kernels.violation_stats eligibility row) —
+        # report it so fallback-policy relaxation can kick in upstream
+        inelig = False
         if cands.size == 0:
             cands = np.flatnonzero(valid)
+            inelig = True
         if cands.size == 0:
             cands = np.arange(N)
+            inelig = True
         fits = []
         for n in cands:
             if np.any(load[n] + demand[s] > capacity[n]):
@@ -74,6 +80,8 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
                 n = min(fits)
             else:  # spread
                 n = fits[int(np.argmin(util))]
+            if inelig:
+                violations += 1
         else:
             # least-bad: minimize overflow on an eligible node
             over = (np.maximum(load[cands] + demand[s] - capacity[cands], 0)

@@ -1,0 +1,146 @@
+"""Fallback-policy relaxation tests (model.rs:49 FallbackPolicy semantics):
+infeasible placements retry with constraint classes relaxed in the declared
+order — preferences, spread, then eligibility — and the placement source
+records what was given up."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core.parser import parse_kdl_string
+from fleetflow_tpu.core.errors import SolverError
+from fleetflow_tpu.core.model import ResourceSpec, ServerLabels, ServerResource
+from fleetflow_tpu.lower import lower_stage, synthetic_problem
+from fleetflow_tpu.sched import (HostGreedyScheduler, place_with_fallback,
+                                 relax_problem)
+
+
+def _nodes(n=2, tier=None):
+    return [ServerResource(
+        name=f"n{i}", capacity=ResourceSpec(cpu=8, memory=16384, disk=99999),
+        labels=ServerLabels(tier=tier)) for i in range(n)]
+
+
+FLOW_TMPL = """
+project "fb"
+service "a" {{ image "x" }}
+service "b" {{ image "y" }}
+stage "live" {{
+    service "a"
+    service "b"
+    servers "n0" "n1"
+    placement {{
+        tier "premium"
+        {fallback}
+    }}
+}}
+"""
+
+
+class TestRelaxProblem:
+    def test_relax_classes(self):
+        pt = synthetic_problem(8, 4, seed=0)
+        pt = dataclasses.replace(pt, max_skew=2,
+                                 preferred=np.ones((8, 4), np.float32))
+        pt.eligible[:, 0] = False
+        assert relax_problem(pt, "preferred_labels").preferred is None
+        assert relax_problem(pt, "spread").max_skew == 0
+        assert relax_problem(pt, "tier").eligible.all()
+        # absent classes return None (nothing to retry)
+        bare = synthetic_problem(8, 4, seed=0)
+        assert relax_problem(bare, "spread") is None
+        assert relax_problem(bare, "preferred_labels") is None
+        assert relax_problem(dataclasses.replace(bare), "unknown-class") is None
+
+
+class TestLoweringWithFallback:
+    def test_no_eligible_node_without_fallback_raises(self):
+        flow = parse_kdl_string(FLOW_TMPL.format(fallback=""))
+        with pytest.raises(SolverError, match="no eligible node"):
+            lower_stage(flow, "live", nodes=_nodes(tier="standard"))
+
+    def test_eligibility_fallback_defers_to_solver(self):
+        flow = parse_kdl_string(FLOW_TMPL.format(fallback='fallback "tier"'))
+        pt = lower_stage(flow, "live", nodes=_nodes(tier="standard"))
+        assert pt.relax_order == ["tier"]
+        assert not pt.eligible.any()      # mask kept, not raised
+
+
+class TestPlaceWithFallback:
+    def test_tier_relaxation_recovers(self):
+        flow = parse_kdl_string(FLOW_TMPL.format(fallback='fallback "tier"'))
+        pt = lower_stage(flow, "live", nodes=_nodes(tier="standard"))
+        placement, relaxed = place_with_fallback(HostGreedyScheduler(), pt)
+        assert placement.feasible
+        assert relaxed == ["tier"]
+        assert "relaxed:tier" in placement.source
+
+    def test_order_is_respected_and_cumulative(self):
+        flow = parse_kdl_string(FLOW_TMPL.format(
+            fallback='fallback "preferred_labels" "spread" "tier"'))
+        pt = lower_stage(flow, "live", nodes=_nodes(tier="standard"))
+        pt = dataclasses.replace(pt, max_skew=1,
+                                 preferred=np.ones((pt.S, pt.N), np.float32))
+        placement, relaxed = place_with_fallback(HostGreedyScheduler(), pt)
+        assert placement.feasible
+        # preferences and spread were tried (and insufficient) before tier
+        assert relaxed == ["preferred_labels", "spread", "tier"]
+
+    def test_feasible_solve_relaxes_nothing(self):
+        pt = synthetic_problem(16, 4, seed=1)
+        pt = dataclasses.replace(pt, relax_order=["tier", "spread"])
+        placement, relaxed = place_with_fallback(HostGreedyScheduler(), pt)
+        assert placement.feasible and relaxed == []
+        assert "relaxed" not in placement.source
+
+    def test_physical_infeasibility_stays_infeasible(self):
+        """Capacity is never relaxed: an overloaded fleet reports honestly."""
+        pt = synthetic_problem(16, 2, seed=2)
+        pt = dataclasses.replace(pt, relax_order=["tier", "spread"],
+                                 capacity=pt.capacity * 0.01)
+        placement, relaxed = place_with_fallback(HostGreedyScheduler(), pt)
+        assert not placement.feasible
+
+
+class TestCpFallback:
+    def test_solve_stage_applies_fallback(self, tmp_path):
+        import asyncio
+
+        from fleetflow_tpu.cp import ServerConfig, start
+        from fleetflow_tpu.core.serialize import flow_to_dict
+        from fleetflow_tpu.cp.protocol import ProtocolClient
+        from fleetflow_tpu.runtime import MockBackend
+
+        async def go():
+            handle = await start(
+                ServerConfig(),
+                backend_factory=lambda: MockBackend(auto_pull=True))
+            # two standard-tier agents; the stage demands premium w/ fallback
+            agents = []
+            for slug in ("n0", "n1"):
+                c, _ = await ProtocolClient.connect(
+                    handle.host, handle.port, identity=slug)
+                await c.request("agent", "register", {
+                    "slug": slug, "version": "1",
+                    "capacity": {"cpu": 8, "memory": 16384, "disk": 99999}})
+                agents.append(c)
+            conn0, _ = await ProtocolClient.connect(
+                handle.host, handle.port, identity="admin")
+            for slug in ("n0", "n1"):
+                # standard tier: ineligible for the stage's premium demand
+                await conn0.request("server", "register", {
+                    "slug": slug, "labels": {"tier": "standard"}})
+            await conn0.close()
+            flow = parse_kdl_string(FLOW_TMPL.format(
+                fallback='fallback "tier"'))
+            conn, _ = await ProtocolClient.connect(
+                handle.host, handle.port, identity="cli")
+            out = await conn.request("placement", "solve", {
+                "flow": flow_to_dict(flow), "stage": "live"})
+            assert out["feasible"], out
+            assert "relaxed:tier" in out["source"]
+            for c in agents + [conn]:
+                await c.close()
+            await handle.stop()
+        asyncio.run(asyncio.wait_for(go(), 30))
