@@ -297,10 +297,11 @@ class PlacementStrategy(str, enum.Enum):
 
 @dataclass
 class ResourceQuota:
-    """Reference: model.rs:40."""
+    """Reference: model.rs:40 (cpu_cores/memory_gb + max_services)."""
     cpu: Optional[float] = None
     memory: Optional[float] = None
     disk: Optional[float] = None
+    max_services: Optional[int] = None
 
 
 @dataclass

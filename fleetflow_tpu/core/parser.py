@@ -345,6 +345,8 @@ def _parse_quota(node: KdlNode) -> ResourceQuota:
             q.memory = _mem_mb(c.arg(0))
         elif c.name == "disk":
             q.disk = _mem_mb(c.arg(0))
+        elif c.name in ("max-services", "max_services"):
+            q.max_services = int(c.arg(0))
     return q
 
 

@@ -230,6 +230,7 @@ def _policy_to_dict(p: PlacementPolicy) -> dict:
         _put(q, "cpu", p.resource_quota.cpu, None)
         _put(q, "memory", p.resource_quota.memory, None)
         _put(q, "disk", p.resource_quota.disk, None)
+        _put(q, "max_services", p.resource_quota.max_services, None)
         d["resource_quota"] = q
     if p.fallback_policy is not None:
         d["fallback_policy"] = {"relax_order": p.fallback_policy.relax_order}
@@ -245,7 +246,8 @@ def _policy_from_dict(d: dict) -> PlacementPolicy:
     if "resource_quota" in d:
         q = d["resource_quota"]
         quota = ResourceQuota(cpu=q.get("cpu"), memory=q.get("memory"),
-                              disk=q.get("disk"))
+                              disk=q.get("disk"),
+                              max_services=q.get("max_services"))
     fallback = None
     if "fallback_policy" in d:
         fallback = FallbackPolicy(relax_order=d["fallback_policy"].get(

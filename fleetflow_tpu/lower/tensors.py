@@ -253,6 +253,25 @@ def lower_stage(flow: Flow, stage_name: str,
         for i in range(S):
             eligible[i, j] = ok
             preferred[i, j] = pref
+    # quota enforcement (model.rs:40 ResourceQuota, FSC-26 Phase B-3): the
+    # stage's aggregate demand must fit the declared ceiling — a violated
+    # quota is a config error, reported at lowering with the excess named
+    if policy and policy.resource_quota:
+        q = policy.resource_quota
+        if q.max_services is not None and S > q.max_services:
+            raise SolverError(
+                f"stage exceeds quota: {S} service rows > "
+                f"max-services {q.max_services}")
+        # float64 sum + float32-epsilon slack: ten services of float32 cpu
+        # 0.1 must not "exceed" a quota of exactly 1
+        totals = demand.astype(np.float64).sum(axis=0)
+        for i, (name, cap_q) in enumerate(
+                (("cpu", q.cpu), ("memory", q.memory), ("disk", q.disk))):
+            if cap_q is not None and totals[i] > cap_q * (1 + 1e-6) + 1e-9:
+                raise SolverError(
+                    f"stage exceeds quota: total {name} demand "
+                    f"{totals[i]:g} > quota {cap_q:g}")
+
     relax_order = list(policy.fallback_policy.relax_order) \
         if policy and policy.fallback_policy else []
     if not eligible.any(axis=1).all():

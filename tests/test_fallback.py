@@ -144,3 +144,60 @@ class TestCpFallback:
                 await c.close()
             await handle.stop()
         asyncio.run(asyncio.wait_for(go(), 30))
+
+
+class TestQuota:
+    QF = """
+project "q"
+service "a" {{ image "x"; resources {{ cpu 2; memory 1024 }} }}
+service "b" {{ image "y"; resources {{ cpu 2; memory 1024 }} }}
+stage "live" {{
+    service "a"
+    service "b"
+    servers "n0" "n1"
+    placement {{ quota {{ {quota} }} }}
+}}
+"""
+
+    def test_cpu_quota_exceeded_raises(self):
+        flow = parse_kdl_string(self.QF.format(quota="cpu 3"))
+        with pytest.raises(SolverError, match="cpu demand 4 > quota 3"):
+            lower_stage(flow, "live", nodes=_nodes())
+
+    def test_max_services_quota(self):
+        flow = parse_kdl_string(self.QF.format(quota="max-services 1"))
+        with pytest.raises(SolverError, match="max-services 1"):
+            lower_stage(flow, "live", nodes=_nodes())
+
+    def test_within_quota_ok(self):
+        flow = parse_kdl_string(self.QF.format(
+            quota="cpu 4; memory 4096; max-services 2"))
+        pt = lower_stage(flow, "live", nodes=_nodes())
+        assert pt.S == 2
+
+
+    def test_quota_tolerates_float32_sums(self):
+        """Ten float32 0.1-cpu services sum to 1.0000001; quota cpu 1 must
+        not reject an exactly-met budget."""
+        services = "\n".join(
+            f'service "s{i}" {{ image "x"; resources {{ cpu 0.1 }} }}'
+            for i in range(10))
+        stanzas = "\n".join(f'    service "s{i}"' for i in range(10))
+        flow = parse_kdl_string(f"""
+project "q"
+{services}
+stage "live" {{
+{stanzas}
+    servers "n0" "n1"
+    placement {{ quota {{ cpu 1 }} }}
+}}
+""")
+        pt = lower_stage(flow, "live", nodes=_nodes())
+        assert pt.S == 10
+
+    def test_quota_survives_serialize_roundtrip(self):
+        from fleetflow_tpu.core.serialize import flow_from_dict, flow_to_dict
+        flow = parse_kdl_string(self.QF.format(quota="max-services 1"))
+        flow2 = flow_from_dict(flow_to_dict(flow))
+        with pytest.raises(SolverError, match="max-services 1"):
+            lower_stage(flow2, "live", nodes=_nodes())
