@@ -188,6 +188,13 @@ def cmd_up(args) -> int:
     if not res.ok:
         _stop_procs(dev_procs)
         return 1
+    # one-shot readiness report (up.rs:444-505): failures are reported,
+    # not fatal — the containers are up, the endpoint just isn't answering
+    from ..runtime.readiness import run_readiness_checks
+    wanted = set(target or stage.services)
+    run_readiness_checks(
+        [s for s in stage.resolved_services(flow) if s.name in wanted],
+        on_line=print)
     # keep the dev servers in the foreground alongside the containers
     return _wait_procs(dev_procs)
 

@@ -1,0 +1,128 @@
+"""One-shot readiness probe tests (up.rs:444-505 analog): port resolution,
+retry-until-deadline, HTTP status classes, and the non-fatal report."""
+
+from fleetflow_tpu.core.model import Port, ReadinessCheck, Service
+from fleetflow_tpu.runtime.readiness import (check_readiness,
+                                             run_readiness_checks)
+
+
+def _svc(name="api", port=18080, rc_port=None, timeout=6.0, interval=2.0):
+    return Service(name=name, image="x",
+                   ports=[Port(host=port, container=80)],
+                   readiness=ReadinessCheck(path="/health", port=rc_port,
+                                            timeout=timeout,
+                                            interval=interval))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class TestCheckReadiness:
+    def test_ready_on_first_probe(self):
+        clock = FakeClock()
+        res = check_readiness(_svc(), fetch=lambda u, t: 200,
+                              sleep=clock.sleep, clock=clock)
+        assert res.ready and res.attempts == 1
+        assert res.url == "http://127.0.0.1:18080/health"
+
+    def test_retries_until_success(self):
+        clock = FakeClock()
+        codes = iter([500, 503, 204])
+        res = check_readiness(_svc(), fetch=lambda u, t: next(codes),
+                              sleep=clock.sleep, clock=clock)
+        assert res.ready and res.attempts == 3
+
+    def test_deadline_exceeded_reports_detail(self):
+        clock = FakeClock()
+        res = check_readiness(_svc(timeout=4.0),
+                              fetch=lambda u, t: 503,
+                              sleep=clock.sleep, clock=clock)
+        assert not res.ready
+        assert res.detail == "HTTP 503"
+        assert res.attempts >= 2
+
+    def test_transport_errors_are_retried(self):
+        clock = FakeClock()
+        calls = []
+
+        def fetch(u, t):
+            calls.append(u)
+            if len(calls) < 2:
+                raise ConnectionRefusedError("refused")
+            return 200
+
+        res = check_readiness(_svc(), fetch=fetch,
+                              sleep=clock.sleep, clock=clock)
+        assert res.ready and len(calls) == 2
+
+    def test_explicit_readiness_port_wins(self):
+        clock = FakeClock()
+        res = check_readiness(_svc(rc_port=9999), fetch=lambda u, t: 200,
+                              sleep=clock.sleep, clock=clock)
+        assert ":9999/" in res.url
+
+    def test_no_readiness_declared_is_none(self):
+        svc = Service(name="db", image="x")
+        assert check_readiness(svc, fetch=lambda u, t: 200) is None
+
+    def test_no_port_is_not_ready(self):
+        svc = Service(name="db", image="x",
+                      readiness=ReadinessCheck())
+        res = check_readiness(svc, fetch=lambda u, t: 200)
+        assert not res.ready and "no port" in res.detail
+
+
+class TestTcpAndTypes:
+    def test_tcp_probe_success(self):
+        import socket as _socket
+        import threading
+        srv = _socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        threading.Thread(target=lambda: srv.accept(), daemon=True).start()
+        clock = FakeClock()
+        svc = Service(name="db", image="x",
+                      readiness=ReadinessCheck(type="tcp", port=port,
+                                               timeout=4.0, interval=1.0))
+        res = check_readiness(svc, sleep=clock.sleep, clock=clock)
+        srv.close()
+        assert res.ready and res.url == f"tcp://127.0.0.1:{port}"
+
+    def test_tcp_probe_refused_times_out(self):
+        clock = FakeClock()
+        svc = Service(name="db", image="x",
+                      readiness=ReadinessCheck(type="tcp", port=1,
+                                               timeout=2.0, interval=1.0))
+        res = check_readiness(svc, sleep=clock.sleep, clock=clock)
+        assert not res.ready
+
+    def test_unknown_type_reports_unsupported(self):
+        svc = Service(name="db", image="x",
+                      readiness=ReadinessCheck(type="grpc", port=1))
+        res = check_readiness(svc, fetch=lambda u, t: 200)
+        assert not res.ready and "unsupported" in res.detail
+
+
+class TestRunChecks:
+    def test_reports_each_declared_service(self):
+        clock = FakeClock()
+        lines = []
+        results = run_readiness_checks(
+            [_svc("a", 1001), Service(name="plain", image="x"),
+             _svc("b", 1002, timeout=2.0, interval=2.0)],
+            on_line=lines.append,
+            fetch=lambda u, t: 200 if ":1001" in u else 500,
+            sleep=clock.sleep, clock=clock)
+        assert [r.service for r in results] == ["a", "b"]
+        assert [r.ready for r in results] == [True, False]
+        assert lines[0].startswith("  ✓ a ")
+        assert lines[1].startswith("  ✗ b ")
