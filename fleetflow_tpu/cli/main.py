@@ -402,6 +402,13 @@ def cmd_validate(args) -> int:
     issues = []
     for stage_name in sorted(flow.stages):
         try:
+            stage_obj = flow.stage(stage_name)
+            static, container = _split_stage(flow, stage_obj,
+                                             stage_obj.services)
+            if static and not container:
+                print(f"  stage {stage_name}: static-only "
+                      f"({len(static)} site(s)), nothing to place")
+                continue
             pt = lower_stage(flow, stage_name)
             sched = pick_scheduler(pt.S, pt.N, prefer_tpu=False)
             placement, relaxed = place_with_fallback(sched, pt)
@@ -425,6 +432,12 @@ def cmd_solve(args) -> int:
     """TPU placement preview (no reference analog)."""
     flow = _load(args)
     stage_name = _stage(args)
+    stage_obj = flow.stage(stage_name)
+    static, container = _split_stage(flow, stage_obj, stage_obj.services)
+    if static and not container:
+        print(f"stage {stage_name} is static-only "
+              f"({', '.join(s.name for s in static)}); nothing to place")
+        return 0
     pt = lower_stage(flow, stage_name)
     sched = pick_scheduler(pt.S, pt.N, prefer_tpu=not args.host)
     placement, _relaxed = place_with_fallback(sched, pt)

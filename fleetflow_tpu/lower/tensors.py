@@ -35,7 +35,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.errors import SolverError
-from ..core.model import (Flow, PlacementPolicy, PlacementStrategy,
+from ..core.model import (ServiceType, Flow, PlacementPolicy, PlacementStrategy,
                           ResourceSpec, ServerResource, Service, Stage)
 
 __all__ = ["ProblemTensors", "lower_stage", "dependency_depths",
@@ -167,7 +167,18 @@ def lower_stage(flow: Flow, stage_name: str,
     story, where placement degenerates to ordering).
     """
     stage = flow.stage(stage_name)
-    services = stage.resolved_services(flow)
+    # static sites ship via wrangler Pages, not containers: they consume no
+    # node capacity and must not occupy port/conflict groups in the solve;
+    # dependencies pointing AT them are vacuous for placement (the static
+    # build/deploy runs before the container loop)
+    resolved = stage.resolved_services(flow)
+    static_names = {s.name for s in resolved
+                    if s.service_type is ServiceType.STATIC}
+    services = [s for s in resolved if s.name not in static_names]
+    if not services and static_names:
+        raise SolverError(
+            f"stage {stage_name!r} is static-only (services "
+            f"{sorted(static_names)} deploy via Pages); nothing to place")
     policy = stage.placement
 
     if nodes is None:
@@ -211,6 +222,8 @@ def lower_stage(flow: Flow, stage_name: str,
     for svc in services:
         for i in base_index[svc.name]:
             for dep in rows[i].depends_on:
+                if dep in static_names:
+                    continue   # static targets ship before the container loop
                 if dep not in base_index:
                     raise SolverError(
                         f"service {rows[i].name!r} depends on unknown service {dep!r}")

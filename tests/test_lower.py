@@ -209,3 +209,43 @@ class TestSyntheticProblem:
     def test_aggregate_feasibility_headroom(self):
         pt = synthetic_problem(100, 10, seed=0)
         assert (pt.capacity.sum(axis=0) >= pt.demand.sum(axis=0)).all()
+
+
+class TestStaticExclusion:
+    def test_static_only_stage_raises_clearly(self):
+        import pytest
+        from fleetflow_tpu.core.errors import SolverError
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.lower import lower_stage
+        flow = parse_kdl_string("""
+project "p"
+service "site" { type "static"; build { context "." } }
+stage "live" { service "site" }
+""")
+        with pytest.raises(SolverError, match="static-only"):
+            lower_stage(flow, "live")
+
+    def test_dep_on_static_is_vacuous(self):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.lower import lower_stage
+        flow = parse_kdl_string("""
+project "p"
+service "site" { type "static"; build { context "." } }
+service "app" { image "x"; depends_on "site" }
+stage "live" { service "app"; service "site" }
+""")
+        pt = lower_stage(flow, "live")
+        assert pt.service_names == ["app"]
+        assert not pt.dep_adj.any()
+
+    def test_static_services_not_lowered(self):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.lower import lower_stage
+        flow = parse_kdl_string("""
+project "p"
+service "app" { image "x" }
+service "site" { type "static"; build { context "./site" } }
+stage "live" { service "app"; service "site" }
+""")
+        pt = lower_stage(flow, "live")
+        assert pt.service_names == ["app"]
