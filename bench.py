@@ -35,7 +35,7 @@ def main() -> None:
     chains = int(os.environ.get("BENCH_CHAINS", "4"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
-    block = int(os.environ.get("BENCH_BLOCK", "16"))
+    block = int(os.environ.get("BENCH_BLOCK", "8"))
     proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
 
     # Decide the platform BEFORE any jax device use; never hang, never die

@@ -102,5 +102,5 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.heartbeat_stale_s = float(v)
         elif n == "autoscale-interval":
             cfg.autoscale_interval_s = float(v)
-        elif n == "tpu-solver":
+        elif n in ("tpu-solver", "use-tpu-solver"):
             cfg.use_tpu_solver = bool(v)

@@ -87,7 +87,7 @@ def make_chain_inits(prob: DeviceProblem, seed_assignment: jax.Array,
 def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
             t0: float, t1: float, migration_weight: float, *,
             chains: int, steps: int, warm: bool, adaptive: bool = False,
-            anneal_block: int = 16,
+            anneal_block: int = 8,
             proposals_per_step: Optional[int] = None,
             sharding=None):
     """The fused device pipeline after the seed: chain fan-out, annealing,
@@ -168,7 +168,7 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
            seed_batch: int = 256,
            seed_rounds: int = 2,
            adaptive: bool = True,
-           anneal_block: int = 16,
+           anneal_block: int = 8,
            proposals_per_step: Optional[int] = None) -> SolveResult:
     """Solve a placement instance end to end.
 
