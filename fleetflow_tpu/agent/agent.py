@@ -211,6 +211,16 @@ class Agent:
             await loop.run_in_executor(None, lambda: self.backend.restart(name))
             return {"restarted": name}
 
+        if method == "start":
+            name = validate_container_name(payload["container"])
+            await loop.run_in_executor(None, lambda: self.backend.start(name))
+            return {"started": name}
+
+        if method == "stop":
+            name = validate_container_name(payload["container"])
+            await loop.run_in_executor(None, lambda: self.backend.stop(name))
+            return {"stopped": name}
+
         if method == "deploy.execute":
             req = DeployRequest.from_dict(payload["request"])
             if not req.node:

@@ -608,6 +608,16 @@ def _cp_dispatch(cp: CpClient, args) -> int:
         return show(cp.request("health", "overview"))
     if sub == "tenant":
         verb = args.verb
+        if verb == "status":
+            # TenantCommands::Status: the tenant's projects + users at a
+            # glance (main.rs:308)
+            tenant = args.name or args.tenant or "default"
+            projects = cp.request("project", "list",
+                                  {"tenant": tenant})["projects"]
+            users = cp.request("tenant", "user.list",
+                               {"tenant": tenant})["users"]
+            return show({"tenant": tenant, "projects": projects,
+                         "users": users})
         if verb == "list":
             return show(cp.request("tenant", "list")["tenants"])
         if verb == "create":
@@ -627,6 +637,10 @@ def _cp_dispatch(cp: CpClient, args) -> int:
             return show(cp.request("project", "create",
                                    {"name": _need(args.name, "project name"),
                                     "tenant": args.tenant or "default"}))
+        if args.verb == "show":
+            return show(cp.request("project", "get",
+                                   {"name": _need(args.name, "project name"),
+                                    "tenant": args.tenant or "default"}))
     if sub == "server":
         verb = args.verb
         if verb == "list":
@@ -636,6 +650,18 @@ def _cp_dispatch(cp: CpClient, args) -> int:
                       f"{s['scheduling_state']:<12} "
                       f"cpu {s['allocated']['cpu']:.1f}/{s['capacity']['cpu']}")
             return 0
+        if verb == "status":
+            return show(cp.request("server", "get",
+                                   {"slug": _need(args.name, "server slug")}))
+        if verb == "check":
+            return show(cp.request("server", "check_all"))
+        if verb == "ping":
+            return show(cp.request("server", "ping",
+                                   {"slug": _need(args.name, "server slug")}))
+        if verb in ("boot", "shutdown"):
+            return show(cp.request("server", verb,
+                                   {"slug": _need(args.name, "server slug")},
+                                   timeout=120))
         if verb in ("cordon", "uncordon", "drain"):
             return show(cp.request("server", verb,
                                    {"slug": _need(args.name, "server slug")}))
@@ -683,14 +709,18 @@ def _cp_dispatch(cp: CpClient, args) -> int:
                                {"tenant": getattr(args, "tenant", None)})
                     ["alerts"])
     if sub == "cost":
+        if args.verb == "list":
+            return show(cp.request("cost", "list",
+                                   {"tenant": args.tenant,
+                                    "month": args.month})["entries"])
         if args.verb == "summary":
             return show(cp.request("cost", "summary",
                                    {"tenant": args.tenant or "default",
-                                    "month": args.month}))
+                                    "month": _need(args.month, "--month")}))
         if args.verb == "add":
             return show(cp.request("cost", "add",
                                    {"tenant": args.tenant or "default",
-                                    "month": args.month,
+                                    "month": _need(args.month, "--month"),
                                     "amount": _need(args.amount, "--amount"),
                                     "server": args.name or ""}))
     if sub == "dns":
@@ -703,6 +733,10 @@ def _cp_dispatch(cp: CpClient, args) -> int:
                                     "name": _need(args.name, "--name"),
                                     "content": _need(args.content, "--content"),
                                     "record_type": args.type}))
+        if args.verb == "delete":
+            return show(cp.request("dns", "delete",
+                                   {"zone": _need(args.zone, "--zone"),
+                                    "name": _need(args.name, "--name")}))
         if args.verb == "sync":
             return show(cp.request("dns", "sync", {}))
     if sub == "volume":
@@ -721,6 +755,9 @@ def _cp_dispatch(cp: CpClient, args) -> int:
                                     "push": args.push}))
         if args.verb == "list":
             return show(cp.request("build", "list")["jobs"])
+        if args.verb == "show":
+            return show(cp.request("build", "show",
+                                   {"job": _need(args.name, "job id")}))
         if args.verb == "logs":
             return show(cp.request("build", "logs",
                                    {"job": _need(args.name, "job id")}))
@@ -949,16 +986,18 @@ def build_parser() -> argparse.ArgumentParser:
     q = cps.add_parser("logout")
     q = cps.add_parser("status")
     q = cps.add_parser("daemon")
-    q.add_argument("daemon_command", choices=["run", "stop", "status"])
+    q.add_argument("daemon_command",
+                   choices=["run", "start", "stop", "status"])
     q.add_argument("-c", "--config")
     q = cps.add_parser("agents")
     q = cps.add_parser("alerts")
     q.add_argument("--tenant")
 
     for group, verbs in [
-        ("tenant", ["list", "create", "delete", "users"]),
-        ("project", ["list", "create"]),
-        ("server", ["list", "register", "cordon", "uncordon", "drain",
+        ("tenant", ["status", "list", "create", "delete", "users"]),
+        ("project", ["list", "create", "show"]),
+        ("server", ["list", "register", "status", "check", "ping", "boot",
+                    "shutdown", "cordon", "uncordon", "drain",
                     "delete", "provision", "deprovision", "pool-create",
                     "pool-list"]),
         ("stage", ["status", "adopt"]),
@@ -974,14 +1013,14 @@ def build_parser() -> argparse.ArgumentParser:
             q.add_argument("--max", type=int, help="pool max servers")
 
     q = cps.add_parser("cost")
-    q.add_argument("verb", choices=["summary", "add"])
-    q.add_argument("--month", required=True)
+    q.add_argument("verb", choices=["list", "summary", "add"])
+    q.add_argument("--month")
     q.add_argument("--amount", type=float)
     q.add_argument("--tenant")
     q.add_argument("--name")
 
     q = cps.add_parser("dns")
-    q.add_argument("verb", choices=["list", "create", "sync"])
+    q.add_argument("verb", choices=["list", "create", "delete", "sync"])
     q.add_argument("--zone")
     q.add_argument("--name")
     q.add_argument("--content")
@@ -993,7 +1032,8 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--name")
 
     q = cps.add_parser("build")
-    q.add_argument("verb", choices=["submit", "list", "logs", "cancel"])
+    q.add_argument("verb", choices=["submit", "list", "show", "logs",
+                                    "cancel"])
     q.add_argument("--repo")
     q.add_argument("--tag")
     q.add_argument("--ref", default="main")

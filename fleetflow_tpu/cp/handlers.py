@@ -27,7 +27,39 @@ from .protocol import Connection, ProtocolServer
 if TYPE_CHECKING:
     from .server import AppState
 
-__all__ = ["register_all"]
+__all__ = ["register_all", "check_all_servers", "dns_sync"]
+
+
+def check_all_servers(state: "AppState") -> dict:
+    """Bulk connectivity check shared by the server.check_all channel
+    method and POST /api/health-check (web.rs /api/health-check): agent
+    connected == online."""
+    db = state.store
+    statuses = {s.slug: ("online"
+                         if state.agent_registry.is_connected(s.slug)
+                         else "offline")
+                for s in db.list("servers")}
+    return {"updated": db.bulk_server_status(statuses),
+            "statuses": statuses}
+
+
+def dns_sync(state: "AppState") -> dict:
+    """Push unsynced records through the cloud DNS adapter; without a
+    backend they stay pending (never mark unsent records synced). Shared by
+    the dns.sync channel method and POST /api/dns/sync."""
+    db = state.store
+    pending = db.list("dns_records", lambda r: not r.synced)
+    if state.dns_backend is None:
+        return {"synced": 0, "pending": len(pending),
+                "error": "no DNS backend configured"}
+    synced = 0
+    for rec in pending:
+        state.dns_backend.ensure_record(
+            rec.zone, rec.name, rec.type, rec.content,
+            ttl=rec.ttl, proxied=rec.proxied)
+        db.update("dns_records", rec.id, synced=True)
+        synced += 1
+    return {"synced": synced}
 
 
 def _require(payload: dict, *keys: str) -> list:
@@ -197,6 +229,13 @@ def _container(state: "AppState"):
             entries = state.log_router.retained(
                 topic_for(server, container), limit=p.get("limit"))
             return {"lines": [e.to_dict() for e in entries]}
+        if method in ("start", "stop", "restart"):
+            # granular lifecycle (MCP cp_container_start/stop/restart):
+            # routed to the owning node's agent
+            server, container = _require(p, "server", "container")
+            result = await state.agent_registry.send_command(
+                server, method, {"container": container})
+            return {"result": result}
         raise ValueError(f"unknown method container.{method}")
     return handle
 
@@ -242,13 +281,46 @@ def _server(state: "AppState"):
             if method == "drain":
                 state.placement.node_event(s.slug, online=False)
             return {"ok": True, "scheduling_state": new_state}
+        if method == "ping":
+            # single-server liveness (ServerCommands::Ping): round-trip
+            # through the connected agent; offline agents answer here, not
+            # with a timeout
+            (slug,) = _require(p, "slug")
+            if not state.agent_registry.is_connected(slug):
+                return {"ok": False, "error": f"agent {slug!r} not connected"}
+            result = await state.agent_registry.send_command(
+                slug, "ping", {}, timeout=p.get("timeout", 10))
+            return {"ok": True, "result": result}
+        if method in ("boot", "shutdown"):
+            # ServerCommands::{Boot,Shutdown}: power control through the
+            # cloud ServerProvider (server.rs power on-off); CLI shellouts
+            # run off-loop like provision/deprovision
+            (slug,) = _require(p, "slug")
+            s = db.server_by_slug(slug)
+            if s is None:
+                return {"ok": False, "error": f"no server {slug}"}
+            if not s.provider:
+                return {"ok": False,
+                        "error": f"server {slug} has no provider; "
+                                 f"cannot control power"}
+            sp = state.server_provider_factory(
+                s.provider, **p.get("provider_args", {}))
+            loop = asyncio.get_running_loop()
+            infos = await loop.run_in_executor(None, sp.list_servers)
+            match = next((i for i in infos if i.name == slug), None)
+            if match is None:
+                return {"ok": False,
+                        "error": f"provider has no instance named {slug}"}
+            op = sp.power_on if method == "boot" else sp.power_off
+            ok = await loop.run_in_executor(None, lambda: op(match.id))
+            if ok and method == "shutdown":
+                db.update("servers", s.id, status="offline")
+                await loop.run_in_executor(
+                    None, lambda: state.placement.node_event(slug,
+                                                             online=False))
+            return {"ok": bool(ok), "instance": match.id}
         if method == "check_all":
-            # bulk connectivity: agent-connected -> online
-            statuses = {s.slug: ("online" if state.agent_registry.is_connected(s.slug)
-                                 else "offline")
-                        for s in db.list("servers")}
-            n = db.bulk_server_status(statuses)
-            return {"updated": n, "statuses": statuses}
+            return check_all_servers(state)
         if method == "provision":
             # server.rs provision: create the machine through the cloud
             # ServerProvider, then register it (status provisioning until
@@ -371,6 +443,13 @@ def _cost(state: "AppState"):
             tenant = p.get("tenant", "default")
             return {"month": month, "tenant": tenant,
                     "total": state.store.monthly_cost(tenant, month)}
+        if method == "list":
+            tenant = p.get("tenant")
+            month = p.get("month")
+            rows = db.list("cost_entries",
+                           lambda e: (tenant is None or e.tenant == tenant)
+                           and (month is None or e.month == month))
+            return {"entries": [e.to_dict() for e in rows]}
         raise ValueError(f"unknown method cost.{method}")
     return handle
 
@@ -390,22 +469,17 @@ def _dns(state: "AppState"):
             return {"records": [r.to_dict() for r in db.list(
                 "dns_records", lambda r: zone is None or r.zone == zone)]}
         if method == "delete":
-            return {"deleted": db.delete("dns_records", p.get("id", ""))}
+            # by id, or by (zone, name) the way DnsCommands::Delete
+            # addresses records (main.rs:441)
+            rid = p.get("id", "")
+            if not rid and p.get("zone") and p.get("name"):
+                rec = db.find_one(
+                    "dns_records",
+                    lambda r: r.zone == p["zone"] and r.name == p["name"])
+                rid = rec.id if rec else ""
+            return {"deleted": db.delete("dns_records", rid)}
         if method == "sync":
-            # push unsynced records through the cloud DNS adapter; without a
-            # backend they stay pending (never mark unsent records synced)
-            pending = db.list("dns_records", lambda r: not r.synced)
-            if state.dns_backend is None:
-                return {"synced": 0, "pending": len(pending),
-                        "error": "no DNS backend configured"}
-            synced = 0
-            for rec in pending:
-                state.dns_backend.ensure_record(
-                    rec.zone, rec.name, rec.type, rec.content,
-                    ttl=rec.ttl, proxied=rec.proxied)
-                db.update("dns_records", rec.id, synced=True)
-                synced += 1
-            return {"synced": synced}
+            return dns_sync(state)
         raise ValueError(f"unknown method dns.{method}")
     return handle
 

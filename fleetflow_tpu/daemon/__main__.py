@@ -22,11 +22,36 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fleetflowd",
                                  description="fleetflow-tpu control-plane daemon")
     ap.add_argument("command", nargs="?", default="run",
-                    choices=["run", "stop", "status"])
+                    choices=["run", "start", "stop", "status"])
     ap.add_argument("-c", "--config", help="path to fleetflowd.kdl")
     args = ap.parse_args(argv)
 
     cfg = load_daemon_config(args.config)
+
+    if args.command == "start":
+        # DaemonCommands::Start: POSIX double-fork detach — the second fork
+        # drops session leadership so the daemon can never reacquire a
+        # controlling terminal
+        st, pid = PidFile(cfg.pid_file).status()
+        if st is PidStatus.RUNNING:
+            print(f"already running (pid {pid})")
+            return 1
+        child = os.fork()
+        if child > 0:
+            os.waitpid(child, 0)   # reap the intermediate immediately
+            print("started fleetflowd")
+            return 0
+        os.setsid()
+        grandchild = os.fork()
+        if grandchild > 0:
+            os._exit(0)            # intermediate exits; daemon reparents
+        # the grandchild is the daemon; stdio detaches from the terminal
+        log = open(cfg.log_file or os.devnull, "a")
+        devnull = open(os.devnull, "r")
+        os.dup2(devnull.fileno(), 0)
+        os.dup2(log.fileno(), 1)
+        os.dup2(log.fileno(), 2)
+        args.command = "run"
 
     if args.command == "status":
         st, pid = PidFile(cfg.pid_file).status()

@@ -194,6 +194,21 @@ class WebServer:
         def dashboard(body, query):
             return 200, _DASHBOARD_HTML
 
+        @self.route("GET", "/api/me")
+        def me(body, query):
+            # web.rs /api/me: the authenticated identity. Token details are
+            # checked by the auth middleware; this surfaces what it accepted.
+            return {"auth": ("none" if isinstance(state.auth, NoAuth)
+                             else "token"),
+                    "name": state.name}
+
+        @self.route("POST", "/api/health-check")
+        def health_check(body, query):
+            # web.rs /api/health-check: the same bulk connectivity check
+            # the server.check_all channel method runs
+            from ..cp.handlers import check_all_servers
+            return check_all_servers(state)
+
         @self.route("GET", "/api/overview")
         def overview(body, query):
             servers = db.list("servers")
@@ -391,6 +406,18 @@ class WebServer:
                 proxied=body.get("proxied", False)))
             return 201, {"record": rec.to_dict()}
 
+        @self.route("DELETE", "/api/dns/{rid}")
+        def dns_delete(body, query, rid):
+            if not db.delete("dns_records", rid):
+                raise HttpError(404, f"no dns record {rid}")
+            return {"deleted": rid}
+
+        @self.route("POST", "/api/dns/sync")
+        def dns_sync(body, query):
+            # web.rs /api/dns/sync: same push as the dns.sync channel method
+            from ..cp.handlers import dns_sync as run_sync
+            return run_sync(state)
+
         # -- volumes / builds --------------------------------------------
         @self.route("GET", "/api/volumes")
         def volumes(body, query):
@@ -419,6 +446,16 @@ class WebServer:
             if j is None:
                 raise HttpError(404, f"no build {jid}")
             return {"log": j.log, "status": j.status, "error": j.error}
+
+        @self.route("POST", "/api/builds/{jid}/cancel")
+        def build_cancel(body, query, jid):
+            j = db.get("build_jobs", jid)
+            if j is None:
+                raise HttpError(404, f"no build {jid}")
+            if j.status in ("succeeded", "failed", "cancelled"):
+                return {"job": j.to_dict()}   # terminal: no-op
+            db.update("build_jobs", jid, status="cancelled")
+            return {"job": db.get("build_jobs", jid).to_dict()}
 
         # -- placement ---------------------------------------------------
         @self.route("GET", "/api/placement")

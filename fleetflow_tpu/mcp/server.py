@@ -274,6 +274,27 @@ class FleetMcpServer:
                                      {"tenant": tenant})["projects"]
         return _text({"tenant": tenant, "projects": projects})
 
+    @_tool("cp_project_detail", "One project's record and stages "
+           "(fleetflow_cp_project_detail)",
+           {"type": "object", "properties": {"project": {"type": "string"}},
+            "required": ["project"]})
+    def cp_project_detail(self, project: str) -> dict:
+        rec = self.cp().request("project", "get", {"name": project})
+        proj = rec.get("project")
+        # stages are keyed by project ID, not the human name
+        stages = (self.cp().request(
+            "stage", "list", {"project": proj["id"]})["stages"]
+            if proj else [])
+        return _text({"project": proj, "stages": stages})
+
+    @_tool("cp_stage_services", "Services registered under a stage "
+           "(fleetflow_cp_stage_services)",
+           {"type": "object", "properties": {"stage_id": {"type": "string"}},
+            "required": ["stage_id"]})
+    def cp_stage_services(self, stage_id: str) -> dict:
+        return _text(self.cp().request("service", "list",
+                                       {"stage": stage_id})["services"])
+
     @_tool("cp_stage_status", "Services/deployments/alerts of a stage",
            {"type": "object", "properties": {"stage_id": {"type": "string"}},
             "required": ["stage_id"]})
@@ -315,6 +336,36 @@ class FleetMcpServer:
     def cp_containers(self, server: Optional[str] = None) -> dict:
         return _text(self.cp().request("container", "ps",
                                        {"server": server})["containers"])
+
+    @_tool("cp_container_start", "Start a stopped container via its node "
+           "agent (fleetflow_cp_container_start)",
+           {"type": "object", "properties": {
+               "server": {"type": "string"}, "container": {"type": "string"}},
+            "required": ["server", "container"]})
+    def cp_container_start(self, server: str, container: str) -> dict:
+        return _text(self.cp().request("container", "start",
+                                       {"server": server,
+                                        "container": container}))
+
+    @_tool("cp_container_stop", "Stop a running container via its node "
+           "agent (fleetflow_cp_container_stop)",
+           {"type": "object", "properties": {
+               "server": {"type": "string"}, "container": {"type": "string"}},
+            "required": ["server", "container"]})
+    def cp_container_stop(self, server: str, container: str) -> dict:
+        return _text(self.cp().request("container", "stop",
+                                       {"server": server,
+                                        "container": container}))
+
+    @_tool("cp_container_restart", "Restart a container via its node "
+           "agent (fleetflow_cp_container_restart)",
+           {"type": "object", "properties": {
+               "server": {"type": "string"}, "container": {"type": "string"}},
+            "required": ["server", "container"]})
+    def cp_container_restart(self, server: str, container: str) -> dict:
+        return _text(self.cp().request("container", "restart",
+                                       {"server": server,
+                                        "container": container}))
 
     @_tool("cp_agents", "Connected node agents")
     def cp_agents(self) -> dict:
