@@ -36,6 +36,7 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
     block = int(os.environ.get("BENCH_BLOCK", "8"))
+    warm_block = int(os.environ.get("BENCH_WARM_BLOCK", "2"))
     proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
 
     # Decide the platform BEFORE any jax device use; never hang, never die
@@ -80,11 +81,11 @@ def main() -> None:
     prob2 = _dc.replace(prob, node_valid=_jnp.asarray(valid))
     solve(pt2, prob=prob2, chains=chains, steps=steps, seed=2,   # compile warm path
           init_assignment=res.assignment, anneal_block=block,
-          proposals_per_step=proposals)
+          warm_block=warm_block, proposals_per_step=proposals)
     t1 = time.perf_counter()
     res2 = solve(pt2, prob=prob2, chains=chains, steps=steps, seed=3,
                  init_assignment=res.assignment, anneal_block=block,
-                 proposals_per_step=proposals)
+                 warm_block=warm_block, proposals_per_step=proposals)
     reschedule_ms = (time.perf_counter() - t1) * 1e3
     moved = int((res2.assignment != res.assignment).sum())
     affected = int((res.assignment == victim).sum())
@@ -109,6 +110,7 @@ def main() -> None:
         "seed_batch": seed_batch,
         "sweeps_run": res.steps,
         "anneal_block": block,
+        "warm_block": warm_block,
         "proposals_per_step": proposals,
         "backend": jax.default_backend(),
         "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},

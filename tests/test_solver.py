@@ -170,6 +170,28 @@ class TestSolve:
         assert not (res2.assignment == dead).any()
         moved = (res2.assignment != res.assignment).mean()
         assert moved < 0.6  # warm start keeps most placements
+        # warm path checks the adaptive exit every warm_block sweeps, so it
+        # stops at the first even sweep count that reaches feasibility
+        # (13/100 services displaced here needs ~6; large fleets with
+        # proportionally smaller churn exit in 2-4, see bench reschedule)
+        assert res2.steps <= 8, res2.steps
+        assert res2.steps % 2 == 0  # exited on a warm_block boundary
+
+    def test_warm_block_exits_earlier_than_cold_block(self):
+        pt = synthetic_problem(100, 10, seed=3)
+        res = solve(pt, chains=4, steps=300, seed=3)
+        dead = int(np.bincount(res.assignment, minlength=pt.N).argmax())
+        pt.node_valid[dead] = False
+        pt.eligible[:, dead] = False
+        fine = solve(pt, chains=4, steps=300, seed=4,
+                     init_assignment=res.assignment, warm_block=1)
+        coarse = solve(pt, chains=4, steps=300, seed=4,
+                       init_assignment=res.assignment, warm_block=64,
+                       anneal_block=64)
+        assert fine.feasible and coarse.feasible
+        assert fine.steps < coarse.steps
+        # both must produce a fully valid placement despite the early exit
+        assert not (fine.assignment == dead).any()
 
     def test_spread_beats_random_balance(self):
         pt = synthetic_problem(120, 12, seed=7)
