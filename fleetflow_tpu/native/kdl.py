@@ -1,0 +1,178 @@
+"""Native KDL parse: ctypes binding over native/kdl.cpp.
+
+`native_parse_document(text)` returns the same list[KdlNode] as the pure
+Python parser (core/kdl.py), ~5x faster on fleet-scale documents, or None
+when the fast path cannot be used (library missing, document exercises an
+unsupported corner like int64-overflowing literals). On a native parse
+ERROR the caller must reparse in Python: that path raises the canonical
+KdlError with codepoint-exact line/col, and also covers the one known
+lenient-mode divergence (non-ASCII unicode digits start a number in Python
+but an identifier in C++ — hostile input either way).
+
+Parity across the whole KDL test corpus is enforced by
+tests/test_native_kdl.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Optional
+
+import numpy as np
+
+from .lib import load
+
+__all__ = ["native_parse_document", "kdl_native_available"]
+
+_configured = False
+
+
+def _configure(lib) -> bool:
+    global _configured
+    if _configured:
+        return True
+    try:
+        lib.ff_kdl_parse.restype = ctypes.c_void_p
+        lib.ff_kdl_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ff_kdl_counts.restype = None
+        lib.ff_kdl_counts.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.ff_kdl_export.restype = None
+        lib.ff_kdl_export.argtypes = [
+            ctypes.c_void_p,
+            *([ctypes.POINTER(ctypes.c_int32)] * 8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            *([ctypes.POINTER(ctypes.c_int32)] * 4),
+            ctypes.c_char_p,
+        ]
+        lib.ff_kdl_free.restype = None
+        lib.ff_kdl_free.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        return False    # stale .so without the kdl symbols
+    _configured = True
+    return True
+
+
+def kdl_native_available() -> bool:
+    lib = load()
+    return lib is not None and _configure(lib)
+
+
+def _i32(n: int) -> np.ndarray:
+    return np.zeros(max(n, 1), dtype=np.int32)
+
+
+def _pt(a: np.ndarray, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def native_parse_document(text: str) -> Optional[list]:
+    """Parse KDL text natively; None => caller must use the Python parser
+    (either unavailable, or the document needs Python semantics — including
+    every parse-error path, so errors carry the canonical message)."""
+    lib = load()
+    if lib is None or not _configure(lib):
+        return None
+    from ..core.kdl import KdlNode
+
+    raw = text.encode("utf-8", "surrogatepass")
+    errbuf = ctypes.create_string_buffer(256)
+    eline = ctypes.c_int32(0)
+    ecol = ctypes.c_int32(0)
+    handle = lib.ff_kdl_parse(raw, len(raw), errbuf, len(errbuf),
+                              ctypes.byref(eline), ctypes.byref(ecol))
+    if not handle:
+        return None     # error or unsupported: Python parser decides
+    try:
+        n_nodes = ctypes.c_int64(0)
+        n_vals = ctypes.c_int64(0)
+        n_str = ctypes.c_int64(0)
+        lib.ff_kdl_counts(handle, ctypes.byref(n_nodes),
+                          ctypes.byref(n_vals), ctypes.byref(n_str))
+        nn, nv, ns = n_nodes.value, n_vals.value, n_str.value
+
+        parent, name_off, name_len = _i32(nn), _i32(nn), _i32(nn)
+        type_off, type_len = _i32(nn), _i32(nn)
+        val_start, nargs, nprops = _i32(nn), _i32(nn), _i32(nn)
+        vkind = np.zeros(max(nv, 1), dtype=np.uint8)
+        vint = np.zeros(max(nv, 1), dtype=np.int64)
+        vnum = np.zeros(max(nv, 1), dtype=np.float64)
+        vstr_off, vstr_len = _i32(nv), _i32(nv)
+        vkey_off, vkey_len = _i32(nv), _i32(nv)
+        strbuf = ctypes.create_string_buffer(max(ns, 1))
+
+        lib.ff_kdl_export(
+            handle,
+            _pt(parent, ctypes.c_int32), _pt(name_off, ctypes.c_int32),
+            _pt(name_len, ctypes.c_int32), _pt(type_off, ctypes.c_int32),
+            _pt(type_len, ctypes.c_int32), _pt(val_start, ctypes.c_int32),
+            _pt(nargs, ctypes.c_int32), _pt(nprops, ctypes.c_int32),
+            _pt(vkind, ctypes.c_uint8), _pt(vint, ctypes.c_int64),
+            _pt(vnum, ctypes.c_double),
+            _pt(vstr_off, ctypes.c_int32), _pt(vstr_len, ctypes.c_int32),
+            _pt(vkey_off, ctypes.c_int32), _pt(vkey_len, ctypes.c_int32),
+            strbuf)
+    finally:
+        lib.ff_kdl_free(handle)
+
+    buf = strbuf.raw[:ns]
+    scache: dict[tuple[int, int], str] = {}
+
+    def getstr(off: int, ln: int) -> str:
+        key = (off, ln)
+        s = scache.get(key)
+        if s is None:
+            s = buf[off:off + ln].decode("utf-8", "surrogatepass")
+            scache[key] = s
+        return s
+
+    def getval(j: int) -> Any:
+        k = vkind_l[j]
+        if k == 5:
+            return getstr(vstr_off_l[j], vstr_len_l[j])
+        if k == 3:
+            return vint_l[j]
+        if k == 4:
+            return vnum_l[j]
+        if k == 2:
+            return True
+        if k == 1:
+            return False
+        return None
+
+    # plain-list indexing is ~3x faster than numpy scalars in this loop
+    parent_l = parent.tolist()
+    name_off_l, name_len_l = name_off.tolist(), name_len.tolist()
+    type_off_l, type_len_l = type_off.tolist(), type_len.tolist()
+    val_start_l = val_start.tolist()
+    nargs_l, nprops_l = nargs.tolist(), nprops.tolist()
+    vkind_l, vint_l, vnum_l = vkind.tolist(), vint.tolist(), vnum.tolist()
+    vstr_off_l, vstr_len_l = vstr_off.tolist(), vstr_len.tolist()
+    vkey_off_l, vkey_len_l = vkey_off.tolist(), vkey_len.tolist()
+
+    top: list[KdlNode] = []
+    all_nodes: list[KdlNode] = []
+    for i in range(nn):
+        vs = val_start_l[i]
+        na = nargs_l[i]
+        node = KdlNode(
+            name=getstr(name_off_l[i], name_len_l[i]),
+            args=[getval(j) for j in range(vs, vs + na)],
+            props={getstr(vkey_off_l[j], vkey_len_l[j]): getval(j)
+                   for j in range(vs + na, vs + na + nprops_l[i])},
+            type_annotation=(getstr(type_off_l[i], type_len_l[i])
+                             if type_off_l[i] >= 0 else None),
+        )
+        all_nodes.append(node)
+        p = parent_l[i]
+        if p < 0:
+            top.append(node)
+        else:
+            all_nodes[p].children.append(node)
+    return top
