@@ -1,13 +1,13 @@
 """Native KDL parse: ctypes binding over native/kdl.cpp.
 
 `native_parse_document(text)` returns the same list[KdlNode] as the pure
-Python parser (core/kdl.py), ~5x faster on fleet-scale documents, or None
-when the fast path cannot be used (library missing, document exercises an
-unsupported corner like int64-overflowing literals). On a native parse
-ERROR the caller must reparse in Python: that path raises the canonical
-KdlError with codepoint-exact line/col, and also covers the one known
-lenient-mode divergence (non-ASCII unicode digits start a number in Python
-but an identifier in C++ — hostile input either way).
+Python parser (core/kdl.py), several times faster on fleet-scale documents,
+or None when the fast path cannot be used: library missing, the document
+exercises an unsupported corner (int64-overflowing literals), a known
+unicode classification divergence is possible (`_unicode_divergence_risk`),
+or the native parse errored. On None the caller must parse in Python — the
+error path then raises the canonical KdlError with codepoint-exact
+line/col. core/kdl.py:parse_document does exactly this.
 
 Parity across the whole KDL test corpus is enforced by
 tests/test_native_kdl.py.
@@ -64,6 +64,32 @@ def kdl_native_available() -> bool:
     return lib is not None and _configure(lib)
 
 
+def _unicode_divergence_risk(text: str) -> bool:
+    """True when the document could hit a known native/Python classification
+    divergence, so the caller must take the Python path.
+
+    The C++ parser classifies value-starts with ASCII-only isdigit/isalpha
+    (kdl.cpp documented divergence); Python's checks are unicode-aware. Two
+    inputs flip between "value" and "bare identifier" across the parsers:
+      - a non-ASCII unicode digit anywhere (`a ٣`: Python enters
+        parse_number and raises; C++ accepts a bare-word arg)
+      - '#' immediately followed by a non-ASCII alpha (`a #é`: Python
+        enters keyword parsing and raises; C++ accepts a bare word)
+    Conservative by design: a '#é' inside a quoted string also triggers the
+    fallback — merely slower, never wrong.
+    """
+    for ch in set(text):
+        if not ch.isascii() and ch.isdigit():
+            return True
+    idx = text.find("#")
+    while idx != -1 and idx + 1 < len(text):
+        nxt = text[idx + 1]
+        if not nxt.isascii() and nxt.isalpha():
+            return True
+        idx = text.find("#", idx + 1)
+    return False
+
+
 def _i32(n: int) -> np.ndarray:
     return np.zeros(max(n, 1), dtype=np.int32)
 
@@ -78,6 +104,8 @@ def native_parse_document(text: str) -> Optional[list]:
     every parse-error path, so errors carry the canonical message)."""
     lib = load()
     if lib is None or not _configure(lib):
+        return None
+    if not text.isascii() and _unicode_divergence_risk(text):
         return None
     from ..core.kdl import KdlNode
 
@@ -132,20 +160,6 @@ def native_parse_document(text: str) -> Optional[list]:
             scache[key] = s
         return s
 
-    def getval(j: int) -> Any:
-        k = vkind_l[j]
-        if k == 5:
-            return getstr(vstr_off_l[j], vstr_len_l[j])
-        if k == 3:
-            return vint_l[j]
-        if k == 4:
-            return vnum_l[j]
-        if k == 2:
-            return True
-        if k == 1:
-            return False
-        return None
-
     # plain-list indexing is ~3x faster than numpy scalars in this loop
     parent_l = parent.tolist()
     name_off_l, name_len_l = name_off.tolist(), name_len.tolist()
@@ -156,20 +170,44 @@ def native_parse_document(text: str) -> Optional[list]:
     vstr_off_l, vstr_len_l = vstr_off.tolist(), vstr_len.tolist()
     vkey_off_l, vkey_len_l = vkey_off.tolist(), vkey_len.tolist()
 
+    # Materialize all values (and property keys) in one pass so node
+    # assembly is list slicing, not per-index function calls — this loop is
+    # the wrapper's hot path (a 10k-service doc has ~10^5 values).
+    _KW = {0: None, 1: False, 2: True}   # VKind; .get so a skewed .so with
+    vals: list = [None] * nv             # an unknown kind degrades to None
+    keys: list = [None] * nv             # instead of crashing the load
+    for j in range(nv):
+        k = vkind_l[j]
+        if k == 5:
+            vals[j] = getstr(vstr_off_l[j], vstr_len_l[j])
+        elif k == 3:
+            vals[j] = vint_l[j]
+        elif k == 4:
+            vals[j] = vnum_l[j]
+        else:
+            vals[j] = _KW.get(k)
+        ko = vkey_off_l[j]
+        if ko >= 0:
+            keys[j] = getstr(ko, vkey_len_l[j])
+
+    new = KdlNode.__new__
     top: list[KdlNode] = []
     all_nodes: list[KdlNode] = []
+    append_all = all_nodes.append
     for i in range(nn):
         vs = val_start_l[i]
-        na = nargs_l[i]
-        node = KdlNode(
-            name=getstr(name_off_l[i], name_len_l[i]),
-            args=[getval(j) for j in range(vs, vs + na)],
-            props={getstr(vkey_off_l[j], vkey_len_l[j]): getval(j)
-                   for j in range(vs + na, vs + na + nprops_l[i])},
-            type_annotation=(getstr(type_off_l[i], type_len_l[i])
-                             if type_off_l[i] >= 0 else None),
-        )
-        all_nodes.append(node)
+        mid = vs + nargs_l[i]
+        end = mid + nprops_l[i]
+        to = type_off_l[i]
+        # bypass the dataclass __init__ (measured ~2x on fleet-scale docs);
+        # field set must stay in sync with core.kdl.KdlNode
+        node = new(KdlNode)
+        node.name = getstr(name_off_l[i], name_len_l[i])
+        node.args = vals[vs:mid]
+        node.props = dict(zip(keys[mid:end], vals[mid:end]))
+        node.children = []
+        node.type_annotation = getstr(to, type_len_l[i]) if to >= 0 else None
+        append_all(node)
         p = parent_l[i]
         if p < 0:
             top.append(node)

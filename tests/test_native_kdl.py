@@ -1,0 +1,269 @@
+"""Native KDL parser parity corpus (VERDICT r2 item 2; ADVICE r2 mediums).
+
+The native parser (native/kdl.cpp via fleetflow_tpu/native/kdl.py) must be
+indistinguishable from the pure-Python executable spec (core/kdl.py) through
+the wired entry point `parse_document`:
+
+  - every valid document parses to an identical KdlNode tree;
+  - every invalid document raises the SAME KdlError (message, line, col) —
+    the native side signals error, the wrapper returns None, and the caller
+    re-parses in Python, so errors are canonical by construction. What this
+    suite guards against is the silent direction: native ACCEPTING what
+    Python rejects (ADVICE r2: slash-dashed annotated entries; unicode
+    digit/alpha classification);
+  - documents outside the native subset (int64 overflow, unicode
+    divergence risk) transparently take the Python path.
+
+Ref analog: crates/fleetflow-core/src/parser/tests.rs (the corpus pattern);
+crates/fleetflow-core/src/parser/mod.rs:31 (the kdl-crate-backed fast parse
+this component mirrors).
+"""
+
+import os
+import time
+
+import pytest
+
+from fleetflow_tpu.core.kdl import KdlError, _Parser, parse_document
+from fleetflow_tpu.native.kdl import (
+    kdl_native_available,
+    native_parse_document,
+)
+
+pytestmark = pytest.mark.skipif(
+    not kdl_native_available(), reason="libffnative.so not built")
+
+
+def python_parse(text):
+    """The pure-Python parser, bypassing the native fast path."""
+    return _Parser(text).parse_nodes()
+
+
+def _norm(v):
+    """NaN compares unequal to itself; map it to a sentinel so #nan args
+    don't fail the structural diff. Also pin the int/float distinction
+    (True == 1 in Python, and 1 == 1.0 — both matter for parity)."""
+    if isinstance(v, float) and v != v:
+        return "<nan>"
+    return (type(v).__name__, v)
+
+
+def tree(nodes):
+    """Structural projection for comparison (KdlNode is eq-comparable, but a
+    projection gives readable pytest diffs on mismatch)."""
+    return [
+        (n.name, [_norm(a) for a in n.args],
+         {k: _norm(v) for k, v in n.props.items()},
+         n.type_annotation, tree(n.children))
+        for n in nodes
+    ]
+
+
+# -- corpus -----------------------------------------------------------------
+# Valid documents covering the full grammar surface of core/kdl.py.
+
+VALID_CORPUS = [
+    "",
+    "\n\n  \n",
+    "node",
+    'service "postgres" "extra"',
+    "nums 1 -2 3.5 1e3 0x1F 0o17 0b101 1_000_000",
+    "nums +7 -0x10 -0o7 -0b11 2.5e-3 1E+2 1_0.5_0",
+    "kw true false null",
+    "kw #true #false #null #inf #nan",
+    "port host=8080 container=80 protocol=\"udp\"",
+    'volume "./data" "/data" read-only=true',
+    "a; b; c",
+    "a;; b ;\n c",
+    '"weird name" 1',
+    'service "db" {\n  image "postgres"\n  version "16"\n}',
+    "a { b { c { d 1 } } }",
+    "cap { cpu 4 } labels { tier \"web\" }",
+    "inline { x 1; y 2 }",
+    "// comment\nnode 1 // trailing\n",
+    "/* block */ node /* mid */ 1",
+    "/* nested /* deeper */ still */ node",
+    "/-node 1 2 { child }\nkept",
+    "/- node-with-space 1\nkept",
+    "a /-1 2",
+    "a /-k=1 j=2",
+    "a /-{ discarded 1 } b=2",
+    # ADVICE r2 medium: slash-dashed type-annotated entry must parse (and
+    # discard the entry) identically in both parsers.
+    "a /- (t)5 b=2",
+    "a /- (t)\"s\" 1",
+    'esc "a\\nb\\tc\\\\d\\"e\\s"',
+    'uni "\\u{1F600}\\u{41}"',
+    'raw r"no\\escape"',
+    'raw r#"has "quotes" inside"#',
+    'raw r##"deep "# inside"##',
+    "multi 1 \\\n  2 \\  // comment after continuation\n  3",
+    "crlf 1\r\nnext 2\r\n",
+    "tabs\t1\t\tk=2",
+    "(ty)node 1",
+    '("quoted ty")node 1',
+    "n (u8)1 (f)2.5 (s)\"x\"",
+    "dup k=1 k=2 k=3",
+    "bare word-arg under_score dotted.name",
+    'unicode-strings "データベース" name="日本語"',
+    "﻿bom-doc 1",
+    "nbsp arg",
+    "u2028 next",
+    "deep" + " { x" * 100 + " 1" + " }" * 100,
+    "semi-only ;;;",
+    "empty-children {}",
+    "children-then-sibling { a 1 } sibling 2",
+    # numbers that stress int/float distinction
+    "ints 0 -0 9223372036854775807 -9223372036854775808",
+    "floats 0.0 -0.5 3.14159 1e0 1e-0",
+]
+
+# Invalid documents: Python raises KdlError; native must NOT silently accept
+# (it may either error -> wrapper None, or be guarded into the Python path).
+INVALID_CORPUS = [
+    "}",
+    "a {",
+    "a { b",
+    '"unterminated',
+    'esc "bad \\q escape"',
+    'esc "bad \\u41"',
+    'esc "bad \\u{FFFFFFFF}"',
+    "raw r#\"unterminated",
+    "raw r#missing-quote",
+    "/* unterminated",
+    "(ty node 1",
+    "a (ty",
+    "num 0x",
+    "num 0xZZ",
+    "num 1.2.3.4e5e6",
+    "a =1",
+    "a ==",
+    "deep" + " { x" * 200,
+    "a #unknownkw",
+    "a ٣",          # unicode digit: Python "bad number", guard -> Python path
+    "a +٣",
+    "a #é",         # '#' + unicode alpha: Python "unknown keyword"
+    'q "k"=1',      # quoted property keys: rejected by both parsers
+    "n k=(t)3",     # annotated property values: rejected by both parsers
+]
+
+# Documents valid in Python but outside the native subset: wrapper must
+# return None and the wired path must produce the Python result.
+PYTHON_ONLY_CORPUS = [
+    "big 99999999999999999999999999999",      # int64 overflow -> bigint
+    "big -99999999999999999999999999999",
+    "big k=170141183460469231731687303715884105727",
+]
+
+
+@pytest.mark.parametrize("text", VALID_CORPUS, ids=range(len(VALID_CORPUS)))
+def test_valid_parity(text):
+    py = python_parse(text)
+    native = native_parse_document(text)
+    if native is None:
+        # Allowed only for guarded documents (never for plain ASCII).
+        assert not text.isascii(), \
+            f"native refused a valid ASCII document: {text!r}"
+    else:
+        assert tree(native) == tree(py)
+    # The wired entry point must match pure Python regardless of path taken.
+    assert tree(parse_document(text)) == tree(py)
+
+
+@pytest.mark.parametrize("text", INVALID_CORPUS, ids=range(len(INVALID_CORPUS)))
+def test_invalid_never_silently_accepted(text):
+    with pytest.raises(KdlError) as py_err:
+        python_parse(text)
+    assert native_parse_document(text) is None, \
+        f"native accepted a document Python rejects: {text!r}"
+    # Wired path raises the canonical Python error (message, line, col).
+    with pytest.raises(KdlError) as wired_err:
+        parse_document(text)
+    assert str(wired_err.value) == str(py_err.value)
+    assert getattr(wired_err.value, "line", None) == \
+        getattr(py_err.value, "line", None)
+    assert getattr(wired_err.value, "col", None) == \
+        getattr(py_err.value, "col", None)
+
+
+@pytest.mark.parametrize("text", PYTHON_ONLY_CORPUS,
+                         ids=range(len(PYTHON_ONLY_CORPUS)))
+def test_python_only_documents_fall_back(text):
+    assert native_parse_document(text) is None
+    assert tree(parse_document(text)) == tree(python_parse(text))
+
+
+def test_fleet_scale_document_parity_and_speed():
+    """The motivating case: a 10k-service fleet document. Parity exactly,
+    and the native path must be measurably faster (the reason it exists —
+    kdl.cpp header: 2.3 s Python parse vs ~70 ms solve)."""
+    parts = []
+    for i in range(10_000):
+        parts.append(
+            f'service "svc-{i}" {{\n'
+            f'    image "registry.example/app:{i % 37}"\n'
+            f'    port host={10000 + i} container=80 protocol="tcp"\n'
+            f'    volume "./data-{i}" "/data" read-only=true\n'
+            f'    cpu {1 + i % 4}\n    mem {256 * (1 + i % 8)}\n'
+            f'    depends-on "svc-{max(0, i - 1)}"\n'
+            f'    labels {{ tier "t{i % 5}" region "r{i % 3}" }}\n'
+            f'}}\n')
+    text = "".join(parts)
+
+    t_native = float("inf")
+    for _ in range(3):     # min-of-3: immune to CI noisy-neighbor spikes
+        t0 = time.perf_counter()
+        native = native_parse_document(text)
+        t_native = min(t_native, time.perf_counter() - t0)
+    assert native is not None
+
+    t0 = time.perf_counter()
+    py = python_parse(text)
+    t_py = time.perf_counter() - t0
+
+    assert tree(native) == tree(py)
+    assert len(native) == 10_000
+    # Generous bound (measured ~3x); guards against the fast path rotting
+    # into a slow path without anyone noticing.
+    assert t_native < t_py / 2, \
+        f"native {t_native:.2f}s not faster than Python {t_py:.2f}s"
+
+
+def test_wrapper_sets_every_kdlnode_field():
+    """The wrapper bypasses the dataclass __init__, so a field added to
+    KdlNode later would silently be missing on native-parsed nodes; pin the
+    field set here so that change trips a test instead."""
+    import dataclasses
+
+    from fleetflow_tpu.core.kdl import KdlNode
+
+    assert [f.name for f in dataclasses.fields(KdlNode)] == \
+        ["name", "args", "props", "children", "type_annotation"]
+    node = native_parse_document("(ty)n 1 k=2 { c }")[0]
+    for f in dataclasses.fields(KdlNode):
+        assert hasattr(node, f.name)
+
+
+def test_env_knob_disables_native(monkeypatch):
+    monkeypatch.setenv("FLEET_KDL_NATIVE", "0")
+    text = 'service "db" { image "postgres" }'
+    assert tree(parse_document(text)) == tree(python_parse(text))
+
+
+def test_loader_path_uses_wired_parser(tmp_path, monkeypatch):
+    """End-to-end: the project loader goes through parse_document, so the
+    native fast path serves real loads (VERDICT r2 item 2 'wire into
+    core/parser.py/loader.py')."""
+    from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+
+    d = tmp_path / ".fleetflow"
+    d.mkdir()
+    (d / "fleet.kdl").write_text(
+        'project "parity"\n'
+        'service "db" { image "postgres" }\n'
+        'stage "local" { service "db" }\n')
+    flow_native = load_project_from_root_with_stage(str(tmp_path))
+    monkeypatch.setenv("FLEET_KDL_NATIVE", "0")
+    flow_py = load_project_from_root_with_stage(str(tmp_path))
+    assert flow_native.services.keys() == flow_py.services.keys()
+    assert flow_native.name == flow_py.name
