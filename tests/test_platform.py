@@ -100,11 +100,46 @@ class TestEnsurePlatform:
             "b = fp.ensure_platform(min_devices=4, probe_timeout=60);"
             "import jax; print('RES', b, jax.device_count())",
             {"JAX_PLATFORMS": "nonexistent_backend_xyz",
-             "FLEET_PROBE_TIMEOUT": ""})
+             "FLEET_PROBE_TIMEOUT": "", "FLEET_PROBE_RETRIES": "0"})
         assert out.returncode == 0, out.stderr
         line = [l for l in out.stdout.splitlines() if l.startswith("RES ")][0]
         _, backend, ndev = line.split()
         assert backend == "cpu" and int(ndev) >= 4
+
+    def test_probe_failure_is_retried_and_reported(self):
+        # VERDICT r2 weak #1: a flaky tunnel gets N retries, and every
+        # attempt's outcome is in platform_report() for the bench artifact.
+        out = run_py(
+            "import json, fleetflow_tpu.platform as fp;"
+            "b = fp.ensure_platform(min_devices=1, probe_timeout=60);"
+            "print('REP', json.dumps(fp.platform_report()))",
+            {"JAX_PLATFORMS": "nonexistent_backend_xyz",
+             "FLEET_PROBE_TIMEOUT": "", "FLEET_PROBE_RETRIES": "2",
+             "FLEET_PROBE_RETRY_DELAY": "0.1"})
+        assert out.returncode == 0, out.stderr
+        import json as _json
+        line = [l for l in out.stdout.splitlines() if l.startswith("REP ")][0]
+        rep = _json.loads(line[4:])
+        assert rep["requested"] == "nonexistent_backend_xyz"
+        assert rep["decision"] == "cpu"
+        assert len(rep["attempts"]) == 3
+        for att in rep["attempts"]:
+            assert att["ok"] is False
+            assert att["error"]           # failure class present
+            assert "elapsed_s" in att
+
+    def test_probe_success_reported(self):
+        out = run_py(
+            "import json, fleetflow_tpu.platform as fp;"
+            "b = fp.ensure_platform(min_devices=1);"
+            "print('REP', json.dumps(fp.platform_report()))",
+            {"JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        import json as _json
+        line = [l for l in out.stdout.splitlines() if l.startswith("REP ")][0]
+        rep = _json.loads(line[4:])
+        # cpu fast path: no probe needed, decision recorded
+        assert rep["decision"] == "cpu"
 
     def test_decision_is_cached(self, monkeypatch):
         # First call decides (JAX_PLATFORMS=cpu fast path from conftest);
@@ -116,6 +151,7 @@ class TestEnsurePlatform:
             raise AssertionError("cached decision must not re-probe")
 
         monkeypatch.setattr(fp, "probe_default_platform", boom)
+        monkeypatch.setattr(fp, "probe_default_platform_ex", boom)
         monkeypatch.setenv("JAX_PLATFORMS", "axon")
         assert fp.ensure_platform(min_devices=1) == first
 
