@@ -32,12 +32,6 @@ import time
 def main() -> None:
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (1000, 100) if small else (10000, 1000)
-    chains = int(os.environ.get("BENCH_CHAINS", "4"))
-    steps = int(os.environ.get("BENCH_STEPS", "128"))
-    seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
-    block = int(os.environ.get("BENCH_BLOCK", "8"))
-    warm_block = int(os.environ.get("BENCH_WARM_BLOCK", "2"))
-    proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
 
     # Decide the platform BEFORE any jax device use; never hang, never die
     # on a broken tunnel (round-1 failure mode: rc=1 inside device_put).
@@ -47,6 +41,25 @@ def main() -> None:
     # "tunnel down" from "builder bug" (VERDICT r2 weak #1).
     from fleetflow_tpu.platform import ensure_platform, platform_report
     backend = ensure_platform(min_devices=1, probe_timeout=240.0)
+
+    # Backend-scaled defaults (VERDICT r2 item 5: the CPU fallback is a
+    # first-class path, not the TPU config run slowly). CPU: the native FFD
+    # seed is already feasible, sweep cost is linear in chains x proposals,
+    # so a narrow 2-chain / 4-sweep-block polish keeps the cold solve well
+    # under 1 s while the anneal still buys soft score. TPU: 4 wide chains
+    # at the 256-proposal MXU knee (solver picks 256 via its default).
+    cpu = backend == "cpu"
+    chains = int(os.environ.get("BENCH_CHAINS", "2" if cpu else "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "128"))
+    seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
+    block = int(os.environ.get("BENCH_BLOCK", "4" if cpu else "8"))
+    warm_block = int(os.environ.get("BENCH_WARM_BLOCK", "2"))
+    proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
+    # Warm reschedules start one churn event from feasible and are not
+    # perturbed, so extra chains only duplicate work; on CPU (where chains
+    # serialize) one chain cuts the reschedule ~40% (193 vs 347 ms measured).
+    resched_chains = int(os.environ.get("BENCH_RESCHED_CHAINS",
+                                        "1" if cpu else str(chains)))
 
     from fleetflow_tpu.lower import synthetic_problem
     from fleetflow_tpu.solver import prepare_problem, solve
@@ -83,11 +96,11 @@ def main() -> None:
     pt2 = _dc.replace(pt, node_valid=valid)
     import jax.numpy as _jnp
     prob2 = _dc.replace(prob, node_valid=_jnp.asarray(valid))
-    solve(pt2, prob=prob2, chains=chains, steps=steps, seed=2,   # compile warm path
+    solve(pt2, prob=prob2, chains=resched_chains, steps=steps, seed=2,   # compile warm path
           init_assignment=res.assignment, anneal_block=block,
           warm_block=warm_block, proposals_per_step=proposals)
     t1 = time.perf_counter()
-    res2 = solve(pt2, prob=prob2, chains=chains, steps=steps, seed=3,
+    res2 = solve(pt2, prob=prob2, chains=resched_chains, steps=steps, seed=3,
                  init_assignment=res.assignment, anneal_block=block,
                  warm_block=warm_block, proposals_per_step=proposals)
     reschedule_ms = (time.perf_counter() - t1) * 1e3
@@ -110,12 +123,17 @@ def main() -> None:
         "pre_repair_violations": res.pre_repair_violations,
         "moves_repaired": res.moves_repaired,
         "chains": chains,
+        "resched_chains": resched_chains,
         "steps": steps,
         "seed_batch": seed_batch,
         "sweeps_run": res.steps,
         "anneal_block": block,
         "warm_block": warm_block,
-        "proposals_per_step": proposals,
+        # the width the solver actually ran when BENCH_PROPOSALS is unset
+        # (CPU narrows to 64; accelerators use the 256 knee) — the artifact
+        # must state the config that produced the number
+        "proposals_per_step": proposals or (
+            min(64, S // 2) if cpu else min(256, S // 2)),
         "backend": jax.default_backend(),
         "probe": platform_report(),
         "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},

@@ -78,6 +78,14 @@ def available() -> bool:
     return load() is not None
 
 
+def available_nobuild() -> bool:
+    """True when the native library can be used WITHOUT triggering a
+    synchronous `make` (already loaded, or the .so exists on disk). Latency-
+    sensitive auto-pick paths (solver seed selection) use this so a fresh
+    checkout never pays a surprise C++ compile inside a timed solve."""
+    return _lib is not None or (_REPO_NATIVE / _LIB_NAME).is_file()
+
+
 def _ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 

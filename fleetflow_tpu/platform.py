@@ -240,6 +240,10 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
         budget = float(os.environ.get("FLEET_PROBE_BUDGET", "600"))
     except ValueError:
         budget = 600.0
+    # the budget bounds the FIRST attempt too, not just retries — a
+    # FLEET_PROBE_TIMEOUT above the budget would otherwise break the
+    # "time-to-fallback <= budget" contract on a hung backend
+    probe_timeout = min(probe_timeout, budget)
 
     # want == "" means "whatever the install default is" — on a real TPU host
     # that is the TPU backend, so it must be probed, not assumed CPU.

@@ -182,10 +182,13 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
     across re-solves.
 
     `seed_impl` picks the greedy seed: "scan" (one lax.scan step per service
-    — exact FFD, best on CPU where the loop body is cheap), "batched"
-    (ceil(S/256)-deep batch placement — the accelerator shape: sequential
-    depth is what a TPU pays for, per-step width is nearly free), or None to
-    choose by backend.
+    — exact FFD, best when the device is fast but dispatch is cheap),
+    "batched" (ceil(S/256)-deep batch placement — the accelerator shape:
+    sequential depth is what a TPU pays for, per-step width is nearly
+    free), "native" (host C++ FFD via native/placer.cpp — the violation-
+    free floor in ~90 ms at 10k x 1k; VERDICT r2 item 5), or None to choose
+    by backend: CPU fallback prefers "native" (falling back to "scan" when
+    the library is absent), accelerators use "batched".
 
     `warm_block` is the adaptive-exit check granularity for warm starts:
     a churn reschedule starts one node-event away from feasible and the
@@ -211,22 +214,54 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
         seed_assignment = jnp.asarray(init_assignment, dtype=jnp.int32)
         t0 = min(t0, 0.1)  # warm start: refine, don't re-scramble
     else:
-        order = jnp.asarray(placement_order(pt.demand, pt.dep_depth,
-                                            np.asarray(prob.conflict_ids)))
         if seed_impl is None:
-            seed_impl = "scan" if jax.default_backend() == "cpu" else "batched"
-        if seed_impl not in ("scan", "batched"):
-            raise ValueError(f"seed_impl must be 'scan', 'batched' or None, "
-                             f"got {seed_impl!r}")
-        if seed_impl == "scan":
-            seed_assignment = greedy_place(prob, order)
-        else:
-            seed_assignment = greedy_place_batched(prob, order,
-                                                   batch=seed_batch,
-                                                   rounds=seed_rounds)
-        # no block here: the refine dispatch queues behind the seed on-device,
-        # so seed_ms is dispatch time only and the device runs back-to-back
+            if jax.default_backend() == "cpu":
+                # nobuild: auto-pick must never trigger a synchronous make
+                # inside the timed solve; explicit seed_impl="native" may
+                from ..native.lib import available_nobuild
+                seed_impl = "native" if available_nobuild() else "scan"
+            else:
+                seed_impl = "batched"
+        if seed_impl not in ("scan", "batched", "native"):
+            raise ValueError(f"seed_impl must be 'scan', 'batched', "
+                             f"'native' or None, got {seed_impl!r}")
+        if seed_impl == "native":
+            # Host C++ FFD: feasible in tens of ms at 10k x 1k, so the
+            # anneal only buys soft score (the CPU-fallback design point).
+            from ..native.lib import native_place
+            try:
+                host_assignment, _ = native_place(
+                    pt.demand, pt.capacity, pt.eligible, pt.node_valid,
+                    pt.dep_depth, pt.port_ids, pt.volume_ids, pt.anti_ids,
+                    strategy=pt.strategy.value)
+                seed_assignment = jnp.asarray(host_assignment,
+                                              dtype=jnp.int32)
+            except (RuntimeError, OSError):
+                # corrupt/stale .so: degrade to the device scan seed rather
+                # than fail the solve (the .so existing was only a hint)
+                log.warning("native seed unavailable at call time; "
+                            "falling back to scan")
+                seed_impl = "scan"
+        if seed_impl != "native":
+            order = jnp.asarray(placement_order(
+                pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+            if seed_impl == "scan":
+                seed_assignment = greedy_place(prob, order)
+            else:
+                seed_assignment = greedy_place_batched(prob, order,
+                                                       batch=seed_batch,
+                                                       rounds=seed_rounds)
+        # no block here: the refine dispatch queues behind the seed on-device
+        # (device impls), so seed_ms is dispatch time only and the device
+        # runs back-to-back; the native impl is synchronous host work.
     timings["seed_ms"] = (t() - t_seed) * 1e3
+
+    if proposals_per_step is None and jax.default_backend() == "cpu":
+        # CPU sweep cost is ~linear in proposals (no free width the way the
+        # MXU gives it): a 64-wide sweep costs ~25 ms at 10k x 1k vs ~100 ms
+        # at the 256 TPU knee, and with a feasible seed the sweeps only buy
+        # soft polish. Measured in VERDICT r2 item 5 tuning.
+        proposals_per_step = max(1, min(64, pt.demand.shape[0] // 2))
 
     t_anneal = t()
     sharding = (NamedSharding(mesh, P(CHAIN_AXIS, None))

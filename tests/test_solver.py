@@ -287,6 +287,41 @@ class TestBatchedGreedy:
         with pytest.raises(ValueError, match="seed_impl"):
             solve(pt, chains=2, steps=10, seed=8, seed_impl="ffd")
 
+    def test_solve_with_native_seed_is_feasible(self):
+        # VERDICT r2 item 5: the host C++ FFD is the violation-free floor
+        # of the CPU fallback; the anneal on top must preserve feasibility
+        # (winner-per-target sweeps) and never need the repair backstop.
+        from fleetflow_tpu.native.lib import available
+        if not available():
+            pytest.skip("libffnative.so not built")
+        pt = synthetic_problem(300, 30, seed=4, n_tenants=4,
+                               port_fraction=0.2, volume_fraction=0.1)
+        res = solve(pt, chains=2, steps=64, seed=4, seed_impl="native")
+        assert res.feasible, res.stats
+        assert res.pre_repair_violations == 0
+        assert res.moves_repaired == 0
+
+    def test_default_seed_on_cpu_is_native(self, monkeypatch):
+        # The CPU fallback auto-picks the native seed when the library is
+        # present (tests always run on the forced-CPU platform). Assert the
+        # native placer was actually invoked, not just that solve worked.
+        import fleetflow_tpu.native.lib as nlib
+        if not nlib.available():
+            pytest.skip("libffnative.so not built")
+        calls = []
+        real = nlib.native_place
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(nlib, "native_place", spy)
+        pt = synthetic_problem(120, 12, seed=9, port_fraction=0.2)
+        res = solve(pt, chains=2, steps=32, seed=9)   # seed_impl=None
+        assert calls, "auto-pick on CPU must route through native_place"
+        assert res.feasible, res.stats
+        assert res.pre_repair_violations == 0
+
 
 class TestCarriedStateInvariants:
     """The adaptive exit + chain ranking trust the anneal's incrementally
