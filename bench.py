@@ -129,11 +129,9 @@ def main() -> None:
         "sweeps_run": res.steps,
         "anneal_block": block,
         "warm_block": warm_block,
-        # the width the solver actually ran when BENCH_PROPOSALS is unset
-        # (CPU narrows to 64; accelerators use the 256 knee) — the artifact
-        # must state the config that produced the number
-        "proposals_per_step": proposals or (
-            min(64, S // 2) if cpu else min(256, S // 2)),
+        # the width the solver actually ran (after backend defaults) — the
+        # artifact must state the config that produced the number
+        "proposals_per_step": res.proposals_per_step,
         "backend": jax.default_backend(),
         "probe": platform_report(),
         "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},

@@ -516,6 +516,67 @@ class TestStore:
         db.replace_observed("n1", [ObservedContainer(name="c")])
         assert [o.name for o in db.observed_on("n1")] == ["c"]
 
+    def test_heartbeats_do_not_rewrite_database(self, tmp_path):
+        """VERDICT r2 item 3: the design point is 1k nodes at 30 s
+        heartbeats (~33 updates/s); each must cost one O(record) journal
+        append, never an O(database) snapshot rewrite."""
+        path = str(tmp_path / "cp.json")
+        db = Store(path)
+        with db.batch():
+            for i in range(1000):
+                db.register_server(f"n{i}", hostname=f"host{i}")
+        db.flush()   # establish the snapshot; journal now empty
+        base = db.journal_stats()
+        snap_before = (tmp_path / "cp.json").stat().st_mtime_ns
+
+        for i in range(1000):
+            db.heartbeat(f"n{i}")
+        st = db.journal_stats()
+        assert st["compactions"] == base["compactions"], \
+            "1k heartbeats must not trigger compaction at default thresholds"
+        assert st["entries"] - base["entries"] == 1000
+        # bounded amplification: ~one serialized server record (<2 KB) per
+        # beat, not the ~1k-server database
+        assert (st["bytes"] - base["bytes"]) / 1000 < 2048
+        assert (tmp_path / "cp.json").stat().st_mtime_ns == snap_before, \
+            "snapshot must not be rewritten by heartbeats"
+        # recovery: snapshot + journal replay reproduces every heartbeat
+        db2 = Store(path)
+        assert db2.server_by_slug("n999").status == "online"
+        assert db2.server_by_slug("n0").last_heartbeat > 0
+
+    def test_journal_compaction_bounds_size(self, tmp_path):
+        path = str(tmp_path / "cp.json")
+        db = Store(path, journal_max_entries=100)
+        for i in range(350):
+            db.register_server(f"s{i % 7}", hostname=f"h{i}")
+        st = db.journal_stats()
+        assert st["compactions"] >= 3
+        assert st["entries"] < 100
+        db2 = Store(path)
+        assert db2.server_by_slug("s6").hostname == "h349"
+
+    def test_torn_journal_tail_is_dropped(self, tmp_path):
+        path = str(tmp_path / "cp.json")
+        db = Store(path)
+        db.ensure_tenant("acme")
+        db.register_server("n1", hostname="h1")
+        with open(str(tmp_path / "cp.json.journal"), "a") as f:
+            f.write('{"op": "put", "t": "servers", "r": {"id": "tr')
+        db2 = Store(path)   # must not raise
+        assert db2.server_by_slug("n1").hostname == "h1"
+        assert db2.tenant_by_name("acme") is not None
+
+    def test_delete_survives_restart(self, tmp_path):
+        path = str(tmp_path / "cp.json")
+        db = Store(path)
+        s = db.register_server("gone", hostname="h")
+        db.register_server("kept", hostname="h2")
+        db.delete("servers", s.id)
+        db2 = Store(path)
+        assert db2.server_by_slug("gone") is None
+        assert db2.server_by_slug("kept") is not None
+
 
 class TestAuth:
     def test_token_roundtrip_and_tamper(self):
