@@ -556,15 +556,26 @@ def cmd_cp(args) -> int:
         creds = CredentialStore()
         endpoint = args.cp or default_endpoint()
         token = args.token
+        if not token and getattr(args, "idp", None):
+            # OAuth Device Flow against an external IdP (the reference's
+            # Auth0 login, fleetflow/src/auth.rs:68-263)
+            from .device_flow import DeviceFlowError, device_login
+            try:
+                tok = device_login(args.idp, args.client_id or "fleetflow",
+                                   audience=getattr(args, "audience", None),
+                                   scope=getattr(args, "scope", "") or "")
+            except DeviceFlowError as e:
+                print(f"login failed: {e}", file=sys.stderr)
+                return 1
+            token = tok["access_token"]
         if not token and args.secret:
-            # mint locally from a shared secret (stand-in for the
-            # reference's Auth0 device flow, auth.rs:68)
+            # mint locally from a shared secret (self-issued HS256 path)
             from ..cp.auth import TokenAuth
             token = TokenAuth(args.secret).issue(
                 args.email or "operator@local", ["admin:all"],
                 tenant=args.tenant or "default")
         if not token:
-            print("provide --token or --secret", file=sys.stderr)
+            print("provide --token, --secret, or --idp", file=sys.stderr)
             return 1
         creds.save_token(endpoint, token, email=args.email or "")
         print(f"credentials saved for {endpoint}")
@@ -981,6 +992,10 @@ def build_parser() -> argparse.ArgumentParser:
     q = cps.add_parser("login")
     q.add_argument("--token")
     q.add_argument("--secret", help="shared secret to mint a token")
+    q.add_argument("--idp", help="IdP base URL for OAuth device-flow login")
+    q.add_argument("--client-id", help="OAuth client id for --idp")
+    q.add_argument("--audience", help="OAuth audience for --idp")
+    q.add_argument("--scope", help="OAuth scopes for --idp")
     q.add_argument("--email")
     q.add_argument("--tenant")
     q = cps.add_parser("logout")

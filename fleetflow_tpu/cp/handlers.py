@@ -69,21 +69,53 @@ def _require(payload: dict, *keys: str) -> list:
     return [payload[k] for k in keys]
 
 
+# Per-method permission verbs (VERDICT r2 item 4: per-route claims
+# enforcement, web.rs:140 / auth.rs Claims analog). A connection whose
+# authenticate verdict attached Claims must hold `<verb>:<channel>` (or
+# admin:all / `<verb>:*`) for each call; NoAuth connections carry no claims
+# and skip enforcement ("everything is the anonymous admin"). The agent
+# channel is exempt: agents authenticate with the same token gate at the
+# handshake, and their session protocol (register/heartbeat/alert/log/
+# command_result) is machine-to-machine, not an operator surface.
+#   - secret.get is deliberately NOT read-gated: it returns decrypted
+#     secret material, which a read-only dashboard grant must not reach
+#   - placement.solve is NOT read-gated: solve with reserve=true creates
+#     a capacity reservation (state mutation under a read grant otherwise)
+_READ_METHODS = frozenset({
+    "get", "list", "history", "status", "overview", "summary", "alerts",
+    "logs", "show", "snapshots", "ps", "pool.list", "user.list", "ping",
+})
+_PERM_EXEMPT_CHANNELS = frozenset({"agent"})
+
+
+def _perm_wrap(channel: str, handler):
+    """Wrap a channel handler with claims-based permission enforcement."""
+    if channel in _PERM_EXEMPT_CHANNELS:
+        return handler
+
+    async def wrapped(conn: Connection, method: str, p: dict):
+        claims = getattr(conn, "claims", None)
+        if claims is not None:
+            verb = "read" if method in _READ_METHODS else "write"
+            perm = f"{verb}:{channel}"
+            if not claims.has(perm):
+                raise PermissionError(
+                    f"missing permission {perm} (have: "
+                    f"{', '.join(claims.permissions) or 'none'})")
+        return await handler(conn, method, p)
+
+    return wrapped
+
+
 def register_all(server: ProtocolServer, state: "AppState") -> None:
     """handlers/mod.rs register_all:21-35."""
-    server.register_channel("tenant", _tenant(state))
-    server.register_channel("project", _project(state))
-    server.register_channel("stage", _stage(state))
-    server.register_channel("service", _service(state))
-    server.register_channel("container", _container(state))
-    server.register_channel("server", _server(state))
-    server.register_channel("health", _health(state))
-    server.register_channel("cost", _cost(state))
-    server.register_channel("dns", _dns(state))
-    server.register_channel("deploy", _deploy(state))
-    server.register_channel("volume", _volume(state))
-    server.register_channel("build", _build(state))
-    server.register_channel("placement", _placement(state))
+    for channel, factory in (
+            ("tenant", _tenant), ("project", _project), ("stage", _stage),
+            ("service", _service), ("container", _container),
+            ("server", _server), ("health", _health), ("cost", _cost),
+            ("dns", _dns), ("deploy", _deploy), ("volume", _volume),
+            ("build", _build), ("placement", _placement)):
+        server.register_channel(channel, _perm_wrap(channel, factory(state)))
     agent_handler, agent_events = _agent(state)
     server.register_channel("agent", agent_handler, agent_events)
     server.on_disconnect = _on_disconnect(state)

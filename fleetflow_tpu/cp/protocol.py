@@ -78,6 +78,10 @@ class Connection:
     reader: asyncio.StreamReader
     writer: asyncio.StreamWriter
     identity: str = "?"
+    # Claims attached by the server's authenticate callback (None when the
+    # server runs without auth or the callback returns a bare bool); channel
+    # handlers enforce per-method permissions against this.
+    claims: Optional[object] = None
     handlers: dict[str, Handler] = field(default_factory=dict)
     event_handlers: dict[str, EventHandler] = field(default_factory=dict)
     _ids: itertools.count = field(default_factory=lambda: itertools.count(1))
@@ -227,7 +231,9 @@ class ProtocolServer:
             writer.close()
             return
         identity = str(hello.get("identity", "?"))
-        if self.authenticate and not self.authenticate(identity, hello.get("token")):
+        verdict = (self.authenticate(identity, hello.get("token"))
+                   if self.authenticate else True)
+        if not verdict:
             log.warning("rejected %s", kv(identity=identity,
                                           reason="unauthorized"))
             writer.write(encode_frame({"type": "error", "error": "unauthorized"}))
@@ -237,6 +243,8 @@ class ProtocolServer:
         log.info("connected %s", kv(identity=identity,
                                     peers=len(self.connections) + 1))
         conn = Connection(reader=reader, writer=writer, identity=identity,
+                          # a truthy non-bool verdict is the peer's Claims
+                          claims=None if verdict is True else verdict,
                           handlers=self.handlers,
                           event_handlers=self.event_handlers)
         self.connections.add(conn)

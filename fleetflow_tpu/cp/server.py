@@ -33,8 +33,11 @@ class ServerConfig:
     port: int = 0                      # 0 = ephemeral (tests)
     name: str = "fleetflow-cp"
     db_path: Optional[str] = None      # None = in-memory (kv-mem analog)
-    auth_kind: str = "none"            # none | token
+    auth_kind: str = "none"            # none | token | jwks/auth0
     auth_secret: Optional[str] = None
+    auth_jwks: Optional[str] = None    # JWKS url/path for kind=jwks
+    auth_issuer: Optional[str] = None
+    auth_audience: Optional[str] = None
     tls_dir: Optional[str] = None      # mesh-CA dir; None = plaintext
     use_tpu_solver: bool = False
     master_key_env: bool = False       # load SecretBox from env
@@ -110,7 +113,9 @@ async def start(config: ServerConfig, *,
                 ) -> CpServerHandle:
     """server.rs start:82-126."""
     store = Store(config.db_path)
-    auth = make_provider(config.auth_kind, config.auth_secret)
+    auth = make_provider(config.auth_kind, config.auth_secret,
+                         jwks=config.auth_jwks, issuer=config.auth_issuer,
+                         audience=config.auth_audience)
 
     secret_box = None
     if config.master_key_env:
@@ -132,12 +137,16 @@ async def start(config: ServerConfig, *,
         deploy_sleep=deploy_sleep,
     )
 
-    def authenticate(identity: str, token: Optional[str]) -> bool:
+    def authenticate(identity: str, token: Optional[str]):
+        """Returns the peer's Claims (stashed on the Connection for
+        per-method permission checks, handlers._need_perm) or False.
+        NoAuth returns True: no claims, handlers skip enforcement —
+        the reference's NoAuth '(everything is the anonymous admin)'."""
         if isinstance(auth, NoAuth):
             return True
         try:
             claims: Claims = auth.verify(token)
-            return bool(claims.sub)
+            return claims if claims.sub else False
         except Exception:
             return False
 

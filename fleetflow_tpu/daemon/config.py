@@ -31,6 +31,9 @@ class DaemonConfig:
     db_path: Optional[str] = "~/.local/state/fleetflow/cp.json"
     auth_kind: str = "none"
     auth_secret: Optional[str] = None
+    auth_jwks: Optional[str] = None
+    auth_issuer: Optional[str] = None
+    auth_audience: Optional[str] = None
     tls_dir: Optional[str] = "~/.local/state/fleetflow/ca"
     health_interval_s: float = 60.0        # config.rs:33
     heartbeat_stale_s: float = 90.0
@@ -94,6 +97,10 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             secret = node.prop("secret")
             if secret is not None:
                 cfg.auth_secret = str(secret)
+            for key in ("jwks", "issuer", "audience"):
+                val = node.prop(key)
+                if val is not None:
+                    setattr(cfg, f"auth_{key}", str(val))
         elif n == "tls-dir":
             cfg.tls_dir = str(v) if v else None
         elif n == "health-interval":

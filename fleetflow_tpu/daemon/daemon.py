@@ -37,7 +37,9 @@ class Daemon:
         self.cp = await cp_start(ServerConfig(
             host=cfg.listen_host, port=cfg.listen_port,
             db_path=cfg.db_path, auth_kind=cfg.auth_kind,
-            auth_secret=cfg.auth_secret, tls_dir=cfg.tls_dir,
+            auth_secret=cfg.auth_secret, auth_jwks=cfg.auth_jwks,
+            auth_issuer=cfg.auth_issuer, auth_audience=cfg.auth_audience,
+            tls_dir=cfg.tls_dir,
             use_tpu_solver=cfg.use_tpu_solver))
         if cfg.web_enabled:
             self.web = WebServer(self.cp.state)

@@ -1,0 +1,406 @@
+"""External-IdP auth: RS256/JWKS verification, per-route permissions,
+device-flow login (VERDICT r2 item 4).
+
+Ref analogs: controlplane/src/auth.rs:26-38 (Auth0Verifier: JWKS cache +
+Claims with permissions), fleetflowd/src/web.rs:140 (per-route claims
+middleware), fleetflow/src/auth.rs:68-263 (Device Flow login).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs
+
+import pytest
+
+from fleetflow_tpu.cp.auth import (AuthError, Claims, JwksAuth, TokenAuth,
+                                   make_provider)
+
+from test_cp import run  # shared asyncio runner
+
+
+# -- RS256 fixture ----------------------------------------------------------
+
+def _b64url(data: bytes) -> str:
+    import base64
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+class RsaIdp:
+    """A tiny in-test identity provider: one RSA key, JWKS doc, RS256
+    token minting."""
+
+    def __init__(self, kid: str = "k1", issuer: str = "https://idp.test/"):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        self.key = rsa.generate_private_key(public_exponent=65537,
+                                            key_size=2048)
+        self.kid = kid
+        self.issuer = issuer
+
+    def jwks(self) -> dict:
+        pub = self.key.public_key().public_numbers()
+        nbytes = (pub.n.bit_length() + 7) // 8
+        return {"keys": [{
+            "kty": "RSA", "kid": self.kid, "use": "sig", "alg": "RS256",
+            "n": _b64url(pub.n.to_bytes(nbytes, "big")),
+            "e": _b64url(pub.e.to_bytes(3, "big")),
+        }]}
+
+    def token(self, sub: str = "auth0|user1", permissions=None, scope=None,
+              exp_in: float = 3600.0, aud="fleet-api", kid=None,
+              issuer=None, email="op@example.com") -> str:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        header = {"alg": "RS256", "typ": "JWT", "kid": kid or self.kid}
+        payload = {"sub": sub, "email": email,
+                   "iss": issuer or self.issuer, "aud": aud,
+                   "iat": int(time.time()),
+                   "exp": int(time.time() + exp_in)}
+        if permissions is not None:
+            payload["permissions"] = permissions
+        if scope is not None:
+            payload["scope"] = scope
+        signing = (_b64url(json.dumps(header).encode()) + "." +
+                   _b64url(json.dumps(payload).encode()))
+        sig = self.key.sign(signing.encode(), padding.PKCS1v15(),
+                            hashes.SHA256())
+        return signing + "." + _b64url(sig)
+
+
+@pytest.fixture(scope="module")
+def idp():
+    return RsaIdp()
+
+
+class TestJwksAuth:
+    def test_valid_token_verifies(self, idp):
+        auth = JwksAuth(idp.jwks(), issuer=idp.issuer, audience="fleet-api")
+        claims = auth.verify(idp.token(permissions=["read:servers"]))
+        assert claims.email == "op@example.com"
+        assert claims.has("read:servers")
+        assert not claims.has("write:servers")
+
+    def test_scope_fallback(self, idp):
+        auth = JwksAuth(idp.jwks())
+        claims = auth.verify(idp.token(scope="read:stages write:stages"))
+        assert claims.has("read:stages") and claims.has("write:stages")
+
+    def test_expired_rejected(self, idp):
+        auth = JwksAuth(idp.jwks())
+        with pytest.raises(AuthError, match="expired"):
+            auth.verify(idp.token(exp_in=-10))
+
+    def test_wrong_issuer_rejected(self, idp):
+        auth = JwksAuth(idp.jwks(), issuer=idp.issuer)
+        with pytest.raises(AuthError, match="issuer"):
+            auth.verify(idp.token(issuer="https://evil.test/"))
+
+    def test_wrong_audience_rejected(self, idp):
+        auth = JwksAuth(idp.jwks(), audience="fleet-api")
+        with pytest.raises(AuthError, match="audience"):
+            auth.verify(idp.token(aud="other-api"))
+
+    def test_unknown_kid_rejected(self, idp):
+        auth = JwksAuth(idp.jwks())
+        with pytest.raises(AuthError, match="unknown signing key"):
+            auth.verify(idp.token(kid="rotated-away"))
+
+    def test_tampered_signature_rejected(self, idp):
+        auth = JwksAuth(idp.jwks())
+        tok = idp.token()
+        head, pay, sig = tok.split(".")
+        with pytest.raises(AuthError, match="signature"):
+            auth.verify(f"{head}.{pay}.{sig[:-4]}AAAA")
+
+    def test_hs256_alg_confusion_rejected(self, idp):
+        # classic JWT attack: re-sign with HS256 using public material
+        auth = JwksAuth(idp.jwks())
+        hs = TokenAuth("guessable").issue("evil@x", ["admin:all"])
+        with pytest.raises(AuthError, match="alg"):
+            auth.verify(hs)
+
+    def test_key_rotation_refetches(self, idp, tmp_path):
+        path = tmp_path / "jwks.json"
+        path.write_text(json.dumps(idp.jwks()))
+        auth = JwksAuth(str(path))
+        auth._cooldown = 0.0    # no rate limit in tests
+        assert auth.verify(idp.token()).sub
+        idp2 = RsaIdp(kid="k2", issuer=idp.issuer)
+        doc = idp.jwks()
+        doc["keys"] += idp2.jwks()["keys"]
+        path.write_text(json.dumps(doc))
+        assert auth.verify(idp2.token()).sub    # unknown kid -> refetch
+
+    def test_jwks_file_source_and_make_provider(self, idp, tmp_path):
+        path = tmp_path / "jwks.json"
+        path.write_text(json.dumps(idp.jwks()))
+        auth = make_provider("auth0", jwks=str(path), issuer=idp.issuer)
+        assert auth.verify(idp.token()).email == "op@example.com"
+        with pytest.raises(AuthError, match="issue"):
+            auth.issue("x@y", ["admin:all"])
+
+    def test_bad_source_fails_loudly(self, tmp_path):
+        with pytest.raises(AuthError, match="cannot load JWKS"):
+            JwksAuth(str(tmp_path / "missing.json"))
+
+
+class TestClaimsWildcards:
+    def test_verb_wildcard(self):
+        c = Claims(sub="s", permissions=["read:*"])
+        assert c.has("read:anything") and not c.has("write:anything")
+
+    def test_admin_all(self):
+        assert Claims(sub="s", permissions=["admin:all"]).has("write:x")
+
+
+class TestWebRoutePermissions:
+    """Per-route enforcement in daemon/web.py (web.rs:140 analog):
+    read-only claims can GET but mutations 403."""
+
+    def test_read_only_token_cannot_mutate(self):
+        from fleetflow_tpu.cp import ServerConfig, start
+        from fleetflow_tpu.daemon.web import WebServer
+        from test_cp import mock_backend_factory
+        from test_daemon import http_get, http_post
+
+        async def go():
+            handle = await start(ServerConfig(auth_kind="token",
+                                              auth_secret="s3"),
+                                 backend_factory=mock_backend_factory)
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            reader = handle.state.auth.issue("ro@x", ["read:*"])
+            writer = handle.state.auth.issue("rw@x", ["read:*", "write:*"])
+
+            st, _ = await http_get(host, port, "/api/overview", reader)
+            assert st == 200
+            st, body = await http_post(host, port, "/api/tenants",
+                                       {"name": "acme"}, reader)
+            assert st == 403, body
+            assert "write:tenant" in body["error"]
+            st, _ = await http_post(host, port, "/api/tenants",
+                                    {"name": "acme"}, writer)
+            assert st in (200, 201)
+            # narrow grant: read:overview alone cannot read servers
+            narrow = handle.state.auth.issue("n@x", ["read:overview"])
+            st, _ = await http_get(host, port, "/api/overview", narrow)
+            assert st == 200
+            st, _ = await http_get(host, port, "/api/servers", narrow)
+            assert st == 403
+            await web.stop()
+            await handle.stop()
+        run(go())
+
+
+class TestCrossSurfaceVocabulary:
+    """One grant vocabulary across REST and RPC: read:server works on
+    GET /api/servers AND the server.list channel method."""
+
+    def test_same_grant_both_surfaces(self):
+        from fleetflow_tpu.cp.protocol import ProtocolClient
+        from fleetflow_tpu.daemon.web import WebServer
+        from test_cp import mock_backend_factory, start_cp
+        from test_daemon import http_get
+
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            tok = handle.state.auth.issue("s@x", ["read:server"])
+            st, _ = await http_get(host, port, "/api/servers", tok)
+            assert st == 200
+            conn, task = await ProtocolClient.connect(
+                "127.0.0.1", handle.port, identity="cli", token=tok)
+            assert "servers" in await conn.request("server", "list")
+            await conn.close()
+            task.cancel()
+            await web.stop()
+            await handle.stop()
+        run(go())
+
+    def test_secret_get_needs_write(self):
+        from fleetflow_tpu.cp.protocol import ProtocolClient, RpcError
+        from test_cp import start_cp
+
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            ro = handle.state.auth.issue("ro@x", ["read:*"])
+            conn, task = await ProtocolClient.connect(
+                "127.0.0.1", handle.port, identity="cli", token=ro)
+            # decrypted secret material is not a read-grant payload
+            with pytest.raises(RpcError, match="write:tenant"):
+                await conn.request("tenant", "secret.get",
+                                   {"name": "t", "key": "k"})
+            await conn.close()
+            task.cancel()
+            await handle.stop()
+        run(go())
+
+
+class TestChannelPermissions:
+    """Per-method enforcement on CP channels (handlers._perm_wrap)."""
+
+    def test_read_only_client_cannot_mutate(self):
+        from fleetflow_tpu.cp.protocol import ProtocolClient, RpcError
+        from test_cp import mock_backend_factory, start_cp
+
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            ro = handle.state.auth.issue("ro@x", ["read:*"])
+            conn, task = await ProtocolClient.connect(
+                "127.0.0.1", handle.port, identity="cli", token=ro)
+            out = await conn.request("tenant", "list")
+            assert "tenants" in out
+            with pytest.raises(RpcError, match="write:tenant"):
+                await conn.request("tenant", "create", {"name": "acme"})
+            await conn.close()
+            task.cancel()
+            await handle.stop()
+        run(go())
+
+    def test_admin_token_can_mutate(self):
+        from fleetflow_tpu.cp.protocol import ProtocolClient
+        from test_cp import start_cp
+
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            admin = handle.state.auth.issue("op@x", ["admin:all"])
+            conn, task = await ProtocolClient.connect(
+                "127.0.0.1", handle.port, identity="cli", token=admin)
+            out = await conn.request("tenant", "create", {"name": "acme"})
+            assert out["tenant"]["name"] == "acme"
+            await conn.close()
+            task.cancel()
+            await handle.stop()
+        run(go())
+
+
+# -- device flow ------------------------------------------------------------
+
+class MockIdpHandler(BaseHTTPRequestHandler):
+    """RFC 8628 shape: /oauth/device/code then /oauth/token with two
+    pending polls before success (or denial when configured)."""
+    polls_until_grant = 2
+    deny = False
+    state = {"polls": 0}
+
+    def log_message(self, *a):   # quiet
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        form = {k: v[0] for k, v in
+                parse_qs(self.rfile.read(length).decode()).items()}
+        if self.path == "/oauth/device/code":
+            self.state["polls"] = 0
+            self._json(200, {
+                "device_code": "dev-123", "user_code": "ABCD-EFGH",
+                "verification_uri": "https://idp.test/activate",
+                "verification_uri_complete":
+                    "https://idp.test/activate?user_code=ABCD-EFGH",
+                "interval": 0, "expires_in": 60})
+        elif self.path == "/oauth/token":
+            assert form["device_code"] == "dev-123"
+            if self.deny:
+                self._json(403, {"error": "access_denied"})
+                return
+            self.state["polls"] += 1
+            if self.state["polls"] <= self.polls_until_grant:
+                self._json(403, {"error": "authorization_pending"})
+            else:
+                self._json(200, {"access_token": "tok-xyz",
+                                 "token_type": "Bearer"})
+        else:
+            self._json(404, {"error": "not_found"})
+
+    def _json(self, status, doc):
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def mock_idp():
+    srv = HTTPServer(("127.0.0.1", 0), MockIdpHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+    t.join(timeout=5)
+
+
+class TestDeviceFlow:
+    def test_login_polls_until_grant(self, mock_idp, capsys):
+        from fleetflow_tpu.cli.device_flow import device_login
+        MockIdpHandler.deny = False
+        shown = []
+        tok = device_login(mock_idp, "cli-1", out=shown.append,
+                           sleep=lambda s: None)
+        assert tok["access_token"] == "tok-xyz"
+        assert any("ABCD-EFGH" in s for s in shown)
+        assert any("activate" in s for s in shown)
+
+    def test_login_denied(self, mock_idp):
+        from fleetflow_tpu.cli.device_flow import (DeviceFlowError,
+                                                   device_login)
+        MockIdpHandler.deny = True
+        try:
+            with pytest.raises(DeviceFlowError, match="denied"):
+                device_login(mock_idp, "cli-1", out=lambda s: None,
+                             sleep=lambda s: None)
+        finally:
+            MockIdpHandler.deny = False
+
+    def test_cli_login_via_idp(self, mock_idp, tmp_path, monkeypatch):
+        # fleet cp login --idp ... end to end, creds land in the store
+        # (HOME redirected: CRED_PATH expands under ~ at use time)
+        monkeypatch.setenv("HOME", str(tmp_path))
+        MockIdpHandler.deny = False
+        from fleetflow_tpu.cli.main import main
+        rc = main(["cp", "login", "--idp", mock_idp,
+                   "--client-id", "cli-1"])
+        assert rc == 0
+        saved = json.loads(
+            (tmp_path / ".config/fleetflow/credentials.json").read_text())
+        assert any(v.get("token") == "tok-xyz" for v in saved.values())
+
+
+class TestJwksEndToEnd:
+    """A JWKS-authenticated CP: RS256 token from the fixture IdP opens a
+    channel and is permission-enforced — the full production-auth path."""
+
+    def test_rs256_token_against_cp(self, idp, tmp_path):
+        from fleetflow_tpu.cp import ServerConfig, start
+        from fleetflow_tpu.cp.protocol import ProtocolClient, RpcError
+        from test_cp import mock_backend_factory
+
+        path = tmp_path / "jwks.json"
+        path.write_text(json.dumps(idp.jwks()))
+
+        async def go():
+            handle = await start(
+                ServerConfig(auth_kind="jwks", auth_jwks=str(path),
+                             auth_issuer=idp.issuer),
+                backend_factory=mock_backend_factory)
+            tok = idp.token(permissions=["read:health", "read:tenant"])
+            conn, task = await ProtocolClient.connect(
+                "127.0.0.1", handle.port, identity="cli", token=tok)
+            assert (await conn.request("health", "ping"))["pong"]
+            with pytest.raises(RpcError, match="write:tenant"):
+                await conn.request("tenant", "create", {"name": "x"})
+            await conn.close()
+            task.cancel()
+            # a garbage token is rejected at the handshake
+            with pytest.raises(Exception):
+                await ProtocolClient.connect(
+                    "127.0.0.1", handle.port, identity="cli",
+                    token="not-a-jwt")
+            await handle.stop()
+        run(go())
