@@ -33,14 +33,76 @@ def _default_runner(args: list[str]) -> tuple[int, str]:
     return proc.returncode, proc.stdout if proc.returncode == 0 else proc.stderr
 
 
+def parse_plan(plan: Optional[str]) -> tuple[int, int]:
+    """'2core-4gb' -> (cpu, memory_gb) (provider.rs parse_plan:16).
+    Unparseable or absent plans fall back to the 2core/4gb dogfood size."""
+    if plan:
+        import re as _re
+        m = _re.fullmatch(r"(\d+)core-(\d+)gb", plan.strip().lower())
+        if m:
+            return max(int(m.group(1)), 1), max(int(m.group(2)), 1)
+    return 2, 4
+
+
 class SakuraServerProvider(ServerProvider):
-    """usacloud.rs:21-66 CRUD."""
+    """usacloud.rs:21-66 CRUD + the note (startup-script) management of
+    provider.rs:131-190: named scripts resolve to cloud notes —
+    builtins (cloud/startup_scripts.py) are get-or-created, user scripts
+    are looked up by name — and attach to server create; @@VAR@@
+    placeholders are substituted via script_vars before registration."""
 
     name = "sakura"
 
     def __init__(self, zone: str = DEFAULT_ZONE, runner=None):
         self.zone = zone
         self.runner = runner or _default_runner
+
+    # -- notes (startup scripts) --------------------------------------
+    def find_note_by_name(self, name: str) -> Optional[str]:
+        for row in self._json("note", "list"):
+            if row.get("Name") == name:
+                return str(row.get("ID", "")) or None
+        return None
+
+    def get_or_create_note(self, name: str, content: str) -> str:
+        """provider.rs get_or_create_note:153 via `usacloud note`."""
+        existing = self.find_note_by_name(name)
+        if existing:
+            return existing
+        rows = self._json("note", "create", "--name", name,
+                          "--content", content, "--class", "shell", "-y")
+        nid = str(rows[0].get("ID", "")) if rows else ""
+        if not nid:
+            raise CloudError(f"note create for {name!r} returned no id")
+        return nid
+
+    def resolve_startup_scripts(self, names: list[str],
+                                script_vars: Optional[dict] = None
+                                ) -> list[str]:
+        """Script names -> note ids. Builtins are registered on first use
+        (with @@VAR@@ substitution); unknown non-builtin names must already
+        exist as notes or the create fails loudly (provider.rs:148-177)."""
+        from .startup_scripts import get_builtin_script, substitute_vars
+        ids = []
+        for name in names:
+            content = get_builtin_script(name)
+            if content is not None:
+                content = substitute_vars(content, script_vars, context=name)
+                # vars change content: key the note by name+vars hash so a
+                # new CP endpoint doesn't silently reuse the stale note
+                note_name = name
+                if script_vars:
+                    import hashlib as _h
+                    note_name = (f"{name}-"
+                                 f"{_h.sha256(content.encode()).hexdigest()[:8]}")
+                ids.append(self.get_or_create_note(note_name, content))
+                continue
+            nid = self.find_note_by_name(name)
+            if nid is None:
+                raise CloudError(f"startup script {name!r} is not a builtin "
+                                 f"and no note with that name exists")
+            ids.append(nid)
+        return ids
 
     def _json(self, *args: str) -> list[dict]:
         rc, out = self.runner([*args, "--zone", self.zone, "--output-type",
@@ -76,22 +138,40 @@ class SakuraServerProvider(ServerProvider):
                 return s
         return None
 
-    def create_server(self, spec: ServerResource) -> ServerInfo:
+    def create_server(self, spec: ServerResource,
+                      script_vars: Optional[dict] = None) -> ServerInfo:
+        """Create with disk + startup scripts (provider.rs
+        create_server:102-190): the plan string ('2core-4gb') wins over
+        capacity when present, the startup script resolves to note ids."""
+        if spec.plan:
+            cpu, mem_gb = parse_plan(spec.plan)
+        else:
+            cpu = int(max(spec.capacity.cpu, 1))
+            mem_gb = int(max(spec.capacity.memory / 1024, 1))
         args = ["server", "create", "--name", spec.name,
-                "--cpu", str(int(max(spec.capacity.cpu, 1))),
-                "--memory", str(int(max(spec.capacity.memory / 1024, 1))),
+                "--cpu", str(cpu), "--memory", str(mem_gb),
                 "--disk-size", str(spec.disk_size or 40),
                 "--os-type", spec.os or "ubuntu2204", "-y"]
         if spec.startup_script:
-            args += ["--note", spec.startup_script]
+            names = [s.strip() for s in spec.startup_script.split(",")
+                     if s.strip()]
+            for nid in self.resolve_startup_scripts(names, script_vars):
+                args += ["--note-id", nid]
+        for key in spec.ssh_keys:
+            args += ["--ssh-key-ids", key]
         for tag in spec.tags:
             args += ["--tags", tag]
         rows = self._json(*args)
         return self._info(rows[0]) if rows else ServerInfo(id="", name=spec.name)
 
-    def delete_server(self, server_id: str) -> bool:
-        rc, _ = self.runner(["server", "delete", server_id, "--zone",
-                             self.zone, "-y", "--output-type", "json"])
+    def delete_server(self, server_id: str, with_disks: bool = True) -> bool:
+        """provider.rs delete_server:199: fleet nodes own their disks, so
+        deletion removes them by default (no orphaned disk billing)."""
+        args = ["server", "delete", server_id, "--zone", self.zone, "-y",
+                "--output-type", "json"]
+        if with_disks:
+            args.insert(3, "--with-disks")
+        rc, _ = self.runner(args)
         return rc == 0
 
     def power_on(self, server_id: str) -> bool:
@@ -149,10 +229,24 @@ class SakuraProvider(CloudProvider):
                 plan.actions.append(Action(
                     ActionType.NOOP, "server", spec.name, "exists"))
             else:
+                # full spec rides the plan so apply creates what was
+                # declared (disk, plan, scripts), not a bare default
                 plan.actions.append(Action(
                     ActionType.CREATE, "server", spec.name,
-                    f"plan={spec.plan or 'default'}",
-                    desired={"name": spec.name}))
+                    f"plan={spec.plan or 'default'} "
+                    f"disk={spec.disk_size or 40}gb"
+                    + (f" scripts={spec.startup_script}"
+                       if spec.startup_script else ""),
+                    desired={"name": spec.name, "plan": spec.plan,
+                             "disk_size": spec.disk_size, "os": spec.os,
+                             "startup_script": spec.startup_script,
+                             "ssh_keys": spec.ssh_keys, "tags": spec.tags,
+                             # per-server script variables; the provider
+                             # declaration's script-vars option supplies
+                             # fleet-wide ones (CP endpoint, CA)
+                             "script_vars": dict(
+                                 (decl.options or {}).get("script-vars")
+                                 or {}, SERVER_SLUG=spec.name)}))
         for name in current:
             if name not in desired_names:
                 plan.actions.append(Action(
@@ -165,8 +259,15 @@ class SakuraProvider(CloudProvider):
         for action in plan.changes:
             try:
                 if action.type is ActionType.CREATE:
+                    d = action.desired or {}
                     info = self.servers.create_server(
-                        ServerResource(name=action.resource_id))
+                        ServerResource(
+                            name=action.resource_id, plan=d.get("plan"),
+                            disk_size=d.get("disk_size"), os=d.get("os"),
+                            startup_script=d.get("startup_script"),
+                            ssh_keys=list(d.get("ssh_keys") or []),
+                            tags=list(d.get("tags") or [])),
+                        script_vars=d.get("script_vars") or None)
                     if not info.id:
                         raise CloudError(
                             f"create of {action.resource_id} returned no id")

@@ -85,6 +85,8 @@ class WebServer:
         "stage": "stage", "servers": "server", "deployments": "deploy",
         "volumes": "volume", "builds": "build", "agents": "agent",
         "alerts": "health", "health-check": "health", "users": "tenant",
+        "containers": "container", "logs": "container",
+        "pools": "server",   # worker pools live on the server channel
     }
 
     def route(self, method: str, pattern: str, *, public: bool = False,

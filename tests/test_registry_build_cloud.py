@@ -309,8 +309,291 @@ class TestCloud:
         from fleetflow_tpu.cloud.aws import instance_type_for
         assert instance_type_for("micro") == "t3.micro"
         assert instance_type_for("c5.large") == "c5.large"
-        assert instance_type_for(None, 1) == "t3.micro"
-        assert instance_type_for(None, 16) == "m5.2xlarge"
+        assert instance_type_for(None, 1, 1024) == "t3.micro"
+        # memory matters, not just cpu (reference instance-type models)
+        assert instance_type_for(None, 2, 8192) == "t3.large"
+        assert instance_type_for(None, 2, 16 * 1024) == "t3.xlarge"
+        assert instance_type_for(None, 16, 4096) == "m5.8xlarge"
+        assert instance_type_for(None, 64, 1024 * 1024) == "m5.8xlarge"
+
+    def test_sakura_plan_parsing(self):
+        from fleetflow_tpu.cloud.sakura import parse_plan
+        assert parse_plan("2core-4gb") == (2, 4)
+        assert parse_plan("8CORE-32GB") == (8, 32)
+        assert parse_plan("weird") == (2, 4)
+        assert parse_plan(None) == (2, 4)
+
+    def test_sakura_create_with_disk_and_startup_scripts(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        notes: dict[str, str] = {}   # name -> id
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["note", "list"]:
+                return 0, json.dumps([{"ID": nid, "Name": name}
+                                      for name, nid in notes.items()])
+            if args[:2] == ["note", "create"]:
+                name = args[args.index("--name") + 1]
+                notes[name] = str(700 + len(notes))
+                return 0, json.dumps([{"ID": notes[name], "Name": name}])
+            if args[:2] == ["server", "create"]:
+                return 0, json.dumps([{"ID": "900", "Name": "w1",
+                                       "InstanceStatus": "up"}])
+            return 0, "{}"
+
+        p = SakuraServerProvider(runner=runner)
+        spec = ServerResource(name="w1", plan="4core-8gb", disk_size=100,
+                              startup_script="docker-setup,agent-setup",
+                              tags=["fleet"])
+        info = p.create_server(spec, script_vars={
+            "CP_ENDPOINT": "cp.example:4510", "SERVER_SLUG": "w1",
+            "CA_PEM_B64": ""})
+        assert info.id == "900"
+        create = next(a for a in calls if a[:2] == ["server", "create"])
+        # plan string wins over capacity, disk size declared
+        assert create[create.index("--cpu") + 1] == "4"
+        assert create[create.index("--memory") + 1] == "8"
+        assert create[create.index("--disk-size") + 1] == "100"
+        # two builtin notes resolved to ids and attached
+        note_ids = [create[i + 1] for i, a in enumerate(create)
+                    if a == "--note-id"]
+        assert len(note_ids) == 2 and all(n in notes.values()
+                                          for n in note_ids)
+        # substituted content was registered (agent-setup carries the CP
+        # endpoint; the var-hash suffix keys the note)
+        created_note = next(a for a in calls if a[:2] == ["note", "create"]
+                            and "agent-setup" in a[a.index("--name") + 1])
+        assert "cp.example:4510" in created_note[
+            created_note.index("--content") + 1]
+        # second create of the same scripts reuses notes (get-or-create)
+        n_created = sum(1 for a in calls if a[:2] == ["note", "create"])
+        p.create_server(spec, script_vars={
+            "CP_ENDPOINT": "cp.example:4510", "SERVER_SLUG": "w1",
+            "CA_PEM_B64": ""})
+        assert sum(1 for a in calls
+                   if a[:2] == ["note", "create"]) == n_created
+
+    def test_sakura_unknown_script_fails_loudly(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        from fleetflow_tpu.core.errors import CloudError
+
+        def runner(args):
+            if args[:2] == ["note", "list"]:
+                return 0, "[]"
+            return 0, "{}"
+
+        p = SakuraServerProvider(runner=runner)
+        with pytest.raises(CloudError, match="not a builtin"):
+            p.create_server(ServerResource(name="w1",
+                                           startup_script="my-script"))
+
+    def test_sakura_delete_removes_disks(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        calls = []
+        p = SakuraServerProvider(runner=lambda a: (calls.append(a), (0, "{}"))[1])
+        p.delete_server("900")
+        assert "--with-disks" in calls[0]
+        p.delete_server("900", with_disks=False)
+        assert "--with-disks" not in calls[1]
+
+    def test_sakura_apply_creates_declared_spec(self):
+        from fleetflow_tpu.cloud.sakura import SakuraProvider
+        from fleetflow_tpu.core.model import CloudProviderDecl
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["server", "list"]:
+                return 0, "[]"
+            if args[:2] == ["server", "create"]:
+                return 0, json.dumps([{"ID": "300", "Name": "db-1",
+                                       "InstanceStatus": "up"}])
+            return 0, "{}"
+
+        p = SakuraProvider(runner=runner)
+        plan = p.plan(CloudProviderDecl(name="sakura"),
+                      [ServerResource(name="db-1", plan="4core-8gb",
+                                      disk_size=200)])
+        res = p.apply(plan)
+        assert res.ok
+        create = next(a for a in calls if a[:2] == ["server", "create"])
+        # the apply created what was DECLARED, not a bare default
+        assert create[create.index("--disk-size") + 1] == "200"
+        assert create[create.index("--cpu") + 1] == "4"
+
+    def test_aws_security_group_and_subnet(self):
+        from fleetflow_tpu.cloud.aws import AwsServerProvider
+        calls = []
+        sgs: dict[str, str] = {}
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["ec2", "describe-security-groups"]:
+                name = args[args.index("--filters") + 1].split("=")[-1]
+                hit = sgs.get(name)
+                return 0, json.dumps(
+                    {"SecurityGroups": [{"GroupId": hit}] if hit else []})
+            if args[:2] == ["ec2", "create-security-group"]:
+                name = args[args.index("--group-name") + 1]
+                sgs[name] = f"sg-{len(sgs)}"
+                return 0, json.dumps({"GroupId": sgs[name]})
+            if args[:2] == ["ec2", "authorize-security-group-ingress"]:
+                return 0, "{}"
+            if args[:2] == ["ec2", "create-subnet"]:
+                return 0, json.dumps({"Subnet": {"SubnetId": "subnet-1"}})
+            if args[:2] == ["ec2", "describe-subnets"]:
+                return 0, json.dumps({"Subnets": [
+                    {"SubnetId": "subnet-1",
+                     "Tags": [{"Key": "Name", "Value": "net-a"}]}]})
+            return 0, "{}"
+
+        net = AwsServerProvider(runner=runner).network
+        gid = net.ensure_security_group(
+            "fleet-sg", "vpc-1", [{"port": 22}, {"port": 443}])
+        assert gid == "sg-0"
+        ingress = [a for a in calls
+                   if a[:2] == ["ec2", "authorize-security-group-ingress"]]
+        assert len(ingress) == 2
+        assert ingress[0][ingress[0].index("--port") + 1] == "22"
+        # idempotent: second ensure finds the group, re-authorizes only
+        assert net.ensure_security_group("fleet-sg", "vpc-1",
+                                         [{"port": 22}]) == "sg-0"
+        assert sum(1 for a in calls
+                   if a[:2] == ["ec2", "create-security-group"]) == 1
+        sid = net.create_subnet("net-a", "vpc-1", "10.0.1.0/24", az="apne1-az1")
+        assert sid == "subnet-1"
+        create = next(a for a in calls if a[:2] == ["ec2", "create-subnet"])
+        assert "10.0.1.0/24" in create and "apne1-az1" in create
+        assert net.list_managed_subnets() == [("subnet-1", "net-a")]
+
+    def test_aws_create_with_network_disk_and_userdata(self):
+        from fleetflow_tpu.cloud.aws import AwsServerProvider
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["ec2", "run-instances"]:
+                return 0, json.dumps({"Instances": [
+                    {"InstanceId": "i-1", "State": {"Name": "running"},
+                     "Tags": [{"Key": "Name", "Value": "w1"}]}]})
+            return 0, "{}"
+
+        p = AwsServerProvider(runner=runner)
+        spec = ServerResource(name="w1", disk_size=120,
+                              startup_script="docker-setup",
+                              ssh_keys=["ops-key"])
+        info = p.create_server(spec, subnet_id="subnet-1",
+                               security_group_ids=["sg-0"])
+        assert info.id == "i-1"
+        run = calls[0]
+        assert run[run.index("--subnet-id") + 1] == "subnet-1"
+        assert run[run.index("--security-group-ids") + 1] == "sg-0"
+        assert run[run.index("--key-name") + 1] == "ops-key"
+        bdm = json.loads(run[run.index("--block-device-mappings") + 1])
+        assert bdm[0]["Ebs"]["VolumeSize"] == 120
+        # raw script text: the AWS CLI base64-encodes --user-data itself,
+        # so pre-encoding would double-encode (cloud-init would see soup)
+        ud = run[run.index("--user-data") + 1]
+        assert ud.startswith("#!/bin/bash") and "docker" in ud
+
+    def test_aws_plan_includes_network_objects(self):
+        from fleetflow_tpu.cloud.aws import AwsProvider
+        from fleetflow_tpu.core.model import CloudProviderDecl
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["ec2", "describe-instances"]:
+                return 0, json.dumps({"Reservations": []})
+            if args[:2] == ["ec2", "describe-security-groups"]:
+                return 0, json.dumps({"SecurityGroups": []})
+            if args[:2] == ["ec2", "describe-subnets"]:
+                return 0, json.dumps({"Subnets": []})
+            if args[:2] == ["ec2", "create-security-group"]:
+                return 0, json.dumps({"GroupId": "sg-9"})
+            if args[:2] == ["ec2", "authorize-security-group-ingress"]:
+                return 0, "{}"
+            if args[:2] == ["ec2", "create-subnet"]:
+                return 0, json.dumps({"Subnet": {"SubnetId": "subnet-9"}})
+            if args[:2] == ["ec2", "run-instances"]:
+                return 0, json.dumps({"Instances": [
+                    {"InstanceId": "i-9", "State": {"Name": "running"}}]})
+            return 0, "{}"
+
+        p = AwsProvider(runner=runner)
+        decl = CloudProviderDecl(name="aws", options={
+            "vpc": "vpc-1", "subnet-cidr": "10.0.2.0/24",
+            "ingress": [22, 4510]})
+        plan = p.plan(decl, [ServerResource(name="node-1", plan="small")])
+        kinds = {(a.type.value, a.resource_type) for a in plan.actions}
+        assert ("create", "security_group") in kinds
+        assert ("create", "subnet") in kinds
+        assert ("create", "server") in kinds
+        res = p.apply(plan)
+        assert res.ok, res.failed
+        # instance landed in the subnet + SG the same apply created
+        run = next(a for a in calls if a[:2] == ["ec2", "run-instances"])
+        assert run[run.index("--subnet-id") + 1] == "subnet-9"
+        assert run[run.index("--security-group-ids") + 1] == "sg-9"
+
+    def test_aws_second_apply_wires_existing_network(self):
+        # apply #2: SG/subnet already exist, so the plan has no network
+        # actions — new servers must still land in them (resolved by name)
+        from fleetflow_tpu.cloud.aws import AwsProvider
+        from fleetflow_tpu.core.model import CloudProviderDecl
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["ec2", "describe-instances"]:
+                return 0, json.dumps({"Reservations": []})
+            if args[:2] == ["ec2", "describe-security-groups"]:
+                return 0, json.dumps(
+                    {"SecurityGroups": [{"GroupId": "sg-old"}]})
+            if args[:2] == ["ec2", "describe-subnets"]:
+                return 0, json.dumps({"Subnets": [
+                    {"SubnetId": "subnet-old",
+                     "Tags": [{"Key": "Name",
+                               "Value": "fleetflow-ap-northeast-1"}]}]})
+            if args[:2] == ["ec2", "run-instances"]:
+                return 0, json.dumps({"Instances": [
+                    {"InstanceId": "i-2", "State": {"Name": "running"}}]})
+            return 0, "{}"
+
+        p = AwsProvider(runner=runner)
+        decl = CloudProviderDecl(name="aws", options={
+            "vpc": "vpc-1", "subnet-cidr": "10.0.2.0/24", "ingress": [22]})
+        plan = p.plan(decl, [ServerResource(name="node-2")])
+        assert {a.resource_type for a in plan.changes} == {"server"}
+        res = p.apply(plan)
+        assert res.ok, res.failed
+        run = next(a for a in calls if a[:2] == ["ec2", "run-instances"])
+        assert run[run.index("--subnet-id") + 1] == "subnet-old"
+        assert run[run.index("--security-group-ids") + 1] == "sg-old"
+
+    def test_missing_script_vars_fail_loudly(self):
+        # agent-setup without CP_ENDPOINT must error, not ship a unit file
+        # with a literal @@CP_ENDPOINT@@ (silently unjoinable node)
+        from fleetflow_tpu.cloud.aws import AwsServerProvider
+        from fleetflow_tpu.core.errors import CloudError
+        p = AwsServerProvider(runner=lambda a: (0, "{}"))
+        with pytest.raises(CloudError, match="CP_ENDPOINT"):
+            p.create_server(ServerResource(name="w1",
+                                           startup_script="agent-setup"))
+
+    def test_builtin_startup_scripts(self):
+        from fleetflow_tpu.cloud.startup_scripts import (
+            get_builtin_script, is_builtin_script)
+        assert is_builtin_script("docker-setup")
+        assert is_builtin_script("agent-setup")
+        assert is_builtin_script("worker-init")
+        assert not is_builtin_script("nope")
+        assert get_builtin_script("nope") is None
+        for name in ("docker-setup", "agent-setup", "worker-init"):
+            s = get_builtin_script(name)
+            assert s.startswith("#!/bin/bash")
+            assert f"/var/lib/fleetflow/{name}.done" in s
 
     def test_ssh_argv(self):
         from fleetflow_tpu.cloud.ssh import SshTarget, exec
