@@ -728,7 +728,7 @@ def _cp_dispatch(cp: CpClient, args) -> int:
             return show(cp.request("cost", "summary",
                                    {"tenant": args.tenant or "default",
                                     "month": _need(args.month, "--month")}))
-        if args.verb == "add":
+        if args.verb in ("add", "record"):
             return show(cp.request("cost", "add",
                                    {"tenant": args.tenant or "default",
                                     "month": _need(args.month, "--month"),
@@ -1028,7 +1028,8 @@ def build_parser() -> argparse.ArgumentParser:
             q.add_argument("--max", type=int, help="pool max servers")
 
     q = cps.add_parser("cost")
-    q.add_argument("verb", choices=["list", "summary", "add"])
+    # "record" = the reference's verb (CostCommands::Record); "add" kept
+    q.add_argument("verb", choices=["list", "summary", "add", "record"])
     q.add_argument("--month")
     q.add_argument("--amount", type=float)
     q.add_argument("--tenant")

@@ -221,7 +221,7 @@ def _stage(state: "AppState"):
                 alerts = [a.to_dict() for a in db.active_alerts()
                           if any(a.server == srv for srv in stage.servers)]
             return {"services": services,
-                    "last_deployment": deps[0].to_dict() if deps else None,
+                    "last_deployment": deps[0].public_dict() if deps else None,
                     "alerts": alerts}
         if method == "adopt":
             (sid,) = _require(p, "stage")
@@ -524,7 +524,7 @@ def _deploy(state: "AppState"):
     async def handle(conn: Connection, method: str, p: dict) -> dict:
         db = state.store
         if method == "history":
-            return {"deployments": [d.to_dict() for d in db.deployment_history(
+            return {"deployments": [d.public_dict() for d in db.deployment_history(
                 stage=p.get("stage"), limit=p.get("limit", 50))]}
         if method == "run":
             # legacy SSH remote-exec path (handlers/deploy.rs:24-252):
@@ -562,77 +562,88 @@ def _deploy(state: "AppState"):
                 db.finish_deployment(dep.id, DeploymentStatus.FAILED,
                                      error=str(e))
                 raise
-            return {"deployment": db.get("deployments", dep.id).to_dict()}
+            return {"deployment": db.get("deployments", dep.id).public_dict()}
         if method == "execute":
-            req = DeployRequest.from_dict(p["request"])
-            tenant_name = p.get("tenant", "default")
-            tenant = db.ensure_tenant(tenant_name)
-            project = db.ensure_project(tenant.name, req.flow.name)
-            stage_cfg = req.flow.stage(req.stage_name)
-            stage = db.ensure_stage(project.id, req.stage_name,
-                                    backend=stage_cfg.backend.value,
-                                    servers=stage_cfg.servers)
-            dep = db.create("deployments", Deployment(
-                tenant=tenant.name, project=project.id, stage=stage.id,
-                status=DeploymentStatus.RUNNING.value,
-                services=[s.name for s in stage_cfg.resolved_services(req.flow)]))
-
-            targets = [s for s in stage_cfg.servers
-                       if state.agent_registry.is_connected(s)]
-            try:
-                if targets:
-                    # Fan out to EVERY connected stage server concurrently —
-                    # the reference routes to .first() only and defers fan-out
-                    # (handlers/deploy.rs:386-398); the placement solve makes
-                    # per-node slices explicit, so we send each agent its own.
-                    placement, rid = await asyncio.get_running_loop(
-                        ).run_in_executor(None, lambda: state.placement
-                                          .solve_stage(req.flow, req.stage_name,
-                                                       tenant=tenant.name))
-                    if not placement.feasible:
-                        raise ValueError(
-                            f"placement infeasible: {placement.violations}")
-                    results = await asyncio.gather(*[
-                        state.agent_registry.send_command(
-                            slug, "deploy.execute",
-                            {"request": DeployRequest(
-                                flow=req.flow, stage_name=req.stage_name,
-                                target_services=req.target_services,
-                                no_pull=req.no_pull, no_prune=req.no_prune,
-                                node=slug).to_dict(),
-                             "assignment": placement.assignment},
-                            timeout=DEPLOY_TIMEOUT)
-                        for slug in targets], return_exceptions=True)
-                    errors = [str(r) for r in results if isinstance(r, Exception)]
-                    if errors:
-                        if rid:
-                            state.placement.release(rid)
-                        raise ValueError("; ".join(errors))
-                    if rid:
-                        state.placement.commit(rid)
-                    log = "\n".join(str(r) for r in results
-                                    if not isinstance(r, Exception))
-                    db.update("deployments", dep.id,
-                              placement=placement.assignment)
-                else:
-                    # CP-local execution (handlers/deploy.rs:470-507)
-                    engine = DeployEngine(state.backend_factory(),
-                                          sleep=state.deploy_sleep)
-                    res = await asyncio.get_running_loop().run_in_executor(
-                        None, lambda: engine.execute(req))
-                    if not res.ok:
-                        raise ValueError(f"failed services: {res.failed}")
-                    log = f"deployed {len(res.deployed)} containers locally"
-                for svc in (db.get("deployments", dep.id).services or []):
-                    db.upsert_service(stage.id, svc, status="deployed")
-                db.finish_deployment(dep.id, DeploymentStatus.SUCCEEDED, log=log)
-            except Exception as e:
-                db.finish_deployment(dep.id, DeploymentStatus.FAILED,
-                                     error=str(e))
-                raise
-            return {"deployment": db.get("deployments", dep.id).to_dict()}
+            return await execute_deploy(
+                state, DeployRequest.from_dict(p["request"]),
+                tenant_name=p.get("tenant", "default"))
         raise ValueError(f"unknown method deploy.{method}")
     return handle
+
+
+async def execute_deploy(state: "AppState", req: DeployRequest,
+                         tenant_name: str = "default") -> dict:
+    """The deploy.execute path (handlers/deploy.rs:280-542), shared by the
+    deploy channel and the web redeploy route: record the deployment (with
+    the request, so redeploy can replay it), solve placement, fan out to
+    every connected stage agent (or run CP-locally), finish the record."""
+    db = state.store
+    tenant = db.ensure_tenant(tenant_name)
+    project = db.ensure_project(tenant.name, req.flow.name)
+    stage_cfg = req.flow.stage(req.stage_name)
+    stage = db.ensure_stage(project.id, req.stage_name,
+                            backend=stage_cfg.backend.value,
+                            servers=stage_cfg.servers)
+    dep = db.create("deployments", Deployment(
+        tenant=tenant.name, project=project.id, stage=stage.id,
+        status=DeploymentStatus.RUNNING.value,
+        services=[s.name for s in stage_cfg.resolved_services(req.flow)],
+        request=req.to_dict()))
+
+    targets = [s for s in stage_cfg.servers
+               if state.agent_registry.is_connected(s)]
+    try:
+        if targets:
+            # Fan out to EVERY connected stage server concurrently —
+            # the reference routes to .first() only and defers fan-out
+            # (handlers/deploy.rs:386-398); the placement solve makes
+            # per-node slices explicit, so we send each agent its own.
+            placement, rid = await asyncio.get_running_loop(
+                ).run_in_executor(None, lambda: state.placement
+                                  .solve_stage(req.flow, req.stage_name,
+                                               tenant=tenant.name))
+            if not placement.feasible:
+                raise ValueError(
+                    f"placement infeasible: {placement.violations}")
+            results = await asyncio.gather(*[
+                state.agent_registry.send_command(
+                    slug, "deploy.execute",
+                    {"request": DeployRequest(
+                        flow=req.flow, stage_name=req.stage_name,
+                        target_services=req.target_services,
+                        no_pull=req.no_pull, no_prune=req.no_prune,
+                        node=slug).to_dict(),
+                     "assignment": placement.assignment},
+                    timeout=DEPLOY_TIMEOUT)
+                for slug in targets], return_exceptions=True)
+            errors = [str(r) for r in results if isinstance(r, Exception)]
+            if errors:
+                if rid:
+                    state.placement.release(rid)
+                raise ValueError("; ".join(errors))
+            if rid:
+                state.placement.commit(rid)
+            log = "\n".join(str(r) for r in results
+                            if not isinstance(r, Exception))
+            db.update("deployments", dep.id,
+                      placement=placement.assignment)
+        else:
+            # CP-local execution (handlers/deploy.rs:470-507)
+            engine = DeployEngine(state.backend_factory(),
+                                  sleep=state.deploy_sleep)
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: engine.execute(req))
+            if not res.ok:
+                raise ValueError(f"failed services: {res.failed}")
+            log = f"deployed {len(res.deployed)} containers locally"
+        for svc in (db.get("deployments", dep.id).services or []):
+            db.upsert_service(stage.id, svc, status="deployed")
+        db.finish_deployment(dep.id, DeploymentStatus.SUCCEEDED, log=log)
+    except Exception as e:
+        db.finish_deployment(dep.id, DeploymentStatus.FAILED,
+                             error=str(e))
+        raise
+    return {"deployment": db.get("deployments", dep.id).public_dict()}
 
 
 # --------------------------------------------------------------------------

@@ -257,7 +257,20 @@ class Deployment(Record):
     log: str = ""
     error: str = ""
     placement: Optional[dict] = None   # assignment snapshot
+    # the serialized DeployRequest that produced this deployment, kept so
+    # redeploy (web.rs api_stage_redeploy analog) can re-execute without
+    # access to the project's config tree
+    request: Optional[dict] = None
     finished_at: float = 0.0
+
+    def public_dict(self) -> dict:
+        """API/listing payload: to_dict minus the stored request — the
+        whole flow config would otherwise ride along in every 50-entry
+        history response. Persistence keeps to_dict (the request must
+        survive restarts for redeploy)."""
+        d = self.to_dict()
+        d.pop("request", None)
+        return d
 
 
 # --------------------------------------------------------------------------

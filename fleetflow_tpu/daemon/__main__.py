@@ -27,6 +27,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     cfg = load_daemon_config(args.config)
+    ready_fd = None   # set when daemonizing via `start`
 
     if args.command == "start":
         # DaemonCommands::Start: POSIX double-fork detach — the second fork
@@ -36,11 +37,31 @@ def main(argv=None) -> int:
         if st is PidStatus.RUNNING:
             print(f"already running (pid {pid})")
             return 1
+        # readiness pipe: the grandchild writes one byte AFTER its sockets
+        # bound; pipe EOF without the byte means it died. This is race-free
+        # (ADVICE r2: the parent used to exit 0 right after the fork; a
+        # pidfile poll instead would race the acquire-before-bind window)
+        # and fails fast — a dead daemon closes the pipe immediately
+        # instead of burning a fixed poll budget.
+        ready_r, ready_w = os.pipe()
         child = os.fork()
         if child > 0:
+            os.close(ready_w)
             os.waitpid(child, 0)   # reap the intermediate immediately
-            print("started fleetflowd")
-            return 0
+            import select
+            readable, _, _ = select.select([ready_r], [], [], 30.0)
+            data = os.read(ready_r, 2) if readable else b""
+            os.close(ready_r)
+            if data == b"ok":
+                _, pid = PidFile(cfg.pid_file).status()
+                print(f"started fleetflowd (pid {pid})")
+                return 0
+            print("fleetflowd failed to start"
+                  + (f" (see {cfg.log_file})" if cfg.log_file
+                     else " (set log-file in fleetflowd.kdl for details)"),
+                  file=sys.stderr)
+            return 1
+        os.close(ready_r)
         os.setsid()
         grandchild = os.fork()
         if grandchild > 0:
@@ -52,6 +73,7 @@ def main(argv=None) -> int:
         os.dup2(log.fileno(), 1)
         os.dup2(log.fileno(), 2)
         args.command = "run"
+        ready_fd = ready_w
 
     if args.command == "status":
         st, pid = PidFile(cfg.pid_file).status()
@@ -67,7 +89,7 @@ def main(argv=None) -> int:
         print(f"sent SIGTERM to {pid}")
         return 0
 
-    daemon = Daemon(cfg)
+    daemon = Daemon(cfg, ready_fd=ready_fd)
 
     async def run():
         await daemon.run_forever()

@@ -22,9 +22,13 @@ __all__ = ["Daemon"]
 
 
 class Daemon:
-    def __init__(self, config: DaemonConfig):
+    def __init__(self, config: DaemonConfig, ready_fd: Optional[int] = None):
         self.config = config
         self.pidfile = PidFile(config.pid_file)
+        # write-end of the daemonizer's readiness pipe: one byte after a
+        # successful start(); closed-without-write (process death) tells
+        # the parent the daemon failed — no pidfile polling race
+        self.ready_fd = ready_fd
         self.cp: Optional[CpServerHandle] = None
         self.web: Optional[WebServer] = None
         self.health: Optional[HealthChecker] = None
@@ -69,6 +73,14 @@ class Daemon:
         self.pidfile.acquire()
         try:
             await self.start()
+            if self.ready_fd is not None:
+                import os
+                try:
+                    os.write(self.ready_fd, b"ok")
+                    os.close(self.ready_fd)
+                except OSError:
+                    pass
+                self.ready_fd = None
             loop = asyncio.get_running_loop()
             for sig in (signal.SIGTERM, signal.SIGINT):
                 try:

@@ -55,7 +55,8 @@ def _response(status: int, body, content_type="application/json") -> bytes:
         payload = body
     reason = {200: "OK", 201: "Created", 400: "Bad Request",
               401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
-              405: "Method Not Allowed", 500: "Internal Server Error"}.get(
+              405: "Method Not Allowed", 500: "Internal Server Error",
+              502: "Bad Gateway", 503: "Service Unavailable"}.get(
                   status, "Unknown")
     head = (f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {content_type}\r\n"
@@ -329,9 +330,31 @@ class WebServer:
             deps = db.deployment_history(stage=sid, limit=1)
             return {"stage": stage.to_dict(),
                     "services": [s.to_dict() for s in db.services_of(sid)],
-                    "last_deployment": deps[0].to_dict() if deps else None,
+                    "last_deployment": deps[0].public_dict() if deps else None,
                     "alerts": [a.to_dict() for a in db.active_alerts()
                                if a.server in stage.servers]}
+
+        @self.route("POST", "/api/stages/{sid}/redeploy",
+                    perm="write:deploy")   # same grant as deploy.execute
+        async def stage_redeploy(body, query, sid):
+            # web.rs api_stage_redeploy:867 — re-run the stage's last
+            # deployment; the stored DeployRequest replays without access
+            # to the project config tree
+            stage = db.get("stages", sid)
+            if stage is None:
+                raise HttpError(404, f"no stage {sid}")
+            last = next((d for d in db.deployment_history(stage=sid)
+                         if d.request), None)
+            if last is None:
+                raise HttpError(404, "stage has no replayable deployment")
+            from ..cp.handlers import execute_deploy
+            from ..runtime.engine import DeployRequest
+            try:
+                return await execute_deploy(
+                    state, DeployRequest.from_dict(last.request),
+                    tenant_name=last.tenant or "default")
+            except ValueError as e:
+                raise HttpError(503, str(e)) from None
 
         @self.route("POST", "/api/stages/{sid}/adopt")
         def stage_adopt(body, query, sid):
@@ -400,7 +423,7 @@ class WebServer:
         # -- deployments / alerts ----------------------------------------
         @self.route("GET", "/api/deployments")
         def deployments(body, query):
-            return {"deployments": [d.to_dict() for d in db.deployment_history(
+            return {"deployments": [d.public_dict() for d in db.deployment_history(
                 stage=query.get("stage"),
                 limit=int(query.get("limit", 50)))]}
 

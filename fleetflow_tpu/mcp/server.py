@@ -276,10 +276,15 @@ class FleetMcpServer:
 
     @_tool("cp_project_detail", "One project's record and stages "
            "(fleetflow_cp_project_detail)",
-           {"type": "object", "properties": {"project": {"type": "string"}},
+           {"type": "object", "properties": {"project": {"type": "string"},
+                                             "tenant": {"type": "string"}},
             "required": ["project"]})
-    def cp_project_detail(self, project: str) -> dict:
-        rec = self.cp().request("project", "get", {"name": project})
+    def cp_project_detail(self, project: str, tenant: str = "default") -> dict:
+        # without a tenant the handler defaults to 'default' and projects
+        # in other tenants come back null even though cp_projects can list
+        # them (ADVICE r2)
+        rec = self.cp().request("project", "get",
+                                {"name": project, "tenant": tenant})
         proj = rec.get("project")
         # stages are keyed by project ID, not the human name
         stages = (self.cp().request(

@@ -306,9 +306,13 @@ def _batched_step(prob: DeviceProblem, state: ChainState,
 
 def default_proposals_per_step(S: int) -> int:
     """Batch width: enough parallel proposals to keep the device busy,
-    capped so tiny instances don't over-propose. 256 is the measured knee
-    on v5e — below it a sweep costs the same fixed overhead, above it the
-    sweep goes bandwidth-bound (and winner-per-target wastes the surplus)."""
+    capped so tiny instances don't over-propose. 256 targets the
+    accelerator knee — below it a sweep costs the same fixed overhead,
+    above it the sweep goes bandwidth-bound (and winner-per-target wastes
+    the surplus). Hardware re-validation is pending TPU access; the CPU
+    path overrides to 64, where sweep cost is ~linear in width (measured
+    round 3, docs/guide/03-placement-and-the-tpu-solver.md tuning notes +
+    docs/profiles/)."""
     return max(1, min(256, S // 2))
 
 

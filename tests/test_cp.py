@@ -344,6 +344,37 @@ class TestDeployExecute:
             await handle.stop()
         run(go())
 
+    def test_web_redeploy_replays_last_deployment(self, project):
+        # web.rs api_stage_redeploy:867 analog: the stored DeployRequest
+        # replays through POST /api/stages/{sid}/redeploy
+        from fleetflow_tpu.daemon.web import WebServer
+        from test_daemon import http_post
+
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await conn.request("deploy", "execute",
+                                     {"request": req.to_dict(),
+                                      "tenant": "acme"})
+            sid = out["deployment"]["stage"]
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            st, body = await http_post(host, port,
+                                       f"/api/stages/{sid}/redeploy")
+            assert st == 200, body
+            assert body["deployment"]["status"] == "succeeded"
+            hist = await conn.request("deploy", "history", {})
+            assert len(hist["deployments"]) == 2
+            # unknown stage -> 404
+            st, _ = await http_post(host, port, "/api/stages/nope/redeploy")
+            assert st == 404
+            await web.stop()
+            await conn.close()
+            await handle.stop()
+        run(go())
+
     def test_routed_to_agent(self, project):
         async def go():
             flow = _load_flow(project)

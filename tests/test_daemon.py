@@ -305,3 +305,52 @@ class TestDashboard:
             await web.stop()
             await handle.stop()
         run(go())
+
+
+class TestDaemonizedStart:
+    """`fleetflowd start` must report startup FAILURE with a nonzero exit,
+    not a false 'started' with the error buried in the log (ADVICE r2:
+    previously the parent exited 0 right after the double-fork)."""
+
+    def _cfg(self, tmp_path, port, web=True):
+        p = tmp_path / "fleetflowd.kdl"
+        p.write_text(
+            f'pid-file "{tmp_path}/d.pid"\n'
+            f'log-file "{tmp_path}/d.log"\n'
+            f'listen "127.0.0.1" {port}\n'
+            + (f'web "127.0.0.1" 0\n' if web else 'web enabled=#false\n'))
+        return str(p)
+
+    def test_start_failure_is_nonzero(self, tmp_path):
+        import socket
+        import subprocess
+        import sys as _sys
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        try:
+            out = subprocess.run(
+                [_sys.executable, "-m", "fleetflow_tpu.daemon", "start",
+                 "-c", self._cfg(tmp_path, port)],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 1, out.stdout + out.stderr
+            assert "failed to start" in out.stderr
+            assert "d.log" in out.stderr     # points at the log
+        finally:
+            blocker.close()
+
+    def test_start_success_reports_pid_then_stops(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        cfg = self._cfg(tmp_path, 0)
+        out = subprocess.run(
+            [_sys.executable, "-m", "fleetflow_tpu.daemon", "start",
+             "-c", cfg], capture_output=True, text=True, timeout=60)
+        try:
+            assert out.returncode == 0, out.stdout + out.stderr
+            assert "started fleetflowd (pid" in out.stdout
+        finally:
+            subprocess.run(
+                [_sys.executable, "-m", "fleetflow_tpu.daemon", "stop",
+                 "-c", cfg], capture_output=True, text=True, timeout=60)
