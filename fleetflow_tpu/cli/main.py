@@ -203,6 +203,25 @@ def cmd_down(args) -> int:
     flow = _load(args)
     stage_name = _stage(args)
     stage = flow.stage(stage_name)
+    if stage.backend is Backend.QUADLET:
+        # commands/quadlet.rs down:71 — systemctl stop (+ unit removal),
+        # never the docker engine
+        if args.services:
+            print("warning: -n is not supported on the quadlet backend; "
+                  "stopping the whole stage", file=sys.stderr)
+        from ..runtime.quadlet import down_stage
+        outcome = down_stage(flow, stage_name,
+                             remove=getattr(args, "remove", False))
+        for u in outcome.stopped:
+            print(f"  stopped {u}")
+        for u in outcome.removed:
+            print(f"  removed {u}")
+        for u, err in outcome.errors.items():
+            print(f"  FAILED {u}: {err}", file=sys.stderr)
+        return 0 if outcome.ok else 1
+    if getattr(args, "remove", False):
+        print("warning: --remove only applies to the quadlet backend; "
+              "ignored", file=sys.stderr)
     if stage.backend is Backend.COMPOSE:
         if args.services:
             print("warning: -n is not supported on the compose backend; "
@@ -891,6 +910,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("down", help="stop a stage")
     stage_args(p)
     p.add_argument("-n", "--service", dest="services", action="append")
+    p.add_argument("--remove", action="store_true",
+                   help="quadlet backend: also delete the generated units")
     p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("restart", help="restart services")

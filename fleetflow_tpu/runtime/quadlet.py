@@ -21,7 +21,7 @@ from ..core.model import Flow, RestartPolicy, Service, ServiceType, Stage
 from .converter import container_name, network_name
 
 __all__ = ["generate_container_unit", "generate_network_unit",
-           "build_stage_units", "sync_units", "apply_stage",
+           "build_stage_units", "sync_units", "apply_stage", "down_stage",
            "QuadletApplyOutcome", "OWNERSHIP_MARKER"]
 
 OWNERSHIP_MARKER = "# Managed by fleetflow-tpu; do not edit."
@@ -124,14 +124,30 @@ def build_stage_units(flow: Flow, stage: Stage) -> dict[str, str]:
     return units
 
 
-def sync_units(units: dict[str, str], unit_dir: str) -> tuple[list[str], list[str]]:
-    """Write units into `unit_dir`; remove stale fleetflow-owned units for
-    the same prefix. Never touches files without the ownership marker
-    (quadlet.rs:229-250). Returns (written, removed)."""
+def _stage_scope(project: str, stage: str) -> tuple[str, str]:
+    """(exact network unit name, service-unit prefix) identifying which
+    files belong to one project/stage. The separator-terminated prefix is
+    load-bearing: a plain startswith('proj-live') would also match a
+    sibling stage named 'live2' (quadlet.rs is_fleetflow_unit:229)."""
+    return _network_unit_name(project, stage), \
+        f"{network_name(project, stage)}-"
+
+
+def _owned_by_stage(name: str, scope: tuple[str, str]) -> bool:
+    net_unit, svc_prefix = scope
+    return name == net_unit or name.startswith(svc_prefix)
+
+
+def sync_units(units: dict[str, str], unit_dir: str, *,
+               scope: tuple[str, str]) -> tuple[list[str], list[str]]:
+    """Write units into `unit_dir`; remove stale fleetflow-owned units of
+    the SAME project/stage (`scope` from _stage_scope) that are not in the
+    new bundle. Never touches files without the ownership marker, and
+    never another stage's files (quadlet.rs:229-250). Returns
+    (written, removed)."""
     d = Path(unit_dir)
     d.mkdir(parents=True, exist_ok=True)
     written, removed = [], []
-    prefixes = {name.rsplit("-", 1)[0] for name in units}
     for f in d.iterdir():
         if f.suffix not in (".container", ".network"):
             continue
@@ -141,7 +157,7 @@ def sync_units(units: dict[str, str], unit_dir: str) -> tuple[list[str], list[st
             head = f.read_text().splitlines()[0] if f.stat().st_size else ""
         except OSError:
             continue
-        if head == OWNERSHIP_MARKER and any(f.name.startswith(p) for p in prefixes):
+        if head == OWNERSHIP_MARKER and _owned_by_stage(f.name, scope):
             f.unlink()
             removed.append(f.name)
     for name, text in units.items():
@@ -158,6 +174,7 @@ class QuadletApplyOutcome:
     written: list[str] = field(default_factory=list)
     removed: list[str] = field(default_factory=list)
     started: list[str] = field(default_factory=list)
+    stopped: list[str] = field(default_factory=list)
     errors: dict[str, str] = field(default_factory=dict)
 
     @property
@@ -167,6 +184,71 @@ class QuadletApplyOutcome:
 
 def default_unit_dir() -> str:
     return os.path.expanduser("~/.config/containers/systemd")
+
+
+def _default_systemctl(args: list[str]) -> tuple[int, str]:
+    proc = subprocess.run(["systemctl", "--user", *args],
+                          capture_output=True, text=True)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+# stop-failure outputs that mean "already down" — idempotent, not an error
+_ALREADY_DOWN = ("not loaded", "not found", "does not exist", "not-found")
+
+
+def down_stage(flow: Flow, stage_name: str, *, remove: bool = False,
+               unit_dir: Optional[str] = None,
+               systemctl=None) -> QuadletApplyOutcome:
+    """`fleet down` on the quadlet backend (commands/quadlet.rs down:71):
+    stop every service unit + the stage's network service; with `remove`,
+    delete this project/stage's fleetflow-owned unit files and
+    daemon-reload so the generated .service units disappear. Idempotent:
+    stopping an already-gone unit is success, and removal is SKIPPED when
+    any real stop failed (deleting the definition of a still-running
+    container would orphan it from both systemd and `fleet up`)."""
+    stage = flow.stage(stage_name)
+    if systemctl is None:
+        systemctl = _default_systemctl
+    outcome = QuadletApplyOutcome()
+    net = network_name(flow.name, stage_name)
+    units = [f"{container_name(flow.name, stage_name, svc.name)}.service"
+             for svc in stage.resolved_services(flow)
+             if svc.service_type is not ServiceType.STATIC]
+    # quadlet generates <name>-network.service from the .network file;
+    # leaving it running would orphan the podman network after --remove
+    units.append(f"{net}-network.service")
+    for unit in units:
+        rc, out = systemctl(["stop", unit])
+        if rc == 0 or any(m in out.lower() for m in _ALREADY_DOWN):
+            outcome.stopped.append(unit)
+        else:
+            outcome.errors[unit] = out
+    if remove:
+        if outcome.errors:
+            outcome.errors["remove"] = \
+                "skipped: stop failures above (a running container must " \
+                "not lose its unit definition)"
+            return outcome
+        scope = _stage_scope(flow.name, stage_name)
+        d = Path(unit_dir or default_unit_dir())
+        removed = []
+        if d.is_dir():
+            for f in d.iterdir():
+                if f.suffix not in (".container", ".network"):
+                    continue
+                try:
+                    head = (f.read_text().splitlines() or [""])[0]
+                except OSError:
+                    continue
+                if head == OWNERSHIP_MARKER and _owned_by_stage(f.name,
+                                                                scope):
+                    f.unlink()
+                    removed.append(f.name)
+        outcome.removed = removed
+        rc, out = systemctl(["daemon-reload"])
+        if rc != 0:
+            outcome.errors["daemon-reload"] = out
+    return outcome
 
 
 def apply_stage(flow: Flow, stage_name: str, *,
@@ -179,13 +261,11 @@ def apply_stage(flow: Flow, stage_name: str, *,
     units = build_stage_units(flow, stage)
     outcome = QuadletApplyOutcome()
     outcome.written, outcome.removed = sync_units(
-        units, unit_dir or default_unit_dir())
+        units, unit_dir or default_unit_dir(),
+        scope=_stage_scope(flow.name, stage_name))
 
     if systemctl is None:
-        def systemctl(args: list[str]) -> tuple[int, str]:
-            proc = subprocess.run(["systemctl", "--user", *args],
-                                  capture_output=True, text=True)
-            return proc.returncode, proc.stdout + proc.stderr
+        systemctl = _default_systemctl
 
     rc, out = systemctl(["daemon-reload"])
     if rc != 0:

@@ -50,22 +50,43 @@ class TestQuadlet:
         assert "Environment=POSTGRES_USER=u" in unit
 
     def test_stage_units_and_sync(self, tmp_path):
+        from fleetflow_tpu.runtime.quadlet import _stage_scope
         flow = demo_flow()
+        scope = _stage_scope("proj", "live")
         units = build_stage_units(flow, flow.stages["live"])
         assert set(units) == {"proj-live.network", "proj-live-db.container",
                               "proj-live-app.container"}
         d = tmp_path / "systemd"
-        written, removed = sync_units(units, str(d))
+        written, removed = sync_units(units, str(d), scope=scope)
         assert sorted(written) == sorted(units)
         # idempotent second sync writes nothing
-        written2, _ = sync_units(units, str(d))
+        written2, _ = sync_units(units, str(d), scope=scope)
         assert written2 == []
         # stale fleetflow-owned unit is removed; foreign unit untouched
         (d / "proj-live-old.container").write_text(OWNERSHIP_MARKER + "\n")
         (d / "proj-live-user.container").write_text("# hand-written\n")
-        _, removed = sync_units(units, str(d))
+        _, removed = sync_units(units, str(d), scope=scope)
         assert removed == ["proj-live-old.container"]
         assert (d / "proj-live-user.container").exists()
+
+    def test_sync_never_touches_sibling_stage(self, tmp_path):
+        # regression: a prefix-only ownership test would let `fleet up
+        # live` destroy stage live2's units (and the bare project prefix
+        # from the .network name would eat EVERY stage's units)
+        from fleetflow_tpu.runtime.quadlet import _stage_scope
+        flow = demo_flow()
+        units = build_stage_units(flow, flow.stages["live"])
+        d = tmp_path / "systemd"
+        d.mkdir()
+        (d / "proj-live2-db.container").write_text(
+            OWNERSHIP_MARKER + "\n[Container]\n")
+        (d / "proj-live2.network").write_text(
+            OWNERSHIP_MARKER + "\n[Network]\n")
+        _, removed = sync_units(units, str(d),
+                                scope=_stage_scope("proj", "live"))
+        assert removed == []
+        assert (d / "proj-live2-db.container").exists()
+        assert (d / "proj-live2.network").exists()
 
     def test_apply_stage_with_fake_systemctl(self, tmp_path):
         flow = demo_flow()
@@ -81,6 +102,75 @@ class TestQuadlet:
         assert calls[0] == ["daemon-reload"]
         assert sorted(outcome.started) == ["proj-live-app.service",
                                            "proj-live-db.service"]
+
+    def test_down_stage_stops_and_removes(self, tmp_path):
+        # commands/quadlet.rs down:71 — stop all units; --remove deletes
+        # only THIS stage's fleetflow-owned files
+        from fleetflow_tpu.runtime.quadlet import _stage_scope, down_stage
+        flow = demo_flow()
+        units = build_stage_units(flow, flow.stages["live"])
+        sync_units(units, str(tmp_path), scope=_stage_scope("proj", "live"))
+        # a sibling stage ("live2") and a foreign file must survive
+        (tmp_path / "proj-live2-db.container").write_text(
+            OWNERSHIP_MARKER + "\n[Container]\n")
+        (tmp_path / "proj-live-user.container").write_text("# hand-written\n")
+        calls = []
+
+        def fake_systemctl(args):
+            calls.append(args)
+            return 0, ""
+
+        outcome = down_stage(flow, "live", remove=True,
+                             unit_dir=str(tmp_path), systemctl=fake_systemctl)
+        assert outcome.ok
+        assert sorted(outcome.stopped) == ["proj-live-app.service",
+                                           "proj-live-db.service",
+                                           "proj-live-network.service"]
+        assert sorted(outcome.removed) == ["proj-live-app.container",
+                                           "proj-live-db.container",
+                                           "proj-live.network"]
+        assert calls[-1] == ["daemon-reload"]
+        assert (tmp_path / "proj-live2-db.container").exists()
+        assert (tmp_path / "proj-live-user.container").exists()
+
+    def test_down_stage_without_remove_keeps_units(self, tmp_path):
+        from fleetflow_tpu.runtime.quadlet import _stage_scope, down_stage
+        flow = demo_flow()
+        sync_units(build_stage_units(flow, flow.stages["live"]),
+                   str(tmp_path), scope=_stage_scope("proj", "live"))
+        outcome = down_stage(flow, "live", unit_dir=str(tmp_path),
+                             systemctl=lambda a: (0, ""))
+        assert outcome.ok and outcome.removed == []
+        assert (tmp_path / "proj-live-db.container").exists()
+
+    def test_down_is_idempotent_on_stopped_stage(self, tmp_path):
+        # second `fleet down`: systemctl reports units not loaded -> still
+        # success (compose down is idempotent; quadlet must be too)
+        from fleetflow_tpu.runtime.quadlet import down_stage
+        flow = demo_flow()
+        outcome = down_stage(
+            flow, "live", unit_dir=str(tmp_path),
+            systemctl=lambda a: (5, f"Unit {a[-1]} not loaded."))
+        assert outcome.ok
+        assert len(outcome.stopped) == 3    # db, app, network service
+
+    def test_remove_skipped_when_stop_fails(self, tmp_path):
+        from fleetflow_tpu.runtime.quadlet import _stage_scope, down_stage
+        flow = demo_flow()
+        sync_units(build_stage_units(flow, flow.stages["live"]),
+                   str(tmp_path), scope=_stage_scope("proj", "live"))
+
+        def wedged(args):
+            if args == ["stop", "proj-live-app.service"]:
+                return 1, "Job failed"
+            return 0, ""
+
+        outcome = down_stage(flow, "live", remove=True,
+                             unit_dir=str(tmp_path), systemctl=wedged)
+        assert not outcome.ok
+        assert "skipped" in outcome.errors["remove"]
+        # unit files survive so systemd can still manage the container
+        assert (tmp_path / "proj-live-app.container").exists()
 
 
 class TestCompose:
