@@ -294,7 +294,14 @@ def cmd_logs(args) -> int:
     from ..runtime.converter import container_name
     backend = _backend(args)
     cname = container_name(flow.name, stage_name, args.service)
-    print(backend.logs(cname, tail=args.tail), end="")
+    if getattr(args, "follow", False):
+        # logs.rs follow path; mock backend has no stream to follow
+        if not hasattr(backend, "logs_follow"):
+            print(backend.logs(cname, tail=args.tail, since=args.since),
+                  end="")
+            return 0
+        return backend.logs_follow(cname, tail=args.tail, since=args.since)
+    print(backend.logs(cname, tail=args.tail, since=args.since), end="")
     return 0
 
 
@@ -931,6 +938,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("service")
     stage_args(p, positional=False)
     p.add_argument("--tail", type=int, default=100)
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="stream new lines until Ctrl+C (logs.rs follow)")
+    p.add_argument("--since", help="only lines after this (e.g. 10m, 2h, "
+                   "RFC3339 timestamp)")
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("exec", help="exec into a service container")

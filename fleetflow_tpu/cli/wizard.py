@@ -141,8 +141,99 @@ def resolve_config_path(choice: int, project_root: str) -> Path:
     return Path(project_root) / rel
 
 
+def _tty_capable() -> bool:
+    """Arrow-key picking needs a real terminal on both ends."""
+    import sys
+    try:
+        return sys.stdin.isatty() and sys.stdout.isatty()
+    except (ValueError, OSError):
+        return False
+
+
+def _read_key() -> str:
+    """One keypress in raw mode: 'up'/'down'/'enter'/'esc'/'other' or the
+    char. A bare Esc is detected with a short select() poll (a blocking
+    read(2) would hang until two more keys arrive); full CSI sequences
+    (arrows, Del, Home: ESC [ ... final-byte) are consumed entirely so no
+    stray bytes leak into the next keypress, and unrecognized ones are
+    'other' (ignored), not a silent quit."""
+    import select
+    import sys
+    import termios
+    import tty
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        ch = sys.stdin.read(1)
+        if ch == "\x1b":
+            if not select.select([fd], [], [], 0.05)[0]:
+                return "esc"                   # a lone Esc keypress
+            nxt = sys.stdin.read(1)
+            if nxt != "[":
+                return "esc"                   # ESC+<char> (alt-key etc.)
+            seq = ""
+            while True:                        # CSI: params then @..~ final
+                c = sys.stdin.read(1)
+                seq += c
+                if "@" <= c <= "~":
+                    break
+            return {"A": "up", "B": "down"}.get(seq[-1], "other") \
+                if len(seq) == 1 else "other"
+        if ch in ("\r", "\n"):
+            return "enter"
+        if ch == "\x03":                       # Ctrl+C
+            raise KeyboardInterrupt
+        return ch.lower()
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def _pick_tty(title: str, options: list[str], default: int = 0,
+              read_key=_read_key) -> Optional[int]:
+    """Full-screen-free arrow-key picker (the ratatui list of
+    tui/init.rs:123 without taking over the terminal): ↑/↓ move, enter
+    selects, q/esc quits. Redraws in place with ANSI cursor-up."""
+    import sys
+    sel = default
+    drawn = False
+
+    def draw():
+        nonlocal drawn
+        if drawn:
+            sys.stdout.write(f"\x1b[{len(options) + 1}A")
+        sys.stdout.write(f"\r\x1b[K{title} (↑/↓, enter, q)\n")
+        for i, opt in enumerate(options):
+            cursor = "\x1b[7m ❯ " if i == sel else "   "   # reverse video
+            reset = " \x1b[0m" if i == sel else ""
+            sys.stdout.write(f"\r\x1b[K{cursor}{opt}{reset}\n")
+        sys.stdout.flush()
+        drawn = True
+
+    while True:
+        draw()
+        key = read_key()
+        if key == "up":
+            sel = (sel - 1) % len(options)
+        elif key == "down":
+            sel = (sel + 1) % len(options)
+        elif key == "enter":
+            return sel
+        elif key in ("q", "esc"):
+            return None
+        elif key.isdigit() and 1 <= int(key) <= len(options):
+            return int(key) - 1
+        # 'other' (unrecognized sequences) and stray chars: redraw, ignore
+
+
 def _pick(prompt_fn, print_fn, title: str, options: list[str],
-          default: int = 0) -> Optional[int]:
+          default: int = 0, interactive: Optional[bool] = None) -> Optional[int]:
+    """Selection step: arrow-key TUI picker on a real terminal, numbered
+    prompt otherwise (CI, pipes, tests with injected IO)."""
+    if interactive is None:
+        interactive = prompt_fn is input and _tty_capable()
+    if interactive:
+        return _pick_tty(title, options, default)
     print_fn(title)
     for i, opt in enumerate(options):
         marker = "*" if i == default else " "

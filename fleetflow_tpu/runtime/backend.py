@@ -64,7 +64,8 @@ class ContainerBackend(Protocol):
     def inspect(self, name_or_id: str) -> Optional[ContainerInfo]: ...
     def list(self, label_filter: Optional[dict[str, str]] = None,
              all: bool = True) -> list[ContainerInfo]: ...
-    def logs(self, name_or_id: str, tail: int = 100) -> str: ...
+    def logs(self, name_or_id: str, tail: int = 100,
+             since: Optional[str] = None) -> str: ...
     def prune_images(self, older_than_hours: int = 168) -> int: ...
 
 
@@ -188,7 +189,8 @@ class MockBackend:
             out.append(info)
         return out
 
-    def logs(self, name_or_id: str, tail: int = 100) -> str:
+    def logs(self, name_or_id: str, tail: int = 100,
+             since: Optional[str] = None) -> str:
         return ""
 
     def prune_images(self, older_than_hours: int = 168) -> int:
@@ -329,9 +331,36 @@ class DockerCliBackend:
         names = [n for n in proc.stdout.splitlines() if n]
         return [info for n in names if (info := self.inspect(n)) is not None]
 
-    def logs(self, name_or_id: str, tail: int = 100) -> str:
-        proc = self._run("logs", "--tail", str(tail), name_or_id, check=False)
+    def logs(self, name_or_id: str, tail: int = 100,
+             since: Optional[str] = None) -> str:
+        args = ["logs", "--tail", str(tail)]
+        if since:
+            args += ["--since", since]
+        proc = self._run(*args, name_or_id, check=False)
         return proc.stdout + proc.stderr
+
+    def logs_follow(self, name_or_id: str, tail: int = 100,
+                    since: Optional[str] = None, on_line=print) -> int:
+        """Stream logs until the container exits or the caller interrupts
+        (logs.rs follow path): one on_line call per line, returns the
+        docker exit code."""
+        args = [self.binary, "logs", "--follow", "--tail", str(tail)]
+        if since:
+            args += ["--since", since]
+        args.append(name_or_id)
+        proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        interrupted = False
+        try:
+            for line in proc.stdout:
+                on_line(line.rstrip("\n"))
+        except KeyboardInterrupt:
+            interrupted = True
+            proc.terminate()
+        rc = proc.wait()
+        if interrupted:
+            return 130     # conventional SIGINT exit; stopping follow is
+        return rc if rc >= 0 else 1   # not a failure worth a weird status
 
     def prune_images(self, older_than_hours: int = 168) -> int:
         # reference prune policy: unused + dangling > 168h (engine.rs:458-489)

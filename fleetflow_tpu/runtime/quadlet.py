@@ -48,6 +48,7 @@ def generate_network_unit(project: str, stage: str) -> str:
     net = network_name(project, stage)
     return "\n".join([
         OWNERSHIP_MARKER,
+        _scope_line(project, stage),
         "[Unit]",
         f"Description=fleetflow network {net}",
         "",
@@ -68,7 +69,7 @@ def generate_container_unit(svc: Service, project: str, stage: str) -> str:
     loop to systemd's dependency engine.
     """
     net_unit = _network_unit_name(project, stage)
-    lines = [OWNERSHIP_MARKER, "[Unit]",
+    lines = [OWNERSHIP_MARKER, _scope_line(project, stage), "[Unit]",
              f"Description=fleetflow service {svc.name} ({project}/{stage})"]
     for dep in svc.depends_on:
         dep_unit = f"{container_name(project, stage, dep)}.service"
@@ -124,42 +125,69 @@ def build_stage_units(flow: Flow, stage: Stage) -> dict[str, str]:
     return units
 
 
-def _stage_scope(project: str, stage: str) -> tuple[str, str]:
-    """(exact network unit name, service-unit prefix) identifying which
-    files belong to one project/stage. The separator-terminated prefix is
-    load-bearing: a plain startswith('proj-live') would also match a
-    sibling stage named 'live2' (quadlet.rs is_fleetflow_unit:229)."""
-    return _network_unit_name(project, stage), \
-        f"{network_name(project, stage)}-"
+def _scope_line(project: str, stage: str) -> str:
+    """Second header line embedding the exact owner; the authoritative
+    ownership test, immune to the name-prefix ambiguity of a stage
+    called 'live' vs a sibling 'live-blue' (both hyphen-join into unit
+    names where prefix matching cannot tell them apart)."""
+    return f"# fleetflow-scope: {project}/{stage}"
 
 
-def _owned_by_stage(name: str, scope: tuple[str, str]) -> bool:
-    net_unit, svc_prefix = scope
-    return name == net_unit or name.startswith(svc_prefix)
+@dataclass(frozen=True)
+class StageScope:
+    """Which unit files belong to one project/stage
+    (quadlet.rs is_fleetflow_unit:229 with exact-owner precision)."""
+    project: str
+    stage: str
+
+    def owns(self, name: str, header: list[str]) -> bool:
+        if not header or header[0] != OWNERSHIP_MARKER:
+            return False
+        # scope line is authoritative when present; older files without
+        # one fall back to the name test (exact network unit name or
+        # separator-terminated service prefix — still ambiguous for
+        # hyphenated sibling stages, which is why the scope line exists)
+        if len(header) > 1 and header[1].startswith("# fleetflow-scope:"):
+            return header[1] == _scope_line(self.project, self.stage)
+        return (name == _network_unit_name(self.project, self.stage)
+                or name.startswith(f"{network_name(self.project, self.stage)}-"))
+
+
+def _stage_scope(project: str, stage: str) -> StageScope:
+    return StageScope(project, stage)
+
+
+def _remove_owned(d: Path, scope: StageScope,
+                  keep: frozenset = frozenset()) -> list[str]:
+    """Delete every unit file owned by `scope` except `keep`; shared by
+    sync_units (stale cleanup) and down_stage --remove so the ownership
+    test can never diverge between the two paths."""
+    removed = []
+    if not d.is_dir():
+        return removed
+    for f in d.iterdir():
+        if f.suffix not in (".container", ".network") or f.name in keep:
+            continue
+        try:
+            header = f.read_text().splitlines()[:2]
+        except OSError:
+            continue
+        if scope.owns(f.name, header):
+            f.unlink()
+            removed.append(f.name)
+    return removed
 
 
 def sync_units(units: dict[str, str], unit_dir: str, *,
-               scope: tuple[str, str]) -> tuple[list[str], list[str]]:
+               scope: StageScope) -> tuple[list[str], list[str]]:
     """Write units into `unit_dir`; remove stale fleetflow-owned units of
-    the SAME project/stage (`scope` from _stage_scope) that are not in the
-    new bundle. Never touches files without the ownership marker, and
-    never another stage's files (quadlet.rs:229-250). Returns
-    (written, removed)."""
+    the SAME project/stage that are not in the new bundle. Never touches
+    files without the ownership marker, and never another stage's files
+    (quadlet.rs:229-250). Returns (written, removed)."""
     d = Path(unit_dir)
     d.mkdir(parents=True, exist_ok=True)
-    written, removed = [], []
-    for f in d.iterdir():
-        if f.suffix not in (".container", ".network"):
-            continue
-        if f.name in units:
-            continue
-        try:
-            head = f.read_text().splitlines()[0] if f.stat().st_size else ""
-        except OSError:
-            continue
-        if head == OWNERSHIP_MARKER and _owned_by_stage(f.name, scope):
-            f.unlink()
-            removed.append(f.name)
+    removed = _remove_owned(d, scope, keep=frozenset(units))
+    written = []
     for name, text in units.items():
         target = d / name
         if not target.exists() or target.read_text() != text:
@@ -229,22 +257,9 @@ def down_stage(flow: Flow, stage_name: str, *, remove: bool = False,
                 "skipped: stop failures above (a running container must " \
                 "not lose its unit definition)"
             return outcome
-        scope = _stage_scope(flow.name, stage_name)
-        d = Path(unit_dir or default_unit_dir())
-        removed = []
-        if d.is_dir():
-            for f in d.iterdir():
-                if f.suffix not in (".container", ".network"):
-                    continue
-                try:
-                    head = (f.read_text().splitlines() or [""])[0]
-                except OSError:
-                    continue
-                if head == OWNERSHIP_MARKER and _owned_by_stage(f.name,
-                                                                scope):
-                    f.unlink()
-                    removed.append(f.name)
-        outcome.removed = removed
+        outcome.removed = _remove_owned(
+            Path(unit_dir or default_unit_dir()),
+            _stage_scope(flow.name, stage_name))
         rc, out = systemctl(["daemon-reload"])
         if rc != 0:
             outcome.errors["daemon-reload"] = out

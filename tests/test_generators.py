@@ -88,6 +88,27 @@ class TestQuadlet:
         assert (d / "proj-live2-db.container").exists()
         assert (d / "proj-live2.network").exists()
 
+    def test_sync_never_touches_hyphenated_sibling(self, tmp_path):
+        # 'live' vs 'live-blue': unit names are prefix-ambiguous
+        # (proj-live-blue-db startswith proj-live-), so ownership rides an
+        # exact scope header line in every generated unit
+        from fleetflow_tpu.runtime.quadlet import (_scope_line, _stage_scope,
+                                                   generate_network_unit)
+        flow = demo_flow()
+        units = build_stage_units(flow, flow.stages["live"])
+        assert _scope_line("proj", "live") in units["proj-live.network"]
+        d = tmp_path / "systemd"
+        d.mkdir()
+        (d / "proj-live-blue-db.container").write_text(
+            OWNERSHIP_MARKER + "\n" + _scope_line("proj", "live-blue")
+            + "\n[Container]\n")
+        other_net = generate_network_unit("proj", "live-blue")
+        (d / "proj-live-blue.network").write_text(other_net)
+        _, removed = sync_units(units, str(d),
+                                scope=_stage_scope("proj", "live"))
+        assert removed == []
+        assert (d / "proj-live-blue-db.container").exists()
+
     def test_apply_stage_with_fake_systemctl(self, tmp_path):
         flow = demo_flow()
         calls = []

@@ -90,6 +90,50 @@ class TestWizard:
         assert any("invalid choice" in line for line in lines)
 
 
+class TestTtyPicker:
+    """Arrow-key picker (the ratatui list analog, tui/init.rs:123),
+    driven with a scripted key feed; terminal output goes to a buffer."""
+
+    def _pick(self, options, keys, default=0):
+        import io
+        import sys
+        from fleetflow_tpu.cli.wizard import _pick_tty
+        feed = iter(keys)
+        buf = io.StringIO()
+        real, sys.stdout = sys.stdout, buf
+        try:
+            return _pick_tty("t:", options, default=default,
+                             read_key=lambda: next(feed)), buf.getvalue()
+        finally:
+            sys.stdout = real
+
+    def test_arrows_and_enter(self):
+        sel, out = self._pick(["a", "b", "c"], ["down", "down", "enter"])
+        assert sel == 2
+        assert "❯" in out            # highlighted cursor rendered
+
+    def test_wraparound(self):
+        sel, _ = self._pick(["a", "b", "c"], ["up", "enter"])
+        assert sel == 2
+        sel, _ = self._pick(["a", "b", "c"], ["down", "enter"], default=2)
+        assert sel == 0
+
+    def test_quit_and_escape(self):
+        assert self._pick(["a"], ["q"])[0] is None
+        assert self._pick(["a"], ["esc"])[0] is None
+
+    def test_digit_shortcut(self):
+        sel, _ = self._pick(["a", "b", "c"], ["2"])
+        assert sel == 1
+
+    def test_pick_falls_back_without_tty(self):
+        # injected prompt_fn (tests/CI) must never enter raw-terminal mode
+        from fleetflow_tpu.cli.wizard import _pick
+        lines = []
+        sel = _pick(lambda p: "2", lines.append, "t:", ["a", "b"])
+        assert sel == 1 and lines    # printed the numbered menu
+
+
 class TestCliInit:
     def test_non_tty_uses_direct_writer(self, tmp_path, capsys):
         # pytest's stdin is not a tty, so init stays non-interactive
