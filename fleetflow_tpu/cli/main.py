@@ -178,7 +178,26 @@ def cmd_up(args) -> int:
     if static:
         # static services never reach the container engine
         target = [s.name for s in container]
-    engine = DeployEngine(_backend(args), scheduler=pick_scheduler(
+    backend = _backend(args)
+    # local builds before the container loop (up.rs:6-51): a service with
+    # build{} gets its image built here so create/start never pulls a tag
+    # that only exists locally. Built under the SAME tag the engine will
+    # create from (svc.image_name()) — the resolver's registry-prefixed
+    # tag is the push workflow's, not the local engine's. Mock backend
+    # materializes images on pull, so builds are skipped there.
+    if not isinstance(backend, MockBackend):
+        buildable = [s for s in container
+                     if s.build is not None and
+                     (not target or s.name in target)]
+        try:
+            _build_images(flow, buildable,
+                          getattr(args, "project_root", None),
+                          tag_for=lambda s: s.image_name())
+        except FlowError as e:
+            print(f"  {e}", file=sys.stderr)
+            _stop_procs(dev_procs)
+            return 1
+    engine = DeployEngine(backend, scheduler=pick_scheduler(
         len(services), 1, prefer_tpu=False))
     res = engine.execute(
         DeployRequest(flow=flow, stage_name=stage_name,
@@ -336,28 +355,51 @@ def cmd_exec(args) -> int:
 # Ship commands
 # --------------------------------------------------------------------------
 
+def _build_images(flow: Flow, services, project_root: Optional[str],
+                  registry: Optional[str] = None, push: bool = False,
+                  tag_for=None) -> list[str]:
+    """Shared build loop (build.rs orchestrator) used by `fleet build` and
+    the pre-deploy build step of `fleet up`. `tag_for(svc)` overrides the
+    resolver's (registry-prefixed) tag — the local engine creates from
+    svc.image_name(), the push workflow from the resolver tag. Returns the
+    built tags; raises BuildError/BuildFailed (FlowError) on failure."""
+    import dataclasses as _dc
+
+    from ..build import BuildResolver, ImageBuilder, ImagePusher
+    resolver = BuildResolver(project_root or ".",
+                             registry=registry or (
+                                 flow.registry.url if flow.registry else None))
+    tags = []
+    for svc in services:
+        resolved = resolver.resolve(svc)
+        if tag_for is not None:
+            resolved = _dc.replace(resolved, tag=tag_for(svc))
+        print(f"building {resolved.tag} from {resolved.context}")
+        ImageBuilder().build(resolved, on_line=lambda l: print(f"  {l}"))
+        if push:
+            print(f"pushing {resolved.tag}")
+            ImagePusher().push(resolved.tag,
+                               on_line=lambda l: print(f"  {l}"))
+        tags.append(resolved.tag)
+    return tags
+
+
 def cmd_build(args) -> int:
     flow = _load(args)
-    from ..build import BuildResolver, ImageBuilder, ImagePusher
-    registry = flow.registry.url if flow.registry else None
-    resolver = BuildResolver(getattr(args, "project_root", None) or ".",
-                             registry=args.registry or registry)
     names = [args.name] if args.name else [
         n for n, s in flow.services.items() if s.build is not None]
     if not names:
         print("no services with build{} config", file=sys.stderr)
         return 1
+    services = []
     for name in names:
         svc = flow.services.get(name)
         if svc is None or svc.build is None:
             print(f"service {name!r} has no build config", file=sys.stderr)
             return 1
-        resolved = resolver.resolve(svc)
-        print(f"building {resolved.tag} from {resolved.context}")
-        ImageBuilder().build(resolved, on_line=lambda l: print(f"  {l}"))
-        if args.push:
-            print(f"pushing {resolved.tag}")
-            ImagePusher().push(resolved.tag, on_line=lambda l: print(f"  {l}"))
+        services.append(svc)
+    _build_images(flow, services, getattr(args, "project_root", None),
+                  registry=args.registry, push=args.push)
     return 0
 
 

@@ -157,24 +157,33 @@ def _read_key() -> str:
     (arrows, Del, Home: ESC [ ... final-byte) are consumed entirely so no
     stray bytes leak into the next keypress, and unrecognized ones are
     'other' (ignored), not a silent quit."""
+    import os
     import select
     import sys
     import termios
     import tty
     fd = sys.stdin.fileno()
     old = termios.tcgetattr(fd)
+
+    def read1() -> str:
+        # os.read, NOT sys.stdin.read: the TextIOWrapper would slurp the
+        # whole \x1b[A sequence into a userspace buffer on the first byte,
+        # making the select() probe below see an empty fd and misread
+        # every arrow key as a lone Esc
+        return os.read(fd, 1).decode("latin-1")
+
     try:
         tty.setraw(fd)
-        ch = sys.stdin.read(1)
+        ch = read1()
         if ch == "\x1b":
             if not select.select([fd], [], [], 0.05)[0]:
                 return "esc"                   # a lone Esc keypress
-            nxt = sys.stdin.read(1)
+            nxt = read1()
             if nxt != "[":
                 return "esc"                   # ESC+<char> (alt-key etc.)
             seq = ""
             while True:                        # CSI: params then @..~ final
-                c = sys.stdin.read(1)
+                c = read1()
                 seq += c
                 if "@" <= c <= "~":
                     break

@@ -93,10 +93,15 @@ def generate_compose_yaml(flow: Flow, stage: Stage) -> str:
             lines.append(f"      timeout: {int(hc.timeout)}s")
             lines.append(f"      retries: {hc.retries}")
             lines.append(f"      start_period: {int(hc.start_period)}s")
-        if svc.labels:
-            lines.append("    labels:")
-            for k, val in sorted(svc.labels.items()):
-                lines.append(f"      {k}: {_yaml_escape(val)}")
+        # attribution labels ride every backend (converter.rs:128-139):
+        # the agent monitor's inventory report keys on them, so compose-
+        # deployed containers must carry them too
+        labels = {"fleetflow.project": flow.name,
+                  "fleetflow.stage": stage.name,
+                  "fleetflow.service": svc.name, **svc.labels}
+        lines.append("    labels:")
+        for k, val in sorted(labels.items()):
+            lines.append(f"      {k}: {_yaml_escape(val)}")
         lines.append("    networks:")
         lines.append("      default:")
         lines.append("        aliases:")

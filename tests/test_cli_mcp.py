@@ -80,6 +80,45 @@ class TestCliFlows:
         assert "[done]" in out and "3 deployed" in out
         assert main([*base, "ps", "local"]) == 0
 
+    def test_up_builds_services_with_build_config(self, project, capsys,
+                                                  monkeypatch):
+        # up.rs:6-51: a service with build{} is built BEFORE create/start
+        root, write = project
+        (root / "appdir").mkdir()
+        (root / "appdir" / "Dockerfile").write_text("FROM scratch\n")
+        write("services/built.kdl", '''
+service "built" {
+    build { context "appdir" }
+}
+stage "b" { service "built" }
+''')
+        import sys
+        cli_main = sys.modules["fleetflow_tpu.cli.main"]  # pkg __init__
+        from fleetflow_tpu.runtime.backend import MockBackend  # shadows it
+
+        # a docker stand-in that is NOT a MockBackend instance (duck-typed
+        # delegation) so the build step runs
+        built = []
+
+        class DockerStandIn:
+            def __init__(self):
+                self._m = MockBackend(auto_pull=True)
+
+            def __getattr__(self, name):
+                return getattr(self._m, name)
+
+        monkeypatch.setattr(cli_main, "_backend",
+                            lambda a: DockerStandIn())
+
+        import fleetflow_tpu.build.builder as bmod
+        monkeypatch.setattr(
+            bmod.ImageBuilder, "build",
+            lambda self, resolved, on_line=None: built.append(resolved.tag)
+            or resolved.tag)
+        rc = main(["--project-root", str(root), "up", "b"])
+        assert rc == 0
+        assert built and built[0].startswith("built")
+
     def test_dry_run_masks_secrets(self, project, capsys):
         root, write = project
         write("services/secret.kdl", '''
