@@ -118,6 +118,10 @@ def main() -> None:
         "solve_ms": round(elapsed * 1e3, 1),
         "violations": res.violations,
         "feasible": res.feasible,
+        # soft objective of the winner (strategy + preference + coloc
+        # terms): lets rounds compare placement QUALITY, not just
+        # feasibility/latency, across config changes
+        "soft_score": round(res.soft, 4),
         # honesty metrics (VERDICT item 4): what the device solver produced
         # before the host repair backstop — 0/0 means the TPU did the work.
         "pre_repair_violations": res.pre_repair_violations,
@@ -138,6 +142,7 @@ def main() -> None:
         # BASELINE config 5: warm reschedule after killing the busiest node
         "reschedule_ms": round(reschedule_ms, 1),
         "reschedule_violations": res2.violations,
+        "reschedule_soft": round(res2.soft, 4),
         "reschedule_sweeps": res2.steps,
         "churn_affected": affected,
         "churn_moved": moved,
