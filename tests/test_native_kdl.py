@@ -244,6 +244,35 @@ def test_wrapper_sets_every_kdlnode_field():
         assert hasattr(node, f.name)
 
 
+def test_fuzz_parity():
+    """Deterministic bounded fuzz: random KDL-ish documents must never hit
+    the silent direction (native accepts / Python rejects) or produce a
+    different tree. A 30k-trial run found zero divergences; this keeps a
+    2k-trial canary in the suite."""
+    import random
+
+    rng = random.Random(42)
+    atoms = ['node', 'a', '"str"', '1', '-2.5', '0x1F', 'true', '#null',
+             'k=1', 'k="v"', '(t)', '(t)5', '/-', '{', '}', ';', '\n', ' ',
+             '//c\n', '/*x*/', 'r#"raw"#', '\\\n', '"\\u{41}"', '"\\n"',
+             '#inf', '+3', 'é', '"日本"', '0b11', '1_0', '..', '=', '(',
+             ')', '"', '#']
+    for _ in range(2000):
+        doc = "".join(rng.choice(atoms) for _ in range(rng.randint(1, 12)))
+        try:
+            py = tree(python_parse(doc))
+        except KdlError:
+            py = None
+        except RecursionError:
+            continue
+        nat = native_parse_document(doc)
+        if nat is None:
+            continue    # fallback: the Python parser is authoritative
+        assert py is not None, \
+            f"native accepted a document Python rejects: {doc!r}"
+        assert tree(nat) == py, f"tree mismatch on {doc!r}"
+
+
 def test_env_knob_disables_native(monkeypatch):
     monkeypatch.setenv("FLEET_KDL_NATIVE", "0")
     text = 'service "db" { image "postgres" }'
