@@ -572,7 +572,8 @@ _DASHBOARD_HTML = """<!doctype html>
 'use strict';
 // -- tiny SPA over the CP REST surface (web.rs:47-116 SPA analog) ---------
 const VIEWS=['overview','servers','stages','deployments','alerts',
-             'placement','agents','pools','dns','volumes','builds'];
+             'placement','agents','pools','containers','tenants','dns',
+             'volumes','builds'];
 function esc(v){return String(v??'').replace(/[&<>"']/g,
  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function token(){return localStorage.getItem('fleet_token')||''}
@@ -635,7 +636,8 @@ const views={
    `<div class="crumb"><a href="#stages">stages</a> / ${esc(st.stage.name)}</div>`+
    card(`<b>${esc(st.stage.name)}</b> · project ${esc(st.stage.project)} · `+
     (st.stage.adopted?'<span class="ok">adopted</span>':
-     `<button data-adopt data-sid="${esc(sid)}">adopt</button>`))+
+     `<button data-adopt data-sid="${esc(sid)}">adopt</button>`)+
+    ` · <button data-redeploy data-sid="${esc(sid)}">redeploy</button>`)+
    card('<h3>services</h3>'+table(['service','image','status','actions'],
     st.services.map(x=>[`<code>${esc(x.name)}</code>`,esc(x.image),
      badge(x.status||'unknown'),
@@ -689,6 +691,23 @@ const views={
     x.servers.map(s=>`${badge(s.status)} <code>${esc(s.slug)}</code>`)
      .join(' · ')])):
    '<span class="muted">no worker pools</span>')},
+ async containers(){
+  const c=await api('/api/containers');
+  main().innerHTML=card(c.containers.length?table(
+   ['server','container','state','project/stage/service'],
+   c.containers.map(x=>[esc(x.server),`<code>${esc(x.name)}</code>`,
+    badge(x.state||'unknown'),
+    [x.project,x.stage,x.service].filter(Boolean).map(esc).join('/')
+     ||'<span class="muted">unmanaged</span>'])):
+   '<span class="muted">no observed containers</span>')},
+ async tenants(){
+  const t=await api('/api/tenants');
+  const rows=await Promise.all(t.tenants.map(async x=>{
+   const u=await api('/api/tenants/'+enc(x.name)+'/users');
+   return [`<code>${esc(x.name)}</code>`,esc(x.display_name||x.name),
+    u.users.map(y=>`${esc(y.email)} <span class="muted">(${esc(y.role)})</span>`)
+     .join(', ')||'<span class="muted">no users</span>']}));
+  main().innerHTML=card(table(['tenant','display name','users'],rows))},
  async dns(){
   const d=await api('/api/dns');
   main().innerHTML=card(table(['zone','name','type','content','ttl','proxied'],
@@ -718,6 +737,9 @@ document.addEventListener('click',async ev=>{
    await post(`/api/servers/${enc(b.dataset.slug)}/${enc(b.dataset.act)}`);route()}
   else if(b.dataset.adopt!==undefined){
    await post(`/api/stages/${enc(b.dataset.sid)}/adopt`);route()}
+  else if(b.dataset.redeploy!==undefined){
+   const r=await post(`/api/stages/${enc(b.dataset.sid)}/redeploy`);
+   alert('redeployed: '+r.deployment.status);route()}
   else if(b.dataset.restart!==undefined){
    const r=await post(`/api/stages/${enc(b.dataset.sid)}/services/${enc(b.dataset.svc)}/restart`);
    alert('restarted: '+JSON.stringify(r.restarted))}

@@ -238,13 +238,14 @@ class TestDashboard:
             st, html = await http_get_raw(host, port, "/")
             assert st == 200
             for view in ("overview", "servers", "stages", "deployments",
-                         "alerts", "placement", "agents", "pools", "dns",
-                         "volumes", "builds"):
+                         "alerts", "placement", "agents", "pools",
+                         "containers", "tenants", "dns", "volumes",
+                         "builds"):
                 assert f"async {view}(" in html, f"view {view} missing"
             # per-stage detail view + actions (VERDICT round 1 item 10)
             assert "async stage(" in html and "async deployment(" in html
             for action in ("data-restart", "data-adopt", "data-act",
-                           "'cordon'", "'drain'"):
+                           "data-redeploy", "'cordon'", "'drain'"):
                 assert action in html, f"action {action} missing"
             # interpolation is escaped (stored names are tenant input), and
             # no tenant-controlled string is interpolated into inline JS
