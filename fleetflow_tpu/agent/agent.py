@@ -260,10 +260,9 @@ class Agent:
         # only the dependency level schedule matters here — the node set was
         # the CP's concern — so lower against a synthetic local node rather
         # than resolving stage.servers (which this agent can't)
-        from ..core.model import ResourceSpec, ServerResource
-        pt = lower_stage(req.flow, req.stage_name, nodes=[ServerResource(
-            name=self.config.slug,
-            capacity=ResourceSpec(cpu=1e6, memory=1e9, disk=1e9))])
+        from ..lower.tensors import local_node
+        pt = lower_stage(req.flow, req.stage_name,
+                         nodes=[local_node(self.config.slug)])
         return Placement(assignment=dict(assignment),
                          levels=level_schedule(pt),
                          feasible=True, source="cp-solved")

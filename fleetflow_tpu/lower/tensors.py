@@ -29,6 +29,7 @@ the reference's one-host-port-per-node reality.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -39,7 +40,7 @@ from ..core.model import (ServiceType, Flow, PlacementPolicy, PlacementStrategy,
                           ResourceSpec, ServerResource, Service, Stage)
 
 __all__ = ["ProblemTensors", "lower_stage", "dependency_depths",
-           "LOCAL_NODE_NAME", "synthetic_problem"]
+           "LOCAL_NODE_NAME", "local_node", "synthetic_problem"]
 
 LOCAL_NODE_NAME = "local"
 
@@ -158,13 +159,29 @@ def _preference_row(policy: Optional[PlacementPolicy],
     return hits / max(len(policy.preferred_labels), 1)
 
 
+def local_node(name: str = LOCAL_NODE_NAME) -> ServerResource:
+    """The single implicit node of local execution (`fleet up` / CP-local
+    deploys) or an agent's synthetic level-schedule node: generous
+    capacity, so placement degenerates to ordering."""
+    return ServerResource(
+        name=name,
+        capacity=ResourceSpec(cpu=1e6, memory=1e9, disk=1e9))
+
+
 def lower_stage(flow: Flow, stage_name: str,
-                nodes: Optional[list[ServerResource]] = None) -> ProblemTensors:
+                nodes: Optional[list[ServerResource]] = None,
+                local: bool = False) -> ProblemTensors:
     """Lower one stage of a Flow into ProblemTensors.
 
     Node set: explicit `nodes` arg > stage.servers > all flow.servers > a
     single implicit "local" node with generous capacity (the `fleet up local`
     story, where placement degenerates to ordering).
+
+    `local=True` lowers for single-machine execution: node-targeting
+    constraints (label/tier eligibility, explicit anti-affinity, spread)
+    are dropped — they describe cross-node placement and would otherwise
+    fail a local deploy of a policied stage — while port/volume conflicts
+    stay (two containers genuinely cannot bind one host port here).
     """
     stage = flow.stage(stage_name)
     # static sites ship via wrangler Pages, not containers: they consume no
@@ -180,6 +197,13 @@ def lower_stage(flow: Flow, stage_name: str,
             f"stage {stage_name!r} is static-only (services "
             f"{sorted(static_names)} deploy via Pages); nothing to place")
     policy = stage.placement
+    if local:
+        # single-machine execution: the policy's node-targeting parts
+        # (eligibility/preference/spread) describe a fleet this machine
+        # isn't; quotas still apply (they bound the stage, not a node)
+        policy = None if stage.placement is None else dataclasses.replace(
+            stage.placement, tier=None, required_labels={},
+            preferred_labels={}, spread_constraint=None)
 
     if nodes is None:
         if stage.servers:
@@ -191,9 +215,7 @@ def lower_stage(flow: Flow, stage_name: str,
         elif flow.servers:
             nodes = list(flow.servers.values())
         else:
-            nodes = [ServerResource(
-                name=LOCAL_NODE_NAME,
-                capacity=ResourceSpec(cpu=1e6, memory=1e9, disk=1e9))]
+            nodes = [local_node()]
 
     # ---- replica expansion -------------------------------------------------
     rows: list[Service] = []
@@ -250,8 +272,9 @@ def lower_stage(flow: Flow, stage_name: str,
             if ck is not None:
                 vg.append(vol_key_ids.setdefault(ck, len(vol_key_ids)))
         vol_groups.append(vg)
-        ag = [anti_key_ids.setdefault(k, len(anti_key_ids))
-              for k in svc.anti_affinity]
+        ag = ([] if local else
+              [anti_key_ids.setdefault(k, len(anti_key_ids))
+               for k in svc.anti_affinity])
         anti_groups.append(ag)
         cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
               for k in svc.colocate_with]

@@ -28,7 +28,7 @@ from ..core.errors import FlowError
 from ..core.model import Flow
 from ..core.serialize import flow_from_dict, flow_to_dict
 from ..obs import get_logger, kv, span
-from ..lower.tensors import LOCAL_NODE_NAME, lower_stage
+from ..lower.tensors import LOCAL_NODE_NAME, local_node, lower_stage
 from ..sched import (HostGreedyScheduler, Placement, Scheduler,
                      place_with_fallback)
 from .backend import BackendError, ContainerBackend
@@ -140,7 +140,17 @@ class DeployEngine:
 
         # ---- step 0: placement (replaces order_by_dependencies) ----------
         if placement is None:
-            pt = lower_stage(flow, req.stage_name)
+            # req.node unset = LOCAL execution (fleet up / CP-local deploy,
+            # handlers/deploy.rs:470-507): everything runs on THIS machine,
+            # so lower onto the single implicit local node — servers the
+            # flow declares for remote stages must not siphon services into
+            # slices nobody here executes (the "up deployed 0" trap).
+            # Agents (req.node set) receive a CP-solved placement instead.
+            if req.node is None:
+                pt = lower_stage(flow, req.stage_name,
+                                 nodes=[local_node()], local=True)
+            else:
+                pt = lower_stage(flow, req.stage_name)
             placement, _relaxed = place_with_fallback(self.scheduler, pt)
         emit(DeployEvent("place", message=(
             f"{len(placement.assignment)} rows -> "

@@ -143,6 +143,40 @@ class TestEngine:
         steps = {e.step for e in events}
         assert {"place", "pull", "network", "start", "prune", "done"} <= steps
 
+    def test_local_execute_ignores_declared_remote_servers(self, project):
+        # regression ("up deployed 0" trap): a flow declaring servers for a
+        # REMOTE stage must not siphon a local stage's services into slices
+        # this machine never executes — local execution places everything
+        # on the implicit local node
+        from fleetflow_tpu.core.model import (ResourceSpec, ServerResource)
+        flow = load(project)
+        flow.servers["node-1"] = ServerResource(
+            name="node-1", capacity=ResourceSpec(cpu=8, memory=16384,
+                                                 disk=102400))
+        flow.servers["node-2"] = ServerResource(
+            name="node-2", capacity=ResourceSpec(cpu=8, memory=16384,
+                                                 disk=102400))
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        assert res.ok
+        assert len(res.deployed) == 3, res.deployed
+        assert set(res.placement.assignment.values()) == {"local"}
+
+    def test_local_execute_ignores_node_targeting_policies(self, project):
+        # required_labels / anti-affinity / spread are cross-node concepts;
+        # a local deploy of such a stage must succeed on the one machine
+        # (port/volume conflicts would still be enforced — physically real)
+        from fleetflow_tpu.core.model import PlacementPolicy
+        flow = load(project)
+        flow.stage("local").placement = PlacementPolicy(
+            required_labels={"role": "db"})
+        engine, b = make_engine()
+        b.images.update({"postgres:16", "redis:7", "myapp:latest"})
+        res = engine.execute(DeployRequest(flow=flow, stage_name="local"))
+        assert res.ok
+        assert len(res.deployed) == 3
+
     def test_redeploy_removes_existing(self, project):
         flow = load(project)
         engine, b = make_engine()
