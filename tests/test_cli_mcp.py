@@ -7,6 +7,7 @@ behavioral flows); MCP tests drive the JSON-RPC handler directly.
 
 import io
 import json
+from pathlib import Path
 
 import pytest
 
@@ -262,3 +263,31 @@ class TestAgentCommand:
                                           "--cp-port", "4517"])
         assert args.slug == "n1" and args.cp_port == 4517
         assert args.cpu == 2.0 and args.fn.__name__ == "cmd_agent"
+
+
+class TestBundledExamples:
+    """The examples shipped in the repo must keep working — the hello-world
+    quick start is the first thing a user runs (and the 'up deployed 0'
+    regression hid exactly here: configs that declare remote servers)."""
+
+    EX = Path(__file__).resolve().parent.parent / "examples"
+
+    def test_hello_world_up_deploys_everything(self, capsys):
+        rc = main(["--project-root", str(self.EX / "hello-world"), "--mock",
+                   "up", "local"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "3 deployed, 0 removed, 0 failed" in out
+
+    def test_hello_world_live_stage_solves(self, capsys):
+        rc = main(["--project-root", str(self.EX / "hello-world"),
+                   "solve", "live"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "violations=0" in out
+
+    def test_production_example_validates(self, capsys):
+        rc = main(["--project-root", str(self.EX / "production"),
+                   "validate"])
+        assert rc == 0
+        assert "config valid" in capsys.readouterr().out
