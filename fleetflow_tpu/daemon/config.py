@@ -35,6 +35,7 @@ class DaemonConfig:
     auth_issuer: Optional[str] = None
     auth_audience: Optional[str] = None
     tls_dir: Optional[str] = "~/.local/state/fleetflow/ca"
+    health_tailscale: bool = False
     health_interval_s: float = 60.0        # config.rs:33
     heartbeat_stale_s: float = 90.0
     autoscale_interval_s: float = 0.0      # 0 = autoscaler off
@@ -105,6 +106,8 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.tls_dir = str(v) if v else None
         elif n == "health-interval":
             cfg.health_interval_s = float(v)
+        elif n == "health-tailscale":
+            cfg.health_tailscale = bool(v)
         elif n == "heartbeat-stale":
             cfg.heartbeat_stale_s = float(v)
         elif n == "autoscale-interval":

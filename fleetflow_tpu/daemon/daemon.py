@@ -50,7 +50,8 @@ class Daemon:
             self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)
         self.health = HealthChecker(self.cp.state,
                                     interval_s=cfg.health_interval_s,
-                                    stale_after_s=cfg.heartbeat_stale_s)
+                                    stale_after_s=cfg.heartbeat_stale_s,
+                                    use_tailscale=cfg.health_tailscale)
         self.health.spawn()
         if cfg.autoscale_interval_s > 0:
             self.autoscaler = Autoscaler(

@@ -72,6 +72,7 @@ web enabled=#true host="0.0.0.0" port=8080
 db "/var/lib/ff/cp.json"
 auth "token" secret="hunter2"
 health-interval 15
+health-tailscale #true
 tpu-solver #true
 ''')
         cfg = load_daemon_config()
@@ -80,6 +81,7 @@ tpu-solver #true
         assert (cfg.web_host, cfg.web_port) == ("0.0.0.0", 8080)
         assert cfg.auth_kind == "token" and cfg.auth_secret == "hunter2"
         assert cfg.health_interval_s == 15.0
+        assert cfg.health_tailscale is True
         assert cfg.use_tpu_solver is True
         assert cfg.source == "fleetflowd.kdl"
 
@@ -212,6 +214,34 @@ class TestHealthChecker:
             changed = hc.run_check()
             assert "n1" in changed
             assert db.server_by_slug("n1").status == "online"
+            await handle.stop()
+        run(go())
+
+    def test_tailscale_fallback_for_agentless_servers(self):
+        # health.rs:34-69: `tailscale status` peers (hostname == slug)
+        # keep SSH-managed agentless servers online; a broken tailscale
+        # CLI must degrade to heartbeat-only, never mark the fleet down
+        async def go():
+            import json as _json
+            handle = await start(ServerConfig(),
+                                 backend_factory=mock_backend_factory)
+            db = handle.state.store
+            status = _json.dumps({"Peer": {
+                "k1": {"HostName": "Edge-1", "Online": True},
+            }})
+            hc = HealthChecker(handle.state, interval_s=999,
+                               stale_after_s=90, clock=lambda: 1000.0,
+                               use_tailscale=True,
+                               tailscale_runner=lambda a: (0, status))
+            db.register_server("edge-1")     # no heartbeat, no agent
+            db.register_server("dark-1")
+            hc.run_check()
+            assert db.server_by_slug("edge-1").status == "online"
+            assert db.server_by_slug("dark-1").status == "offline"
+            # CLI failure: statuses fall back to heartbeat-only
+            hc.tailscale_runner = lambda a: (1, "not running")
+            hc.run_check()
+            assert db.server_by_slug("edge-1").status == "offline"
             await handle.stop()
         run(go())
 
