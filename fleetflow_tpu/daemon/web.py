@@ -263,6 +263,11 @@ class WebServer:
                 "stages": len(db.list("stages")),
                 "deployments": len(db.list("deployments")),
                 "active_alerts": len(db.active_alerts()),
+                # durability observability: journal entries/bytes since the
+                # last compaction + compactions (zeros when in-memory) —
+                # authed surface, not public /api/health (write-rate is a
+                # fingerprintable internal)
+                "store": db.journal_stats(),
             }
 
         # -- tenants -----------------------------------------------------

@@ -137,6 +137,7 @@ class TestWebServer:
             # public
             st, body = await http_get(host, port, "/api/health")
             assert st == 200 and body["status"] == "ok"
+            assert "store" not in body   # write-rate stats are authed-only
             st, body = await http_get(host, port, "/api/auth/config")
             assert body["kind"] == "token"
             # protected without token -> 401
@@ -145,6 +146,8 @@ class TestWebServer:
             token = handle.state.auth.issue("op@x", ["admin:all"])
             st, body = await http_get(host, port, "/api/overview", token)
             assert st == 200 and body["servers"] == 0
+            assert body["store"] == {"entries": 0, "bytes": 0,
+                                     "compactions": 0}
             # unknown route -> 404
             st, _ = await http_get(host, port, "/api/nope", token)
             assert st == 404
