@@ -614,6 +614,7 @@ const views={
    <div class="card stat"><b>${esc(o.stages)}</b><span>stages</span></div>
    <div class="card stat"><b>${esc(o.deployments)}</b><span>deployments</span></div>
    <div class="card stat"><b class="${o.active_alerts?'bad':'ok'}">${esc(o.active_alerts)}</b><span>active alerts</span></div>
+   <div class="card stat"><b>${esc(o.store.entries)}</b><span>journal entries (${esc(o.store.compactions)} compactions)</span></div>
   </div>`},
  async servers(){
   const s=await api('/api/servers');
