@@ -652,6 +652,16 @@ def cmd_cp(args) -> int:
         ok = CredentialStore().forget(args.cp or default_endpoint())
         print("logged out" if ok else "no stored credentials")
         return 0
+    if sub == "token":
+        # scoped minting: per-node agent identities make the registry's
+        # slug->principal anti-hijack fence effective (agent_registry.py
+        # register); a shared admin:all token would give every node the
+        # same subject
+        from ..cp.auth import TokenAuth
+        perms = [s.strip() for s in args.permissions.split(",") if s.strip()]
+        print(TokenAuth(args.secret).issue(
+            args.email, perms, tenant=args.tenant, ttl_s=args.ttl))
+        return 0
     if sub == "daemon":
         from ..daemon.__main__ import main as daemon_main
         argv = [args.daemon_command]
@@ -1073,6 +1083,18 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--email")
     q.add_argument("--tenant")
     q = cps.add_parser("logout")
+    q = cps.add_parser("token", help="mint a scoped HS256 token (e.g. a "
+                       "per-node agent identity: --email agent@<slug> "
+                       "--permissions write:agent)")
+    q.add_argument("--secret", required=True,
+                   help="the CP's shared HS256 secret")
+    q.add_argument("--email", required=True,
+                   help="token subject (use a distinct one per node agent)")
+    q.add_argument("--permissions", default="write:agent",
+                   help="comma-separated grants (default: write:agent)")
+    q.add_argument("--tenant", default="default")
+    q.add_argument("--ttl", type=float, default=86400.0 * 365,
+                   help="lifetime in seconds (default: one year)")
     q = cps.add_parser("status")
     q = cps.add_parser("daemon")
     q.add_argument("daemon_command",

@@ -24,6 +24,7 @@ import hmac
 import json
 import threading
 import time
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -145,6 +146,15 @@ class JwksAuth:
     def __init__(self, source: Union[str, dict], issuer: Optional[str] = None,
                  audience: Optional[str] = None,
                  refresh_cooldown_s: float = 300.0):
+        # ADVICE r3: signing keys fetched over cleartext can be swapped by
+        # an on-path attacker, forging every identity the CP accepts.
+        # Plain http is allowed only for loopback (the mock-IdP test rig).
+        if isinstance(source, str) and source.startswith("http://"):
+            host = urllib.parse.urlsplit(source).hostname or ""
+            if host not in ("127.0.0.1", "localhost", "::1"):
+                raise AuthError(
+                    f"refusing cleartext JWKS source {source!r}: use https "
+                    "or a local file path (http is allowed for loopback only)")
         self._source = source
         self._issuer = issuer
         self._audience = audience
@@ -182,21 +192,24 @@ class JwksAuth:
             return json.loads(Path(src).read_text())
         return src
 
-    def _refresh(self, force: bool = False) -> None:
+    def _refresh(self, force: bool = False) -> Optional[threading.Thread]:
         """Refresh the key cache. Local/dict sources refresh inline (a
         disk read). An http(s) source refreshes in a BACKGROUND thread:
         verify() runs on the CP's event loop (protocol handshake, web
         _authorize), and a synchronous 10 s fetch there would stall every
-        heartbeat and RPC in the process — the unknown-kid verify fails
-        now, the rotated client retries seconds later against the updated
-        cache. `force` (constructor) fetches inline regardless: it runs
-        before the server serves traffic and must fail loudly."""
+        heartbeat and RPC in the process. The spawned thread is returned
+        so the unknown-kid path can grant it a short bounded join (ADVICE
+        r3): a fast fetch completes in-request and the rotated token
+        verifies immediately; a slow fetch keeps the no-stall property and
+        the client retries against the updated cache. `force` (constructor)
+        fetches inline regardless: it runs before the server serves
+        traffic and must fail loudly."""
         is_http = (isinstance(self._source, str)
                    and self._source.startswith(("http://", "https://")))
         with self._lock:
             now = time.monotonic()
             if not force and now - self._last_fetch < self._cooldown:
-                return
+                return None
             self._last_fetch = now
         if force or not is_http:
             try:
@@ -206,10 +219,10 @@ class JwksAuth:
                     raise AuthError(
                         f"cannot load JWKS from {self._source!r}: {e}") \
                         from None
-                return   # rotation refetch failed: keep serving cached keys
+                return None  # rotation refetch failed: keep cached keys
             with self._lock:
                 self._install(doc)
-            return
+            return None
 
         def bg():
             try:
@@ -219,7 +232,9 @@ class JwksAuth:
             with self._lock:
                 self._install(doc)
 
-        threading.Thread(target=bg, name="jwks-refresh", daemon=True).start()
+        t = threading.Thread(target=bg, name="jwks-refresh", daemon=True)
+        t.start()
+        return t
 
     # -- provider API -----------------------------------------------------
     def issue(self, email: str, permissions: list[str],
@@ -246,7 +261,12 @@ class JwksAuth:
         kid = header.get("kid", "")
         key = self._keys.get(kid)
         if key is None:
-            self._refresh()          # key rotation: one cooldown-limited hit
+            # key rotation: one cooldown-limited hit; give a background
+            # http fetch up to 1.5s to land so the first post-rotation
+            # verify usually succeeds in-request (ADVICE r3)
+            fetcher = self._refresh()
+            if fetcher is not None:
+                fetcher.join(timeout=1.5)
             key = self._keys.get(kid)
         if key is None:
             raise AuthError(f"unknown signing key {kid!r}")

@@ -74,9 +74,12 @@ def _require(payload: dict, *keys: str) -> list:
 # authenticate verdict attached Claims must hold `<verb>:<channel>` (or
 # admin:all / `<verb>:*`) for each call; NoAuth connections carry no claims
 # and skip enforcement ("everything is the anonymous admin"). The agent
-# channel is exempt: agents authenticate with the same token gate at the
-# handshake, and their session protocol (register/heartbeat/alert/log/
-# command_result) is machine-to-machine, not an operator surface.
+# channel is NOT wrapped here (its register-first session protocol needs
+# its own state), but it is no longer exempt from claims (ADVICE r3): when
+# a connection carries Claims it must hold write:agent (or admin:all /
+# write:*) for any agent-channel method or event — otherwise a read-only
+# dashboard token could register as a node, forge heartbeats, and receive
+# deploy fan-out payloads containing the full flow config.
 #   - secret.get is deliberately NOT read-gated: it returns decrypted
 #     secret material, which a read-only dashboard grant must not reach
 #   - placement.solve is NOT read-gated: solve with reserve=true creates
@@ -85,13 +88,8 @@ _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "show", "snapshots", "ps", "pool.list", "user.list", "ping",
 })
-_PERM_EXEMPT_CHANNELS = frozenset({"agent"})
-
-
 def _perm_wrap(channel: str, handler):
     """Wrap a channel handler with claims-based permission enforcement."""
-    if channel in _PERM_EXEMPT_CHANNELS:
-        return handler
 
     async def wrapped(conn: Connection, method: str, p: dict):
         claims = getattr(conn, "claims", None)
@@ -132,12 +130,12 @@ def _tenant(state: "AppState"):
             (name,) = _require(p, "name")
             t = db.create("tenants", Tenant(
                 name=name, display_name=p.get("display_name", name)))
-            return {"tenant": t.to_dict()}
+            return {"tenant": t.public_dict()}
         if method == "list":
-            return {"tenants": [t.to_dict() for t in db.list("tenants")]}
+            return {"tenants": [t.public_dict() for t in db.list("tenants")]}
         if method == "get":
             t = db.tenant_by_name(p.get("name", ""))
-            return {"tenant": t.to_dict() if t else None}
+            return {"tenant": t.public_dict() if t else None}
         if method == "delete":
             t = db.tenant_by_name(p.get("name", ""))
             return {"deleted": bool(t and db.delete("tenants", t.id))}
@@ -302,6 +300,15 @@ def _server(state: "AppState"):
             return {"server": s.to_dict() if s else None}
         if method == "delete":
             s = db.server_by_slug(p.get("slug", ""))
+            if s is not None:
+                # evict any live agent session with the record: this is the
+                # operator escape hatch when a slug is held by a session
+                # that should not have it (the registry's anti-hijack fence
+                # otherwise keeps refusing the legitimate agent)
+                live = state.agent_registry.connection_of(s.slug)
+                state.agent_registry.unregister(s.slug)
+                if live is not None:
+                    await live.close()
             return {"deleted": bool(s and db.delete("servers", s.id))}
         if method in ("cordon", "uncordon", "drain"):
             s = db.server_by_slug(p.get("slug", ""))
@@ -787,11 +794,27 @@ def _agent(state: "AppState"):
     registered: dict[int, str] = {}   # id(conn) -> slug
     state._agent_conn_slugs = registered
 
+    def _check_agent_perm(conn: Connection) -> None:
+        """ADVICE r3: the agent channel is machine-to-machine but not
+        permission-free — a token-authenticated connection must hold
+        write:agent to act as a node agent."""
+        claims = getattr(conn, "claims", None)
+        if claims is not None and not claims.has("write:agent"):
+            raise PermissionError(
+                "missing permission write:agent (have: "
+                f"{', '.join(claims.permissions) or 'none'})")
+
+    def _principal_of(conn: Connection) -> str:
+        claims = getattr(conn, "claims", None)
+        return getattr(claims, "sub", "") or conn.identity
+
     async def handle(conn: Connection, method: str, p: dict) -> dict:
         db = state.store
+        _check_agent_perm(conn)
         if method == "register":
             (slug,) = _require(p, "slug")
-            state.agent_registry.register(slug, conn)
+            state.agent_registry.register(slug, conn,
+                                          principal=_principal_of(conn))
             registered[id(conn)] = slug
             db.register_server(slug, hostname=p.get("hostname", slug))
             db.heartbeat(slug, version=p.get("version", ""))
@@ -811,6 +834,10 @@ def _agent(state: "AppState"):
 
     async def events(conn: Connection, method: str, p: dict) -> None:
         db = state.store
+        try:
+            _check_agent_perm(conn)
+        except PermissionError:
+            return  # events carry no response channel: drop silently
         slug = registered.get(id(conn))
         if slug is None:
             return  # events from unregistered connections are dropped

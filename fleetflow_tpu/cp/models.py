@@ -81,6 +81,15 @@ class Tenant(Record):
     display_name: str = ""
     secrets: dict[str, str] = field(default_factory=dict)  # name -> ciphertext
 
+    def public_dict(self) -> dict:
+        """API/listing payload: to_dict minus the secrets map. Without a
+        master key the stored values are plaintext, and even ciphertext
+        must not be reachable under a read grant (the same invariant that
+        keeps secret.get write-gated). Persistence keeps to_dict."""
+        d = self.to_dict()
+        d.pop("secrets", None)
+        return d
+
 
 class TenantRole(str, enum.Enum):
     OWNER = "owner"

@@ -18,8 +18,12 @@ that was already folded into the snapshot (crash between snapshot rename
 and truncate) is idempotent — puts overwrite with identical rows, deletes
 of absent rows are no-ops. A torn final line (crash mid-append) is
 detected and dropped. Writes are flushed to the OS on every append;
-`fsync=True` additionally fsyncs (the reference's RocksDB WAL default) at
-a throughput cost.
+`fsync=True` (or `FLEET_STORE_FSYNC=1`, honored by every construction
+site) additionally fsyncs each append and crash-orders compaction — the
+snapshot bytes and directory entry reach disk before the journal is
+truncated — matching the reference's RocksDB WAL guarantee at a
+throughput cost.
+
 
 Thread-safe: one RLock guards all tables (handler tasks run on one asyncio
 loop, but the REST surface and background checkers may call from executor
@@ -57,7 +61,7 @@ class Store:
     def __init__(self, path: Optional[str] = None, *,
                  journal_max_bytes: int = 4 * 1024 * 1024,
                  journal_max_entries: int = 20_000,
-                 fsync: bool = False):
+                 fsync: Optional[bool] = None):
         self._lock = threading.RLock()
         self._tables: dict[str, dict[str, Record]] = {t: {} for t in _TABLES}
         self._path = Path(path) if path else None
@@ -65,6 +69,9 @@ class Store:
                               if self._path else None)
         self._journal_max_bytes = journal_max_bytes
         self._journal_max_entries = journal_max_entries
+        if fsync is None:   # FLEET_STORE_FSYNC=1 opts any deployment in
+            fsync = os.environ.get("FLEET_STORE_FSYNC", "").strip().lower() \
+                in ("1", "true", "yes", "on")
         self._fsync = fsync
         self._journal_file = None          # lazily-opened append handle
         self._journal_bytes = 0

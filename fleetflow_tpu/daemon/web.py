@@ -88,6 +88,11 @@ class WebServer:
         "alerts": "health", "health-check": "health", "users": "tenant",
         "containers": "container", "logs": "container",
         "pools": "server",   # worker pools live on the server channel
+        # channel-less areas must still land in the grant vocabulary
+        # (ADVICE r3): the overview is the dashboard's status landing view,
+        # so the health grant covers it — read:overview exists in no
+        # channel and would 403 every per-channel token
+        "overview": "health",
     }
 
     def route(self, method: str, pattern: str, *, public: bool = False,
@@ -273,7 +278,7 @@ class WebServer:
         # -- tenants -----------------------------------------------------
         @self.route("GET", "/api/tenants")
         def tenants(body, query):
-            return {"tenants": [t.to_dict() for t in db.list("tenants")]}
+            return {"tenants": [t.public_dict() for t in db.list("tenants")]}
 
         @self.route("POST", "/api/tenants")
         def tenant_create(body, query):
@@ -281,7 +286,7 @@ class WebServer:
             t = db.create("tenants", Tenant(
                 name=body["name"],
                 display_name=body.get("display_name", body["name"])))
-            return 201, {"tenant": t.to_dict()}
+            return 201, {"tenant": t.public_dict()}
 
         @self.route("GET", "/api/tenants/{name}/overview")
         def tenant_overview(body, query, name):

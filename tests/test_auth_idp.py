@@ -185,11 +185,18 @@ class TestWebRoutePermissions:
             st, _ = await http_post(host, port, "/api/tenants",
                                     {"name": "acme"}, writer)
             assert st in (200, 201)
-            # narrow grant: read:overview alone cannot read servers
-            narrow = handle.state.auth.issue("n@x", ["read:overview"])
+            # narrow grant: the overview (dashboard landing view) is
+            # covered by the health grant (ADVICE r3: every derived area
+            # must land in the channel grant vocabulary), which still
+            # cannot read servers
+            narrow = handle.state.auth.issue("n@x", ["read:health"])
             st, _ = await http_get(host, port, "/api/overview", narrow)
             assert st == 200
             st, _ = await http_get(host, port, "/api/servers", narrow)
+            assert st == 403
+            # the old out-of-vocabulary grant no longer unlocks anything
+            stale = handle.state.auth.issue("o@x", ["read:overview"])
+            st, _ = await http_get(host, port, "/api/overview", stale)
             assert st == 403
             await web.stop()
             await handle.stop()
@@ -404,3 +411,46 @@ class TestJwksEndToEnd:
                     token="not-a-jwt")
             await handle.stop()
         run(go())
+
+
+class TestJwksTransportHygiene:
+    """Round-4 ADVICE fixes: cleartext JWKS sources are refused (except
+    loopback, which the mock-IdP rig below depends on), and the
+    unknown-kid background refresh gets a short bounded join so the first
+    verify after a key rotation usually succeeds in-request."""
+
+    def test_cleartext_jwks_rejected(self):
+        with pytest.raises(AuthError, match="cleartext"):
+            JwksAuth("http://idp.example.com/.well-known/jwks.json")
+
+    def test_loopback_http_and_rotation_join(self, idp):
+        # a one-doc loopback JWKS server we can rotate under the verifier
+        doc = {"doc": json.dumps(idp.jwks())}
+
+        class JwksHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = doc["doc"].encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), JwksHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_port}/jwks.json"
+            auth = JwksAuth(url)          # loopback http is allowed
+            auth._cooldown = 0.0
+            assert auth.verify(idp.token()).sub
+            # rotate: new key appears at the IdP; the FIRST verify of a
+            # new-kid token must succeed (background fetch + bounded join)
+            idp2 = RsaIdp(kid="k-rot", issuer=idp.issuer)
+            merged = idp.jwks()
+            merged["keys"] += idp2.jwks()["keys"]
+            doc["doc"] = json.dumps(merged)
+            assert auth.verify(idp2.token()).sub == "auth0|user1"
+        finally:
+            srv.shutdown()

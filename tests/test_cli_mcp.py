@@ -59,6 +59,19 @@ class TestCliParser:
                 main([cmd, "--help"])
             assert e.value.code == 0
 
+    def test_cp_token_mints_scoped_identity(self, capsys):
+        """`fleet cp token` mints per-node agent identities (the
+        anti-hijack fence needs distinct subjects per node)."""
+        rc = main(["cp", "token", "--secret", "s3",
+                   "--email", "agent@node-1"])
+        assert rc == 0
+        token = capsys.readouterr().out.strip()
+        from fleetflow_tpu.cp.auth import TokenAuth
+        claims = TokenAuth("s3").verify(token)
+        assert claims.email == "agent@node-1"
+        assert claims.permissions == ["write:agent"]
+        assert claims.has("write:agent") and not claims.has("read:server")
+
 
 class TestCliFlows:
     def test_init_then_up_dry_run(self, tmp_path, capsys):
