@@ -51,6 +51,7 @@ class AgentConfig:
     monitor_interval_s: float = 30.0
     restart_threshold: int = 3
     deploy_base: str = "~/.fleetflow/deploys"
+    quadlet_unit_dir: Optional[str] = None   # None = user systemd dir
     capacity: dict = field(default_factory=lambda: {
         "cpu": 2.0, "memory": 4096.0, "disk": 40960.0})
     version: str = "0.1.0"
@@ -59,10 +60,16 @@ class AgentConfig:
 class Agent:
     def __init__(self, config: AgentConfig, *,
                  backend: Optional[ContainerBackend] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 systemctl=None, compose_runner=None):
         self.config = config
         self.backend = backend or DockerCliBackend()
         self.sleep = sleep
+        # injectable shellouts for the non-docker deploy backends
+        # (quadlet systemctl, docker compose) — tests fake these the same
+        # way the CP tests fake the docker backend
+        self.systemctl = systemctl
+        self.compose_runner = compose_runner
         self.detector = AnomalyDetector(
             restart_threshold=config.restart_threshold)
         self.conn: Optional[Connection] = None
@@ -225,23 +232,35 @@ class Agent:
             req = DeployRequest.from_dict(payload["request"])
             if not req.node:
                 req.node = self.config.slug
+            # live streaming (agent.rs:257-333 mpsc analog): each deploy
+            # event is forwarded to the CP log router AS IT HAPPENS from
+            # the executor thread, so `fleet logs -f` shows the deploy in
+            # flight, not a burst after completion. Send failures are
+            # dropped — a slow CP must not stall the deploy.
+            emit = self._live_emitter(loop, f"deploy/{req.stage_name}")
+
+            # dispatch by the stage's execution backend
+            # (agent.rs:374-445 executes Quadlet stages via apply_stage;
+            # the docker path runs the placement-sliced DeployEngine)
+            from ..core.model import Backend
+            stage = req.flow.stage(req.stage_name)
+            if stage.backend is Backend.QUADLET:
+                return await loop.run_in_executor(
+                    None, lambda: self._deploy_quadlet(req, emit))
+            if stage.backend is Backend.COMPOSE:
+                return await loop.run_in_executor(
+                    None, lambda: self._deploy_compose(req, emit))
+
             placement = self._placement_from(req, payload.get("assignment"))
             engine = DeployEngine(self.backend, sleep=self.sleep)
 
             def run_deploy():
-                events: list[str] = []
-                res = engine.execute(req, on_event=lambda e: events.append(str(e)),
-                                     placement=placement)
-                return res, events
+                return engine.execute(req, on_event=lambda e: emit(str(e)),
+                                      placement=placement)
 
-            res, events = await loop.run_in_executor(None, run_deploy)
+            res = await loop.run_in_executor(None, run_deploy)
             if not res.ok:
                 raise RuntimeError(f"failed services: {res.failed}")
-            # stream the event log to the CP afterward (agent.rs drain-and-
-            # forward :257-333: mpsc during, drain after)
-            for line in events:
-                await self.conn.send_event("agent", "log", {
-                    "container": f"deploy/{req.stage_name}", "line": line})
             return {"deployed": res.deployed, "removed": res.removed,
                     "duration_s": res.duration_s}
 
@@ -250,6 +269,69 @@ class Agent:
                 None, lambda: self._run_build(payload))
 
         raise ValueError(f"unknown agent command {method!r}")
+
+    def _live_emitter(self, loop: asyncio.AbstractEventLoop,
+                      container: str) -> Callable[[str], None]:
+        """A thread-safe log emitter: schedules the send on the session
+        loop and returns immediately (the reference's mpsc sender half)."""
+        conn = self.conn
+
+        def emit(line: str) -> None:
+            if conn is None:
+                return
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    conn.send_event("agent", "log", {
+                        "container": container, "line": line}), loop)
+            except RuntimeError:
+                pass   # loop already closed mid-deploy
+        return emit
+
+    def _deploy_quadlet(self, req: DeployRequest, emit) -> dict:
+        """Quadlet-backed stage through the CP (agent.rs apply_stage
+        dispatch :374-445): unit generation + sync with stage-scoped
+        ownership, daemon-reload, start — runtime/quadlet.py does the
+        work; here we stream its outcome and keep the command contract."""
+        from ..runtime.quadlet import apply_stage
+        outcome = apply_stage(req.flow, req.stage_name,
+                              unit_dir=self.config.quadlet_unit_dir,
+                              systemctl=self.systemctl)
+        for unit in outcome.written:
+            emit(f"unit written: {unit}")
+        for unit in outcome.removed:
+            emit(f"unit removed: {unit}")
+        for unit in outcome.started:
+            emit(f"started {unit}")
+        for unit, err in outcome.errors.items():
+            emit(f"FAILED {unit}: {err}")
+        if not outcome.ok:
+            raise RuntimeError(f"quadlet apply failed: "
+                               f"{sorted(outcome.errors)}")
+        return {"deployed": outcome.started, "removed": outcome.removed,
+                "backend": "quadlet"}
+
+    def _deploy_compose(self, req: DeployRequest, emit) -> dict:
+        """Compose-backed stage: emit the generated file under the agent's
+        deploy workspace and run `docker compose up -d` (the reference's
+        compose-path deploy with mid-deploy log streaming, agent.rs
+        :257-333)."""
+        import os
+
+        from ..runtime.compose import compose_up
+        root = os.path.join(os.path.expanduser(self.config.deploy_base),
+                            req.flow.name, req.stage_name)
+        os.makedirs(root, exist_ok=True)
+        emit(f"compose up: {req.flow.name}/{req.stage_name}")
+        rc, out = compose_up(req.flow, req.stage_name, root,
+                             runner=self.compose_runner)
+        for line in out.strip().splitlines():
+            emit(line)
+        if rc != 0:
+            raise RuntimeError(f"compose up failed (rc={rc}): "
+                               f"{out.strip()[-500:]}")
+        return {"deployed": [s for s in req.flow.stage(
+                    req.stage_name).services],
+                "removed": [], "backend": "compose"}
 
     def _placement_from(self, req: DeployRequest,
                         assignment: Optional[dict]) -> Optional[Placement]:

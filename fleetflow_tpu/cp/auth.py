@@ -127,6 +127,17 @@ class TokenAuth:
                       exp=exp)
 
 
+def _on_event_loop() -> bool:
+    """True when the calling thread is running an asyncio event loop (the
+    CP handshake / web authorize paths) — blocking there is forbidden."""
+    import asyncio
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False
+
+
 class JwksAuth:
     """RS256 verification against a cached JWKS (auth.rs:26-38).
 
@@ -263,9 +274,12 @@ class JwksAuth:
         if key is None:
             # key rotation: one cooldown-limited hit; give a background
             # http fetch up to 1.5s to land so the first post-rotation
-            # verify usually succeeds in-request (ADVICE r3)
+            # verify usually succeeds in-request (ADVICE r3) — but NEVER
+            # block the CP's event loop (a bogus-kid token is pre-auth
+            # input, and the no-stall property is the whole point of the
+            # background fetch): join only from plain threads.
             fetcher = self._refresh()
-            if fetcher is not None:
+            if fetcher is not None and not _on_event_loop():
                 fetcher.join(timeout=1.5)
             key = self._keys.get(kid)
         if key is None:

@@ -682,6 +682,16 @@ def _placement(state: "AppState"):
             return {"rescheduled": [
                 {"stage": key, "assignment": pl.assignment,
                  "feasible": pl.feasible} for key, pl in moved]}
+        if method == "node_events":
+            # coalesced burst: [{"slug": ..., "online": bool}, ...] -> ONE
+            # warm re-solve per affected stage against the final mask
+            (raw,) = _require(p, "events")
+            events = [(e["slug"], bool(e["online"])) for e in raw]
+            moved = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.placement.node_events(events))
+            return {"rescheduled": [
+                {"stage": key, "assignment": pl.assignment,
+                 "feasible": pl.feasible} for key, pl in moved]}
         if method == "commit":
             return {"ok": state.placement.commit(p.get("reservation", ""))}
         if method == "release":

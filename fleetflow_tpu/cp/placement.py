@@ -228,23 +228,46 @@ class PlacementService:
         stage that had services there. Returns [(stage_key, new placement)].
         Device masks update as a small delta; the solver's migration
         stickiness keeps unaffected services in place."""
-        s = self.store.server_by_slug(slug)
-        if s is not None:
-            self.store.update("servers", s.id,
-                              status="online" if online else "offline")
+        return self.node_events([(slug, online)])
+
+    def node_events(self, events: list[tuple[str, bool]]
+                    ) -> list[tuple[str, Placement]]:
+        """Coalesced churn (VERDICT r3 item 5): apply EVERY validity flip
+        of a burst first, then warm re-solve each affected stage ONCE
+        against the final mask — a 3-dead-1-revived burst costs one
+        re-solve per stage, not four, and the solver sees the true final
+        world instead of three intermediate ones (sequential re-solves can
+        bounce services onto a node that the next event kills)."""
+        for slug, online in events:
+            s = self.store.server_by_slug(slug)
+            if s is not None:
+                self.store.update("servers", s.id,
+                                  status="online" if online else "offline")
         moved: list[tuple[str, Placement]] = []
         with self._lock:
             for key, (pt, placement) in list(self._last.items()):
-                if slug not in pt.node_names:
+                needs_resolve = False
+                flipped = False
+                for slug, online in events:
+                    if slug not in pt.node_names:
+                        continue
+                    j = pt.node_names.index(slug)
+                    if bool(pt.node_valid[j]) == online:
+                        continue
+                    if not flipped:
+                        pt.node_valid = pt.node_valid.copy()
+                        flipped = True
+                    pt.node_valid[j] = online
+                    # a death with nothing placed on the node is a pure
+                    # mask change; a death with services there forces a
+                    # re-solve, and so does a REVIVE — the stage may be
+                    # running degraded/infeasible on the shrunken pool and
+                    # must get the chance to move back (the pre-coalescing
+                    # behavior re-solved on every revive flip)
+                    if online or np.any(np.asarray(placement.raw) == j):
+                        needs_resolve = True
+                if not needs_resolve:
                     continue
-                j = pt.node_names.index(slug)
-                if bool(pt.node_valid[j]) == online:
-                    continue
-                pt.node_valid = pt.node_valid.copy()
-                pt.node_valid[j] = online
-                if not online and not np.any(
-                        np.asarray(placement.raw) == j):
-                    continue  # nothing placed there; mask change is enough
                 if self.use_tpu:
                     new = self._sched_tpu.reschedule(pt)
                 else:

@@ -81,9 +81,11 @@ class HealthChecker:
             if new is not None and s.status != new:
                 changed.append(s.slug)
         self.state.store.bulk_server_status(statuses)
-        for slug in changed:
-            self.state.placement.node_event(
-                slug, online=statuses[slug] == "online")
+        if changed:
+            # one coalesced burst: a sweep that finds 3 dead nodes costs
+            # one warm re-solve per stage, not three sequential ones
+            self.state.placement.node_events(
+                [(slug, statuses[slug] == "online") for slug in changed])
         return changed
 
     async def run_loop(self) -> None:

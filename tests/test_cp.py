@@ -522,6 +522,67 @@ class TestPlacementChannel:
             await handle.stop()
         run(go())
 
+    def test_revive_triggers_resolve(self, project):
+        """A node coming BACK online must re-solve affected stages (the
+        placement may be degraded on the shrunken pool); regression for
+        the r4 coalescing rewrite which briefly made revives mask-only."""
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            agents = [await FakeAgent(f"node-{i}").connect(handle)
+                      for i in range(2)]
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            first = await conn.request("placement", "solve",
+                                       {"flow": flow_to_dict(flow),
+                                        "stage": "local"})
+            kill = sorted(set(first["assignment"].values()))[0]
+            await conn.request("placement", "node_event",
+                               {"slug": kill, "online": False})
+            out = await conn.request("placement", "node_event",
+                                     {"slug": kill, "online": True})
+            assert len(out["rescheduled"]) == 1, \
+                "revive must warm re-solve the affected stage"
+            assert out["rescheduled"][0]["feasible"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_burst_coalesces_into_one_resolve(self, project):
+        """VERDICT r3 item 5: a churn burst (2 nodes die, 1 revives) must
+        cost ONE warm re-solve per affected stage against the final mask,
+        not one per event."""
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            agents = [await FakeAgent(f"node-{i}").connect(handle)
+                      for i in range(4)]
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            first = await conn.request("placement", "solve",
+                                       {"flow": flow_to_dict(flow),
+                                        "stage": "local"})
+            used = sorted(set(first["assignment"].values()))
+            # count scheduler invocations under the burst
+            sched = handle.state.placement._sched_host
+            calls = []
+            orig = sched.place
+            sched.place = lambda pt: (calls.append(1), orig(pt))[1]
+            spare = next(s for s in ("node-0", "node-1", "node-2", "node-3")
+                         if s not in used[:2])
+            out = await conn.request("placement", "node_events", {
+                "events": [{"slug": used[0], "online": False},
+                           {"slug": used[1] if len(used) > 1 else used[0],
+                            "online": False},
+                           {"slug": spare, "online": True}]})
+            assert len(calls) == 1, f"burst ran {len(calls)} re-solves"
+            for entry in out["rescheduled"]:
+                assert entry["feasible"]
+                assert used[0] not in set(entry["assignment"].values())
+            await conn.close()
+            await handle.stop()
+        run(go())
+
 
 # --------------------------------------------------------------------------
 # store unit tests
