@@ -126,7 +126,7 @@ def main() -> None:
     # backend is a single chip (real ICI once >= 8 chips are visible).
     sharded = None
     if os.environ.get("BENCH_SHARDED", "1").lower() not in ("0", "false"):
-        sharded = _sharded_scenario(backend)
+        sharded = _sharded_scenario()
 
     pps = S / elapsed
     baseline_pps = 50.0  # sequential docker loop at 20 ms/call
@@ -267,7 +267,7 @@ def _burst_scenario(S: int, N: int, *, chains: int, steps: int, block: int,
     }
 
 
-def _sharded_scenario(parent_backend: str) -> dict:
+def _sharded_scenario() -> dict:
     """Run the sharded child (below) in a subprocess: it needs an 8-device
     mesh, which a single-chip parent can only get from virtual CPU devices
     (xla_force_host_platform_device_count). With >= 8 real devices the

@@ -318,8 +318,13 @@ class Agent:
         import os
 
         from ..runtime.compose import compose_up
-        root = os.path.join(os.path.expanduser(self.config.deploy_base),
-                            req.flow.name, req.stage_name)
+        # flow.name/stage_name arrive in the CP payload: confine the
+        # workspace under deploy_base like _run_build confines its
+        # context (a name like "../../etc" must not escape)
+        base = os.path.expanduser(self.config.deploy_base)
+        os.makedirs(base, exist_ok=True)
+        root = str(confine_path(
+            os.path.join(req.flow.name, req.stage_name), base))
         os.makedirs(root, exist_ok=True)
         emit(f"compose up: {req.flow.name}/{req.stage_name}")
         rc, out = compose_up(req.flow, req.stage_name, root,

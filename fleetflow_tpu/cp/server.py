@@ -38,6 +38,7 @@ class ServerConfig:
     auth_jwks: Optional[str] = None    # JWKS url/path for kind=jwks
     auth_issuer: Optional[str] = None
     auth_audience: Optional[str] = None
+    auth_client_id: Optional[str] = None   # OAuth client for device flow
     tls_dir: Optional[str] = None      # mesh-CA dir; None = plaintext
     use_tpu_solver: bool = False
     master_key_env: bool = False       # load SecretBox from env
@@ -62,6 +63,9 @@ class AppState:
     deploy_sleep: Callable[[float], None] = time.sleep
     started_at: float = field(default_factory=time.time)
     bg_tasks: set = field(default_factory=set)
+    # {"issuer", "client_id", "audience"} when the CP runs JwksAuth with a
+    # device-flow-capable IdP; the dashboard's browser login uses it
+    auth_idp: Optional[dict] = None
 
 
 class CpServerHandle:
@@ -135,6 +139,12 @@ async def start(config: ServerConfig, *,
                                  or _default_server_provider_factory),
         ssh_runner=ssh_runner,
         deploy_sleep=deploy_sleep,
+        auth_idp=({"issuer": config.auth_issuer,
+                   "client_id": config.auth_client_id,
+                   "audience": config.auth_audience}
+                  if (config.auth_kind in ("jwks", "auth0")
+                      and config.auth_issuer and config.auth_client_id)
+                  else None),
     )
 
     def authenticate(identity: str, token: Optional[str]):

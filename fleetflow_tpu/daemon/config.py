@@ -34,6 +34,7 @@ class DaemonConfig:
     auth_jwks: Optional[str] = None
     auth_issuer: Optional[str] = None
     auth_audience: Optional[str] = None
+    auth_client_id: Optional[str] = None
     tls_dir: Optional[str] = "~/.local/state/fleetflow/ca"
     health_tailscale: bool = False
     health_interval_s: float = 60.0        # config.rs:33
@@ -102,6 +103,9 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
                 val = node.prop(key)
                 if val is not None:
                     setattr(cfg, f"auth_{key}", str(val))
+            client_id = node.prop("client-id")
+            if client_id is not None:
+                cfg.auth_client_id = str(client_id)
         elif n == "tls-dir":
             cfg.tls_dir = str(v) if v else None
         elif n == "health-interval":

@@ -43,6 +43,7 @@ class Daemon:
             db_path=cfg.db_path, auth_kind=cfg.auth_kind,
             auth_secret=cfg.auth_secret, auth_jwks=cfg.auth_jwks,
             auth_issuer=cfg.auth_issuer, auth_audience=cfg.auth_audience,
+            auth_client_id=cfg.auth_client_id,
             tls_dir=cfg.tls_dir,
             use_tpu_solver=cfg.use_tpu_solver))
         if cfg.web_enabled:
