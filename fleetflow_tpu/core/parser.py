@@ -451,6 +451,8 @@ def parse_server(node: KdlNode) -> ServerResource:
             s.disk_size = int(c.arg(0, 0))
         elif n == "os":
             s.os = c.first_string()
+        elif n == "archive":
+            s.archive = c.first_string()
         elif n in ("ssh-key", "ssh-keys"):
             s.ssh_keys.extend(_str_args(c))
         elif n in ("ssh-host", "host"):

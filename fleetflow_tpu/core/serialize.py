@@ -329,6 +329,7 @@ def _server_to_dict(sv: ServerResource) -> dict:
     _put(d, "plan", sv.plan, None)
     _put(d, "disk_size", sv.disk_size, None)
     _put(d, "os", sv.os, None)
+    _put(d, "archive", sv.archive, None)
     _put(d, "ssh_keys", sv.ssh_keys, [])
     _put(d, "ssh_host", sv.ssh_host, None)
     _put(d, "ssh_user", sv.ssh_user, None)
@@ -348,6 +349,7 @@ def _server_from_dict(d: dict) -> ServerResource:
     return ServerResource(
         name=d["name"], provider=d.get("provider"), plan=d.get("plan"),
         disk_size=d.get("disk_size"), os=d.get("os"),
+        archive=d.get("archive"),
         ssh_keys=d.get("ssh_keys", []), ssh_host=d.get("ssh_host"),
         ssh_user=d.get("ssh_user"), tags=d.get("tags", []),
         startup_script=d.get("startup_script"),

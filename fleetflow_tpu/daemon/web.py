@@ -252,24 +252,32 @@ class WebServer:
         # device codes, nor let 15s IdP fetches starve the shared
         # executor), and the scope is server-configured, never
         # caller-chosen.
-        device_rl = {"t": 0.0, "tokens": 4.0}
+        # separate buckets: /start is strict (each call costs an IdP
+        # roundtrip and mints a device code), /poll is sized for several
+        # concurrent browser logins at the default 5s interval — one
+        # anonymous /start loop must not starve legitimate polls (and the
+        # SPA backs off on 429 rather than failing the login)
+        device_rl = {"start": {"t": 0.0, "tokens": 4.0},
+                     "poll": {"t": 0.0, "tokens": 12.0}}
+        _RL_CFG = {"start": (4.0, 0.5), "poll": (12.0, 3.0)}
 
-        def _device_ratelimit() -> None:
+        def _device_ratelimit(kind: str) -> None:
             import time as _t
+            cap, rate = _RL_CFG[kind]
+            b = device_rl[kind]
             now = _t.monotonic()
-            device_rl["tokens"] = min(
-                4.0, device_rl["tokens"] + (now - device_rl["t"]) * 0.5)
-            device_rl["t"] = now
-            if device_rl["tokens"] < 1.0:
+            b["tokens"] = min(cap, b["tokens"] + (now - b["t"]) * rate)
+            b["t"] = now
+            if b["tokens"] < 1.0:
                 raise HttpError(429, "slow down")
-            device_rl["tokens"] -= 1.0
+            b["tokens"] -= 1.0
 
         @self.route("POST", "/api/auth/device/start", public=True)
         async def device_start(body, query):
             idp = state.auth_idp
             if idp is None:
                 raise HttpError(404, "no IdP configured for device login")
-            _device_ratelimit()
+            _device_ratelimit("start")
             from ..cli.device_flow import _post_form
             fields = {"client_id": idp["client_id"]}
             if idp.get("audience"):
@@ -289,7 +297,7 @@ class WebServer:
             idp = state.auth_idp
             if idp is None:
                 raise HttpError(404, "no IdP configured for device login")
-            _device_ratelimit()
+            _device_ratelimit("poll")
             code = body.get("device_code", "")
             if not code:
                 raise HttpError(400, "missing device_code")
@@ -694,9 +702,11 @@ async function startDeviceLogin(){
    const deadline=Date.now()+(d.expires_in||300)*1000;
    while(Date.now()<deadline){
     await new Promise(r=>setTimeout(r,interval));
-    const p=await (await fetch('/api/auth/device/poll',{method:'POST',
+    const r=await fetch('/api/auth/device/poll',{method:'POST',
      headers:{'Content-Type':'application/json'},
-     body:JSON.stringify({device_code:d.device_code})})).json();
+     body:JSON.stringify({device_code:d.device_code})});
+    if(r.status===429){interval+=2000;continue}
+    const p=await r.json();
     if(p.status==='ok'){localStorage.setItem('fleet_token',p.access_token);
      c.textContent='';b.style.display='none';route();return}
     if(p.status==='denied')throw new Error(p.error||'denied');

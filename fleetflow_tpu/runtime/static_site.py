@@ -16,7 +16,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Optional
 
-from ..core.errors import FlowError
+from ..core.errors import CloudError, FlowError
 from ..core.model import Service, ServiceType
 
 __all__ = ["StaticDeployResult", "build_static", "deploy_static",
@@ -121,15 +121,27 @@ def deploy_static(svc: Service, project_root: str,
     if on_line:
         on_line(f"deploy: {out} -> Cloudflare Pages "
                 f"({svc.deploy.project})")
-    from ..cloud.cloudflare import wrangler_pages_deploy
+    from ..cloud.cloudflare import (ensure_pages_project,
+                                    wrangler_pages_deploy)
 
     def _cf_runner(argv: list[str]) -> tuple[int, str]:
         # adapt our (argv, cwd) runner shape to the cloudflare module's
         return runner(argv, project_root)
 
+    cf_runner = _cf_runner if runner else None
+    # first deploy of a fresh project: create it rather than fail
+    # (wrangler errors when the Pages project doesn't exist yet). Best
+    # effort — a listing/create failure falls through to the deploy,
+    # whose own error is authoritative.
+    try:
+        if ensure_pages_project(svc.deploy.project, runner=cf_runner):
+            if on_line:
+                on_line(f"created Pages project {svc.deploy.project}")
+    except CloudError:
+        pass
     text = wrangler_pages_deploy(out, svc.deploy.project,
                                  cwd=project_root,
-                                 runner=_cf_runner if runner else None)
+                                 runner=cf_runner)
     url = None
     for tok in text.split():
         if tok.startswith("https://") and ".pages.dev" in tok:

@@ -1,0 +1,18 @@
+"""README honesty by construction (VERDICT r3 item 10): the performance
+table must match the newest driver bench artifact exactly."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_readme_matches_newest_bench_artifact():
+    proc = subprocess.run(
+        [sys.executable, "-S", str(REPO / "scripts/update_readme_bench.py"),
+         "--check"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"README performance table drifted from the newest BENCH_r*.json: "
+        f"{proc.stderr.strip()} — run python scripts/update_readme_bench.py")

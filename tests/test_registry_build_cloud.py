@@ -670,3 +670,204 @@ route fleet="blog" stage="live" server="osaka-1"
         payloads = sync_servers_payloads(reg)
         assert [p["slug"] for p in payloads] == ["osaka-1", "tokyo-1"]
         assert payloads[1]["hostname"] == "203.0.113.5"
+
+
+from fleetflow_tpu.core.errors import CloudError  # noqa: E402
+
+
+class TestSakuraArchivesDisksKeys:
+    """Round-4 cloud depth (VERDICT r3 item 9): archive resolution, disk
+    grow-in-place, ssh-key resolution — provider.rs:43-46,106-108 /
+    usacloud.rs:268-391 analogs, all via the injectable runner."""
+
+    @staticmethod
+    def _runner(state, calls):
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["archive", "list"]:
+                return 0, json.dumps([
+                    {"ID": 111, "Name": "ubuntu-22.04", "SizeMB": 20480},
+                    {"ID": 222, "Name": "golden-fleet", "SizeMB": 40960}])
+            if args[:2] == ["ssh-key", "list"]:
+                return 0, json.dumps([{"ID": 31, "Name": "ops-key"}])
+            if args[:2] == ["disk", "list"]:
+                return 0, json.dumps([
+                    {"ID": 501, "SizeMB": 40 * 1024, "Server": {"ID": 900}},
+                    {"ID": 502, "SizeMB": 80 * 1024, "Server": {"ID": 901}}])
+            if args[:2] == ["disk", "read"]:
+                return 0, json.dumps([{"ID": int(args[2]),
+                                       "SizeMB": 40 * 1024}])
+            if args[:2] == ["disk", "update"]:
+                state["resized"] = (args[2], args[args.index("--size") + 1])
+                return 0, "{}"
+            if args[:2] == ["server", "create"]:
+                return 0, json.dumps([{"ID": "900", "Name": "w1"}])
+            if args[:2] == ["server", "list"]:
+                return 0, json.dumps([
+                    {"ID": 900, "Name": "w1", "InstanceStatus": "up",
+                     "Tags": ["fleet"]}])
+            return 0, "[]"
+        return runner
+
+    def test_archive_resolution_and_create(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        calls, state = [], {}
+        p = SakuraServerProvider(runner=self._runner(state, calls))
+        assert p.resolve_archive_id("123456") == "123456"  # id passthrough
+        assert p.resolve_archive_id("golden-fleet") == "222"
+        with pytest.raises(CloudError, match="archive not found"):
+            p.resolve_archive_id("nope")
+        info = p.create_server(ServerResource(
+            name="w1", archive="golden-fleet", ssh_keys=["ops-key", "42"]))
+        assert info.id == "900"
+        create = next(a for a in calls if a[:2] == ["server", "create"])
+        i = create.index("--disk-source-archive-id")
+        assert create[i + 1] == "222"
+        assert "--os-type" not in create, "archive wins over os-type"
+        # ssh key name resolved to id; numeric id passed through
+        key_ids = [create[j + 1] for j, a in enumerate(create)
+                   if a == "--ssh-key-ids"]
+        assert key_ids == ["31", "42"]
+
+    def test_disk_grow_and_shrink_refused(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        calls, state = [], {}
+        p = SakuraServerProvider(runner=self._runner(state, calls))
+        disks = p.server_disks("900")
+        assert disks == [{"id": "501", "size_gb": 40}]
+        assert p.resize_disk("501", 100)
+        assert state["resized"] == ("501", "100")
+        with pytest.raises(CloudError, match="cannot\\s+shrink"):
+            p.resize_disk("501", 20)
+
+    def test_plan_emits_disk_resize_and_apply_runs_it(self):
+        from fleetflow_tpu.cloud.provider import CloudProviderDecl
+        from fleetflow_tpu.cloud.sakura import SakuraProvider
+        calls, state = [], {}
+        p = SakuraProvider(runner=self._runner(state, calls))
+        plan = p.plan(CloudProviderDecl(name="sakura"),
+                      [ServerResource(name="w1", disk_size=120)])
+        resize = [a for a in plan.actions if a.resource_type == "disk"]
+        assert len(resize) == 1
+        assert "40gb -> 120gb" in resize[0].description
+        res = p.apply(plan)
+        assert not res.failed
+        assert state["resized"] == ("501", "120")
+        # declared size matching current -> pure noop plan
+        calls.clear()
+        plan2 = p.plan(CloudProviderDecl(name="sakura"),
+                       [ServerResource(name="w1", disk_size=40)])
+        assert all(a.type.value == "noop" for a in plan2.actions)
+        # one zone-wide disk listing regardless of declared servers
+        assert sum(1 for a in calls if a[:2] == ["disk", "list"]) == 1
+
+    def test_shrink_surfaces_in_plan_and_apply_refuses(self):
+        from fleetflow_tpu.cloud.provider import CloudProviderDecl
+        from fleetflow_tpu.cloud.sakura import SakuraProvider
+        calls, state = [], {}
+        p = SakuraProvider(runner=self._runner(state, calls))
+        plan = p.plan(CloudProviderDecl(name="sakura"),
+                      [ServerResource(name="w1", disk_size=20)])
+        shrink = [a for a in plan.actions if a.resource_type == "disk"]
+        assert len(shrink) == 1 and "SHRINK" in shrink[0].description
+        res = p.apply(plan)
+        assert res.failed and "shrink" in res.failed[0][1]
+        assert "resized" not in state
+
+    def test_find_servers_by_tag(self):
+        from fleetflow_tpu.cloud.sakura import SakuraServerProvider
+        calls, state = [], {}
+        p = SakuraServerProvider(runner=self._runner(state, calls))
+        assert [s.name for s in p.find_servers_by_tag("fleet")] == ["w1"]
+        assert p.find_servers_by_tag("other") == []
+
+
+class TestCloudflareManagement:
+    """Pages project management + R2 buckets + workers (wrangler.rs
+    :101-147; VERDICT r3 item 9) via the injectable runner."""
+
+    def test_pages_project_lifecycle(self):
+        from fleetflow_tpu.cloud.cloudflare import (ensure_pages_project,
+                                                    pages_project_create,
+                                                    pages_project_delete,
+                                                    pages_project_list)
+        calls = []
+        table = ("┌──────────────┬──────────────────────┐\n"
+                 "│ Project Name │ Project Domains      │\n"
+                 "├──────────────┼──────────────────────┤\n"
+                 "│ my-pages     │ my-pages.pages.dev   │\n"
+                 "└──────────────┴──────────────────────┘\n")
+
+        def runner(argv):
+            calls.append(argv)
+            if argv[:4] == ["wrangler", "pages", "project", "list"]:
+                return 0, table
+            return 0, "ok"
+
+        projects = pages_project_list(runner=runner)
+        assert projects == [{"name": "my-pages",
+                             "domains": "my-pages.pages.dev"}]
+        # existing project: ensure is a no-op
+        assert ensure_pages_project("my-pages", runner=runner) is False
+        # absent project: ensure creates with the production branch
+        assert ensure_pages_project("fresh", runner=runner) is True
+        create = next(a for a in calls
+                      if a[:4] == ["wrangler", "pages", "project", "create"])
+        assert "fresh" in create and "--production-branch" in create
+        pages_project_create("x", production_branch="rel", runner=runner)
+        assert calls[-1][-1] == "rel"
+        pages_project_delete("x", runner=runner)
+        assert calls[-1][:4] == ["wrangler", "pages", "project", "delete"]
+        assert "--yes" in calls[-1]
+
+    def test_r2_and_worker_management(self):
+        from fleetflow_tpu.cloud.cloudflare import (r2_bucket_create,
+                                                    r2_bucket_delete,
+                                                    r2_bucket_list,
+                                                    worker_delete,
+                                                    worker_list)
+        calls = []
+
+        def runner(argv):
+            calls.append(argv)
+            if argv[:4] == ["wrangler", "r2", "bucket", "list"]:
+                return 0, "name: assets\ncreation_date: x\nname: media\n"
+            if argv[:2] == ["wrangler", "deployments"]:
+                return 0, "Worker: edge-fn\nCreated: x\n"
+            return 0, "ok"
+
+        assert r2_bucket_list(runner=runner) == ["assets", "media"]
+        r2_bucket_create("logs", runner=runner)
+        assert calls[-1] == ["wrangler", "r2", "bucket", "create", "logs"]
+        r2_bucket_delete("logs", runner=runner)
+        assert calls[-1] == ["wrangler", "r2", "bucket", "delete", "logs"]
+        assert worker_list(runner=runner) == ["edge-fn"]
+        worker_delete("edge-fn", runner=runner)
+        assert calls[-1] == ["wrangler", "delete", "--name", "edge-fn",
+                             "--force"]
+
+    def test_failures_raise_loudly(self):
+        from fleetflow_tpu.cloud.cloudflare import (pages_project_create,
+                                                    r2_bucket_create)
+        bad = lambda argv: (1, "boom")  # noqa: E731
+        with pytest.raises(CloudError, match="create failed"):
+            pages_project_create("x", runner=bad)
+        with pytest.raises(CloudError, match="create failed"):
+            r2_bucket_create("x", runner=bad)
+
+    def test_archive_survives_serialize_roundtrip(self):
+        """A flow's declared disk-source archive must ride flow_to_dict /
+        flow_from_dict (DeployRequest, MCP, stored redeploys) — a dropped
+        archive silently provisions from the wrong image."""
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.core.serialize import flow_from_dict, flow_to_dict
+        flow = parse_kdl_string('''
+project "p"
+service "a" { image "x" }
+server "w1" { provider "sakura"; archive "golden-fleet"; disk-size 120 }
+stage "live" { service "a"; servers "w1" }
+''')
+        assert flow.servers["w1"].archive == "golden-fleet"
+        flow2 = flow_from_dict(flow_to_dict(flow))
+        assert flow2.servers["w1"].archive == "golden-fleet"
+        assert flow2.servers["w1"].disk_size == 120

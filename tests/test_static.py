@@ -89,9 +89,16 @@ class TestDeployStatic:
         res = deploy_static(static_svc(), str(tmp_path),
                             runner=make_runner(
                                 log, out="done https://my.pages.dev deployed"))
-        assert log[0][0] == ["sh", "-c", "npm run build"]
-        assert log[1][0][:3] == ["wrangler", "pages", "deploy"]
-        assert "--project-name" in log[1][0] and "my-pages" in log[1][0]
+        argvs = [a for a, _cwd in log]
+        assert argvs[0] == ["sh", "-c", "npm run build"]
+        # first deploy: the project isn't in the (empty) listing, so it
+        # is created before the deploy (ensure_pages_project)
+        assert argvs[1][:4] == ["wrangler", "pages", "project", "list"]
+        assert argvs[2][:4] == ["wrangler", "pages", "project", "create"]
+        assert "my-pages" in argvs[2]
+        deploy = next(a for a in argvs if a[:3] == ["wrangler", "pages",
+                                                   "deploy"])
+        assert "--project-name" in deploy and "my-pages" in deploy
         assert res.url == "https://my.pages.dev"
 
     def test_requires_deploy_config(self, tmp_path):
