@@ -296,15 +296,23 @@ def r2_bucket_delete(name: str, runner=None) -> None:
         raise CloudError(f"r2 bucket delete failed: {out[-500:]}")
 
 
-def worker_list(runner=None) -> list[str]:
-    """wrangler.rs list_workers:126 (`wrangler deployments list` is
-    per-worker; the account-wide listing is the dash API — like the
-    reference, this shells the CLI surface that exists)."""
-    rc, out = _wrangler(["deployments", "list"], runner=runner)
-    if rc != 0:
-        raise CloudError(f"worker list failed: {out[-500:]}")
-    return [ln.split(":", 1)[1].strip() for ln in out.splitlines()
-            if ln.strip().lower().startswith("worker:")]
+def worker_list(account_id: str, *, token: Optional[str] = None,
+                transport: Optional[Transport] = None) -> list[str]:
+    """Account-wide worker script names over the REST API
+    (GET /accounts/{id}/workers/scripts). The reference stubs this as a
+    TODO returning [] (wrangler.rs:126-129) because no wrangler
+    subcommand enumerates account workers; the dash API does, and the
+    same Transport seam the DNS client uses makes it testable."""
+    token = token or os.environ.get(TOKEN_ENV, "")
+    transport = transport or (_default_transport(token) if token else None)
+    if transport is None:
+        raise CloudError(f"no cloudflare credentials ({TOKEN_ENV} unset)")
+    doc = transport("GET", f"/accounts/{account_id}/workers/scripts", None)
+    if not doc.get("success", False):
+        errs = "; ".join(str(e.get("message", e))
+                         for e in doc.get("errors", []))
+        raise CloudError(f"cloudflare API error: {errs or 'unknown'}")
+    return [r.get("id", "") for r in doc.get("result", []) if r.get("id")]
 
 
 def worker_delete(name: str, runner=None) -> None:

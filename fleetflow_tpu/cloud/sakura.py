@@ -344,17 +344,21 @@ class SakuraProvider(CloudProvider):
                                 d["server_id"], []).append(d)
                     disks = disks_by_server.get(str(current[spec.name].id),
                                                 [])
-                    diff = [d for d in disks
-                            if d["size_gb"] and d["size_gb"] != spec.disk_size]
-                    if diff:
-                        kind = ("resize" if diff[0]["size_gb"] < spec.disk_size
+                    # the KDL disk-size declares the BOOT disk (the one
+                    # `server create --disk-size` made, i.e. the oldest =
+                    # lowest id); secondary data disks are out of scope
+                    # and must not be resized or flagged
+                    boot = min((d for d in disks if d["size_gb"]),
+                               key=lambda d: int(d["id"] or 0), default=None)
+                    if boot is not None and boot["size_gb"] != spec.disk_size:
+                        kind = ("resize" if boot["size_gb"] < spec.disk_size
                                 else "SHRINK (will be refused)")
                         plan.actions.append(Action(
                             ActionType.UPDATE, "disk", spec.name,
-                            f"{kind} {diff[0]['size_gb']}gb -> "
+                            f"{kind} {boot['size_gb']}gb -> "
                             f"{spec.disk_size}gb",
-                            current={"disk_id": diff[0]["id"],
-                                     "size_gb": diff[0]["size_gb"]},
+                            current={"disk_id": boot["id"],
+                                     "size_gb": boot["size_gb"]},
                             desired={"size_gb": spec.disk_size}))
                         resized = True
                 if not resized:

@@ -257,9 +257,9 @@ class WebServer:
         # concurrent browser logins at the default 5s interval — one
         # anonymous /start loop must not starve legitimate polls (and the
         # SPA backs off on 429 rather than failing the login)
-        device_rl = {"start": {"t": 0.0, "tokens": 4.0},
-                     "poll": {"t": 0.0, "tokens": 12.0}}
-        _RL_CFG = {"start": (4.0, 0.5), "poll": (12.0, 3.0)}
+        _RL_CFG = {"start": (4.0, 0.5), "poll": (12.0, 3.0)}  # (cap, /s)
+        device_rl = {k: {"t": 0.0, "tokens": cap}
+                     for k, (cap, _rate) in _RL_CFG.items()}
 
         def _device_ratelimit(kind: str) -> None:
             import time as _t

@@ -25,6 +25,9 @@ __all__ = ["StaticDeployResult", "build_static", "deploy_static",
 # runner(argv, cwd) -> (returncode, combined_output)
 Runner = Callable[[list[str], Optional[str]], tuple[int, str]]
 
+# Pages projects already verified/created this process (deploy_static)
+_ENSURED_PAGES_PROJECTS: set = set()
+
 
 def _shell_runner(argv: list[str], cwd: Optional[str]) -> tuple[int, str]:
     proc = subprocess.run(argv, cwd=cwd, capture_output=True, text=True)
@@ -132,13 +135,16 @@ def deploy_static(svc: Service, project_root: str,
     # first deploy of a fresh project: create it rather than fail
     # (wrangler errors when the Pages project doesn't exist yet). Best
     # effort — a listing/create failure falls through to the deploy,
-    # whose own error is authoritative.
-    try:
-        if ensure_pages_project(svc.deploy.project, runner=cf_runner):
-            if on_line:
-                on_line(f"created Pages project {svc.deploy.project}")
-    except CloudError:
-        pass
+    # whose own error is authoritative — and cached per process so
+    # repeat deploys don't pay the listing roundtrip every time.
+    if svc.deploy.project not in _ENSURED_PAGES_PROJECTS:
+        try:
+            if ensure_pages_project(svc.deploy.project, runner=cf_runner):
+                if on_line:
+                    on_line(f"created Pages project {svc.deploy.project}")
+            _ENSURED_PAGES_PROJECTS.add(svc.deploy.project)
+        except CloudError:
+            pass
     text = wrangler_pages_deploy(out, svc.deploy.project,
                                  cwd=project_root,
                                  runner=cf_runner)

@@ -4,8 +4,8 @@
 VERDICT r3 item 10: the README must quote the driver record, not
 development-session recollections. The block between the bench:begin/end
 markers is machine-written from the newest driver artifact;
-tests/test_static.py::test_readme_matches_newest_bench_artifact fails on
-any drift (run `python scripts/update_readme_bench.py` to refresh).
+tests/test_readme_bench.py fails on any drift (run
+`python scripts/update_readme_bench.py` to refresh).
 """
 
 from __future__ import annotations

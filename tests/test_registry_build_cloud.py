@@ -832,8 +832,6 @@ class TestCloudflareManagement:
             calls.append(argv)
             if argv[:4] == ["wrangler", "r2", "bucket", "list"]:
                 return 0, "name: assets\ncreation_date: x\nname: media\n"
-            if argv[:2] == ["wrangler", "deployments"]:
-                return 0, "Worker: edge-fn\nCreated: x\n"
             return 0, "ok"
 
         assert r2_bucket_list(runner=runner) == ["assets", "media"]
@@ -841,7 +839,18 @@ class TestCloudflareManagement:
         assert calls[-1] == ["wrangler", "r2", "bucket", "create", "logs"]
         r2_bucket_delete("logs", runner=runner)
         assert calls[-1] == ["wrangler", "r2", "bucket", "delete", "logs"]
-        assert worker_list(runner=runner) == ["edge-fn"]
+        # workers enumerate over the REST API (the reference stubs this
+        # as TODO []; no wrangler subcommand lists account workers)
+        api_calls = []
+
+        def transport(method, path, body):
+            api_calls.append((method, path))
+            return {"success": True,
+                    "result": [{"id": "edge-fn"}, {"id": "cron-fn"}]}
+
+        assert worker_list("acct1", transport=transport) == [
+            "edge-fn", "cron-fn"]
+        assert api_calls == [("GET", "/accounts/acct1/workers/scripts")]
         worker_delete("edge-fn", runner=runner)
         assert calls[-1] == ["wrangler", "delete", "--name", "edge-fn",
                              "--force"]
@@ -871,3 +880,35 @@ stage "live" { service "a"; servers "w1" }
         flow2 = flow_from_dict(flow_to_dict(flow))
         assert flow2.servers["w1"].archive == "golden-fleet"
         assert flow2.servers["w1"].disk_size == 120
+
+    def test_multi_disk_server_targets_boot_disk_only(self):
+        """The KDL disk-size declares the boot disk (lowest id); a larger
+        secondary data disk must be neither resized nor flagged."""
+        from fleetflow_tpu.cloud.provider import CloudProviderDecl
+        from fleetflow_tpu.cloud.sakura import SakuraProvider
+        calls = []
+
+        def runner(args):
+            calls.append(args)
+            if args[:2] == ["server", "list"]:
+                return 0, json.dumps([{"ID": 900, "Name": "w1",
+                                       "InstanceStatus": "up"}])
+            if args[:2] == ["disk", "list"]:
+                return 0, json.dumps([
+                    {"ID": 777, "SizeMB": 200 * 1024, "Server": {"ID": 900}},
+                    {"ID": 501, "SizeMB": 40 * 1024, "Server": {"ID": 900}}])
+            return 0, "[]"
+
+        p = SakuraProvider(runner=runner)
+        # boot (id 501, 40gb) matches the declaration -> pure noop even
+        # though the 200gb data disk differs
+        plan = p.plan(CloudProviderDecl(name="sakura"),
+                      [ServerResource(name="w1", disk_size=40)])
+        assert all(a.type.value == "noop" for a in plan.actions)
+        # growth targets the boot disk, not the data disk
+        plan2 = p.plan(CloudProviderDecl(name="sakura"),
+                       [ServerResource(name="w1", disk_size=80)])
+        resize = [a for a in plan2.actions if a.resource_type == "disk"]
+        assert len(resize) == 1
+        assert resize[0].current["disk_id"] == "501"
+        assert "resize 40gb -> 80gb" in resize[0].description
