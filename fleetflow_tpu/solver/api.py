@@ -173,6 +173,7 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
            adaptive: bool = True,
            anneal_block: int = 8,
            warm_block: int = 2,
+           prerepair: Optional[bool] = None,
            proposals_per_step: Optional[int] = None) -> SolveResult:
     """Solve a placement instance end to end.
 
@@ -214,7 +215,27 @@ def _solve(pt: ProblemTensors, *, chains: int = 8, steps: int = DEFAULT_STEPS,
     t_seed = t()
     warm = init_assignment is not None
     if warm:
-        seed_assignment = jnp.asarray(init_assignment, dtype=jnp.int32)
+        seed_np = np.asarray(init_assignment, dtype=np.int32)
+        # Churn pre-repair (CPU default): services stranded on newly
+        # dead/ineligible nodes are relocated host-side first — the
+        # worklist is |displaced| (~14 on the bench's node-kill), so this
+        # costs ~ms and hands the anneal a feasible start, which the
+        # adaptive exit then turns into a 1-block polish instead of ~6
+        # repair sweeps. On accelerators the sweep does the same work
+        # on-device without a host round-trip, so it stays off there.
+        if prerepair is None:
+            prerepair = jax.default_backend() == "cpu"
+        if prerepair:
+            rows = np.arange(pt.S)
+            stranded = ((~pt.node_valid[seed_np])
+                        | (~pt.eligible[rows, seed_np]))
+            if stranded.any():
+                from .repair import repair as _host_repair
+                # keep the result even when repair can't reach 0: it is
+                # never worse than its input (repair.py backstop), and a
+                # partially-fixed seed still saves the anneal sweeps
+                seed_np = _host_repair(pt, seed_np, seed=seed).assignment
+        seed_assignment = jnp.asarray(seed_np, dtype=jnp.int32)
         t0 = min(t0, 0.1)  # warm start: refine, don't re-scramble
     else:
         if seed_impl is None:

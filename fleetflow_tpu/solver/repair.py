@@ -103,6 +103,20 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
     rng = np.random.default_rng(seed)
     bounce = np.zeros(S, dtype=np.int64)
 
+    # conflict-id sets are built lazily and shared across rounds (`ids`
+    # never changes): the worklist touches O(|bad| + evictees) services,
+    # and materializing all S sets per round costs more than the whole
+    # repair on warm churn fixes
+    _id_cache: dict = {}
+
+    def id_set(s: int) -> set:
+        v = _id_cache.get(s)
+        if v is None:
+            row = ids[s]
+            v = set(row[row >= 0].tolist()) if G > 0 else set()
+            _id_cache[s] = v
+        return v
+
     for _ in range(max_rounds):
         load = np.zeros((N, demand.shape[1]), dtype=np.float64)
         np.add.at(load, assignment, demand)
@@ -123,10 +137,13 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
                               np.where(valid, ids, 0)], 0)
             in_conflict = (svc_counts > 1).any(axis=1)
             # keep one occupant per conflict cell: mark all, then unmark the
-            # first occurrence per (node, gid)
+            # first occurrence per (node, gid). Only conflicted rows can be
+            # keepers, so iterate those (ascending, same first-wins order) —
+            # a warm churn repair has ~|displaced| conflicted rows, and an
+            # O(S) python loop here would dominate the whole repair.
             keeper = np.zeros(S, dtype=bool)
             seen: set = set()
-            for s in range(S):
+            for s in np.flatnonzero(in_conflict):
                 cells = [(int(assignment[s]), int(g)) for g in ids[s] if g >= 0]
                 if any(counts[c] > 1 for c in cells):
                     if all(c not in seen for c in cells):
@@ -162,8 +179,6 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
         # marks queued services — their demand/conflicts are already out of
         # load/counts and they must not be seen (or evicted) as residents.
         # Bounded by a global move budget so pathological instances terminate.
-        id_sets = [set(ids[s][ids[s] >= 0].tolist()) if G > 0 else set()
-                   for s in range(S)]
         size = demand.sum(axis=1)
         node_members: list[set] = [set() for _ in range(N)]
         for s in np.flatnonzero(~bad):
@@ -174,7 +189,7 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             """Residents of n to evict so s fits (conflicts + capacity);
             None when even a full conflict eviction can't make room."""
             evict = [r for r in node_members[n]
-                     if id_sets[s] & id_sets[r]] if id_sets[s] else []
+                     if id_set(s) & id_set(r)] if id_set(s) else []
             new_load = load[n] + demand[s] - demand[evict].sum(axis=0)
             rest = sorted((r for r in node_members[n] if r not in evict),
                           key=size.__getitem__)
@@ -188,8 +203,8 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
 
         def detach(r: int, n: int) -> None:
             load[n] -= demand[r]
-            if id_sets[r]:
-                counts[n, list(id_sets[r])] -= 1
+            if id_set(r):
+                counts[n, list(id_set(r))] -= 1
             node_members[n].discard(r)
             detached[r] = True
             queue.append(r)
@@ -200,7 +215,7 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             s = int(queue.popleft())
             budget -= 1
             bounce[s] += 1
-            my = list(id_sets[s])
+            my = list(id_set(s))
             fits = (load + demand[s] <= cap * (1 + 1e-6)).all(axis=1)
             ok = fits & pt.eligible[s] & pt.node_valid
             if my:
@@ -224,7 +239,7 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
                     # randomized escape: random eligible node, evict blockers
                     n = int(rng.choice(elig))
                     evict = plan_eviction(n, s) or [
-                        r for r in node_members[n] if id_sets[s] & id_sets[r]]
+                        r for r in node_members[n] if id_set(s) & id_set(r)]
                 else:
                     # ejection: the eligible node whose blockers are cheapest
                     best = None
