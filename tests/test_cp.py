@@ -1024,3 +1024,77 @@ class TestTenantSecretHygiene:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestProtocolRobustness:
+    """Hostile-input behavior of the framed wire protocol: a listener on a
+    network port must shrug off garbage without crashing the CP or leaking
+    the accept coroutine (club-unison analog hardening)."""
+
+    @staticmethod
+    async def _raw(handle):
+        return await asyncio.open_connection(handle.host, handle.port)
+
+    def test_garbage_and_oversized_frames_rejected(self):
+        async def go():
+            handle = await start_cp()
+
+            # raw garbage bytes (not even a frame header worth of sense)
+            r, w = await self._raw(handle)
+            w.write(b"\x00\x00\x00\x05notjs")
+            await w.drain()
+            assert await r.read(64) == b""   # server closes, no reply
+            w.close()
+
+            # oversized length prefix must not allocate/await 2 GiB
+            r, w = await self._raw(handle)
+            w.write((2 << 30).to_bytes(4, "big") + b"x")
+            await w.drain()
+            assert await r.read(64) == b""
+            w.close()
+
+            # a valid hello whose next frame is torn mid-body: the session
+            # dies quietly, the server stays up
+            r, w = await self._raw(handle)
+            from fleetflow_tpu.cp.protocol import encode_frame
+            w.write(encode_frame({"type": "hello", "identity": "x",
+                                  "token": None}))
+            await w.drain()
+            welcome = await asyncio.wait_for(r.read(200), 5)
+            assert b"welcome" in welcome
+            w.write((500).to_bytes(4, "big") + b"short")
+            w.close()
+
+            # after all that abuse, a real client still works
+            conn, _ = await connect(handle)
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_idle_preauth_connection_reaped(self):
+        """A client that connects and sends nothing must not pin the
+        accept coroutine past the handshake timeout."""
+        async def go():
+            handle = await start_cp()
+            handle.server.handshake_timeout = 0.2
+            r, w = await self._raw(handle)
+            data = await asyncio.wait_for(r.read(64), 5)
+            assert data == b""   # reaped without a welcome
+            w.close()
+            conn, _ = await connect(handle)
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_unknown_message_type_ignored(self):
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            # an unknown type after the handshake is dropped, not fatal
+            await conn._send({"type": "mystery", "x": 1})
+            assert (await conn.request("health", "ping"))["pong"]
+            await conn.close()
+            await handle.stop()
+        run(go())
