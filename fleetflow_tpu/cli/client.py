@@ -88,24 +88,59 @@ class CpClient:
 
     # ------------------------------------------------------------------
     def _ssl_context(self):
+        """Returns (ctx, ca_source). FLEET_CP_CA overrides the ambient
+        CA: a path pins that CA, an empty value / "none" forces plaintext
+        (needed when a stale mesh CA from some earlier TLS daemon sits in
+        ~/.local/state but the target CP is plaintext)."""
+        override = os.environ.get("FLEET_CP_CA")
+        if override is not None:
+            if override.strip().lower() in ("", "none", "off"):
+                return None, None
+            from ..cp.cert import client_ssl_context
+            path = os.path.expanduser(override)
+            try:
+                pem = Path(path).read_bytes()
+            except OSError as e:
+                raise RpcError(
+                    f"cannot read FLEET_CP_CA={override!r}: {e}") from None
+            return client_ssl_context(pem), path
         if os.path.isfile(self.ca_path):
             from ..cp.cert import client_ssl_context
-            return client_ssl_context(Path(self.ca_path).read_bytes())
-        return None
+            return client_ssl_context(Path(self.ca_path).read_bytes()), \
+                self.ca_path
+        return None, None
 
     def connect(self) -> "CpClient":
-        self._loop = asyncio.new_event_loop()
+        import ssl as _ssl
+        ctx, ca_source = self._ssl_context()   # before the loop: a bad CA
+        self._loop = asyncio.new_event_loop()  # must not leak a fresh loop
         try:
             self._conn, self._task = self._loop.run_until_complete(
                 ProtocolClient.connect(
                     self.host, self.port, identity=self.identity,
-                    token=self.token, ssl_context=self._ssl_context()))
-        except (OSError, ConnectionError) as e:
+                    token=self.token, ssl_context=ctx))
+        except _ssl.SSLError as e:
             self._loop.close()
             self._loop = None
             raise RpcError(
-                f"cannot reach control plane at {self.endpoint}: {e}\n"
-                "  is fleetflowd running? (fleet cp daemon run)") from None
+                f"TLS handshake with {self.endpoint} failed using the CA "
+                f"at {ca_source}: {e.__class__.__name__}: {e}\n"
+                "  if this CP runs plaintext (or a different CA), set "
+                "FLEET_CP_CA= (empty) to disable pinning or point it at "
+                "the right ca.pem") from None
+        except (OSError, ConnectionError) as e:
+            self._loop.close()
+            self._loop = None
+            detail = str(e) or repr(e)
+            hint = ("  is fleetflowd running? (fleet cp daemon run)"
+                    if ctx is None else
+                    "  is fleetflowd running? (fleet cp daemon run)\n"
+                    f"  note: connecting with TLS pinned to {ca_source}; "
+                    "a plaintext CP drops TLS clients silently — set "
+                    "FLEET_CP_CA= (empty) to disable pinning")
+            raise RpcError(
+                f"cannot reach control plane at {self.endpoint}: {detail}\n"
+                f"{hint}") from None
         return self
 
     def request(self, channel: str, method: str,

@@ -405,6 +405,33 @@ class FleetMcpServer:
                                        {"request": req.to_dict()},
                                        timeout=600))
 
+    @_tool("cp_node_events", "Report a churn burst (nodes going offline/"
+           "online) as ONE coalesced warm re-solve — maintenance windows "
+           "should use this instead of N single node_event calls",
+           {"type": "object", "properties": {
+               "events": {"type": "array", "items": {
+                   "type": "object", "properties": {
+                       "slug": {"type": "string"},
+                       "online": {"type": "boolean"}},
+                   "required": ["slug", "online"]}}},
+            "required": ["events"]})
+    def cp_node_events(self, events: list) -> dict:
+        return _text(self.cp().request("placement", "node_events",
+                                       {"events": events}, timeout=120))
+
+    @_tool("cp_server_cordon", "Cordon, uncordon, or drain a server "
+           "(drain also warm-reschedules its services)",
+           {"type": "object", "properties": {
+               "slug": {"type": "string"},
+               "action": {"type": "string",
+                          "enum": ["cordon", "uncordon", "drain"]}},
+            "required": ["slug", "action"]})
+    def cp_server_cordon(self, slug: str, action: str) -> dict:
+        if action not in ("cordon", "uncordon", "drain"):
+            raise ValueError(f"unknown action {action!r}")
+        return _text(self.cp().request("server", action, {"slug": slug},
+                                       timeout=120))
+
 
 def serve_stdio(project_root: Optional[str] = None,
                 cp_endpoint: Optional[str] = None,

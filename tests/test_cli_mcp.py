@@ -268,6 +268,39 @@ class TestMcp:
         assert json.loads(resp["result"]["content"][0]["text"]) == [
             {"slug": "n1"}]
 
+    def test_cp_churn_tools(self, project):
+        calls = []
+
+        class FakeCp:
+            def request(self, channel, method, payload=None, timeout=60.0):
+                calls.append((channel, method, payload))
+                if method == "node_events":
+                    return {"rescheduled": [{"stage": "p/live",
+                                             "feasible": True}]}
+                return {"ok": True, "scheduling_state": "draining"}
+
+        root, _ = project
+        server = FleetMcpServer(project_root=str(root), cp_client=FakeCp())
+        resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                              "method": "tools/call",
+                              "params": {"name": "cp_node_events",
+                                         "arguments": {"events": [
+                                             {"slug": "n1", "online": False},
+                                             {"slug": "n2", "online": False}]}}})
+        doc = json.loads(resp["result"]["content"][0]["text"])
+        assert doc["rescheduled"][0]["feasible"]
+        assert calls[0] == ("placement", "node_events",
+                            {"events": [{"slug": "n1", "online": False},
+                                        {"slug": "n2", "online": False}]})
+        resp = server.handle({"jsonrpc": "2.0", "id": 2,
+                              "method": "tools/call",
+                              "params": {"name": "cp_server_cordon",
+                                         "arguments": {"slug": "n1",
+                                                       "action": "drain"}}})
+        doc = json.loads(resp["result"]["content"][0]["text"])
+        assert doc["scheduling_state"] == "draining"
+        assert calls[-1] == ("server", "drain", {"slug": "n1"})
+
 
 class TestAgentCommand:
     def test_agent_parser_defaults(self):

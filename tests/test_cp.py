@@ -1098,3 +1098,64 @@ class TestProtocolRobustness:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestSyncCpClient:
+    """The CLI/MCP blocking client against a LIVE CP — previously covered
+    only by fakes, which hid a real operational bug: an ambient mesh CA
+    from some past TLS daemon run forces TLS on every connection, and a
+    plaintext CP then fails with a misleading 'is fleetflowd running?'."""
+
+    def test_plaintext_roundtrip(self, tmp_path, monkeypatch):
+        from fleetflow_tpu.cli.client import CpClient
+        monkeypatch.delenv("FLEET_CP_CA", raising=False)
+
+        async def go():
+            handle = await start_cp()
+
+            def use_client():
+                c = CpClient(endpoint=f"{handle.host}:{handle.port}",
+                             ca_path=str(tmp_path / "absent-ca.pem"))
+                out = c.request("health", "ping")
+                c.close()
+                return out
+
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, use_client)
+            assert out["pong"] is True
+            await handle.stop()
+        run(go())
+
+    def test_stale_ca_diagnosis_and_override(self, tmp_path, monkeypatch):
+        from fleetflow_tpu.cli.client import CpClient
+        from fleetflow_tpu.cp.cert import ensure_mesh_ca
+
+        # an unrelated mesh CA sits where a previous TLS daemon left it
+        ensure_mesh_ca(str(tmp_path / "stale-ca"))
+        ca_pem = tmp_path / "stale-ca" / "ca.pem"
+        assert ca_pem.exists()
+
+        async def go():
+            handle = await start_cp()   # plaintext CP
+            loop = asyncio.get_running_loop()
+
+            def pinned_fails():
+                monkeypatch.delenv("FLEET_CP_CA", raising=False)
+                c = CpClient(endpoint=f"{handle.host}:{handle.port}",
+                             ca_path=str(ca_pem))
+                with pytest.raises(RpcError, match="FLEET_CP_CA"):
+                    c.request("health", "ping")
+
+            def override_works():
+                monkeypatch.setenv("FLEET_CP_CA", "")
+                c = CpClient(endpoint=f"{handle.host}:{handle.port}",
+                             ca_path=str(ca_pem))
+                out = c.request("health", "ping")
+                c.close()
+                return out
+
+            await loop.run_in_executor(None, pinned_fails)
+            out = await loop.run_in_executor(None, override_works)
+            assert out["pong"] is True
+            await handle.stop()
+        run(go())
