@@ -316,6 +316,44 @@ class TestAgentSession:
             await handle.stop()
         run(go())
 
+    def test_quadlet_failure_marks_deployment_failed(self, project, tmp_path):
+        """A systemctl failure on the node surfaces as a FAILED deployment
+        at the CP (with the unit error in the record), not a silent
+        success."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            from fleetflow_tpu.core.model import Backend
+            flow.stages["local"].servers = ["node-1"]
+            flow.stages["local"].backend = Backend.QUADLET
+            handle = await start(ServerConfig())
+
+            def systemctl(args):
+                if args[0] == "start" and "app" in args[1]:
+                    return 1, "unit entered failed state"
+                return 0, ""
+
+            agent, _ = make_agent(
+                handle, quadlet_unit_dir=str(tmp_path / "units"),
+                agent_kw={"systemctl": systemctl})
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            with pytest.raises(Exception, match="quadlet apply failed"):
+                await cli.request("deploy", "execute",
+                                  {"request": req.to_dict()}, timeout=20)
+            deps = handle.state.store.deployment_history()
+            assert deps and deps[0].status == "failed"
+            assert "quadlet" in deps[0].error
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
     def test_deploy_logs_stream_live(self, project):
         """agent.rs:257-333: deploy events must reach the CP log router
         WHILE the deploy runs (mpsc), not as a drain after completion."""
