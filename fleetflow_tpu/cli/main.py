@@ -599,12 +599,24 @@ def cmd_agent(args) -> int:
         monitor_interval_s=args.monitor_interval,
         restart_threshold=args.restart_threshold,
         deploy_base=args.deploy_base,
+        quadlet_unit_dir=getattr(args, "quadlet_unit_dir", None),
         capacity={"cpu": args.cpu, "memory": args.memory, "disk": args.disk},
     )
     # same backend selection as `fleet up` (_backend): FLEET_BACKEND=mock
-    # honored, and a dead docker daemon fails fast instead of registering a
-    # node that cannot execute anything
-    agent = Agent(cfg, backend=_backend(args))
+    # honored, and a dead daemon fails fast instead of registering a node
+    # that cannot execute anything. --runtime podman points the CLI
+    # backend (and the monitor's inventory) at podman on quadlet nodes —
+    # the CLI surfaces are compatible for the subset the backend uses.
+    if args.runtime != "docker" and os.environ.get("FLEET_BACKEND") != "mock":
+        backend = DockerCliBackend(binary=args.runtime)
+        if not backend.ping():
+            print(f"{args.runtime} unreachable. start it, or set "
+                  "FLEET_BACKEND=mock for a dry environment.",
+                  file=sys.stderr)
+            return 3
+    else:
+        backend = _backend(args)
+    agent = Agent(cfg, backend=backend)
     print(f"fleet-agent {cfg.slug} -> {cfg.cp_host}:{cfg.cp_port} "
           f"(Ctrl+C to stop)")
     try:
@@ -1050,6 +1062,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--monitor-interval", type=float, default=30.0)
     p.add_argument("--restart-threshold", type=int, default=3)
     p.add_argument("--deploy-base", default="~/.fleetflow/deploys")
+    p.add_argument("--runtime", default="docker",
+                   help="container binary the agent drives and monitors "
+                        "(docker|podman; quadlet nodes run podman)")
+    p.add_argument("--quadlet-unit-dir",
+                   help="systemd unit dir for quadlet deploys "
+                        "(default: the user systemd dir)")
     p.set_defaults(fn=cmd_agent)
 
     p = sub.add_parser("init", help="write a starter fleet.kdl")

@@ -337,3 +337,26 @@ class TestBundledExamples:
                    "validate"])
         assert rc == 0
         assert "config valid" in capsys.readouterr().out
+
+
+class TestAgentRuntimeFlag:
+    def test_podman_runtime_selected(self, monkeypatch, capsys):
+        """--runtime podman drives the agent's backend at the podman
+        binary (quadlet nodes); an unreachable runtime fails fast."""
+        import sys
+        cli = sys.modules["fleetflow_tpu.cli.main"]  # pkg attr shadows it
+        captured = {}
+
+        class FakeBackend:
+            def __init__(self, binary="docker"):
+                captured["binary"] = binary
+
+            def ping(self):
+                return False   # unreachable -> fast exit 3
+
+        monkeypatch.setattr(cli, "DockerCliBackend", FakeBackend)
+        monkeypatch.delenv("FLEET_BACKEND", raising=False)
+        rc = main(["agent", "--runtime", "podman", "--slug", "n1"])
+        assert rc == 3
+        assert captured["binary"] == "podman"
+        assert "podman unreachable" in capsys.readouterr().err
