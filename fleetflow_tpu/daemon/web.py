@@ -532,6 +532,12 @@ class WebServer:
                     else db.list("observed_containers"))
             return {"containers": [r.to_dict() for r in rows]}
 
+        @self.route("GET", "/api/logs")
+        def log_topics(body, query):
+            # the log router's live topic list (retained ring per topic):
+            # the dashboard logs view enumerates these
+            return {"topics": state.log_router.topics()}
+
         @self.route("GET", "/api/logs/{server}/{container}")
         def container_logs(body, query, server, container):
             from ..cp.log_router import topic_for
@@ -660,8 +666,8 @@ _DASHBOARD_HTML = """<!doctype html>
 'use strict';
 // -- tiny SPA over the CP REST surface (web.rs:47-116 SPA analog) ---------
 const VIEWS=['overview','servers','stages','deployments','alerts',
-             'placement','agents','pools','containers','tenants','dns',
-             'volumes','builds'];
+             'placement','agents','pools','containers','logs','tenants',
+             'dns','volumes','builds'];
 function esc(v){return String(v??'').replace(/[&<>"']/g,
  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function token(){return localStorage.getItem('fleet_token')||''}
@@ -829,6 +835,26 @@ const views={
     [x.project,x.stage,x.service].filter(Boolean).map(esc).join('/')
      ||'<span class="muted">unmanaged</span>'])):
    '<span class="muted">no observed containers</span>')},
+ async logs(arg){
+  if(!arg){
+   const t=await api('/api/logs');
+   main().innerHTML=card(t.topics.length?
+    '<b>log topics</b> (retained ring per container)<br>'+
+    t.topics.map(x=>{const [,srv,...rest]=x.split('/');
+     const c=rest.join('/');
+     return `<a href="#logs/${enc(srv+'~'+c)}"><code>${esc(x)}</code></a>`})
+     .join('<br>'):
+    '<span class="muted">no log topics yet (agents publish container '+
+    'and deploy logs here)</span>');
+   return}
+  const [srv,c]=decodeURIComponent(arg).split('~');
+  const l=await api(`/api/logs/${enc(srv)}/${enc(c)}?limit=200`);
+  main().innerHTML=card(
+   `<b>logs/${esc(srv)}/${esc(c)}</b> — <a href="#logs">all topics</a><br>`+
+   (l.lines.length?l.lines.map(x=>
+    `<code class="${x.level==='error'?'bad':x.level==='warn'?'warn':''}">`+
+    `${esc(x.line)}</code>`).join('<br>'):
+    '<span class="muted">ring is empty</span>'))},
  async tenants(){
   const t=await api('/api/tenants');
   const rows=await Promise.all(t.tenants.map(async x=>{

@@ -388,3 +388,36 @@ class TestDaemonizedStart:
             subprocess.run(
                 [_sys.executable, "-m", "fleetflow_tpu.daemon", "stop",
                  "-c", cfg], capture_output=True, text=True, timeout=60)
+
+
+class TestLogTopics:
+    def test_topics_and_lines_over_rest(self):
+        """The dashboard logs view: enumerate the log router's topics,
+        then read one topic's retained ring; both gated as read:container
+        (the logs area alias)."""
+        async def go():
+            from fleetflow_tpu.cp import ServerConfig, start
+            from fleetflow_tpu.cp.log_router import LogEntry, topic_for
+            from fleetflow_tpu.daemon.web import WebServer
+            from test_cp import mock_backend_factory
+            handle = await start(ServerConfig(auth_kind="token",
+                                              auth_secret="s3"),
+                                 backend_factory=mock_backend_factory)
+            handle.state.log_router.publish(LogEntry(
+                topic=topic_for("n1", "deploy/live"), line="started web",
+                level="info"))
+            web = WebServer(handle.state)
+            host, port = await web.start()
+            tok = handle.state.auth.issue("r@x", ["read:container"])
+            st, doc = await http_get(host, port, "/api/logs", tok)
+            assert st == 200 and doc["topics"] == ["logs/n1/deploy/live"]
+            st, doc = await http_get(host, port,
+                                     "/api/logs/n1/deploy%2Flive", tok)
+            assert st == 200 and doc["lines"][0]["line"] == "started web"
+            # narrow non-container grant cannot read logs
+            other = handle.state.auth.issue("o@x", ["read:health"])
+            st, _ = await http_get(host, port, "/api/logs", other)
+            assert st == 403
+            await web.stop()
+            await handle.stop()
+        run(go())
