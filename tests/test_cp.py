@@ -1159,3 +1159,34 @@ class TestSyncCpClient:
             assert out["pong"] is True
             await handle.stop()
         run(go())
+
+
+class TestVolumeChannel:
+    def test_adopt_snapshot_list(self):
+        """Volume lifecycle over the wire (handlers/volume channel): adopt
+        an observed volume, snapshot it with a label, list both ways."""
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            v = await conn.request("volume", "adopt",
+                                   {"server": "n1", "name": "pgdata",
+                                    "tenant": "acme"})
+            assert v["volume"]["adopted"] is True
+            vid = v["volume"]["id"]
+            # re-adopt is idempotent (same record, still adopted)
+            v2 = await conn.request("volume", "adopt",
+                                    {"server": "n1", "name": "pgdata"})
+            assert v2["volume"]["id"] == vid
+            snap = await conn.request("volume", "snapshot",
+                                      {"volume": vid, "label": "pre-migrate"})
+            assert snap["snapshot"]["label"] == "pre-migrate"
+            listing = await conn.request("volume", "snapshots",
+                                         {"volume": vid})
+            assert len(listing["snapshots"]) == 1
+            vols = await conn.request("volume", "list", {"server": "n1"})
+            assert [x["name"] for x in vols["volumes"]] == ["pgdata"]
+            assert (await conn.request("volume", "list",
+                                       {"server": "other"}))["volumes"] == []
+            await conn.close()
+            await handle.stop()
+        run(go())
