@@ -1190,3 +1190,51 @@ class TestVolumeChannel:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestBuildChannel:
+    def test_submit_routes_to_worker_and_records_log(self):
+        """deploy-pipeline slice over the wire: build.submit routes to a
+        connected worker agent, the command_result lands the log, and the
+        job reaches SUCCEEDED (handlers build channel + _run_build)."""
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("builder-1").connect(handle)
+            agent.respond = lambda cmd, p: {"log": f"built {p['image_tag']}"}
+            conn, _ = await connect(handle)
+            out = await conn.request("build", "submit",
+                                     {"repo": "https://x/y.git",
+                                      "image_tag": "y:1", "push": False})
+            jid = out["job"]["id"]
+            assert out["job"]["worker"] == "builder-1"
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                job = (await conn.request("build", "show",
+                                          {"job": jid}))["job"]
+                if job["status"] in ("succeeded", "failed"):
+                    break
+            assert job["status"] == "succeeded", job
+            logs = await conn.request("build", "logs", {"job": jid})
+            assert logs["log"] == "built y:1"
+            # terminal job: cancel is a no-op
+            res = await conn.request("build", "cancel", {"job": jid})
+            assert res["cancelled"] is False
+            await conn.close()
+            await agent.conn.close()
+            await handle.stop()
+        run(go())
+
+    def test_submit_without_worker_queues(self):
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            out = await conn.request("build", "submit",
+                                     {"repo": "https://x/y.git",
+                                      "image_tag": "y:1"})
+            assert out["job"]["status"] == "queued"
+            res = await conn.request("build", "cancel",
+                                     {"job": out["job"]["id"]})
+            assert res["cancelled"] is True
+            await conn.close()
+            await handle.stop()
+        run(go())
