@@ -23,10 +23,57 @@ to CPU. BENCH_SMALL=1 drops to 1k x 100 for CPU smoke runs.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
 import time
+
+
+@contextlib.contextmanager
+def _watch_compiles():
+    """Yield a list that accumulates jax compile-log events inside the
+    with-block.
+
+    jax_log_compiles makes jax emit one log record per XLA compilation; any
+    record arriving while the watch is active means the timed region paid a
+    compile, which the artifact must show (VERDICT r4 weak #1: the 701.5 ms
+    driver reschedule could not be told apart from a hidden recompile)."""
+    import logging
+
+    import jax
+
+    events: list[str] = []
+
+    class _Handler(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            # exactly one such record per XLA computation compiled; the
+            # 'Compiling ...' / MLIR-conversion records would double-count
+            if "Finished XLA compilation" in msg:
+                events.append(msg.splitlines()[0][:160])
+
+    handler = _Handler()
+    # the records are emitted by child loggers (jax._src.dispatch /
+    # jax._src.interpreters.pxla); an explicit level set there (e.g. via
+    # JAX_LOGGING_LEVEL) would drop the record before it propagates to the
+    # parent handler, so the watch pins every logger in the chain
+    loggers = [logging.getLogger(n) for n in
+               ("jax", "jax._src.dispatch", "jax._src.interpreters.pxla")]
+    old_cfg = jax.config.jax_log_compiles
+    old_levels = [lg.level for lg in loggers]
+    jax.config.update("jax_log_compiles", True)
+    for lg in loggers:
+        if lg.getEffectiveLevel() > logging.WARNING:
+            lg.setLevel(logging.WARNING)
+    loggers[0].addHandler(handler)
+    try:
+        yield events
+    finally:
+        loggers[0].removeHandler(handler)
+        for lg, lvl in zip(loggers, old_levels):
+            lg.setLevel(lvl)
+        jax.config.update("jax_log_compiles", old_cfg)
 
 
 def main() -> None:
@@ -103,11 +150,43 @@ def main() -> None:
     solve(pt2, prob=prob2, chains=resched_chains, steps=steps, seed=2,   # compile warm path
           init_assignment=res.assignment, anneal_block=block,
           warm_block=warm_block, proposals_per_step=proposals)
-    t1 = time.perf_counter()
-    res2 = solve(pt2, prob=prob2, chains=resched_chains, steps=steps, seed=3,
-                 init_assignment=res.assignment, anneal_block=block,
-                 warm_block=warm_block, proposals_per_step=proposals)
-    reschedule_ms = (time.perf_counter() - t1) * 1e3
+    # VERDICT r4 weak #1: a single-shot, unphased timing recorded 701.5 ms
+    # where three dev runs said ~133 and could not explain itself. The timed
+    # reschedule now runs BENCH_RESCHED_REPS times (default 3), reports
+    # median + min + every run's phase breakdown, and counts XLA compiles
+    # inside each timed region — an outlier stays visible but cannot become
+    # the headline, and a recompile can no longer hide.
+    try:
+        reps = max(1, int(os.environ.get("BENCH_RESCHED_REPS") or "3"))
+    except ValueError:
+        reps = 3
+    runs, results = [], []
+    for i in range(reps):
+        with _watch_compiles() as compiles:
+            t1 = time.perf_counter()
+            r = solve(pt2, prob=prob2, chains=resched_chains, steps=steps,
+                      seed=3 + i, init_assignment=res.assignment,
+                      anneal_block=block, warm_block=warm_block,
+                      proposals_per_step=proposals)
+            ms = (time.perf_counter() - t1) * 1e3
+        results.append(r)
+        runs.append({"ms": round(ms, 1),
+                     "timings_ms": {k: round(v, 1)
+                                    for k, v in r.timings_ms.items()},
+                     "sweeps": int(r.steps),
+                     "violations": r.violations,
+                     "soft": round(r.soft, 4),
+                     "pre_repair_violations": r.pre_repair_violations,
+                     "moves_repaired": r.moves_repaired,
+                     "compiles": len(compiles),
+                     "compile_events": compiles[:3]})
+    # lower-middle median: with an even rep count the faster middle run is
+    # the headline (an outlier must never be), and EVERY top-level
+    # reschedule_* field below describes this same run
+    order_idx = sorted(range(reps), key=lambda i: runs[i]["ms"])
+    mid = order_idx[(reps - 1) // 2]
+    median_run, res2 = runs[mid], results[mid]
+    reschedule_ms = median_run["ms"]
     moved = int((res2.assignment != res.assignment).sum())
     affected = int((res.assignment == victim).sum())
 
@@ -164,11 +243,20 @@ def main() -> None:
         "backend": jax.default_backend(),
         "probe": platform_report(),
         "timings_ms": {k: round(v, 1) for k, v in res.timings_ms.items()},
-        # BASELINE config 5: warm reschedule after killing the busiest node
+        # BASELINE config 5: warm reschedule after killing the busiest node.
+        # Headline is the MEDIAN of reschedule_runs; min and the per-run
+        # phase timings + compile counts are alongside (VERDICT r4 weak #1).
         "reschedule_ms": round(reschedule_ms, 1),
-        "reschedule_violations": res2.violations,
-        "reschedule_soft": round(res2.soft, 4),
-        "reschedule_sweeps": res2.steps,
+        "reschedule_ms_min": runs[order_idx[0]]["ms"],
+        "reschedule_timings_ms": median_run["timings_ms"],
+        "reschedule_pre_repair_violations": median_run["pre_repair_violations"],
+        "reschedule_moves_repaired": median_run["moves_repaired"],
+        "reschedule_compiles": median_run["compiles"],
+        "reschedule_runs": runs,
+        # all three describe the SAME (median) run as the fields above
+        "reschedule_violations": median_run["violations"],
+        "reschedule_soft": median_run["soft"],
+        "reschedule_sweeps": median_run["sweeps"],
         "churn_affected": affected,
         "churn_moved": moved,
         "burst": burst,

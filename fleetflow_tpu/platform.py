@@ -18,6 +18,7 @@ case is a CPU fallback plus a diagnostic on stderr.  tests/conftest.py uses
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import re
@@ -44,6 +45,136 @@ _decided_ndev: int = 0
 # Diagnostic record of the last ensure_platform decision, for embedding in
 # bench artifacts: {"requested", "attempts": [probe records], "decision"}.
 _last_report: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# Negative-probe cache (VERDICT r4 item 9): a dead tunnel costs 240 s per
+# probe attempt and the full retry ladder 510 s.  When a recent probe of the
+# same platform already failed, later processes do ONE short re-probe (so a
+# revived tunnel is still noticed within FLEET_PROBE_CACHED_TIMEOUT) instead
+# of the full budget.  FLEET_PROBE_FRESH=1 ignores the cache (the
+# round-start probe); a successful probe deletes it.  The cache entry keeps
+# the original failure trail so artifacts stay self-explanatory.
+# ---------------------------------------------------------------------------
+
+def _probe_cache_path() -> str:
+    import tempfile
+    # per-user default: on multi-user hosts a shared /tmp file would let
+    # users cap each other's probe budgets (and the sticky bit would stop
+    # them correcting the entry)
+    uid = getattr(os, "getuid", lambda: "u")()
+    return os.environ.get(
+        "FLEET_PROBE_CACHE",
+        os.path.join(tempfile.gettempdir(),
+                     f"fleetflow_probe_cache_{uid}.json"))
+
+
+def _probe_cache_ttl() -> float:
+    try:
+        return float(os.environ.get("FLEET_PROBE_CACHE_TTL", "21600"))
+    except ValueError:
+        return 21600.0
+
+
+@contextlib.contextmanager
+def _cache_lock():
+    """Exclusive advisory lock serializing read-modify-write of the cache
+    file across processes — two concurrent probes must not lose each
+    other's entries.  Degrades to unlocked on platforms without fcntl.
+    Only acquisition sits in the try: an exception from the BODY must
+    propagate, not trigger a second yield."""
+    lf = None
+    try:
+        import fcntl
+        lf = open(_probe_cache_path() + ".lock", "w")
+        fcntl.flock(lf, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        if lf is not None:
+            lf.close()
+        lf = None
+    try:
+        yield
+    finally:
+        if lf is not None:
+            try:
+                import fcntl
+                fcntl.flock(lf, fcntl.LOCK_UN)
+            except (ImportError, OSError):
+                pass
+            lf.close()
+
+
+def _read_cache_file() -> dict:
+    """{platform: {ts, attempts}} — tolerant of a missing/corrupt file."""
+    try:
+        with open(_probe_cache_path(), encoding="utf-8") as f:
+            entries = json.load(f)
+        return entries if isinstance(entries, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def read_probe_cache(platform: str) -> dict | None:
+    """The unexpired negative decision for `platform`, or None.  The
+    returned dict gains `age_s` (seconds since the failing probe)."""
+    if os.environ.get("FLEET_PROBE_FRESH", "").lower() not in ("", "0",
+                                                               "false"):
+        return None
+    entry = _read_cache_file().get(platform)
+    if not isinstance(entry, dict):
+        return None
+    try:
+        age = time.time() - float(entry.get("ts", 0))
+    except (ValueError, TypeError):
+        return None   # corrupt cache must never break the fallback contract
+    if age < 0 or age > _probe_cache_ttl():
+        return None
+    entry["age_s"] = round(age, 1)
+    return entry
+
+
+def _write_cache_file(entries: dict) -> None:
+    path = _probe_cache_path()
+    try:
+        if not entries:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            return
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(entries, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def write_probe_cache(platform: str, attempts: list[dict]) -> None:
+    """Record that probing `platform` just failed (attempts = the trail).
+    Entries are keyed per platform: caching a failure for one platform must
+    not clobber another's."""
+    with _cache_lock():
+        entries = _read_cache_file()
+        entries[platform] = {"ts": time.time(), "attempts": attempts}
+        _write_cache_file(entries)
+
+
+def clear_probe_cache(platform: str | None = None) -> None:
+    """Drop `platform`'s negative entry (None: the whole cache file).  A
+    probe SUCCESS clears only its own platform — a live default platform
+    must not erase the still-dead tunnel's entry."""
+    if platform is None:
+        try:
+            os.unlink(_probe_cache_path())
+        except OSError:
+            pass
+        return
+    with _cache_lock():
+        entries = _read_cache_file()
+        if platform in entries:
+            del entries[platform]
+            _write_cache_file(entries)
 
 
 def platform_report() -> dict:
@@ -245,6 +376,30 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
     # "time-to-fallback <= budget" contract on a hung backend
     probe_timeout = min(probe_timeout, budget)
 
+    # Cached negative decision: a recent probe of this exact platform
+    # already failed, so spend one short attempt (a revived tunnel answers
+    # fast) instead of the full 2x240s+backoff ladder.  FLEET_PROBE_FRESH=1
+    # restores the full budget (read_probe_cache returns None then).
+    cached = read_probe_cache(want or "default")
+    if cached is not None:
+        # Default 240 s: ONE full-length attempt (a revived tunnel may
+        # legitimately need minutes of cold backend init — a shorter cap
+        # would leave it invisibly on CPU for the whole TTL) instead of the
+        # full attempts+backoff ladder.
+        try:
+            cached_timeout = float(
+                os.environ.get("FLEET_PROBE_CACHED_TIMEOUT", "240"))
+        except ValueError:
+            cached_timeout = 240.0
+        probe_timeout = min(probe_timeout, cached_timeout)
+        retries = 0
+        log(f"probe cache: {want or 'default'!r} failed "
+            f"{cached['age_s']:.0f}s ago (ttl {_probe_cache_ttl():.0f}s); "
+            f"one {probe_timeout:.0f}s re-probe instead of the full "
+            f"{budget:.0f}s budget (FLEET_PROBE_FRESH=1 overrides)")
+        _last_report["cached"] = {"age_s": cached["age_s"],
+                                  "attempts": cached.get("attempts", [])}
+
     # want == "" means "whatever the install default is" — on a real TPU host
     # that is the TPU backend, so it must be probed, not assumed CPU.
     # Every failure class is retried (a flaky tunnel can surface as a hang
@@ -277,10 +432,17 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
         log(f"platform {want or 'default'!r} failed to initialize or hung "
             f"({1 + max(retries, 0)} attempt(s)); falling back to "
             f"virtual-CPU platform ({min_devices} devices)")
+        if cached is None:
+            # A failed SHORT re-probe must not overwrite the entry: the
+            # original full-ladder trail stays in artifacts, and the TTL
+            # keeps counting from the original failure so the promised
+            # return to full-budget probing actually happens.
+            write_probe_cache(want or "default", _last_report["attempts"])
         force_cpu(min_devices)
         return record_decision(decide_cpu())
 
     backend, ndev = res
+    clear_probe_cache(want or "default")   # it answered: stop short-probing
     if ndev < min_devices:
         # Do NOT silently shrink the mesh (round-1 bug): an n-way sharding
         # dryrun on a 1-device mesh tests nothing. Use a CPU mesh of the
