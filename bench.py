@@ -211,6 +211,18 @@ def main() -> None:
     if os.environ.get("BENCH_SHARDED", "1").lower() not in ("0", "false"):
         sharded = _sharded_scenario()
 
+    # ---- pipeline scenario (VERDICT r4 item 3): config -> placement -----
+    # The FULL production path from KDL text (multi-fleet registry, like
+    # real usage) through parse -> aggregate/lower -> device staging ->
+    # solve, each phase timed separately. The reference pays this pipeline
+    # on every deploy (loader.rs:25-74 + engine.rs:157-167); the headline
+    # solve-only number must not hide what config costs at the same scale.
+    pipeline = None
+    if os.environ.get("BENCH_PIPELINE", "1").lower() not in ("0", "false"):
+        pipeline = _pipeline_scenario(S, N, chains=chains, steps=steps,
+                                      seed_batch=seed_batch, block=block,
+                                      proposals=proposals)
+
     pps = S / elapsed
     baseline_pps = 50.0  # sequential docker loop at 20 ms/call
     import jax
@@ -261,6 +273,7 @@ def main() -> None:
         "churn_moved": moved,
         "burst": burst,
         "sharded": sharded,
+        "pipeline": pipeline,
     }))
 
 
@@ -359,6 +372,94 @@ def _burst_scenario(S: int, N: int, *, chains: int, steps: int, block: int,
     }
 
 
+def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
+                       seed_batch: int, block: int, proposals) -> dict:
+    """Time the whole config->placement pipeline at scale (VERDICT r4
+    item 3): generated multi-fleet KDL text -> parse (native kdl.cpp fast
+    path when built) -> registry aggregation + lowering -> device staging
+    -> solve.  Reports each phase so no stage can hide inside another;
+    generation itself is untimed (it replaces the operator's files on
+    disk, not the deploy path)."""
+    import jax
+
+    from fleetflow_tpu.core.parser import parse_kdl_string
+    from fleetflow_tpu.lower.fleetgen import (generate_fleet_kdl,
+                                              generate_servers_kdl)
+    from fleetflow_tpu.native.kdl import kdl_native_available
+    from fleetflow_tpu.registry.aggregate import aggregate_fleets
+    from fleetflow_tpu.registry.model import FleetEntry, Registry
+    from fleetflow_tpu.solver import prepare_problem, solve
+
+    F = 8                                   # tenant fleets in the registry
+    # disjoint port_base per fleet: conflict identity is (ip, port, proto),
+    # so shared numbering would merge groups across fleets past the cap
+    texts = {f"t{i}": generate_fleet_kdl(f"t{i}", S // F, seed=100 + i,
+                                         n_nodes_hint=N,
+                                         port_base=10000 + i * (S // F))
+             for i in range(F)}
+    servers_text = generate_servers_kdl(N, seed=7)
+    kdl_bytes = sum(len(t) for t in texts.values()) + len(servers_text)
+
+    t0 = time.perf_counter()
+    pool_flow = parse_kdl_string(servers_text)
+    servers_parse_ms = (time.perf_counter() - t0) * 1e3
+
+    fleet_parse_ms = 0.0
+
+    def loader(path: str, stage):
+        nonlocal fleet_parse_ms
+        t = time.perf_counter()
+        flow = parse_kdl_string(texts[path])
+        fleet_parse_ms += (time.perf_counter() - t) * 1e3
+        return flow
+
+    reg = Registry(fleets={n: FleetEntry(name=n, path=n) for n in texts},
+                   servers=pool_flow.servers)
+    t1 = time.perf_counter()
+    pt, _index = aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
+                                  loader=loader)
+    # aggregation = namespacing + merge + lower_stage; its loader calls are
+    # parse time, reported separately
+    lower_ms = (time.perf_counter() - t1) * 1e3 - fleet_parse_ms
+
+    t2 = time.perf_counter()
+    prob = prepare_problem(pt)
+    jax.block_until_ready(prob)
+    stage_ms = (time.perf_counter() - t2) * 1e3
+
+    # warm-up compile on the final shapes, then the timed solve — same
+    # accounting as the headline number (compile reported, not hidden)
+    t3 = time.perf_counter()
+    solve(pt, prob=prob, chains=chains, steps=steps, seed=30,
+          seed_batch=seed_batch, anneal_block=block,
+          proposals_per_step=proposals)
+    compile_s = time.perf_counter() - t3
+    t4 = time.perf_counter()
+    res = solve(pt, prob=prob, chains=chains, steps=steps, seed=31,
+                seed_batch=seed_batch, anneal_block=block,
+                proposals_per_step=proposals)
+    solve_ms = (time.perf_counter() - t4) * 1e3
+
+    parse_ms = servers_parse_ms + fleet_parse_ms
+    return {
+        "fleets": F,
+        "services": pt.S,
+        "nodes": pt.N,
+        "kdl_bytes": kdl_bytes,
+        "native_parse": kdl_native_available(),
+        "parse_ms": round(parse_ms, 1),
+        "lower_ms": round(lower_ms, 1),
+        "stage_ms": round(stage_ms, 1),
+        "solve_ms": round(solve_ms, 1),
+        "end_to_end_ms": round(parse_ms + lower_ms + stage_ms + solve_ms, 1),
+        "compile_s": round(compile_s, 1),
+        "violations": res.violations,
+        "pre_repair_violations": res.pre_repair_violations,
+        "soft_score": round(res.soft, 4),
+        "sweeps": int(res.steps),
+    }
+
+
 def _sharded_scenario() -> dict:
     """Run the sharded child (below) in a subprocess: it needs an 8-device
     mesh, which a single-chip parent can only get from virtual CPU devices
@@ -407,7 +508,8 @@ def _sharded_child() -> None:
     from fleetflow_tpu.solver import prepare_problem
     from fleetflow_tpu.solver.repair import verify
     from fleetflow_tpu.solver.sharded import (SVC_AXIS, anneal_sharded,
-                                              pad_problem, shard_problem)
+                                              pad_problem, per_device_bytes,
+                                              shard_problem)
 
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (997, 100) if small else (9997, 1000)   # ragged: forces padding
@@ -417,7 +519,8 @@ def _sharded_child() -> None:
 
     pt = synthetic_problem(S, N, seed=0, n_tenants=8, port_fraction=0.2,
                            volume_fraction=0.1)
-    padded, orig_s = pad_problem(prepare_problem(pt), D)
+    prob_host = prepare_problem(pt)
+    padded, orig_s = pad_problem(prob_host, D)
     mesh = Mesh(np.array(jax.devices()[:D]), (SVC_AXIS,))
     padded = shard_problem(padded, mesh)
 
@@ -436,17 +539,32 @@ def _sharded_child() -> None:
     init = jnp.pad(jnp.asarray(seed, jnp.int32), (0, padded.S - orig_s))
 
     kw = dict(steps=steps, mesh=mesh, adaptive=True, block=block,
-              n_real=orig_s)
+              n_real=orig_s, return_sweeps=True)
     t_c = time.perf_counter()
-    anneal_sharded(padded, init, jax.random.PRNGKey(0),
-                   **kw).block_until_ready()
+    out, _ = anneal_sharded(padded, init, jax.random.PRNGKey(0), **kw)
+    out.block_until_ready()
     compile_s = time.perf_counter() - t_c
     t0 = time.perf_counter()
-    out = anneal_sharded(padded, init, jax.random.PRNGKey(1), **kw)
+    out, sweeps = anneal_sharded(padded, init, jax.random.PRNGKey(1), **kw)
     out.block_until_ready()
     anneal_ms = (time.perf_counter() - t0) * 1e3
     a = np.asarray(out)[:orig_s]
     stats = verify(pt, a)
+    # quality + effort of the sharded solve, comparable with the
+    # single-device headline (VERDICT r4 weak #3: latency alone was opaque)
+    from fleetflow_tpu.solver.kernels import soft_score
+    soft = float(jax.device_get(soft_score(
+        prob_host, jnp.asarray(a, jnp.int32))))
+    # per-device staging footprint: the service-axis tensors must shrink
+    # ~1/D while replicated node state stays constant (the module's memory
+    # rationale; the 1/D assertion itself lives in tests/test_sharded.py)
+    bytes_by_field = per_device_bytes(padded)
+    sharded_fields = {"demand", "conflict_ids", "coloc_ids", "eligible",
+                      "preferred"}
+    sharded_mib = sum(v for k, v in bytes_by_field.items()
+                      if k in sharded_fields) / 2**20
+    repl_mib = sum(v for k, v in bytes_by_field.items()
+                   if k not in sharded_fields) / 2**20
 
     print(json.dumps({
         "ok": True,
@@ -459,6 +577,10 @@ def _sharded_child() -> None:
         "anneal_ms": round(anneal_ms, 1),
         "compile_s": round(compile_s, 1),
         "violations": int(stats["total"]),
+        "sweeps_run": int(sweeps),
+        "soft_score": round(soft, 4),
+        "per_device_sharded_mib": round(sharded_mib, 1),
+        "per_device_replicated_mib": round(repl_mib, 1),
     }))
 
 

@@ -249,3 +249,74 @@ stage "live" { service "app"; service "site" }
 """)
         pt = lower_stage(flow, "live")
         assert pt.service_names == ["app"]
+
+
+class TestFleetgen:
+    """Fleet-scale KDL generators (lower/fleetgen.py) feeding the pipeline
+    bench (VERDICT r4 item 3): generated documents must parse through the
+    production parser, aggregate across fleets, and lower to a FEASIBLE
+    instance shaped like synthetic_problem's."""
+
+    def _pipeline(self, S=240, N=24, F=3):
+        from fleetflow_tpu.lower.fleetgen import (generate_fleet_kdl,
+                                                  generate_servers_kdl)
+        from fleetflow_tpu.registry.aggregate import aggregate_fleets
+        from fleetflow_tpu.registry.model import FleetEntry, Registry
+        texts = {f"t{i}": generate_fleet_kdl(f"t{i}", S // F, seed=100 + i,
+                                             n_nodes_hint=N,
+                                             port_base=10000 + i * (S // F))
+                 for i in range(F)}
+        pool = parse_kdl_string(generate_servers_kdl(N, seed=7))
+        reg = Registry(
+            fleets={n: FleetEntry(name=n, path=n) for n in texts},
+            servers=pool.servers)
+        return aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
+                                loader=lambda p, s: parse_kdl_string(texts[p]))
+
+    def test_generated_fleet_parses_and_lowers(self):
+        pt, index = self._pipeline()
+        assert pt.S == 240 and pt.N == 24
+        # structure made it through the whole pipeline, not just the parse
+        assert (pt.port_ids >= 0).any(), "port conflicts lost"
+        assert (pt.volume_ids >= 0).any(), "volume conflicts lost"
+        assert pt.dep_adj.any(), "dependency chains lost"
+        assert pt.dep_depth.max() >= 1
+        # namespaced row identity maps back to (fleet, stage, service)
+        fleet, stage, svc = index.rows[0]
+        assert fleet == "t0" and stage == "prod"
+        # with disjoint per-fleet port_base, no merged cross-fleet group
+        # may exceed the node count (feasibility by construction)
+        ids = pt.port_ids[pt.port_ids >= 0]
+        assert np.bincount(ids).max() < pt.N
+
+    def test_port_pool_exhaustion_skips_instead_of_crashing(self):
+        from fleetflow_tpu.lower.fleetgen import generate_fleet_kdl
+        # ~200 would-be publishers vs a pool of 50 x (2-1) slots: the
+        # generator must skip extra ports, not raise
+        text = generate_fleet_kdl("x", 1000, seed=1, n_nodes_hint=2)
+        flow = parse_kdl_string(text)
+        per_port: dict[int, int] = {}
+        for svc in flow.services.values():
+            for p in svc.ports:
+                per_port[p.host] = per_port.get(p.host, 0) + 1
+        assert per_port, "expected some ports before exhaustion"
+        assert max(per_port.values()) <= 1   # cap is n_nodes_hint - 1
+
+    def test_generated_instance_is_feasible(self):
+        from fleetflow_tpu.solver import solve
+        pt, _ = self._pipeline()
+        res = solve(pt, chains=1, steps=64, seed=0)
+        assert res.violations == 0
+
+    def test_native_and_python_parse_agree(self):
+        # the generated corpus is also a parity check for the native parser
+        from fleetflow_tpu.core.kdl import _Parser
+        from fleetflow_tpu.lower.fleetgen import generate_fleet_kdl
+        from fleetflow_tpu.native.kdl import (kdl_native_available,
+                                              native_parse_document)
+        if not kdl_native_available():
+            pytest.skip("native KDL library not built")
+        text = generate_fleet_kdl("t0", 40, seed=5, n_nodes_hint=8)
+        native = native_parse_document(text)
+        assert native is not None
+        assert native == _Parser(text).parse_nodes()
