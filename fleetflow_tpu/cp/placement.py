@@ -121,6 +121,25 @@ class PlacementService:
         stage = flow.stage(stage_name)
         with self._lock:
             nodes, valid = self._inventory(tenant, stage.servers or None)
+            # Config-declared labels back-fill: agents register slug +
+            # capacity only, so live store records usually carry NO labels,
+            # and a blank label passes every gate (_server_matches treats
+            # tier=None as match-any, tensors.py) — a tier-gated stage
+            # could silently place services on a declared-off-tier node
+            # (found by the full-stack smoke: api landed on the standard
+            # node).  Fill per FIELD: only fields the server API has not
+            # set inherit the flow's declaration; API-set fields win.
+            for n in nodes:
+                decl = flow.servers.get(n.name)
+                if decl is None:
+                    continue
+                d, got = decl.labels, n.labels
+                n.labels = ServerLabels(
+                    tier=got.tier if got.tier is not None else d.tier,
+                    region=got.region if got.region is not None else d.region,
+                    clazz=got.clazz if got.clazz is not None else d.clazz,
+                    arch=got.arch if got.arch is not None else d.arch,
+                    extra={**d.extra, **got.extra})
             pt = lower_stage(flow, stage_name, nodes=nodes)
             pt.node_valid &= valid
             key = f"{flow.name}/{stage_name}"

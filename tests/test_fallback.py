@@ -201,3 +201,97 @@ stage "live" {{
         flow2 = flow_from_dict(flow_to_dict(flow))
         with pytest.raises(SolverError, match="max-services 1"):
             lower_stage(flow2, "live", nodes=_nodes())
+
+
+class TestConfigLabelBackfill:
+    """Agents register slug + capacity only, so the CP's live inventory has
+    blank labels — and a blank label passes every gate (_server_matches
+    treats tier=None as match-any), so a tier-gated stage could silently
+    place services on a declared-off-tier node (found by
+    tests/test_fullstack.py: api placed on the standard node).  solve_stage
+    back-fills the FLOW's declared server labels per field; labels set
+    through the server API win over the declaration."""
+
+    FLOW = """
+project "fb"
+service "a" {{ image "x" }}
+service "b" {{ image "y" }}
+server "n0" {{ capacity {{ cpu 8; memory 16384; disk 99999 }}
+              labels {{ tier "{tier}" }} }}
+server "n1" {{ capacity {{ cpu 8; memory 16384; disk 99999 }}
+              labels {{ tier "{tier}" }} }}
+stage "live" {{
+    service "a"
+    service "b"
+    servers "n0" "n1"
+    placement {{
+        tier "premium"
+        fallback "tier"
+    }}
+}}
+"""
+
+    def _solve(self, *, flow_tier: str, api_labels=None):
+        import asyncio
+
+        from fleetflow_tpu.core.serialize import flow_to_dict
+        from fleetflow_tpu.cp import ServerConfig, start
+        from fleetflow_tpu.cp.protocol import ProtocolClient
+        from fleetflow_tpu.runtime import MockBackend
+
+        async def go():
+            handle = await start(
+                ServerConfig(),
+                backend_factory=lambda: MockBackend(auto_pull=True))
+            conns = []
+            for slug in ("n0", "n1"):
+                c, _ = await ProtocolClient.connect(
+                    handle.host, handle.port, identity=slug)
+                await c.request("agent", "register", {
+                    "slug": slug, "version": "1",
+                    "capacity": {"cpu": 8, "memory": 16384, "disk": 99999}})
+                conns.append(c)
+            if api_labels is not None:
+                admin, _ = await ProtocolClient.connect(
+                    handle.host, handle.port, identity="admin")
+                for slug in ("n0", "n1"):
+                    await admin.request("server", "register", {
+                        "slug": slug, "labels": api_labels})
+                await admin.close()
+            flow = parse_kdl_string(self.FLOW.format(tier=flow_tier))
+            cli, _ = await ProtocolClient.connect(
+                handle.host, handle.port, identity="cli")
+            out = await cli.request("placement", "solve", {
+                "flow": flow_to_dict(flow), "stage": "live"})
+            for c in conns + [cli]:
+                await c.close()
+            await handle.stop()
+            return out
+        return asyncio.run(asyncio.wait_for(go(), 30))
+
+    def test_declared_offtier_nodes_are_gated(self):
+        # The discriminating case: both servers DECLARED standard, stage
+        # gated premium.  Without the back-fill the blank live inventory
+        # passes the gate (tier=None matches anything) and the solve lands
+        # off-tier with no relaxation recorded; with it, the gate holds and
+        # the declared fallback must relax tier — visibly.
+        out = self._solve(flow_tier="standard")
+        assert out["feasible"], out
+        assert "relaxed:tier" in out["source"], out["source"]
+
+    def test_backfill_is_per_field_not_all_or_nothing(self):
+        # An operator setting ONE unrelated label via the API must not
+        # suppress the declared tier: region comes from the API, tier still
+        # back-fills from the flow, and the premium gate still relaxes.
+        out = self._solve(flow_tier="standard",
+                          api_labels={"region": "jp"})
+        assert out["feasible"], out
+        assert "relaxed:tier" in out["source"], out["source"]
+
+    def test_api_tier_wins_over_declaration(self):
+        # The flow says standard but the API says premium: stored labels
+        # are operator truth, so the gate passes without relaxation.
+        out = self._solve(flow_tier="standard",
+                          api_labels={"tier": "premium"})
+        assert out["feasible"], out
+        assert "relaxed" not in out["source"], out["source"]
