@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Optional
 
 import numpy as np
@@ -43,6 +43,21 @@ class Reservation:
     demand_by_node: dict[str, np.ndarray]   # node slug -> (R,) reserved demand
     assignment: dict[str, str]
     committed: bool = False
+    # churn reservations hold a displaced stage's NEW nodes between the
+    # burst re-solve and the redeploy that re-commits it, so an admission
+    # landing in that window cannot double-book them; superseded by the
+    # stage's next solve/commit/release (never committed themselves)
+    churn: bool = False
+
+
+def _alloc_vector(s: Server) -> np.ndarray:
+    """(R,) committed+reserved demand recorded on a server record — the ONE
+    definition of 'how much of this node is spoken for' (used by admission
+    inventory and churn capacity refresh alike)."""
+    return np.array([s.allocated.cpu + s.allocated.reserved_cpu,
+                     s.allocated.memory + s.allocated.reserved_memory,
+                     s.allocated.disk + s.allocated.reserved_disk],
+                    dtype=np.float64)
 
 
 def _server_to_resource(s: Server) -> ServerResource:
@@ -74,10 +89,15 @@ class PlacementService:
     # ------------------------------------------------------------------
 
     def _inventory(self, tenant: str,
-                   slugs: Optional[list[str]] = None
+                   slugs: Optional[list[str]] = None,
+                   exclude_demand: Optional[dict[str, np.ndarray]] = None,
                    ) -> tuple[list[ServerResource], np.ndarray]:
         """Live nodes + validity mask, with reserved+committed demand
-        subtracted from capacity."""
+        subtracted from capacity.  `exclude_demand` (slug -> (R,)) is
+        demand attributed to the CALLING stage itself (e.g. its own churn
+        hold) — excluded BEFORE the zero-clamp, so a deficit against a
+        shrunken node cannot turn into phantom free capacity the way a
+        post-clamp add-back would."""
         # a tenant sees its own servers plus the shared "default" pool;
         # "default" solves never touch tenant-dedicated capacity
         servers = self.store.list(
@@ -89,10 +109,9 @@ class PlacementService:
         nodes, valid = [], []
         for s in servers:
             res = _server_to_resource(s)
-            alloc = np.array([s.allocated.cpu + s.allocated.reserved_cpu,
-                              s.allocated.memory + s.allocated.reserved_memory,
-                              s.allocated.disk + s.allocated.reserved_disk])
-            alloc = alloc + reserved.get(s.slug, 0)
+            alloc = _alloc_vector(s) + reserved.get(s.slug, 0)
+            if exclude_demand:
+                alloc = alloc - exclude_demand.get(s.slug, 0)
             cap = np.maximum(np.array(res.capacity.as_tuple()) - alloc, 0.0)
             res.capacity = ResourceSpec(cpu=float(cap[0]), memory=float(cap[1]),
                                         disk=float(cap[2]))
@@ -119,8 +138,20 @@ class PlacementService:
         """Lower the stage against live inventory and solve; optionally open
         a reservation. Returns (placement, reservation_id)."""
         stage = flow.stage(stage_name)
+        key = f"{flow.name}/{stage_name}"
         with self._lock:
-            nodes, valid = self._inventory(tenant, stage.servers or None)
+            # This stage's own churn hold is the placement this solve
+            # supersedes, so it must not count against itself — but the
+            # hold is only RELEASED when a real reservation replaces it
+            # (_reserve): a reserve=False preview or an infeasible solve
+            # must leave the double-book protection standing.
+            own_churn: dict[str, np.ndarray] = {}
+            for r in self._reservations.values():
+                if r.churn and r.stage_key == key:
+                    for slug, d in r.demand_by_node.items():
+                        own_churn[slug] = own_churn.get(slug, 0) + d
+            nodes, valid = self._inventory(tenant, stage.servers or None,
+                                           exclude_demand=own_churn)
             # Config-declared labels back-fill: agents register slug +
             # capacity only, so live store records usually carry NO labels,
             # and a blank label passes every gate (_server_matches treats
@@ -142,7 +173,6 @@ class PlacementService:
                     extra={**d.extra, **got.extra})
             pt = lower_stage(flow, stage_name, nodes=nodes)
             pt.node_valid &= valid
-            key = f"{flow.name}/{stage_name}"
             prev = self._last.get(key)
             if self.use_tpu:
                 warm = (prev is not None
@@ -159,16 +189,31 @@ class PlacementService:
                 rid = self._reserve(key, pt, placement)
         return placement, rid
 
-    def _reserve(self, key: str, pt: ProblemTensors,
-                 placement: Placement) -> str:
-        rid = f"rsv_{next(self._ids)}"
-        demand_by_node: dict[str, np.ndarray] = {}
+    @staticmethod
+    def _demand_by_node(pt: ProblemTensors,
+                        placement: Placement) -> dict[str, np.ndarray]:
+        out: dict[str, np.ndarray] = {}
         for i, node in enumerate(placement.raw):
             slug = pt.node_names[int(node)]
-            demand_by_node[slug] = (demand_by_node.get(slug, 0)
-                                    + pt.demand[i].astype(np.float64))
+            out[slug] = out.get(slug, 0) + pt.demand[i].astype(np.float64)
+        return out
+
+    def _drop_churn(self, key: str) -> None:
+        """A stage's newly-created reservation (_reserve), a fresh
+        commitment, or its teardown supersedes any churn reservation still
+        holding its displaced placement.  Preview solves do NOT drop it —
+        they add it back to their own inventory instead (solve_stage)."""
+        for rid, r in list(self._reservations.items()):
+            if r.churn and r.stage_key == key:
+                del self._reservations[rid]
+
+    def _reserve(self, key: str, pt: ProblemTensors,
+                 placement: Placement) -> str:
+        self._drop_churn(key)
+        rid = f"rsv_{next(self._ids)}"
         self._reservations[rid] = Reservation(
-            id=rid, stage_key=key, demand_by_node=demand_by_node,
+            id=rid, stage_key=key,
+            demand_by_node=self._demand_by_node(pt, placement),
             assignment=dict(placement.assignment))
         return rid
 
@@ -201,6 +246,7 @@ class PlacementService:
             self._apply_allocation(r, +1.0)
             r.committed = True
             self._committed[r.stage_key] = r
+            self._drop_churn(r.stage_key)   # commitment reflects reality now
             return True
 
     def release(self, rid: str, *, undo_commit: bool = False) -> bool:
@@ -215,6 +261,7 @@ class PlacementService:
                     if c.id == rid:
                         self._apply_allocation(c, -1.0)
                         del self._committed[key]
+                        self._drop_churn(key)   # torn down: nothing to hold
                         return True
             return False
 
@@ -222,6 +269,7 @@ class PlacementService:
         """Stage torn down (`fleet down` on a remote stage): return its
         committed capacity."""
         with self._lock:
+            self._drop_churn(stage_key)
             c = self._committed.pop(stage_key, None)
             if c is None:
                 return False
@@ -241,6 +289,62 @@ class PlacementService:
     # ------------------------------------------------------------------
     # streaming re-solve (BASELINE config 5)
     # ------------------------------------------------------------------
+
+    def _stage_demand(self, key: str) -> dict[str, np.ndarray]:
+        """Per-node demand currently attributed to stage `key`: its
+        committed allocation plus any of its own IN-FLIGHT reservations
+        (a churn re-solve racing the stage's deploy window must not
+        double-count the stage against itself)."""
+        out: dict[str, np.ndarray] = {}
+        c = self._committed.get(key)
+        if c is not None:
+            for slug, d in c.demand_by_node.items():
+                out[slug] = out.get(slug, 0) + d
+        for r in self._reservations.values():
+            if r.stage_key == key and not r.committed:
+                for slug, d in r.demand_by_node.items():
+                    out[slug] = out.get(slug, 0) + d
+        return out
+
+    def _refresh_capacity(self, pt: ProblemTensors, key: str,
+                          overrides: Optional[dict[str, tuple]] = None,
+                          server_map: Optional[dict[str, Server]] = None,
+                          ) -> ProblemTensors:
+        """Live per-node capacity for a churn re-solve of stage `key`:
+        raw capacity minus committed allocations and in-flight
+        reservations, plus this stage's OWN demand back (committed AND
+        reserved — its services are the ones being re-placed).
+
+        `overrides` maps stages already re-solved EARLIER IN THE SAME
+        BURST to (their stage-demand snapshot, their new per-node demand):
+        their store records still cite the pre-burst nodes, so without the
+        substitution two stages displaced by one burst would each see the
+        other at its old (dead) node and double-book the survivor.
+        `server_map` (slug -> Server) avoids a per-node linear store scan
+        when the caller already holds one.  Returns pt unchanged (same
+        object, so device stagings keyed on identity stay warm) when
+        nothing moved; otherwise a copy with fresh capacity."""
+        own = self._stage_demand(key)
+        reserved = self._reserved_by_node()
+        other = [snap for okey, snap in (overrides or {}).items()
+                 if okey != key]
+        cap = pt.capacity.copy()
+        for j, slug in enumerate(pt.node_names):
+            s = (server_map.get(slug) if server_map is not None
+                 else self.store.server_by_slug(slug))
+            if s is None:
+                continue
+            alloc = (_alloc_vector(s) + reserved.get(slug, 0)
+                     - own.get(slug, 0))
+            for old_dem, new_dem in other:
+                alloc = (alloc - old_dem.get(slug, 0)
+                         + new_dem.get(slug, 0))
+            raw = np.array([s.capacity.cpu, s.capacity.memory,
+                            s.capacity.disk], dtype=np.float64)
+            cap[j] = np.maximum(raw - alloc, 0.0)
+        if np.array_equal(cap, pt.capacity):
+            return pt
+        return _dc_replace(pt, capacity=cap)
 
     def node_event(self, slug: str, *, online: bool) -> list[tuple[str, Placement]]:
         """Churn: flip the node's validity and warm-start re-solve every
@@ -263,7 +367,13 @@ class PlacementService:
                 self.store.update("servers", s.id,
                                   status="online" if online else "offline")
         moved: list[tuple[str, Placement]] = []
+        # stages re-solved earlier in THIS burst -> (stage-demand snapshot,
+        # new per-node demand), so later re-solves see them at their new
+        # homes instead of their stale store records (double-booking the
+        # survivor node)
+        overrides: dict[str, tuple] = {}
         with self._lock:
+            server_map = {s.slug: s for s in self.store.list("servers")}
             for key, (pt, placement) in list(self._last.items()):
                 needs_resolve = False
                 flipped = False
@@ -287,6 +397,17 @@ class PlacementService:
                         needs_resolve = True
                 if not needs_resolve:
                     continue
+                # Admission-during-churn (SURVEY hard part (c)): pt's
+                # capacity is a snapshot from this stage's admission;
+                # stages committed SINCE then have filled nodes pt still
+                # sees as free, so a warm re-solve against the stale view
+                # can double-book a node (each solve is self-consistent,
+                # so no violation counter would ever say so). Rebuild
+                # per-node capacity from live inventory, excluding this
+                # stage's own commitment + in-flight reservations (its
+                # services are the ones being re-placed) and substituting
+                # burst-mates' already-re-solved positions.
+                pt = self._refresh_capacity(pt, key, overrides, server_map)
                 if self.use_tpu:
                     new = self._sched_tpu.reschedule(pt)
                 else:
@@ -297,5 +418,33 @@ class PlacementService:
                     sched = self._sched_tpu if self.use_tpu else self._sched_host
                     new, _ = place_with_fallback(sched, pt, initial=new)
                 self._last[key] = (pt, new)
+                if new.feasible:
+                    new_dem = self._demand_by_node(pt, new)
+                    # hold the displaced stage's NEW nodes until its
+                    # redeploy re-commits: an admission landing between
+                    # the burst and the redeploy must not double-book
+                    # them.  Reserve only the DELTA above the stage's
+                    # still-standing demand (committed allocation AND any
+                    # in-flight reservation of its own), so no service is
+                    # counted twice.
+                    self._drop_churn(key)
+                    old = self._stage_demand(key)
+                    delta = {}
+                    for slug, d in new_dem.items():
+                        extra = np.maximum(
+                            np.asarray(d, dtype=np.float64)
+                            - old.get(slug, 0), 0.0)
+                        if extra.any():
+                            delta[slug] = extra
+                    if delta:
+                        rid = f"rsv_{next(self._ids)}"
+                        self._reservations[rid] = Reservation(
+                            id=rid, stage_key=key, demand_by_node=delta,
+                            assignment=dict(new.assignment), churn=True)
+                    # snapshot AFTER the churn reservation exists: burst-
+                    # mates' refreshes subtract this exact view and add
+                    # new_dem, cancelling the reservation they also see
+                    # in _reserved_by_node
+                    overrides[key] = (self._stage_demand(key), new_dem)
                 moved.append((key, new))
         return moved

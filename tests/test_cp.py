@@ -984,6 +984,57 @@ class TestAgentChannelSecurity:
             await handle.stop()
         run(go())
 
+    def test_shared_agent_token_allows_takeover(self):
+        """DOCUMENTED weakness (agent_registry.register docstring): one
+        shared write:agent token gives every node the same claims subject,
+        so the slug fence sees any taker as a same-principal reconnect and
+        lets it win.  This pins the failure mode the per-node token story
+        exists to close — if this test ever starts refusing, the docs'
+        threat model needs rewriting."""
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            shared = handle.state.auth.issue("agents@fleet", ["write:agent"])
+            victim, _ = await connect(handle, identity="node-1",
+                                      token=shared)
+            assert (await victim.request("agent", "register",
+                                         {"slug": "node-1"}))["registered"]
+            original = handle.state.agent_registry.connection_of("node-1")
+            mallory, _ = await connect(handle, identity="mallory",
+                                       token=shared)
+            out = await mallory.request("agent", "register",
+                                        {"slug": "node-1"})
+            assert out["registered"]          # takeover SUCCEEDS
+            assert (handle.state.agent_registry.connection_of("node-1")
+                    is not original)          # commands now route to mallory
+            await mallory.close()
+            await victim.close()
+            await handle.stop()
+        run(go())
+
+    def test_per_node_tokens_refuse_takeover(self):
+        """The shipped story (production example + guide): one token per
+        node, subject agent@<slug>, permissions write:agent — a client
+        holding ANOTHER node's token cannot claim a live slug, and the
+        original session keeps the command stream."""
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            tok1 = handle.state.auth.issue("agent@node-1", ["write:agent"])
+            tok2 = handle.state.auth.issue("agent@node-2", ["write:agent"])
+            victim, _ = await connect(handle, identity="node-1", token=tok1)
+            assert (await victim.request("agent", "register",
+                                         {"slug": "node-1"}))["registered"]
+            original = handle.state.agent_registry.connection_of("node-1")
+            mallory, _ = await connect(handle, identity="node-1",
+                                       token=tok2)
+            with pytest.raises(RpcError, match="already registered"):
+                await mallory.request("agent", "register", {"slug": "node-1"})
+            assert (handle.state.agent_registry.connection_of("node-1")
+                    is original)
+            await mallory.close()
+            await victim.close()
+            await handle.stop()
+        run(go())
+
     def test_same_principal_reconnect_wins(self):
         async def go():
             handle = await start_cp()
@@ -1238,3 +1289,318 @@ class TestBuildChannel:
             await conn.close()
             await handle.stop()
         run(go())
+
+
+class TestAdmissionDuringChurn:
+    """SURVEY hard part (c): a stage admitted BETWEEN another stage's
+    placement and a churn burst must stay visible to the burst's warm
+    re-solves.  The re-solve runs against the stage's lowered tensors,
+    which snapshot capacity at admission time — without a live-capacity
+    refresh, services displaced by a node death can be parked on a node
+    another stage has since filled (double-booking that no violation
+    counter reports, because each stage's solve is self-consistent)."""
+
+    CAP = {"cpu": 4.0, "memory": 8192.0, "disk": 99999.0}
+
+    def _svc(self, name, cpu):
+        return (f'service "{name}" {{ image "x"; '
+                f'resources {{ cpu {cpu}; memory 64; disk 1 }} }}')
+
+    def _flow(self, project, services, servers=("n0", "n1", "n2")):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        servers_kdl = "\n".join(
+            f'server "{s}" {{ capacity {{ cpu 4; memory 8192; '
+            f'disk 99999 }} }}' for s in servers)
+        svc_kdl = "\n".join(self._svc(n, c) for n, c in services)
+        names = "\n".join(f'    service "{n}"' for n, _ in services)
+        srv = " ".join(f'"{s}"' for s in servers)
+        return parse_kdl_string(f"""
+project "{project}"
+{servers_kdl}
+{svc_kdl}
+stage "live" {{
+{names}
+    servers {srv}
+    placement {{ strategy "spread_across_pool" }}
+}}
+""")
+
+    def _service(self):
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug in ("n0", "n1", "n2"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(**self.CAP)))
+        return store, PlacementService(store)
+
+    def test_churn_resolve_sees_capacity_committed_after_admission(self):
+        store, svc = self._service()
+        # stage A admitted first: two 1-cpu services spread over two nodes
+        flow_a = self._flow("a", [("a0", 1.0), ("a1", 1.0)])
+        pl_a, rid_a = svc.solve_stage(flow_a, "live")
+        assert pl_a.feasible and svc.commit(rid_a)
+        # stage B admitted AFTER a: one 3.5-cpu service -> the empty node
+        flow_b = self._flow("b", [("b0", 3.5)])
+        pl_b, rid_b = svc.solve_stage(flow_b, "live")
+        assert pl_b.feasible and svc.commit(rid_b)
+        b_node = pl_b.assignment["b0"]
+        a_nodes = set(pl_a.assignment.values())
+        assert b_node not in a_nodes     # spread put b on the empty node
+
+        # burst: the node holding a1 dies mid-flight; a1 must move
+        victim = pl_a.assignment["a1"]
+        moved = dict(svc.node_events([(victim, False)]))
+        assert "a/live" in moved
+        new_a = moved["a/live"]
+        assert new_a.feasible
+        assert new_a.assignment["a1"] != victim    # off the dead node
+        # THE invariant: total committed demand per node <= capacity.
+        # a1 (1 cpu) must NOT land on b's node (0.5 cpu free) even though
+        # stage a's admission-time snapshot saw that node empty.
+        load = {s: 0.0 for s in ("n0", "n1", "n2")}
+        for s, node in new_a.assignment.items():
+            load[node] += {"a0": 1.0, "a1": 1.0}[s]
+        load[b_node] += 3.5
+        over = {n: l for n, l in load.items() if l > self.CAP["cpu"] + 1e-9}
+        assert not over, f"double-booked: {over} (a={new_a.assignment}, b on {b_node})"
+
+    def test_relaxation_preserved_through_churn_with_live_capacity(self):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        store, svc = self._service()
+        # premium-gated stage over label-less declared-standard servers:
+        # admission needs the declared tier fallback
+        flow = parse_kdl_string("""
+project "c"
+server "n0" { capacity { cpu 4; memory 8192; disk 99999 }
+              labels { tier "standard" } }
+server "n1" { capacity { cpu 4; memory 8192; disk 99999 }
+              labels { tier "standard" } }
+server "n2" { capacity { cpu 4; memory 8192; disk 99999 }
+              labels { tier "standard" } }
+service "c0" { image "x"; resources { cpu 1; memory 64; disk 1 } }
+service "c1" { image "x"; resources { cpu 1; memory 64; disk 1 } }
+stage "live" {
+    service "c0"
+    service "c1"
+    servers "n0" "n1" "n2"
+    placement { tier "premium"; fallback "tier" }
+}
+""")
+        pl, rid = svc.solve_stage(flow, "live")
+        assert pl.feasible and "relaxed:tier" in pl.source
+        assert svc.commit(rid)
+        victim = pl.assignment["c1"]
+        moved = dict(svc.node_events([(victim, False)]))
+        new = moved["c/live"]
+        assert new.feasible
+        assert new.assignment["c1"] != victim
+        assert "relaxed:tier" in new.source   # relaxation survived churn
+
+    def test_admission_racing_burst_lands_on_final_world(self):
+        """A new stage whose solve arrives WHILE a churn burst is mid-
+        re-solve must serialize behind it and be placed against the
+        final world: the dead node invalid, the burst's re-placements
+        reserved.  (The bench's phantom-row admission is a bench-local
+        construct; this is the product path.)"""
+        import threading
+        import time as _time
+
+        store, svc = self._service()
+        flow_a = self._flow("a", [("a0", 1.0), ("a1", 1.0)])
+        pl_a, rid_a = svc.solve_stage(flow_a, "live")
+        assert pl_a.feasible and svc.commit(rid_a)
+        victim = pl_a.assignment["a1"]
+
+        # widen the burst window: first re-solve inside node_events stalls
+        real_place = svc._sched_host.place
+        entered = threading.Event()
+
+        def slow_place(pt, **kw):
+            entered.set()
+            _time.sleep(0.3)
+            return real_place(pt, **kw)
+
+        svc._sched_host.place = slow_place
+        burst = threading.Thread(
+            target=lambda: svc.node_events([(victim, False)]))
+        burst.start()
+        assert entered.wait(5)
+        # admission lands mid-burst: must queue behind the lock and see
+        # the post-burst world
+        flow_d = self._flow("d", [("d0", 1.0)])
+        pl_d, rid_d = svc.solve_stage(flow_d, "live")
+        burst.join(5)
+        svc._sched_host.place = real_place
+        assert pl_d.feasible
+        assert pl_d.assignment["d0"] != victim    # dead node excluded
+        assert svc.commit(rid_d)
+        # journal holds: per-node committed demand never exceeds capacity.
+        # (Stage a's commitment still cites the dead node here — the
+        # redeploy that follows a churn re-solve is what re-commits; this
+        # layer only guarantees the re-solve and the admission are
+        # capacity-consistent.)
+        committed = {}
+        for r in svc._committed.values():
+            for slug, dem in r.demand_by_node.items():
+                committed[slug] = committed.get(slug, 0.0) + float(dem[0])
+        for slug, cpu in committed.items():
+            assert cpu <= self.CAP["cpu"] + 1e-9, committed
+
+    def test_own_inflight_reservation_not_double_counted(self):
+        """A churn re-solve racing the stage's own deploy window (reserved,
+        not yet committed) must add the stage's own reservation back — or
+        the stage is counted against itself and a survivor that truly fits
+        reports spuriously infeasible."""
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug in ("n0", "n1"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(cpu=8.0, memory=8192.0,
+                                        disk=99999.0)))
+        svc = PlacementService(store)
+        flow = self._flow("a", [("a0", 3.0), ("a1", 3.0)],
+                          servers=("n0", "n1"))
+        pl, rid = svc.solve_stage(flow, "live")
+        assert pl.feasible and rid is not None    # reserved, NOT committed
+        victim = pl.assignment["a0"]
+        survivor = "n1" if victim == "n0" else "n0"
+        moved = dict(svc.node_events([(victim, False)]))
+        new = moved["a/live"]
+        # 6 cpu onto the 8-cpu survivor: fits, and must say so
+        assert new.feasible, new.source
+        assert set(new.assignment.values()) == {survivor}
+
+    def test_burst_displaced_stages_see_each_other(self):
+        """Two stages displaced by ONE burst must not each see the other at
+        its old (dead) node and silently double-book the survivor; the
+        second re-solve sees the first's new home and reports the truth
+        (here: infeasible, since the survivor fits only one)."""
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug, cpu in (("n0", 4.0), ("n1", 5.0), ("n2", 4.0)):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(cpu=cpu, memory=8192.0,
+                                        disk=99999.0)))
+        svc = PlacementService(store)
+        pl_a, rid_a = svc.solve_stage(
+            self._flow("a", [("a0", 3.0)]), "live")
+        assert pl_a.feasible and svc.commit(rid_a)
+        pl_b, rid_b = svc.solve_stage(
+            self._flow("b", [("b0", 3.0)]), "live")
+        assert pl_b.feasible and svc.commit(rid_b)
+        na, nb = pl_a.assignment["a0"], pl_b.assignment["b0"]
+        assert na != nb
+        survivor = ({"n0", "n1", "n2"} - {na, nb}).pop()
+        moved = dict(svc.node_events([(na, False), (nb, False)]))
+        placed = [p.assignment[s] for key, p, s in
+                  (("a/live", moved["a/live"], "a0"),
+                   ("b/live", moved["b/live"], "b0"))
+                  if moved[key].feasible]
+        # at most ONE 3-cpu service may claim the 4-cpu survivor
+        assert placed.count(survivor) <= 1, moved
+        feasibles = [k for k in ("a/live", "b/live") if moved[k].feasible]
+        assert len(feasibles) == 1, {k: (moved[k].feasible,
+                                         moved[k].assignment)
+                                     for k in moved}
+
+    def test_admission_after_burst_respects_churn_reservation(self):
+        """Between a burst re-solve and the redeploy that re-commits it,
+        the displaced stage's NEW nodes are held by a churn reservation:
+        an admission in that window must not double-book them, and the
+        stage's own redeploy supersedes the reservation cleanly."""
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug in ("n0", "n1"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(cpu=4.0, memory=8192.0,
+                                        disk=99999.0)))
+        svc = PlacementService(store)
+        flow_a = self._flow("a", [("a0", 3.0)], servers=("n0", "n1"))
+        pl_a, rid_a = svc.solve_stage(flow_a, "live")
+        assert pl_a.feasible and svc.commit(rid_a)
+        victim = pl_a.assignment["a0"]
+        survivor = "n1" if victim == "n0" else "n0"
+        moved = dict(svc.node_events([(victim, False)]))
+        assert moved["a/live"].feasible
+        assert moved["a/live"].assignment["a0"] == survivor
+
+        # admission in the window: 3 cpu nowhere to go (survivor holds
+        # a0's churn reservation, victim is down) -> honest infeasible,
+        # NOT a silent double-book of the survivor
+        flow_d = self._flow("d", [("d0", 3.0)], servers=("n0", "n1"))
+        pl_d, _ = svc.solve_stage(flow_d, "live")
+        assert not pl_d.feasible, pl_d.assignment
+
+        # a's redeploy: re-solve + commit supersedes the churn reservation
+        pl_a2, rid_a2 = svc.solve_stage(flow_a, "live")
+        assert pl_a2.feasible and pl_a2.assignment["a0"] == survivor
+        assert svc.commit(rid_a2)
+        assert not any(r.churn for r in svc._reservations.values())
+        # small admission still fits beside a0 (no over-reservation left)
+        flow_e = self._flow("e", [("e0", 1.0)], servers=("n0", "n1"))
+        pl_e, _ = svc.solve_stage(flow_e, "live")
+        assert pl_e.feasible and pl_e.assignment["e0"] == survivor
+
+    def test_preview_solve_keeps_churn_hold(self):
+        """A reserve=False preview of the displaced stage must not void
+        the churn hold: the double-book window only closes when a REAL
+        reservation (the redeploy's) replaces it."""
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug in ("n0", "n1"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(cpu=4.0, memory=8192.0,
+                                        disk=99999.0)))
+        svc = PlacementService(store)
+        flow_a = self._flow("a", [("a0", 3.0)], servers=("n0", "n1"))
+        pl_a, rid_a = svc.solve_stage(flow_a, "live")
+        assert pl_a.feasible and svc.commit(rid_a)
+        victim = pl_a.assignment["a0"]
+        moved = dict(svc.node_events([(victim, False)]))
+        assert moved["a/live"].feasible
+        # preview: must see its own hold as available (same answer) ...
+        prev, rid = svc.solve_stage(flow_a, "live", reserve=False)
+        assert prev.feasible and rid is None
+        # ... and must NOT have released it for anyone else
+        flow_d = self._flow("d", [("d0", 3.0)], servers=("n0", "n1"))
+        pl_d, _ = svc.solve_stage(flow_d, "live")
+        assert not pl_d.feasible, pl_d.assignment
+
+    def test_churn_delta_subtracts_own_inflight_reservation(self):
+        """A stage displaced while its deploy is still in flight (reserved,
+        not committed) must not be double-counted: churn hold = new demand
+        minus committed AND in-flight own demand, so an admission that
+        truly fits is admitted."""
+        from fleetflow_tpu.cp.models import Server, ServerCapacity
+        from fleetflow_tpu.cp.placement import PlacementService
+        store = Store()
+        for slug in ("n0", "n1"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(cpu=8.0, memory=8192.0,
+                                        disk=99999.0)))
+        svc = PlacementService(store)
+        flow_a = self._flow("a", [("a0", 3.0), ("a1", 3.0)],
+                            servers=("n0", "n1"))
+        pl_a, rid_a = svc.solve_stage(flow_a, "live")
+        assert pl_a.feasible and rid_a is not None   # in flight, NOT committed
+        victim = pl_a.assignment["a0"]
+        survivor = "n1" if victim == "n0" else "n0"
+        moved = dict(svc.node_events([(victim, False)]))
+        assert moved["a/live"].feasible
+        assert set(moved["a/live"].assignment.values()) == {survivor}
+        # survivor truly has 8 - 6 = 2 free; a 2-cpu admission fits
+        flow_e = self._flow("e", [("e0", 2.0)], servers=("n0", "n1"))
+        pl_e, _ = svc.solve_stage(flow_e, "live")
+        assert pl_e.feasible, "stage a double-counted against itself"
+        assert pl_e.assignment["e0"] == survivor
