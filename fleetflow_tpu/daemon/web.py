@@ -88,6 +88,7 @@ class WebServer:
         "alerts": "health", "health-check": "health", "users": "tenant",
         "containers": "container", "logs": "container",
         "pools": "server",   # worker pools live on the server channel
+        "costs": "cost",
         # channel-less areas must still land in the grant vocabulary
         # (ADVICE r3): the overview is the dashboard's status landing view,
         # so the health grant covers it — read:overview exists in no
@@ -614,6 +615,32 @@ class WebServer:
             db.update("build_jobs", jid, status="cancelled")
             return {"job": db.get("build_jobs", jid).to_dict()}
 
+        # -- costs (REST face of the cost channel; web.rs cost surface +
+        #    tenant_overview's month total) -------------------------------
+        @self.route("GET", "/api/costs")
+        def costs(body, query):
+            tenant = query.get("tenant")
+            month = query.get("month")
+            rows = db.list(
+                "cost_entries",
+                lambda e: (tenant is None or e.tenant == tenant)
+                and (month is None or e.month == month))
+            return {"entries": [e.to_dict() for e in rows]}
+
+        @self.route("GET", "/api/costs/summary")
+        def costs_summary(body, query):
+            # per-tenant totals for one month (db.rs:896-947 analog);
+            # tenants come from the entries so the view needs no extra call
+            month = query.get("month", "")
+            rows = db.list("cost_entries",
+                           lambda e: not month or e.month == month)
+            totals: dict[str, float] = {}
+            for e in rows:
+                totals[e.tenant] = totals.get(e.tenant, 0.0) + e.amount
+            return {"month": month,
+                    "totals": [{"tenant": t, "total": round(v, 2)}
+                               for t, v in sorted(totals.items())]}
+
         # -- placement ---------------------------------------------------
         @self.route("GET", "/api/placement")
         def placement_last(body, query):
@@ -667,7 +694,7 @@ _DASHBOARD_HTML = """<!doctype html>
 // -- tiny SPA over the CP REST surface (web.rs:47-116 SPA analog) ---------
 const VIEWS=['overview','servers','stages','deployments','alerts',
              'placement','agents','pools','containers','logs','tenants',
-             'dns','volumes','builds'];
+             'costs','dns','volumes','builds'];
 function esc(v){return String(v??'').replace(/[&<>"']/g,
  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function token(){return localStorage.getItem('fleet_token')||''}
@@ -863,6 +890,28 @@ const views={
     u.users.map(y=>`${esc(y.email)} <span class="muted">(${esc(y.role)})</span>`)
      .join(', ')||'<span class="muted">no users</span>']}));
   main().innerHTML=card(table(['tenant','display name','users'],rows))},
+ async costs(arg){
+  // month filter via #costs/2026-07; one unfiltered fetch, client-side
+  // filtering, so the month picker always lists EVERY recorded month
+  const month=arg||'';
+  const list=await api('/api/costs');
+  const entries=month?list.entries.filter(e=>e.month===month):list.entries;
+  const totals={};
+  for(const e of entries)totals[e.tenant]=(totals[e.tenant]||0)+e.amount;
+  const cards=Object.keys(totals).sort().map(t=>
+   `<div class="card stat"><b>${esc(totals[t].toFixed(2))}</b>`
+   +`<span>${esc(t)}${month?' — '+esc(month):''}</span></div>`)
+   .join('')||'<div class="card">no cost entries'
+   +(month?' for '+esc(month):'')+'</div>';
+  const months=[...new Set(list.entries.map(e=>e.month))].sort().reverse();
+  const picker=months.map(m=>
+   `<a href="#costs/${enc(m)}">${esc(m)}</a>`).join(' · ');
+  main().innerHTML=`<div class="cards">${cards}</div>`
+   +(picker?card('months: '+picker+(month?' · <a href="#costs">all</a>':'')):'')
+   +card(table(['tenant','server','provider','month','amount','currency'],
+    entries.map(x=>[esc(x.tenant),`<code>${esc(x.server||'-')}</code>`,
+     esc(x.provider||'-'),esc(x.month),esc(x.amount.toFixed(2)),
+     esc(x.currency)])))},
  async dns(){
   const d=await api('/api/dns');
   main().innerHTML=card(table(['zone','name','type','content','ttl','proxied'],

@@ -1,0 +1,93 @@
+#!/bin/sh
+# fleetflow-tpu installer (reference analog: /root/.. install.sh, which
+# downloads prebuilt binaries; this framework is a Python package + an
+# optional C++ fast-path library, so installing means wiring launchers and
+# building the native lib in place).
+#
+# Usage: ./install.sh [--prefix DIR] [--no-deps] [--python BIN]
+#   --prefix DIR   install `fleet` / `fleetflowd` launchers into DIR/bin
+#                  (default: ~/.local)
+#   --no-deps      skip `pip install` (deps already present / air-gapped)
+#   --python BIN   interpreter to wire into the launchers (default: python3)
+set -eu
+
+PREFIX="${HOME}/.local"
+NO_DEPS=0
+PY="${PYTHON:-python3}"
+
+usage() {
+    # the header comment block, however long it grows
+    awk 'NR > 1 && /^#/ { sub(/^# ?/, ""); print; next }
+         NR > 1 { exit }' "$0"
+}
+
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --prefix)  PREFIX="$2"; shift 2 ;;
+        --no-deps) NO_DEPS=1; shift ;;
+        --python)  PY="$2"; shift 2 ;;
+        -h|--help) usage; exit 0 ;;
+        *) echo "install.sh: unknown flag $1 (see --help)" >&2; exit 2 ;;
+    esac
+done
+
+REPO_DIR="$(CDPATH='' cd -- "$(dirname -- "$0")" && pwd)"
+
+command -v "$PY" >/dev/null 2>&1 || {
+    echo "install.sh: $PY not found (install Python 3.10+ or pass --python)" >&2
+    exit 1
+}
+"$PY" -c 'import sys; raise SystemExit(0 if sys.version_info >= (3, 10) else 1)' || {
+    echo "install.sh: Python >= 3.10 required (got $("$PY" -V 2>&1))" >&2
+    exit 1
+}
+
+if [ "$NO_DEPS" = 0 ]; then
+    echo "==> installing Python dependencies (pip)"
+    if ! "$PY" -m pip install --quiet -r "$REPO_DIR/requirements.txt" \
+            2>/dev/null; then
+        # PEP 668 externally-managed interpreter (Debian 12+, Ubuntu 24.04,
+        # Homebrew): install into a private venv and wire the launchers to
+        # its interpreter instead
+        echo "==> pip refused (externally-managed?); using a venv"
+        VENV="$PREFIX/share/fleetflow/venv"
+        "$PY" -m venv "$VENV"
+        "$VENV/bin/python" -m pip install --quiet \
+            -r "$REPO_DIR/requirements.txt"
+        PY="$VENV/bin/python"
+    fi
+fi
+
+# Native fast paths (FFD placer seed, KDL parser). Optional: every native
+# component has a pure-Python fallback, so a missing toolchain only costs
+# speed.
+if command -v g++ >/dev/null 2>&1; then
+    echo "==> building native components"
+    if ! make -C "$REPO_DIR/native" >/dev/null 2>&1; then
+        echo "    (native build failed; Python fallbacks will be used)"
+    fi
+else
+    echo "==> g++ not found; skipping native components (Python fallbacks)"
+fi
+
+mkdir -p "$PREFIX/bin"
+write_launcher() {
+    # $1 = name, $2 = module
+    cat > "$PREFIX/bin/$1" <<EOF
+#!/bin/sh
+PYTHONPATH="$REPO_DIR\${PYTHONPATH:+:\$PYTHONPATH}" exec "$PY" -m $2 "\$@"
+EOF
+    chmod +x "$PREFIX/bin/$1"
+}
+write_launcher fleet fleetflow_tpu.cli
+write_launcher fleetflowd fleetflow_tpu.daemon
+
+echo "==> installed:"
+echo "    $PREFIX/bin/fleet       (CLI: up/deploy/ps/cp ...)"
+echo "    $PREFIX/bin/fleetflowd  (control-plane daemon: run/start/stop/status)"
+case ":${PATH}:" in
+    *":$PREFIX/bin:"*) ;;
+    *) echo "    NOTE: $PREFIX/bin is not on PATH" ;;
+esac
+echo "==> quick start: fleet init && fleet up local"
+echo "    daemon:      fleetflowd run -c infra/fleetflowd-sample.kdl"
