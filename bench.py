@@ -76,6 +76,43 @@ def _watch_compiles():
         jax.config.update("jax_log_compiles", old_cfg)
 
 
+def _resched_reps() -> int:
+    try:
+        return max(1, int(os.environ.get("BENCH_RESCHED_REPS") or "3"))
+    except ValueError:
+        return 3
+
+
+def _timed_runs(solve_once, reps: int):
+    """Run `solve_once(i)` `reps` times; each run is wall-timed AND
+    compile-watched, with the solver's phase breakdown recorded — the one
+    shape every warm-re-solve leg reports (VERDICT r4 weak #1: a single
+    noisy or recompiling run must never become an unexplainable record).
+
+    Returns (runs, results, order): per-run dicts, the SolveResults, and
+    run indices sorted by wall time — order[(reps-1)//2] is the
+    lower-middle median."""
+    runs, results = [], []
+    for i in range(reps):
+        with _watch_compiles() as compiles:
+            t = time.perf_counter()
+            r = solve_once(i)
+            ms = (time.perf_counter() - t) * 1e3
+        results.append(r)
+        runs.append({"ms": round(ms, 1),
+                     "timings_ms": {k: round(v, 1)
+                                    for k, v in r.timings_ms.items()},
+                     "sweeps": int(r.steps),
+                     "violations": r.violations,
+                     "soft": round(r.soft, 4),
+                     "pre_repair_violations": r.pre_repair_violations,
+                     "moves_repaired": r.moves_repaired,
+                     "compiles": len(compiles),
+                     "compile_events": compiles[:3]})
+    order = sorted(range(reps), key=lambda i: runs[i]["ms"])
+    return runs, results, order
+
+
 def main() -> None:
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (1000, 100) if small else (10000, 1000)
@@ -151,39 +188,20 @@ def main() -> None:
           init_assignment=res.assignment, anneal_block=block,
           warm_block=warm_block, proposals_per_step=proposals)
     # VERDICT r4 weak #1: a single-shot, unphased timing recorded 701.5 ms
-    # where three dev runs said ~133 and could not explain itself. The timed
-    # reschedule now runs BENCH_RESCHED_REPS times (default 3), reports
-    # median + min + every run's phase breakdown, and counts XLA compiles
-    # inside each timed region — an outlier stays visible but cannot become
-    # the headline, and a recompile can no longer hide.
-    try:
-        reps = max(1, int(os.environ.get("BENCH_RESCHED_REPS") or "3"))
-    except ValueError:
-        reps = 3
-    runs, results = [], []
-    for i in range(reps):
-        with _watch_compiles() as compiles:
-            t1 = time.perf_counter()
-            r = solve(pt2, prob=prob2, chains=resched_chains, steps=steps,
-                      seed=3 + i, init_assignment=res.assignment,
-                      anneal_block=block, warm_block=warm_block,
-                      proposals_per_step=proposals)
-            ms = (time.perf_counter() - t1) * 1e3
-        results.append(r)
-        runs.append({"ms": round(ms, 1),
-                     "timings_ms": {k: round(v, 1)
-                                    for k, v in r.timings_ms.items()},
-                     "sweeps": int(r.steps),
-                     "violations": r.violations,
-                     "soft": round(r.soft, 4),
-                     "pre_repair_violations": r.pre_repair_violations,
-                     "moves_repaired": r.moves_repaired,
-                     "compiles": len(compiles),
-                     "compile_events": compiles[:3]})
+    # where three dev runs said ~133 and could not explain itself. Every
+    # timed warm-re-solve leg now runs BENCH_RESCHED_REPS times (default
+    # 3) through _timed_runs: median + min + per-run phase breakdowns +
+    # XLA-compile counts — an outlier stays visible but cannot become the
+    # headline, and a recompile can no longer hide.
+    reps = _resched_reps()
+    runs, results, order_idx = _timed_runs(
+        lambda i: solve(pt2, prob=prob2, chains=resched_chains, steps=steps,
+                        seed=3 + i, init_assignment=res.assignment,
+                        anneal_block=block, warm_block=warm_block,
+                        proposals_per_step=proposals), reps)
     # lower-middle median: with an even rep count the faster middle run is
     # the headline (an outlier must never be), and EVERY top-level
     # reschedule_* field below describes this same run
-    order_idx = sorted(range(reps), key=lambda i: runs[i]["ms"])
     mid = order_idx[(reps - 1) // 2]
     median_run, res2 = runs[mid], results[mid]
     reschedule_ms = median_run["ms"]
@@ -352,20 +370,32 @@ def _burst_scenario(S: int, N: int, *, chains: int, steps: int, block: int,
     solve(ptB, prob=probB, chains=chains, steps=steps, seed=22,  # warm compile
           init_assignment=init, anneal_block=block, warm_block=warm_block,
           proposals_per_step=proposals)
-    t1 = time.perf_counter()
-    resB = solve(ptB, prob=probB, chains=chains, steps=steps, seed=23,
-                 init_assignment=init, anneal_block=block,
-                 warm_block=warm_block, proposals_per_step=proposals)
-    burst_ms = (time.perf_counter() - t1) * 1e3 + seed_ms
+    # same timed-median machinery as the single-kill reschedule: per-run
+    # phase timings + compile counts, lower-middle median as the headline.
+    # Each run's "ms" INCLUDES the (constant, separately-reported)
+    # admission seed, so the runs list sums to the headline at sight.
+    reps = _resched_reps()
+    runs, results, order = _timed_runs(
+        lambda i: solve(ptB, prob=probB, chains=chains, steps=steps,
+                        seed=23 + i, init_assignment=init,
+                        anneal_block=block, warm_block=warm_block,
+                        proposals_per_step=proposals), reps)
+    for r in runs:
+        r["ms"] = round(r["ms"] + seed_ms, 1)
+    mid = order[(reps - 1) // 2]
+    median_run, resB = runs[mid], results[mid]
     affected = int(np.isin(resA.assignment[:S], dead).sum()) + S_new
     moved = int((resB.assignment[:S] != resA.assignment[:S]).sum())
     return {
         "events": {"killed": 3, "revived": 1, "arrived_services": S_new},
-        "reschedule_ms": round(burst_ms, 1),
-        "violations": resB.violations,
-        "pre_repair_violations": resB.pre_repair_violations,
-        "soft": round(resB.soft, 4),
-        "sweeps": int(resB.steps),
+        "reschedule_ms": median_run["ms"],
+        "reschedule_ms_min": runs[order[0]]["ms"],
+        "reschedule_compiles": median_run["compiles"],
+        "reschedule_runs": runs,
+        "violations": median_run["violations"],
+        "pre_repair_violations": median_run["pre_repair_violations"],
+        "soft": median_run["soft"],
+        "sweeps": median_run["sweeps"],
         "affected": affected,
         "moved": moved,
         "admission_seed_ms": round(seed_ms, 1),
