@@ -34,6 +34,11 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
     demand = np.asarray(pt.demand, dtype=np.float64)
     capacity = np.asarray(pt.capacity, dtype=np.float64)
     load = np.zeros_like(capacity)
+    # reciprocal once; the scoring below multiplies instead of divides.
+    # native/placer.cpp mirrors this EXACT float recipe (multiply + plain
+    # sum, no mean) so the two backends keep bit-identical argmins — edit
+    # both together or the parity tests fail on near-ties.
+    inv_cap = 1.0 / np.maximum(capacity, 1e-9)
     # conflict registries: (node, kind, group_id) occupancy
     occupied: set[tuple[int, str, int]] = set()
 
@@ -73,7 +78,10 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
                 continue
             fits.append(int(n))
         if fits:
-            util = (load[fits] / np.maximum(capacity[fits], 1e-9)).mean(axis=1)
+            # sum, not mean: a constant 1/R factor cannot change the
+            # argmin/argmax, and skipping it keeps the float recipe
+            # identical to the native placer's loop
+            util = (load[fits] * inv_cap[fits]).sum(axis=1)
             if pt.strategy == PlacementStrategy.PACK_INTO_DEDICATED:
                 n = fits[int(np.argmax(util))]
             elif pt.strategy == PlacementStrategy.FILL_LOWEST:
@@ -85,7 +93,7 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
         else:
             # least-bad: minimize overflow on an eligible node
             over = (np.maximum(load[cands] + demand[s] - capacity[cands], 0)
-                    / np.maximum(capacity[cands], 1e-9)).sum(axis=1)
+                    * inv_cap[cands]).sum(axis=1)
             n = int(cands[int(np.argmin(over))])
             violations += 1
         assignment[s] = n

@@ -84,3 +84,26 @@ def test_graceful_fallback(monkeypatch):
     placement = ns.NativeGreedyScheduler().place(pt)
     assert placement.source == "host-greedy"
     assert placement.feasible
+
+
+@needs_native
+class TestIneligibleFallbackParity:
+    def test_fallback_placement_counts_violation_in_both_backends(self):
+        """A service with NO eligible node that still fits on a valid one
+        is placed by the fallback chain but must be REPORTED as a
+        violation by both backends (host.py `inelig`; the native placer
+        mirrored the no-fit branch only until round 5) — upstream
+        fallback-policy relaxation keys off this count."""
+        import dataclasses
+        pt = synthetic_problem(24, 6, seed=3)
+        elig = pt.eligible.copy()
+        elig[5, :] = False                        # nobody wants service 5
+        pt = dataclasses.replace(pt, eligible=elig)
+        py_assign, py_viol = greedy_host_place(pt)
+        c_assign, c_viol = native_place(
+            pt.demand, pt.capacity, pt.eligible, pt.node_valid,
+            pt.dep_depth, pt.port_ids, pt.volume_ids, pt.anti_ids,
+            strategy=pt.strategy.value)
+        assert py_viol >= 1                       # the fallback was taken
+        assert c_viol == py_viol
+        assert np.array_equal(c_assign, py_assign)
