@@ -24,17 +24,41 @@ _tried = False
 
 def _build() -> Optional[Path]:
     target = _REPO_NATIVE / _LIB_NAME
-    if target.is_file():
+    # staleness check: a .so older than any source would silently run old
+    # native code after an edit (make would rebuild, but only if invoked —
+    # the library is gitignored and this loader is the path that decides)
+    try:
+        # sources only — make's rule depends on *.cpp, not the Makefile,
+        # so including it here would mark the lib stale forever without
+        # ever triggering a rebuild
+        srcs = list(_REPO_NATIVE.glob("*.cpp"))
+        newest_src = max(p.stat().st_mtime for p in srcs if p.is_file())
+        fresh = target.is_file() and target.stat().st_mtime >= newest_src
+    except (OSError, ValueError):
+        fresh = target.is_file()
+    if fresh:
         return target
     if (shutil.which(os.environ.get("CXX", "g++")) is None
             or shutil.which("make") is None):
-        return None
+        # a stale library beats none at all (ABI is append-only)
+        return target if target.is_file() else None
     try:
+        # make's own mtime rule does the rebuild; a failed rebuild falls
+        # back to whatever library exists (stale beats none) — but NOT
+        # silently: a swallowed compile error would let parity tests
+        # green-light code that never compiled
         proc = subprocess.run(["make", "-C", str(_REPO_NATIVE)],
                               capture_output=True, text=True)
+        if proc.returncode != 0:
+            import warnings
+            warnings.warn(
+                f"native build failed (rc={proc.returncode}); using "
+                f"{'the existing' if target.is_file() else 'NO'} library. "
+                f"stderr tail: {(proc.stderr or '')[-400:]}",
+                RuntimeWarning, stacklevel=2)
     except OSError:
-        return None
-    return target if proc.returncode == 0 and target.is_file() else None
+        pass
+    return target if target.is_file() else None
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -43,35 +67,43 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        # any failure here (no toolchain, corrupt .so from a racing build)
-        # must degrade to the Python placer, never crash the caller
+        # any failure here (no toolchain, corrupt .so from a racing
+        # build, a STALE .so predating a newly-appended symbol — the
+        # registration below raises AttributeError then) must degrade to
+        # the Python fallbacks, never crash the caller
         try:
             path = _build()
             if path is None:
                 return None
             lib = ctypes.CDLL(str(path))
-        except OSError:
+            _register(lib)
+        except (OSError, AttributeError):
             return None
-        lib.ff_place.restype = ctypes.c_int64
-        lib.ff_place.argtypes = [
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.ff_dep_depths.restype = ctypes.c_int64
-        lib.ff_dep_depths.argtypes = [
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-        ]
         _lib = lib
         return _lib
+
+
+def _register(lib: ctypes.CDLL) -> None:
+    """Symbol signatures; raises AttributeError on a .so too old to have
+    one of them (load() degrades to the Python fallbacks then)."""
+    lib.ff_place.restype = ctypes.c_int64
+    lib.ff_place.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ff_dep_depths.restype = ctypes.c_int64
+    lib.ff_dep_depths.argtypes = [
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
 
 
 def available() -> bool:

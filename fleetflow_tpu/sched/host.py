@@ -35,9 +35,11 @@ def greedy_host_place(pt: ProblemTensors) -> tuple[np.ndarray, int]:
     capacity = np.asarray(pt.capacity, dtype=np.float64)
     load = np.zeros_like(capacity)
     # reciprocal once; the scoring below multiplies instead of divides.
-    # native/placer.cpp mirrors this EXACT float recipe (multiply + plain
-    # sum, no mean) so the two backends keep bit-identical argmins — edit
-    # both together or the parity tests fail on near-ties.
+    # native/placer.cpp mirrors this float recipe (multiply + plain sum,
+    # no mean) so the two backends keep identical argmins at R=3 (numpy's
+    # axis-sum is sequential at this width; pairwise summation above ~8
+    # resources would round differently from the C loop) — edit both
+    # together or the parity tests fail on near-ties.
     inv_cap = 1.0 / np.maximum(capacity, 1e-9)
     # conflict registries: (node, kind, group_id) occupancy
     occupied: set[tuple[int, str, int]] = set()

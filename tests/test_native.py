@@ -107,3 +107,33 @@ class TestIneligibleFallbackParity:
         assert py_viol >= 1                       # the fallback was taken
         assert c_viol == py_viol
         assert np.array_equal(c_assign, py_assign)
+
+
+@needs_native
+class TestStaleLibraryRebuild:
+    def test_loader_rebuilds_when_source_is_newer(self):
+        """A .so older than any native source would silently run old code
+        (the library is gitignored; this loader is what decides to build).
+        Touching a source must make the next load() rebuild."""
+        import os
+        import pathlib
+
+        import fleetflow_tpu.native.lib as lib
+        so = pathlib.Path(lib._REPO_NATIVE) / lib._LIB_NAME
+        src = pathlib.Path(lib._REPO_NATIVE) / "placer.cpp"
+        import shutil
+        if not (so.is_file() and src.is_file()):
+            pytest.skip("native sources not present")
+        if shutil.which("make") is None or shutil.which(
+                os.environ.get("CXX", "g++")) is None:
+            # without a toolchain the loader INTENTIONALLY serves the
+            # stale library (stale beats none) — nothing to assert here
+            pytest.skip("no native toolchain")
+        os.utime(src)                      # source now newer than the .so
+        before = so.stat().st_mtime
+        lib._lib, lib._tried = None, False  # reset the loader cache
+        try:
+            assert lib.load() is not None
+            assert so.stat().st_mtime > before, "stale .so was not rebuilt"
+        finally:
+            lib._lib, lib._tried = None, False
