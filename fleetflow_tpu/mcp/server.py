@@ -405,6 +405,25 @@ class FleetMcpServer:
                                        {"request": req.to_dict()},
                                        timeout=600))
 
+    @_tool("cp_cost_summary", "Monthly cost total for a tenant "
+           "(YYYY-MM month)",
+           {"type": "object", "properties": {
+               "month": {"type": "string"},
+               "tenant": {"type": "string"}},
+            "required": ["month"]})
+    def cp_cost_summary(self, month: str, tenant: str = "default") -> dict:
+        return _text(self.cp().request("cost", "summary",
+                                       {"month": month, "tenant": tenant}))
+
+    @_tool("cp_cost_list", "List recorded cost entries, optionally "
+           "filtered by tenant and/or YYYY-MM month",
+           {"type": "object", "properties": {
+               "tenant": {"type": "string"},
+               "month": {"type": "string"}}})
+    def cp_cost_list(self, tenant: str = None, month: str = None) -> dict:
+        return _text(self.cp().request("cost", "list",
+                                       {"tenant": tenant, "month": month}))
+
     @_tool("cp_node_events", "Report a churn burst (nodes going offline/"
            "online) as ONE coalesced warm re-solve — maintenance windows "
            "should use this instead of N single node_event calls",

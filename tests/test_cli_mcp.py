@@ -360,3 +360,35 @@ class TestAgentRuntimeFlag:
         assert rc == 3
         assert captured["binary"] == "podman"
         assert "podman unreachable" in capsys.readouterr().err
+
+
+class TestMcpCostTools:
+    def test_cost_summary_and_list(self, project):
+        calls = []
+
+        class FakeCp:
+            def request(self, channel, method, payload=None, timeout=60.0):
+                calls.append((channel, method, payload))
+                if method == "summary":
+                    return {"month": "2026-07", "tenant": "acme",
+                            "total": 42.5}
+                return {"entries": [{"tenant": "acme", "amount": 42.5}]}
+
+        root, _ = project
+        server = FleetMcpServer(project_root=str(root), cp_client=FakeCp())
+        resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                              "method": "tools/call",
+                              "params": {"name": "cp_cost_summary",
+                                         "arguments": {"month": "2026-07",
+                                                       "tenant": "acme"}}})
+        doc = json.loads(resp["result"]["content"][0]["text"])
+        assert doc["total"] == 42.5
+        assert calls[0] == ("cost", "summary",
+                            {"month": "2026-07", "tenant": "acme"})
+        resp = server.handle({"jsonrpc": "2.0", "id": 2,
+                              "method": "tools/call",
+                              "params": {"name": "cp_cost_list",
+                                         "arguments": {"month": "2026-07"}}})
+        doc = json.loads(resp["result"]["content"][0]["text"])
+        assert doc["entries"][0]["amount"] == 42.5
+        assert calls[1][1] == "list"
