@@ -37,6 +37,11 @@ class AgentRegistry:
         self._agents: dict[str, Connection] = {}
         self._principals: dict[str, str] = {}   # slug -> auth principal
         self._pending: dict[str, asyncio.Future] = {}
+        # request_id -> the connection the command went to, so a
+        # disconnect can fail its in-flight commands IMMEDIATELY instead
+        # of letting callers sit out the full per-call timeout (a deploy
+        # to a crashing agent would otherwise stall up to 600 s)
+        self._pending_conn: dict[str, Connection] = {}
         self._ids = itertools.count(1)
 
     # ------------------------------------------------------------------
@@ -78,6 +83,16 @@ class AgentRegistry:
         if conn is None or self._agents.get(slug) is conn:
             self._agents.pop(slug, None)
             self._principals.pop(slug, None)
+        # fail the dead session's in-flight commands NOW — their results
+        # can never arrive, and callers (deploys especially) must not sit
+        # out the full per-call timeout against a crashed agent
+        if conn is not None:
+            for rid, c in list(self._pending_conn.items()):
+                if c is conn:
+                    fut = self._pending.get(rid)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(ControlPlaneError(
+                            f"agent {slug!r} disconnected mid-command"))
 
     def is_connected(self, slug: str) -> bool:
         return slug in self._agents
@@ -100,6 +115,7 @@ class AgentRegistry:
         request_id = f"req_{next(self._ids)}"
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        self._pending_conn[request_id] = conn
         try:
             await conn.send_event("agent", command, {
                 "request_id": request_id, "payload": payload or {}})
@@ -110,6 +126,12 @@ class AgentRegistry:
                 f"after {timeout:.0f}s") from None
         finally:
             self._pending.pop(request_id, None)
+            self._pending_conn.pop(request_id, None)
+            # if the disconnect path set an exception while send_event was
+            # failing, retrieve it so asyncio doesn't log "exception was
+            # never retrieved" at GC
+            if fut.done() and not fut.cancelled():
+                fut.exception()
 
     async def fire_and_forget(self, slug: str, command: str,
                               payload: dict | None = None) -> None:

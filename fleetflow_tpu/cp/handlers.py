@@ -87,6 +87,7 @@ def _require(payload: dict, *keys: str) -> list:
 _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "show", "snapshots", "ps", "pool.list", "user.list", "ping",
+    "reservations",
 })
 def _perm_wrap(channel: str, handler):
     """Wrap a channel handler with claims-based permission enforcement."""
@@ -696,6 +697,12 @@ def _placement(state: "AppState"):
             return {"ok": state.placement.commit(p.get("reservation", ""))}
         if method == "release":
             return {"ok": state.placement.release(p.get("reservation", ""))}
+        if method == "reservations":
+            # executor: the snapshot takes the PlacementService lock, which
+            # a fleet-scale solve can hold for its full duration — same
+            # off-loop rule as solve/node_events above
+            return await asyncio.get_running_loop().run_in_executor(
+                None, state.placement.reservations_snapshot)
         raise ValueError(f"unknown method placement.{method}")
     return handle
 

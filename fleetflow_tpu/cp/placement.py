@@ -286,6 +286,28 @@ class PlacementService:
                           "solve_ms": round(pl.solve_ms, 2)}
                     for key, (_pt, pl) in self._last.items()}
 
+    def reservations_snapshot(self) -> dict:
+        """Public view of the 2-phase journal: in-flight reservations
+        (including churn holds awaiting a redeploy) and committed
+        allocations per stage — the operator's answer to "why is this
+        node's capacity spoken for?"."""
+        def dem(d: dict[str, np.ndarray]) -> dict[str, list[float]]:
+            return {slug: [round(float(x), 3)
+                           for x in np.asarray(v, dtype=np.float64).ravel()]
+                    for slug, v in d.items()}
+
+        with self._lock:
+            return {
+                "in_flight": [
+                    {"id": r.id, "stage": r.stage_key, "churn": r.churn,
+                     "demand_by_node": dem(r.demand_by_node)}
+                    for r in self._reservations.values()],
+                "committed": [
+                    {"id": r.id, "stage": key,
+                     "demand_by_node": dem(r.demand_by_node)}
+                    for key, r in self._committed.items()],
+            }
+
     # ------------------------------------------------------------------
     # streaming re-solve (BASELINE config 5)
     # ------------------------------------------------------------------

@@ -644,7 +644,19 @@ class WebServer:
         # -- placement ---------------------------------------------------
         @self.route("GET", "/api/placement")
         def placement_last(body, query):
-            return {"stages": state.placement.snapshot()}
+            # executor: both snapshots take the PlacementService lock,
+            # which a fleet-scale solve can hold for its whole duration —
+            # blocking here would stall the web loop
+            async def go():
+                loop = asyncio.get_running_loop()
+                stages = await loop.run_in_executor(
+                    None, state.placement.snapshot)
+                rsv = await loop.run_in_executor(
+                    None, state.placement.reservations_snapshot)
+                # the 2-phase journal: in-flight reservations (incl. churn
+                # holds) + committed allocations per stage
+                return {"stages": stages, "reservations": rsv}
+            return go()
 
 
 _DASHBOARD_HTML = """<!doctype html>
@@ -833,12 +845,22 @@ const views={
  async placement(){
   const p=await api('/api/placement');
   const entries=Object.entries(p.stages);
-  main().innerHTML=entries.length?entries.map(([k,v])=>
+  const rsv=p.reservations||{in_flight:[],committed:[]};
+  const rsvRow=r=>[`<code>${esc(r.stage)}</code>`,esc(r.id),
+   r.churn?'<span class="warn">churn hold</span>':'reserved',
+   Object.keys(r.demand_by_node).map(esc).join(', ')];
+  const journal=(rsv.in_flight.length||rsv.committed.length)?
+   card('<b>reservation journal</b>'+
+    table(['stage','id','kind','nodes'],
+     rsv.in_flight.map(rsvRow).concat(rsv.committed.map(r=>
+      [`<code>${esc(r.stage)}</code>`,esc(r.id),'committed',
+       Object.keys(r.demand_by_node).map(esc).join(', ')])))):'';
+  main().innerHTML=(entries.length?entries.map(([k,v])=>
    card(`<b>${esc(k)}</b> · ${badge(v.feasible?'feasible':'infeasible')} · `+
     `${esc(v.source)} · ${esc(v.solve_ms)}ms · violations ${esc(v.violations)}`+
     table(['service','node'],Object.entries(v.assignment).map(
      ([s,n])=>[`<code>${esc(s)}</code>`,`<code>${esc(n)}</code>`])))).join(''):
-   card('<span class="muted">no placements solved yet</span>')},
+   card('<span class="muted">no placements solved yet</span>'))+journal},
  async agents(){
   const a=await api('/api/agents');
   main().innerHTML=card(a.agents.length?table(['agent'],

@@ -24,12 +24,19 @@ from .lib import load
 
 __all__ = ["native_parse_document", "kdl_native_available"]
 
-_configured = False
+# The CDLL instance whose symbols were configured — a STRONG reference
+# compared with `is`, not a process-global bool (lib.load() can
+# legitimately return a fresh CDLL after a loader cache reset + stale-.so
+# rebuild; calling ff_kdl_parse through an unconfigured handle truncates
+# its returned pointer — observed as a segfault in the test suite) and
+# not id() (freed ids get reused, which would skip configuration on an
+# unlucky allocation).
+_configured_lib = None
 
 
 def _configure(lib) -> bool:
-    global _configured
-    if _configured:
+    global _configured_lib
+    if _configured_lib is lib:
         return True
     try:
         lib.ff_kdl_parse.restype = ctypes.c_void_p
@@ -55,7 +62,7 @@ def _configure(lib) -> bool:
         lib.ff_kdl_free.argtypes = [ctypes.c_void_p]
     except AttributeError:
         return False    # stale .so without the kdl symbols
-    _configured = True
+    _configured_lib = lib
     return True
 
 

@@ -1604,3 +1604,120 @@ stage "live" {
         pl_e, _ = svc.solve_stage(flow_e, "live")
         assert pl_e.feasible, "stage a double-counted against itself"
         assert pl_e.assignment["e0"] == survivor
+
+
+class TestReservationVisibility:
+    """placement.reservations: the operator's read-gated view of the
+    2-phase journal — in-flight reservations, churn holds, and committed
+    allocations (the answer to 'why is this node's capacity spoken
+    for?')."""
+
+    def test_journal_over_the_wire(self):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.core.serialize import flow_to_dict
+
+        async def go():
+            handle = await start_cp()
+            conn, _ = await connect(handle)
+            agents = []
+            for slug in ("n0", "n1"):
+                c, _ = await ProtocolClient.connect(
+                    handle.host, handle.port, identity=slug)
+                await c.request("agent", "register", {
+                    "slug": slug, "version": "1",
+                    "capacity": {"cpu": 4, "memory": 8192, "disk": 99999}})
+                agents.append(c)
+            flow = parse_kdl_string("""
+project "rv"
+service "a0" { image "x"; resources { cpu 3; memory 64; disk 1 } }
+stage "live" { service "a0"; servers "n0" "n1" }
+""")
+            out = await conn.request("placement", "solve", {
+                "flow": flow_to_dict(flow), "stage": "live",
+                "reserve": True})
+            rid = out["reservation"]
+            assert rid
+            j = await conn.request("placement", "reservations")
+            assert [r["id"] for r in j["in_flight"]] == [rid]
+            assert j["in_flight"][0]["stage"] == "rv/live"
+            assert j["in_flight"][0]["churn"] is False
+            (node,) = j["in_flight"][0]["demand_by_node"].keys()
+            assert node in ("n0", "n1")
+            assert j["committed"] == []
+            # commit moves it to the committed side
+            assert (await conn.request("placement", "commit",
+                                       {"reservation": rid}))["ok"]
+            j = await conn.request("placement", "reservations")
+            assert j["in_flight"] == []
+            assert [c["stage"] for c in j["committed"]] == ["rv/live"]
+            # churn: the displaced stage's hold is visible AS a churn hold
+            victim = node
+            await conn.request("placement", "node_events", {
+                "events": [{"slug": victim, "online": False}]})
+            j = await conn.request("placement", "reservations")
+            churn = [r for r in j["in_flight"] if r["churn"]]
+            assert len(churn) == 1 and churn[0]["stage"] == "rv/live"
+            for c in agents + [conn]:
+                await c.close()
+            await handle.stop()
+        run(go())
+
+    def test_reservations_is_read_gated(self):
+        async def go():
+            handle = await start_cp(auth_kind="token", auth_secret="s3")
+            ro = handle.state.auth.issue("dash@x", ["read:placement"])
+            conn, _ = await connect(handle, token=ro)
+            j = await conn.request("placement", "reservations")
+            assert j == {"in_flight": [], "committed": []}
+            await conn.close()
+            await handle.stop()
+        run(go())
+
+
+class TestAgentDeathMidDeploy:
+    def test_deploy_fails_fast_when_agent_dies_mid_command(self, tmp_path):
+        """An agent crashing between receiving a deploy command and
+        answering it must fail the deployment within seconds — not after
+        the 600 s deploy-command timeout (the registry binds in-flight
+        request futures to the connection and fails them on disconnect)."""
+        import time as _time
+
+        from fleetflow_tpu.core.serialize import flow_to_dict
+
+        (tmp_path / ".fleetflow").mkdir(parents=True)
+        (tmp_path / ".fleetflow" / "fleet.kdl").write_text("""
+project "dd"
+service "a" { image "x" }
+stage "live" { service "a"; servers "node-1" }
+""")
+
+        async def go():
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)
+            received = []
+
+            async def on_event(conn, method, payload):
+                received.append(method)
+                await conn.close()          # dies mid-command, no reply
+
+            agent.conn.event_handlers["agent"] = on_event
+
+            flow = load_project_from_root_with_stage(str(tmp_path), "live")
+            cli, _ = await connect(handle)
+            t0 = _time.monotonic()
+            with pytest.raises(RpcError, match="disconnected mid-command"):
+                await cli.request(
+                    "deploy", "execute",
+                    {"request": DeployRequest(flow=flow,
+                                              stage_name="live").to_dict()},
+                    timeout=60)
+            elapsed = _time.monotonic() - t0
+            assert elapsed < 30, f"deploy hung {elapsed:.0f}s on a dead agent"
+            assert received, "agent never saw the command"
+            deps = handle.state.store.list("deployments")
+            assert len(deps) == 1
+            assert deps[0].status == "failed"
+            assert "disconnected mid-command" in (deps[0].error or "")
+            await cli.close()
+            await handle.stop()
+        run(go())
