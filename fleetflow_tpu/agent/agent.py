@@ -264,6 +264,13 @@ class Agent:
             return {"deployed": res.deployed, "removed": res.removed,
                     "duration_s": res.duration_s}
 
+        if method == "deploy.down":
+            req = DeployRequest.from_dict(payload["request"])
+            emit = self._live_emitter(loop, f"deploy/{req.stage_name}")
+            return await loop.run_in_executor(
+                None, lambda: self._down(
+                    req, bool(payload.get("remove")), emit))
+
         if method == "build":
             return await loop.run_in_executor(
                 None, lambda: self._run_build(payload))
@@ -286,6 +293,59 @@ class Agent:
             except RuntimeError:
                 pass   # loop already closed mid-deploy
         return emit
+
+    def _down(self, req: DeployRequest, remove: bool, emit) -> dict:
+        """Tear a stage down on this node, dispatched by the stage's
+        backend like deploy.execute — the CP-routed complement of `fleet
+        down` (the reference's down is local-only, commands/down.rs; a
+        CP-routed deploy needs a CP-routed teardown)."""
+        from ..core.model import Backend
+        stage = req.flow.stage(req.stage_name)
+        if stage.backend is not Backend.DOCKER and req.target_services:
+            # same semantics as the local CLI path: whole-stage only (the
+            # CP normalizes this before fan-out; belt-and-braces here)
+            emit("targeted down is not supported on this backend; "
+                 "taking the whole stage down")
+            req.target_services = []
+        if stage.backend is Backend.QUADLET:
+            from ..runtime.quadlet import down_stage
+            out = down_stage(req.flow, req.stage_name, remove=remove,
+                             unit_dir=self.config.quadlet_unit_dir,
+                             systemctl=self.systemctl)
+            for u in out.stopped:
+                emit(f"stopped {u}")
+            for u in out.removed:
+                emit(f"unit removed: {u}")
+            for u, err in out.errors.items():
+                emit(f"FAILED {u}: {err}")
+            if not out.ok:
+                raise RuntimeError(f"quadlet down failed: "
+                                   f"{sorted(out.errors)}")
+            return {"removed": out.stopped, "backend": "quadlet"}
+        if stage.backend is Backend.COMPOSE:
+            import os
+
+            from ..runtime.compose import compose_down
+            base = os.path.expanduser(self.config.deploy_base)
+            root = str(confine_path(
+                os.path.join(req.flow.name, req.stage_name), base))
+            emit(f"compose down: {req.flow.name}/{req.stage_name}")
+            rc, out_s = compose_down(req.flow, req.stage_name, root,
+                                     runner=self.compose_runner)
+            for line in out_s.strip().splitlines():
+                emit(line)
+            if rc != 0:
+                raise RuntimeError(f"compose down failed (rc={rc}): "
+                                   f"{out_s.strip()[-500:]}")
+            # compose owns the per-container bookkeeping; don't claim
+            # per-service precision this path doesn't have
+            return {"removed": [], "backend": "compose",
+                    "note": "compose down --remove-orphans"}
+        engine = DeployEngine(self.backend, sleep=self.sleep)
+        res = engine.down(req.flow, req.stage_name,
+                          req.target_services or None,
+                          on_event=lambda e: emit(str(e)))
+        return {"removed": res.removed, "backend": "docker"}
 
     def _deploy_quadlet(self, req: DeployRequest, emit) -> dict:
         """Quadlet-backed stage through the CP (agent.rs apply_stage

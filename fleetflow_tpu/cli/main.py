@@ -222,6 +222,39 @@ def cmd_down(args) -> int:
     flow = _load(args)
     stage_name = _stage(args)
     stage = flow.stage(stage_name)
+    if stage.servers and not getattr(args, "local", False):
+        # remote path, same gate as `fleet deploy` (a servers-stage is
+        # CP-routed — asymmetric gates would let a CP-deployed stage
+        # silently "tear down" locally, removing nothing): every
+        # connected stage agent runs the backend-appropriate down for
+        # its node and the CP returns the committed capacity
+        # (deploy.execute's complement; the reference's down is
+        # local-only, commands/down.rs). `fleet up` is always local even
+        # on a servers-stage, so --local forces the local path for
+        # cleaning those up.
+        req = DeployRequest(flow=flow, stage_name=stage_name,
+                            target_services=args.services or [])
+        with CpClient(args.cp) as cp:
+            out = cp.request("deploy", "down",
+                             {"request": req.to_dict(),
+                              "remove": getattr(args, "remove", False),
+                              # same tenant resolution as cmd_deploy, so
+                              # the teardown lands on the REAL stage record
+                              "tenant": getattr(args, "tenant", None) or
+                              (flow.tenant.name if flow.tenant
+                               else "default")},
+                             timeout=600)
+        for slug, info in sorted(out["nodes"].items()):
+            if isinstance(info, dict):
+                if info.get("note"):
+                    print(f"  {slug}: {info['note']}")
+                else:
+                    removed = info.get("removed") or []
+                    print(f"  {slug}: removed {len(removed)} "
+                          f"({info.get('backend', 'docker')})")
+            else:
+                print(f"  {slug}: FAILED — {info}", file=sys.stderr)
+        return 0 if out["ok"] else 1
     if stage.backend is Backend.QUADLET:
         # commands/quadlet.rs down:71 — systemctl stop (+ unit removal),
         # never the docker engine
@@ -983,6 +1016,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", "--service", dest="services", action="append")
     p.add_argument("--remove", action="store_true",
                    help="quadlet backend: also delete the generated units")
+    p.add_argument("--cp", help="CP endpoint host:port (a servers-stage "
+                               "tears down through the control plane, "
+                               "same routing as deploy)")
+    p.add_argument("--local", action="store_true",
+                   help="force the local teardown path (e.g. to clean up "
+                        "a local `fleet up` of a servers-stage)")
+    p.add_argument("--tenant")
     p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("restart", help="restart services")

@@ -575,8 +575,141 @@ def _deploy(state: "AppState"):
             return await execute_deploy(
                 state, DeployRequest.from_dict(p["request"]),
                 tenant_name=p.get("tenant", "default"))
+        if method == "down":
+            return await execute_down(
+                state, DeployRequest.from_dict(p["request"]),
+                tenant_name=p.get("tenant", "default"),
+                remove=bool(p.get("remove", False)))
         raise ValueError(f"unknown method deploy.{method}")
     return handle
+
+
+async def execute_down(state: "AppState", req: DeployRequest,
+                       tenant_name: str = "default",
+                       remove: bool = False) -> dict:
+    """CP-routed teardown: the complement of execute_deploy (the
+    reference's down is local-only, commands/down.rs — but a stage
+    deployed THROUGH the CP must be torn down through it too).
+
+    Fan deploy.down out to every connected stage agent; a stage server
+    WITHOUT a live agent counts as a FAILED node (its containers are still
+    running — releasing capacity for them would let the next solve
+    double-book the node when it reconnects). A stage whose servers were
+    never agent-routed (the CP-local deploy fallback: last deployment has
+    no placement) tears down on the CP host instead. Full-stage success
+    returns committed capacity, marks services removed, and the whole
+    teardown lands in the deployment history like any deploy."""
+    db = state.store
+    tenant = db.ensure_tenant(tenant_name)
+    project = db.ensure_project(tenant.name, req.flow.name)
+    stage_cfg = req.flow.stage(req.stage_name)
+    stage = db.ensure_stage(project.id, req.stage_name)
+
+    # quadlet/compose tear down whole-stage only (same semantics as the
+    # local CLI path, which warns and drops -n); normalizing HERE keeps
+    # the capacity-release decision below consistent with what the agents
+    # actually did
+    from ..core.model import Backend
+    if stage_cfg.backend is not Backend.DOCKER and req.target_services:
+        req.target_services = []
+
+    # "down:*" marks a FULL-stage teardown record: the placement scan
+    # below stops at the last successful one (a later redeploy starts the
+    # stage's placement story over)
+    dep = db.create("deployments", Deployment(
+        tenant=tenant.name, project=project.id, stage=stage.id,
+        status=DeploymentStatus.RUNNING.value,
+        services=(["down:*"] if not req.target_services
+                  else [f"down:{s}" for s in req.target_services])))
+
+    # The placement record is the truth about WHERE the stage's containers
+    # live (failed deploys record none, so the scan must span the FULL
+    # history — a tail of failed redeploys must not flip the verdict, and
+    # deployment_history's default limit would truncate it):
+    #   - some deployment recorded a placement -> agent-routed: fan out to
+    #     connected agents, and every PLACED node without a live agent
+    #     blocks the teardown (its containers are still running; releasing
+    #     capacity for them would double-book the node on reconnect). A
+    #     declared-but-never-placed offline server blocks nothing.
+    #   - no placement anywhere -> the stage only ever ran through the
+    #     CP-local deploy fallback: tear down on the CP host, even if
+    #     agents have connected since (they hold nothing of this stage).
+    placed = None
+    for d in reversed(db.list("deployments",
+                              lambda d: d.stage == stage.id)):
+        if d.id == dep.id:
+            continue
+        if ((d.services or [""])[0] == "down:*"
+                and d.status == DeploymentStatus.SUCCEEDED.value):
+            break         # fully torn down since; older placements are moot
+        if d.placement:
+            placed = d.placement
+            break
+    nodes: dict[str, object] = {}
+    errors: list[str] = []
+    try:
+        if placed is not None:
+            # fan out to every connected node that is declared OR holds
+            # placed containers — a placed node edited OUT of the config
+            # still runs this stage and must be torn down (or block the
+            # release while unreachable)
+            placed_nodes = sorted({n for n in placed.values()})
+            relevant = sorted(set(stage_cfg.servers) | set(placed_nodes))
+            targets = [s for s in relevant
+                       if state.agent_registry.is_connected(s)]
+            missing = [s for s in placed_nodes if s not in targets]
+            if targets:
+                results = await asyncio.gather(*[
+                    state.agent_registry.send_command(
+                        slug, "deploy.down",
+                        {"request": req.to_dict(), "remove": remove},
+                        timeout=DEPLOY_TIMEOUT)
+                    for slug in targets], return_exceptions=True)
+                nodes = {slug: (str(r) if isinstance(r, Exception) else r)
+                         for slug, r in zip(targets, results)}
+                errors = [s for s, r in zip(targets, results)
+                          if isinstance(r, Exception)]
+            for slug in missing:
+                nodes[slug] = "agent not connected (containers may still " \
+                              "be running; reconnect it and re-run down)"
+            errors += missing
+            if not nodes:
+                raise ValueError(
+                    f"no connected agents among stage servers "
+                    f"{stage_cfg.servers} (the stage was agent-deployed; "
+                    f"reconnect the agents to tear it down)")
+        else:
+            engine = DeployEngine(state.backend_factory(),
+                                  sleep=state.deploy_sleep)
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: engine.down(req.flow, req.stage_name,
+                                          req.target_services or None))
+            nodes = {"(cp-local)": {"removed": res.removed,
+                                    "backend": "docker"}}
+
+        ok = not errors
+        if ok:
+            if not req.target_services:
+                # full-stage teardown: capacity back, every service marked
+                state.placement.release_stage(
+                    f"{req.flow.name}/{req.stage_name}")
+                marked = stage_cfg.services
+            else:
+                # targeted: no capacity release (the stage still runs),
+                # but the removed services must not show 'deployed'
+                marked = req.target_services
+            for svc in marked:
+                db.upsert_service(stage.id, svc, status="removed")
+        log = "\n".join(f"{slug}: {info}" for slug, info in nodes.items())
+        db.finish_deployment(
+            dep.id,
+            DeploymentStatus.SUCCEEDED if ok else DeploymentStatus.FAILED,
+            log=log, error="; ".join(errors) if errors else "")
+        return {"ok": ok, "nodes": nodes, "failed_nodes": errors,
+                "deployment": db.get("deployments", dep.id).public_dict()}
+    except Exception as e:
+        db.finish_deployment(dep.id, DeploymentStatus.FAILED, error=str(e))
+        raise
 
 
 async def execute_deploy(state: "AppState", req: DeployRequest,

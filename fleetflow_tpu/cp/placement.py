@@ -276,37 +276,51 @@ class PlacementService:
             self._apply_allocation(c, -1.0)
             return True
 
+    def _snapshot_locked(self) -> dict[str, dict]:
+        return {key: {"assignment": pl.assignment,
+                      "feasible": pl.feasible,
+                      "violations": pl.violations,
+                      "source": pl.source,
+                      "solve_ms": round(pl.solve_ms, 2)}
+                for key, (_pt, pl) in self._last.items()}
+
+    def _reservations_locked(self) -> dict:
+        def dem(d: dict[str, np.ndarray]) -> dict[str, list[float]]:
+            return {slug: [round(float(x), 3)
+                           for x in np.asarray(v, dtype=np.float64).ravel()]
+                    for slug, v in d.items()}
+
+        return {
+            "in_flight": [
+                {"id": r.id, "stage": r.stage_key, "churn": r.churn,
+                 "demand_by_node": dem(r.demand_by_node)}
+                for r in self._reservations.values()],
+            "committed": [
+                {"id": r.id, "stage": key,
+                 "demand_by_node": dem(r.demand_by_node)}
+                for key, r in self._committed.items()],
+        }
+
     def snapshot(self) -> dict[str, dict]:
         """Public view of the latest placement per stage (for REST/MCP)."""
         with self._lock:
-            return {key: {"assignment": pl.assignment,
-                          "feasible": pl.feasible,
-                          "violations": pl.violations,
-                          "source": pl.source,
-                          "solve_ms": round(pl.solve_ms, 2)}
-                    for key, (_pt, pl) in self._last.items()}
+            return self._snapshot_locked()
 
     def reservations_snapshot(self) -> dict:
         """Public view of the 2-phase journal: in-flight reservations
         (including churn holds awaiting a redeploy) and committed
         allocations per stage — the operator's answer to "why is this
         node's capacity spoken for?"."""
-        def dem(d: dict[str, np.ndarray]) -> dict[str, list[float]]:
-            return {slug: [round(float(x), 3)
-                           for x in np.asarray(v, dtype=np.float64).ravel()]
-                    for slug, v in d.items()}
-
         with self._lock:
-            return {
-                "in_flight": [
-                    {"id": r.id, "stage": r.stage_key, "churn": r.churn,
-                     "demand_by_node": dem(r.demand_by_node)}
-                    for r in self._reservations.values()],
-                "committed": [
-                    {"id": r.id, "stage": key,
-                     "demand_by_node": dem(r.demand_by_node)}
-                    for key, r in self._committed.items()],
-            }
+            return self._reservations_locked()
+
+    def placement_state(self) -> dict:
+        """Both views under ONE lock acquisition, so a commit landing
+        between them cannot make the dashboard render a placement with a
+        contradictory journal (and a long solve is only waited out once)."""
+        with self._lock:
+            return {"stages": self._snapshot_locked(),
+                    "reservations": self._reservations_locked()}
 
     # ------------------------------------------------------------------
     # streaming re-solve (BASELINE config 5)

@@ -644,18 +644,15 @@ class WebServer:
         # -- placement ---------------------------------------------------
         @self.route("GET", "/api/placement")
         def placement_last(body, query):
-            # executor: both snapshots take the PlacementService lock,
+            # executor: the snapshot takes the PlacementService lock,
             # which a fleet-scale solve can hold for its whole duration —
-            # blocking here would stall the web loop
+            # blocking here would stall the web loop. One combined call:
+            # stages + the 2-phase journal under a single lock
+            # acquisition, so they cannot contradict each other.
+            # (async wrapper: the router awaits coroutines, not Futures)
             async def go():
-                loop = asyncio.get_running_loop()
-                stages = await loop.run_in_executor(
-                    None, state.placement.snapshot)
-                rsv = await loop.run_in_executor(
-                    None, state.placement.reservations_snapshot)
-                # the 2-phase journal: in-flight reservations (incl. churn
-                # holds) + committed allocations per stage
-                return {"stages": stages, "reservations": rsv}
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, state.placement.placement_state)
             return go()
 
 

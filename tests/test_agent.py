@@ -525,3 +525,299 @@ class TestAgentBuild:
         with pytest.raises(RuntimeError, match="docker build failed"):
             asyncio.run(agent.execute_command("build", {
                 "repo": self._repo(tmp_path), "image_tag": "x:1"}))
+
+
+class TestCpRoutedDown:
+    def test_down_removes_containers_and_releases_capacity(self, project):
+        """`fleet down` on a server-backed stage routes through the CP:
+        every stage agent tears down its slice, the stage's committed
+        capacity returns to the pool, services are marked removed
+        (deploy.execute's complement — the reference's down is
+        local-only, commands/down.rs)."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            assert backend.containers
+            s = handle.state.store.server_by_slug("node-1")
+            assert s.allocated.cpu > 0
+
+            out = await cli.request("deploy", "down",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["ok"], out
+            assert out["nodes"]["node-1"]["backend"] == "docker"
+            assert len(out["nodes"]["node-1"]["removed"]) == 3
+            # the agent's docker daemon is empty again
+            assert backend.containers == {}
+            # committed capacity returned
+            s = handle.state.store.server_by_slug("node-1")
+            assert s.allocated.cpu == 0
+            # services marked removed in the store
+            stage = handle.state.store.list("stages")[0]
+            for svc in handle.state.store.services_of(stage.id):
+                assert svc.status == "removed"
+            # teardown events reached the log router
+            lines = [e.line for e in handle.state.log_router.retained(
+                "logs/node-1/deploy/local")]
+            assert any("remove" in ln for ln in lines)
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_quadlet_down_via_cp(self, project, tmp_path):
+        """Quadlet stages tear down with systemctl on the node, unit
+        removal honoring --remove."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            from fleetflow_tpu.core.model import Backend
+            flow.stages["local"].servers = ["node-1"]
+            flow.stages["local"].backend = Backend.QUADLET
+            handle = await start(ServerConfig())
+            calls = []
+
+            def systemctl(args):
+                calls.append(tuple(args))
+                return 0, ""
+
+            agent, backend = make_agent(
+                handle, quadlet_unit_dir=str(tmp_path / "units"),
+                agent_kw={"systemctl": systemctl})
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            assert (tmp_path / "units").is_dir()
+            calls.clear()
+            out = await cli.request("deploy", "down",
+                                    {"request": req.to_dict(),
+                                     "remove": True}, timeout=20)
+            assert out["ok"], out
+            assert out["nodes"]["node-1"]["backend"] == "quadlet"
+            stops = [c for c in calls if c[0] == "stop"]
+            assert len(stops) >= 3
+            # --remove deleted the generated units
+            left = [p.name for p in (tmp_path / "units").iterdir()]
+            assert left == [], left
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_down_with_disconnected_placed_server_refuses_release(
+            self, project):
+        """A node that HOLDS containers but has no live agent blocks the
+        teardown: the CP must neither report success nor return the
+        stage's committed capacity (the next solve would double-book the
+        node when it reconnects). A declared-but-never-placed offline
+        server must NOT block (reconciled against the recorded
+        placement)."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1", "node-2", "node-3"]
+            handle = await start(ServerConfig())
+            agent1, b1 = make_agent(handle)
+            agent2, b2 = make_agent(handle, slug="node-2",
+                                    backend=MockBackend(auto_pull=True))
+            t1 = asyncio.ensure_future(agent1.run())
+            t2 = asyncio.ensure_future(agent2.run())
+            while not (handle.state.agent_registry.is_connected("node-1")
+                       and handle.state.agent_registry.is_connected(
+                           "node-2")):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            # node-3 never connects: it must not block anything below
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            placed_nodes = set(
+                handle.state.store.deployment_history(limit=1)[0]
+                .placement.values())
+            assert placed_nodes <= {"node-1", "node-2"}
+            before = handle.state.store.server_by_slug("node-1").allocated
+            assert before.cpu > 0
+
+            if "node-2" in placed_nodes:
+                # kill the agent on a PLACED node mid-flight
+                agent2.stop()
+                await asyncio.wait_for(t2, 5)
+                while handle.state.agent_registry.is_connected("node-2"):
+                    await asyncio.sleep(0.02)
+                out = await cli.request("deploy", "down",
+                                        {"request": req.to_dict()},
+                                        timeout=20)
+                assert not out["ok"]
+                assert out["failed_nodes"] == ["node-2"]
+                assert "not connected" in out["nodes"]["node-2"]
+                # never-placed node-3 did NOT make the failure list
+                assert "node-3" not in out["nodes"]
+                # capacity NOT released, teardown recorded as FAILED
+                assert (handle.state.store.server_by_slug("node-1")
+                        .allocated.cpu == before.cpu)
+                down_deps = [
+                    d for d in handle.state.store.deployment_history(limit=5)
+                    if (d.services or [""])[0].startswith("down:")]
+                assert down_deps and down_deps[0].status == "failed"
+            else:
+                # placement used node-1 only: down must SUCCEED despite
+                # node-2/node-3 being gone (they hold nothing)
+                agent2.stop()
+                await asyncio.wait_for(t2, 5)
+                out = await cli.request("deploy", "down",
+                                        {"request": req.to_dict()},
+                                        timeout=20)
+                assert out["ok"], out
+            agent1.stop()
+            await asyncio.wait_for(t1, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_down_records_history_and_tenant(self, project):
+        """Teardown lands in the deployment history under the REAL tenant
+        (the CLI forwards it), so the dashboard's last event for a downed
+        stage is the down, not a stale succeeded deploy."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            await cli.request("deploy", "execute",
+                              {"request": req.to_dict(),
+                               "tenant": "acme"}, timeout=20)
+            out = await cli.request("deploy", "down",
+                                    {"request": req.to_dict(),
+                                     "tenant": "acme"}, timeout=20)
+            assert out["ok"]
+            assert out["deployment"]["status"] == "succeeded"
+            assert out["deployment"]["tenant"] == "acme"
+            # exactly ONE project/stage pair exists — the down reused the
+            # deploy's records instead of minting a default-tenant clone
+            assert len(handle.state.store.list("projects")) == 1
+            assert len(handle.state.store.list("stages")) == 1
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_cp_local_deployed_stage_tears_down_cp_locally(self, project):
+        """A stage that deploy.execute ran CP-LOCALLY (no agents at deploy
+        time -> no placement record) must tear down on the CP host even if
+        an agent has connected since — the agent holds nothing of this
+        stage, and fanning out to it would remove nothing while releasing
+        capacity for containers that keep running."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            cp_backend = MockBackend(auto_pull=True)
+            handle = await start(ServerConfig(),
+                                 backend_factory=lambda: cp_backend,
+                                 deploy_sleep=lambda d: None)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            assert cp_backend.containers        # ran on the CP host
+
+            # an agent connects AFTER the fact
+            agent, agent_backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+
+            out = await cli.request("deploy", "down",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["ok"], out
+            assert "(cp-local)" in out["nodes"]
+            assert cp_backend.containers == {}  # CP host cleaned up
+            assert agent_backend.containers == {}
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_down_after_redeploy_resets_placement_story(self, project):
+        """deploy -> down -> redeploy cycle: a successful full-stage down
+        record ends the placement story, so a targeted down of individual
+        services flips ONLY their store status while the stage keeps its
+        capacity."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            for _ in range(2):       # deploy -> down -> deploy again
+                out = await cli.request("deploy", "execute",
+                                        {"request": req.to_dict()},
+                                        timeout=20)
+                assert out["deployment"]["status"] == "succeeded"
+                out = await cli.request("deploy", "down",
+                                        {"request": req.to_dict()},
+                                        timeout=20)
+                assert out["ok"], out
+            # redeploy once more, then a TARGETED down of one service
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            alloc = handle.state.store.server_by_slug("node-1").allocated.cpu
+            assert alloc > 0
+            treq = DeployRequest(flow=flow, stage_name="local",
+                                 target_services=["app"])
+            out = await cli.request("deploy", "down",
+                                    {"request": treq.to_dict()}, timeout=20)
+            assert out["ok"], out
+            # capacity NOT released (partial down)...
+            assert (handle.state.store.server_by_slug("node-1")
+                    .allocated.cpu == alloc)
+            # ...but the targeted service's status flipped
+            stage = handle.state.store.list("stages")[0]
+            statuses = {s.name: s.status
+                        for s in handle.state.store.services_of(stage.id)}
+            assert statuses["app"] == "removed"
+            assert statuses["postgres"] == "deployed"
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())

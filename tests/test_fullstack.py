@@ -203,6 +203,18 @@ def test_production_example_deploys_end_to_end(tmp_path):
         # the CP), container starts on the placed nodes
         assert any(ln.startswith("[place]") for ln in lines), lines
         assert any(ln.startswith("[start]") for ln in lines), lines
+
+        # ---- fleet down: CP-routed teardown through the same agents -----
+        out = _run_cli(["down", "live", "--cp", f"127.0.0.1:{cp_port}"],
+                       cwd=project, env=env, timeout=300)
+        assert out.returncode == 0, out.stdout + out.stderr
+        for slug in ("tokyo-1", "tokyo-2", "osaka-1"):
+            state = tmp_path / f"docker-{slug}" / "state.json"
+            if state.exists():
+                left = json.loads(state.read_text())["containers"]
+                running = [n for n, c in left.items()
+                           if c.get("state") == "running"]
+                assert not running, (slug, running)
     finally:
         for a in agents:
             a.terminate()
