@@ -228,6 +228,19 @@ class Agent:
             await loop.run_in_executor(None, lambda: self.backend.stop(name))
             return {"stopped": name}
 
+        if method == "logs":
+            # live container logs straight from the node's runtime (the
+            # retained ring only holds agent-PUBLISHED lines like deploy
+            # events; `fleet logs --cp` wants the container's own output)
+            name = validate_container_name(payload["container"])
+            raw_tail = payload.get("tail")
+            tail = 100 if raw_tail is None else int(raw_tail)  # 0 is valid
+            since = payload.get("since")
+            text = await loop.run_in_executor(
+                None, lambda: self.backend.logs(name, tail=tail,
+                                                since=since))
+            return {"logs": text}
+
         if method == "deploy.execute":
             req = DeployRequest.from_dict(payload["request"])
             if not req.node:

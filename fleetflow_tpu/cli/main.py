@@ -344,6 +344,46 @@ def cmd_logs(args) -> int:
     flow = _load(args)
     stage_name = _stage(args)
     from ..runtime.converter import container_name
+    stage = flow.stage(stage_name)
+    if stage.servers and not getattr(args, "local", False):
+        # remote path (same gate as deploy/down): find where the CP
+        # observed the service's containers, fetch live logs from each
+        # owning node's agent
+        if getattr(args, "follow", False):
+            print("warning: --follow is not supported on the CP-routed "
+                  "path; printing a one-shot tail", file=sys.stderr)
+        failed = 0
+        with CpClient(args.cp) as cp:
+            rows = cp.request("container", "ps", {})["containers"]
+            mine = [r for r in rows
+                    if r.get("project") == flow.name
+                    and r.get("stage") == stage_name
+                    and r.get("service") == args.service]
+            if not mine:
+                print(f"no observed containers for "
+                      f"{flow.name}/{stage_name}/{args.service} "
+                      f"(agents report inventory on their monitor "
+                      f"interval)", file=sys.stderr)
+                return 1
+            for r in sorted(mine, key=lambda r: r.get("name", "")):
+                prefix = (f"[{r['server']}/{r['name']}] "
+                          if len(mine) > 1 else "")
+                try:
+                    out = cp.request("container", "logs.live",
+                                     {"server": r["server"],
+                                      "container": r["name"],
+                                      "tail": args.tail,
+                                      "since": args.since})
+                except RpcError as e:
+                    # per-node failures must not hide the other replicas'
+                    # logs (same per-node reporting as cmd_down)
+                    print(f"{prefix or r['server'] + ': '}FAILED — {e}",
+                          file=sys.stderr)
+                    failed += 1
+                    continue
+                for line in out.get("logs", "").splitlines():
+                    print(f"{prefix}{line}")
+        return 1 if failed else 0
     backend = _backend(args)
     cname = container_name(flow.name, stage_name, args.service)
     if getattr(args, "follow", False):
@@ -1046,6 +1086,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stream new lines until Ctrl+C (logs.rs follow)")
     p.add_argument("--since", help="only lines after this (e.g. 10m, 2h, "
                    "RFC3339 timestamp)")
+    p.add_argument("--cp", help="CP endpoint host:port (a servers-stage "
+                               "fetches live logs from the owning nodes)")
+    p.add_argument("--local", action="store_true",
+                   help="force the local docker logs path")
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("exec", help="exec into a service container")

@@ -86,8 +86,8 @@ def _require(payload: dict, *keys: str) -> list:
 #     a capacity reservation (state mutation under a read grant otherwise)
 _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
-    "logs", "show", "snapshots", "ps", "pool.list", "user.list", "ping",
-    "reservations",
+    "logs", "logs.live", "show", "snapshots", "ps", "pool.list",
+    "user.list", "ping", "reservations",
 })
 def _perm_wrap(channel: str, handler):
     """Wrap a channel handler with claims-based permission enforcement."""
@@ -260,6 +260,16 @@ def _container(state: "AppState"):
             entries = state.log_router.retained(
                 topic_for(server, container), limit=p.get("limit"))
             return {"lines": [e.to_dict() for e in entries]}
+        if method == "logs.live":
+            # live container output fetched FROM the node (the retained
+            # ring above only holds agent-published lines — deploy events,
+            # alerts — not container stdout)
+            server, container = _require(p, "server", "container")
+            result = await state.agent_registry.send_command(
+                server, "logs", {"container": container,
+                                 "tail": p.get("tail"),
+                                 "since": p.get("since")})
+            return {"logs": result.get("logs", "")}
         if method in ("start", "stop", "restart"):
             # granular lifecycle (MCP cp_container_start/stop/restart):
             # routed to the owning node's agent

@@ -64,10 +64,18 @@ def main() -> int:
     if cmd == "create":
         name = args[args.index("--name") + 1]
         has_health = "--health-cmd" in args
+        # labels must round-trip through inspect: the agent's monitor
+        # attributes observed containers by the fleetflow.* labels
+        labels = {}
+        for i, a in enumerate(args):
+            if a == "--label" and "=" in args[i + 1]:
+                k, v = args[i + 1].split("=", 1)
+                labels[k] = v
         # image = first non-flag operand after the flags (backend appends
         # image then optional command)
         cs[name] = {"image": "", "state": "created",
-                    "health": "starting" if has_health else None}
+                    "health": "starting" if has_health else None,
+                    "labels": labels}
         save()
         print(f"id-{name}")
         return 0
@@ -108,7 +116,8 @@ def main() -> int:
                "State": {"Status": c["state"], "ExitCode": 0,
                          **({"Health": {"Status": c["health"]}}
                             if c["health"] else {})},
-               "Config": {"Image": c["image"], "Labels": {}},
+               "Config": {"Image": c["image"],
+                          "Labels": c.get("labels") or {}},
                "HostConfig": {"PortBindings": {}}}
         print(json.dumps([doc]))
         return 0

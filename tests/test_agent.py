@@ -821,3 +821,44 @@ class TestCpRoutedDown:
             await cli.close()
             await handle.stop()
         run(go())
+
+
+class TestRemoteLogs:
+    def test_logs_live_fetches_from_owning_node(self, project):
+        """container.logs.live routes to the owning agent and returns the
+        container runtime's own output (the retained ring only holds
+        agent-published lines) — the wire behind `fleet logs --cp`."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            backend.logs = lambda name, tail=100, since=None: \
+                f"hello from {name} (tail={tail})\n"
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            out = await cli.request("container", "logs.live",
+                                    {"server": "node-1",
+                                     "container": "testproj-local-app",
+                                     "tail": 7}, timeout=10)
+            assert out["logs"] == "hello from testproj-local-app (tail=7)\n"
+            # a bogus container name is refused by the agent's guard
+            from fleetflow_tpu.cp.protocol import RpcError
+            with pytest.raises(RpcError):
+                await cli.request("container", "logs.live",
+                                  {"server": "node-1",
+                                   "container": "evil; rm -rf /"},
+                                  timeout=10)
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())

@@ -204,6 +204,13 @@ def test_production_example_deploys_end_to_end(tmp_path):
         assert any(ln.startswith("[place]") for ln in lines), lines
         assert any(ln.startswith("[start]") for ln in lines), lines
 
+        # ---- fleet logs: live container output from the owning node -----
+        out = _run_cli(["logs", "db", "-s", "live", "--tail", "5",
+                        "--cp", f"127.0.0.1:{cp_port}"],
+                       cwd=project, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "log line" in out.stdout     # the fake docker's canned logs
+
         # ---- fleet down: CP-routed teardown through the same agents -----
         out = _run_cli(["down", "live", "--cp", f"127.0.0.1:{cp_port}"],
                        cwd=project, env=env, timeout=300)
