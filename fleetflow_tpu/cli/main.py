@@ -26,6 +26,7 @@ from ..runtime.backend import DockerCliBackend, MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
 from ..sched import pick_scheduler, place_with_fallback
 from .client import CpClient, CredentialStore, default_endpoint
+from ..cp.protocol import RpcError
 from .utils import determine_stage_name, filter_services, mask_env
 
 __all__ = ["main", "build_parser"]
@@ -82,6 +83,20 @@ def _print_plan(flow: Flow, stage_name: str,
             print(f"    env {k}={v}")
         if svc.depends_on:
             print(f"    depends_on {', '.join(svc.depends_on)}")
+
+
+def _observed_for(cp, flow: Flow, stage, stage_name: str,
+                  services: list[str]) -> list[dict]:
+    """Observed containers of this flow's stage, scoped to the stage's
+    DECLARED servers: label attribution alone (project/stage/service)
+    could match another tenant's same-named project on a shared CP, and
+    acting on those would be a cross-tenant action."""
+    rows = cp.request("container", "ps", {})["containers"]
+    return [r for r in rows
+            if r.get("project") == flow.name
+            and r.get("stage") == stage_name
+            and (not services or r.get("service") in services)
+            and r.get("server") in stage.servers]
 
 
 def _event_printer(event) -> None:
@@ -293,10 +308,33 @@ def cmd_down(args) -> int:
 def cmd_restart(args) -> int:
     flow = _load(args)
     stage_name = _stage(args)
+    stage = flow.stage(stage_name)
+    names = filter_services(stage.services, args.services or [])
+    if stage.servers and not getattr(args, "local", False):
+        # remote path (same gate as deploy/down/logs): restart each
+        # service's observed containers on their owning nodes
+        failed = 0
+        with CpClient(args.cp) as cp:
+            mine = _observed_for(cp, flow, stage, stage_name, names)
+            if not mine:
+                print(f"no observed containers for "
+                      f"{flow.name}/{stage_name} services {names} "
+                      f"(agents report inventory on their monitor "
+                      f"interval)", file=sys.stderr)
+                return 1
+            for r in sorted(mine, key=lambda r: r.get("name", "")):
+                try:
+                    cp.request("container", "restart",
+                               {"server": r["server"],
+                                "container": r["name"]})
+                    print(f"  restarted {r['name']} on {r['server']}")
+                except RpcError as e:
+                    print(f"  {r['name']} on {r['server']}: FAILED — {e}",
+                          file=sys.stderr)
+                    failed += 1
+        return 1 if failed else 0
     backend = _backend(args)
     from ..runtime.converter import container_name
-    names = filter_services(flow.stage(stage_name).services,
-                            args.services or [])
     for svc in names:
         cname = container_name(flow.name, stage_name, svc)
         try:
@@ -354,11 +392,8 @@ def cmd_logs(args) -> int:
                   "path; printing a one-shot tail", file=sys.stderr)
         failed = 0
         with CpClient(args.cp) as cp:
-            rows = cp.request("container", "ps", {})["containers"]
-            mine = [r for r in rows
-                    if r.get("project") == flow.name
-                    and r.get("stage") == stage_name
-                    and r.get("service") == args.service]
+            mine = _observed_for(cp, flow, stage, stage_name,
+                                 [args.service])
             if not mine:
                 print(f"no observed containers for "
                       f"{flow.name}/{stage_name}/{args.service} "
@@ -1068,6 +1103,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("restart", help="restart services")
     stage_args(p)
     p.add_argument("-n", "--service", dest="services", action="append")
+    p.add_argument("--cp", help="CP endpoint host:port (a servers-stage "
+                               "restarts containers on their owning nodes)")
+    p.add_argument("--local", action="store_true",
+                   help="force the local docker restart path")
     p.set_defaults(fn=cmd_restart)
 
     p = sub.add_parser("ps", help="list containers")

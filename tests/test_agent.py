@@ -862,3 +862,36 @@ class TestRemoteLogs:
             await cli.close()
             await handle.stop()
         run(go())
+
+
+class TestRemoteRestart:
+    def test_container_restart_routed_to_owning_node(self, project):
+        """container.restart (the wire behind `fleet restart --cp` and the
+        dashboard's restart action) reaches the owning agent's backend."""
+        async def go():
+            root, _ = project
+            flow = load_project_from_root_with_stage(str(root), "local")
+            flow.stages["local"].servers = ["node-1"]
+            handle = await start(ServerConfig())
+            agent, backend = make_agent(handle)
+            task = asyncio.ensure_future(agent.run())
+            while not handle.state.agent_registry.is_connected("node-1"):
+                await asyncio.sleep(0.02)
+            cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                                  identity="cli")
+            req = DeployRequest(flow=flow, stage_name="local")
+            out = await cli.request("deploy", "execute",
+                                    {"request": req.to_dict()}, timeout=20)
+            assert out["deployment"]["status"] == "succeeded"
+            before = len(backend.calls)
+            out = await cli.request("container", "restart",
+                                    {"server": "node-1",
+                                     "container": "testproj-local-app"},
+                                    timeout=10)
+            assert out["result"]["restarted"] == "testproj-local-app"
+            assert ("restart", "testproj-local-app") in backend.calls[before:]
+            agent.stop()
+            await asyncio.wait_for(task, 5)
+            await cli.close()
+            await handle.stop()
+        run(go())

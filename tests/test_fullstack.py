@@ -211,6 +211,13 @@ def test_production_example_deploys_end_to_end(tmp_path):
         assert out.returncode == 0, out.stdout + out.stderr
         assert "log line" in out.stdout     # the fake docker's canned logs
 
+        # ---- fleet restart: routed to the owning nodes ------------------
+        out = _run_cli(["restart", "live", "-n", "db",
+                        "--cp", f"127.0.0.1:{cp_port}"],
+                       cwd=project, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "restarted shop-live-db" in out.stdout
+
         # ---- fleet down: CP-routed teardown through the same agents -----
         out = _run_cli(["down", "live", "--cp", f"127.0.0.1:{cp_port}"],
                        cwd=project, env=env, timeout=300)
