@@ -382,12 +382,14 @@ def parse_stage(node: KdlNode) -> Stage:
     if not name:
         raise FlowError("stage node requires a name argument")
     st = Stage(name=name)
-    for c in node.children:
+    seen = set()   # dedup via set: `in st.services` is O(n) and a
+    for c in node.children:                # 10k-service stage paid O(n^2)
         if c.name == "service":
             sname = c.first_string()
             if not sname:
                 raise FlowError(f"stage {name!r}: service node requires a name")
-            if sname not in st.services:
+            if sname not in seen:
+                seen.add(sname)
                 st.services.append(sname)
             if c.children or c.props:
                 st.service_overrides[sname] = parse_service(c)
@@ -524,8 +526,10 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
             st = parse_stage(node)
             if st.name in flow.stages:
                 old = flow.stages[st.name]
+                have = set(old.services)   # O(n^2) scan at fleet scale
                 for sname in st.services:
-                    if sname not in old.services:
+                    if sname not in have:
+                        have.add(sname)
                         old.services.append(sname)
                 for sname, ov in st.service_overrides.items():
                     if sname in old.service_overrides:
