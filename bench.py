@@ -134,11 +134,17 @@ def main() -> None:
     # second chain only serializes more sweep work (chains=2/block=4:
     # 299 ms; 1/4: 202 ms; 1/2: 143+-3 ms over 3 runs with equal-or-better
     # soft 1.3528, 0 violations); proposals stay at the 64 knee (128: 191
-    # ms, 256: 311 ms, no fewer sweeps). TPU: 4 wide chains at the
-    # 256-proposal MXU knee (solver default) — hardware re-validation
-    # still pending TPU access.
+    # ms, 256: 311 ms, no fewer sweeps). TPU, measured r5 on the live
+    # tunnel (scripts/tpu_tune.py, median of 3 at 10k x 1k, all 0
+    # violations): chains=2 at the 256-proposal knee wins — 1/8/256:
+    # 133.1 ms, 2/8/256: 102.6 ms, 4/8/256: 123.9 ms, 8/8/256: 123.8 ms;
+    # narrower proposals lose soft for little speed (4/8/128: 108.9 ms @
+    # 1.4869, 4/8/64: 108.3 ms @ 1.4894 vs 1.4848); the matrix is partial
+    # (block axis + warm legs unmeasured — the tunnel hung mid-sweep on
+    # the 512-proposal leg, docs/profiles/r5-tpu-tune.md), so warm-path
+    # TPU constants still follow the cold pin.
     cpu = backend == "cpu"
-    chains = int(os.environ.get("BENCH_CHAINS", "1" if cpu else "4"))
+    chains = int(os.environ.get("BENCH_CHAINS", "1" if cpu else "2"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
     block = int(os.environ.get("BENCH_BLOCK", "2" if cpu else "8"))
