@@ -143,11 +143,18 @@ def main() -> None:
     # (block axis + warm legs unmeasured — the tunnel hung mid-sweep on
     # the 512-proposal leg, docs/profiles/r5-tpu-tune.md), so warm-path
     # TPU constants still follow the cold pin.
+    # Block=2 on BOTH backends since best-ever tracking (solver/anneal.py
+    # r5) decoupled block size from quality: the block is now purely the
+    # exit-check granularity, and the r5 TPU artifact shows the old
+    # current-state exit burning 12-14 warm sweeps on feasibility
+    # oscillation that seen-feasible tracking exits at the first feasible
+    # block boundary. TPU block=2 itself is a reasoned default awaiting
+    # tunnel confirmation (scripts/tpu_tune.py measures 2/4/8 first).
     cpu = backend == "cpu"
     chains = int(os.environ.get("BENCH_CHAINS", "1" if cpu else "2"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
-    block = int(os.environ.get("BENCH_BLOCK", "2" if cpu else "8"))
+    block = int(os.environ.get("BENCH_BLOCK", "2"))
     warm_block = int(os.environ.get("BENCH_WARM_BLOCK", "2"))
     proposals = int(os.environ.get("BENCH_PROPOSALS", "0")) or None
     # Warm reschedules start one churn event from feasible and are not

@@ -124,32 +124,44 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
     if sharding is not None:
         inits = jax.lax.with_sharding_constraint(inits, sharding)
     if adaptive:
-        states, sweeps_run = anneal_adaptive_states(
-            prob_a, inits, k_anneal, max_steps=steps, block=anneal_block,
-            t0=t0, t1=t1,
-            proposals_per_step=proposals_per_step)
+        # the adaptive anneal tracks each chain's best-ever state with its
+        # (violations, rank cost); chain ranking is feasibility-first —
+        # a cost argmin alone could prefer an infeasible chain whose
+        # warm-bonused soft undercuts W_HARD (aggregate bonus gap is
+        # unbounded in the fleet size)
+        best_assign_c, best_viol_c, best_cost_c, sweeps_run = \
+            anneal_adaptive_states(
+                prob_a, inits, k_anneal, max_steps=steps, block=anneal_block,
+                t0=t0, t1=t1,
+                proposals_per_step=proposals_per_step)
+        # exact lexicographic (violations, cost): among minimal-violation
+        # chains (0 when any chain saw feasibility), cheapest cost wins
+        min_viol = best_viol_c.min()
+        best = jnp.argmin(jnp.where(best_viol_c == min_viol,
+                                    best_cost_c, jnp.inf))
+        winner = best_assign_c[best]
     else:
         states = anneal_states(prob_a, inits, k_anneal, steps=steps,
                                t0=t0, t1=t1,
                                proposals_per_step=proposals_per_step)
         sweeps_run = jnp.int32(steps)
-    # rank + report from the CARRIED states: same exact numbers as the
-    # kernels.* functions, but elementwise reduces instead of (N, G)
-    # scatter rebuilds (~18 ms saved per evaluation at 10k x 1k)
-    viol = jax.vmap(lambda st: state_violation_stats(prob_a, st)["total"])(states)
-    soft_rank = jax.vmap(lambda st: state_soft_score(prob_a, st))(states)
-    costs = W_HARD * viol + soft_rank
-    best = jnp.argmin(costs)
-    best_state = jax.tree.map(lambda x: x[best], states)
+        # rank from the CARRIED states: same exact numbers as the
+        # kernels.* functions, but elementwise reduces instead of (N, G)
+        # scatter rebuilds (~18 ms saved per evaluation at 10k x 1k)
+        viol = jax.vmap(
+            lambda st: state_violation_stats(prob_a, st)["total"])(states)
+        soft_rank = jax.vmap(
+            lambda st: state_soft_score(prob_a, st))(states)
+        winner = states.assignment[jnp.argmin(W_HARD * viol + soft_rank)]
     # The WINNER's stats are recomputed with the exact from-scratch kernels
     # (one scatter rebuild, ~5 ms): the carried float32 load accumulates
     # .add(+d)/.add(-d) round-off over thousands of proposals, and the
     # feasibility gate that decides whether the host repair backstop runs
     # must not trust drifted state. Chain RANKING above stays carried-state
     # (cheap, and an argmin among near-equals tolerates drift).
-    stats = violation_stats(prob, best_state.assignment)
-    soft = soft_score(prob, best_state.assignment)
-    return best_state.assignment, stats, soft, sweeps_run
+    stats = violation_stats(prob, winner)
+    soft = soft_score(prob, winner)
+    return winner, stats, soft, sweeps_run
 
 
 def solve(pt: ProblemTensors, **kw) -> SolveResult:

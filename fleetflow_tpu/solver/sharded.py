@@ -163,11 +163,16 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
     adaptive early exit actually executed (== steps when adaptive=False),
     so artifacts can report effort, not just latency (VERDICT r4 weak #3).
 
-    `adaptive=True` runs in `block`-sweep chunks inside a lax.while_loop
-    and exits as soon as the placement is exactly feasible (same contract
-    as anneal.anneal_adaptive). The check is nearly free: load/used/topo
-    are replicated so capacity/conflict/skew violations are local math;
-    only the eligibility count needs one scalar psum per block.
+    The returned assignment is the lexicographically best (violations,
+    soft) state EVER VISITED, not the final Metropolis state (r5, same
+    monotonicity contract as anneal.anneal_adaptive): each sweep scores
+    the replicated state — capacity/conflict/skew violations and the
+    strategy/coloc soft terms are local math on the replicated node
+    state; the eligibility count and the two service-axis soft terms add
+    two scalar psums per sweep, noise next to the sweep's four (N,·)
+    state-delta psums. `adaptive=True` additionally runs in `block`-sweep
+    chunks inside a lax.while_loop and exits at the first block boundary
+    after any sweep visited a feasible state.
 
     `n_real` (static) marks rows >= n_real as pad_problem phantoms: they
     are excluded from topology counts, skew deltas, and the feasibility
@@ -261,8 +266,47 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             return (d_cap + d_conf + d_elig + d_skew
                     + (soft_after - soft_before) + d_pref + d_coloc)
 
+        def viol_total(assign, load, used, topo):
+            """Exact hard-violation total: local math on the replicated
+            node state + ONE scalar psum for the shard-local eligibility
+            count (phantoms are eligible everywhere so the `real` mask is
+            belt-and-braces)."""
+            inel = ((~eligible[jnp.arange(S_loc), assign]
+                     | ~node_valid[assign]) & real).sum()
+            inel = jax.lax.psum(inel, SVC_AXIS)
+            return violation_total_from_parts(prob, load, used, topo, inel)
+
+        def soft_here(assign, load, coloc):
+            """anneal.state_soft_score term for term from the replicated
+            node state; the two service-axis terms (preference gather,
+            strategy 2's index mean) psum their shard-local sums. Phantom
+            rows contribute like any row — fine for its only use, a
+            tie-break among equal-violation states."""
+            u = load / jnp.maximum(capacity, 1e-6)
+            usq = (u * u).sum()
+            denom = jnp.float32(max(N, 1))
+            s_denom = jnp.float32(max(S, 1))
+            if prob.strategy == 0:
+                strat = usq / denom
+            elif prob.strategy == 1:
+                strat = -usq / denom
+            else:
+                strat = jax.lax.psum(
+                    (assign.astype(jnp.float32) / denom).sum(),
+                    SVC_AXIS) / s_denom
+            pref = -jax.lax.psum(
+                preferred[jnp.arange(S_loc), assign].sum(),
+                SVC_AXIS) / s_denom
+            if prob.Gc > 0:
+                cc = coloc.astype(jnp.float32)
+                col = -(cc * (cc - 1.0) / 2.0).sum() / s_denom
+            else:
+                col = jnp.float32(0.0)
+            return strat + pref + col
+
         def sweep(carry, i):
-            assign, load, used, coloc, topo, key = carry
+            (assign, load, used, coloc, topo, key,
+             best_assign, best_viol, best_soft) = carry
             temp = t0 * decay ** i.astype(jnp.float32)
             key = jax.random.fold_in(key, i)
             kk = jax.random.fold_in(key, me)   # decorrelate shards
@@ -339,43 +383,56 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             tgt = jnp.where(applied, s_idx, S_loc)
             assign = jnp.zeros((S_loc + 1,), jnp.int32).at[:S_loc].set(
                 assign).at[tgt].set(b_idx.astype(jnp.int32))[:S_loc]
-            return (assign, load, used, coloc, topo, key), None
 
-        def feasible(assign, load, used, topo):
-            # eligibility is shard-local: one scalar psum (phantoms are
-            # eligible everywhere so the mask is belt-and-braces)
-            inel = ((~eligible[jnp.arange(S_loc), assign]
-                     | ~node_valid[assign]) & real).sum()
-            inel = jax.lax.psum(inel, SVC_AXIS)
-            return violation_total_from_parts(prob, load, used, topo,
-                                              inel) == 0
+            # Best-ever tracking, lexicographic (violations, soft) — the
+            # same monotonicity contract as the single-device anneal: a
+            # sweep budget that ENDS on an uphill Metropolis state must
+            # not discard a better state it walked through. Both scalars
+            # are replicated (psums), so the update is identical on every
+            # shard.
+            vt = viol_total(assign, load, used, topo)
+            sf = soft_here(assign, load, coloc)
+            better = (vt < best_viol) | ((vt == best_viol) & (sf < best_soft))
+            best_viol = jnp.where(better, vt, best_viol)
+            best_soft = jnp.where(better, sf, best_soft)
+            best_assign = jnp.where(better, assign, best_assign)
+            return (assign, load, used, coloc, topo, key,
+                    best_assign, best_viol, best_soft), None
+
+        viol0 = viol_total(assign, load0, used0, topo0)
+        soft0 = soft_here(assign, load0, coloc0)
+        carry0 = (assign, load0, used0, coloc0, topo0, key,
+                  assign, viol0, soft0)
 
         if not adaptive:
-            (assign, *_), _ = jax.lax.scan(
-                sweep, (assign, load0, used0, coloc0, topo0, key),
-                jnp.arange(steps, dtype=jnp.int32))
-            return assign, jnp.int32(steps)
+            (_a, _l, _u, _c, _t, _k, best_assign, _bv, _bs), _ = \
+                jax.lax.scan(sweep, carry0,
+                             jnp.arange(steps, dtype=jnp.int32))
+            return best_assign, jnp.int32(steps)
 
         n_blocks = -(-steps // block)
 
         def cond(carry):
-            _assign, _l, _u, _c, _t, _k, b, done = carry
+            *_rest, b, done = carry
             return (~done) & (b < n_blocks)
 
         def blk(carry):
-            assign, load, used, coloc, topo, key, b, _done = carry
+            (assign, load, used, coloc, topo, key,
+             best_assign, best_viol, best_soft, b, _done) = carry
             offsets = b * block + jnp.arange(block, dtype=jnp.int32)
             offsets = jnp.minimum(offsets, steps - 1)   # clamp temp schedule
-            (assign, load, used, coloc, topo, key), _ = jax.lax.scan(
-                sweep, (assign, load, used, coloc, topo, key), offsets)
-            return (assign, load, used, coloc, topo, key, b + 1,
-                    feasible(assign, load, used, topo))
+            (assign, load, used, coloc, topo, key,
+             best_assign, best_viol, best_soft), _ = jax.lax.scan(
+                sweep, (assign, load, used, coloc, topo, key,
+                        best_assign, best_viol, best_soft), offsets)
+            return (assign, load, used, coloc, topo, key,
+                    best_assign, best_viol, best_soft, b + 1,
+                    best_viol == 0)
 
-        assign, _l, _u, _c, _t, _k, b_run, _done = jax.lax.while_loop(
-            cond, blk,
-            (assign, load0, used0, coloc0, topo0, key,
-             jnp.int32(0), jnp.bool_(False)))
-        return assign, jnp.minimum(b_run * block, steps)
+        (_a, _l, _u, _c, _t, _k, best_assign, _bv, _bs, b_run,
+         _done) = jax.lax.while_loop(
+            cond, blk, carry0 + (jnp.int32(0), jnp.bool_(False)))
+        return best_assign, jnp.minimum(b_run * block, steps)
 
     sharded = shard_map(
         body, mesh=mesh,

@@ -370,3 +370,25 @@ class TestCarriedStateInvariants:
         r_adapt = solve(pt, chains=4, steps=128, seed=5, adaptive=True)
         assert r_fixed.feasible == r_adapt.feasible
         assert r_adapt.violations == 0
+
+    def test_best_ever_tracking_is_monotone_in_block(self):
+        """More annealing can only help (r5): the adaptive anneal returns
+        each chain's best-ever state, so a larger block — which runs MORE
+        sweeps past the first feasible point before its exit check — must
+        never return a worse placement than a smaller one. The sweep RNG
+        is folded by sweep index and the temperature schedule is fixed
+        against max_steps, so the block=8 run's visited states are a
+        superset of the block=2 run's; with both feasible, the returned
+        soft must be <=. Pre-fix the 8-sweep run RETURNED soft 1.3714
+        where the 2-sweep run returned 1.3390 on the 1k x 100 instance
+        (the final Metropolis state, not the best visited one)."""
+        pt = synthetic_problem(400, 40, seed=6, n_tenants=4,
+                               port_fraction=0.2)
+        r2 = solve(pt, chains=2, steps=32, seed=7, anneal_block=2)
+        r8 = solve(pt, chains=2, steps=32, seed=7, anneal_block=8)
+        assert r2.violations == 0 and r8.violations == 0
+        assert int(r8.steps) >= int(r2.steps)
+        # tolerance above float32 carried-state drift: winners are
+        # argmin'd on incrementally-accumulated costs while .soft is an
+        # exact recompute, so near-equal chains can invert by ~1e-5
+        assert r8.soft <= r2.soft + 5e-4, (r8.soft, r2.soft)
