@@ -37,7 +37,7 @@ class KdlError(ValueError):
         self.col = col
 
 
-@dataclass
+@dataclass(slots=True)
 class KdlNode:
     """A single KDL node: ``name arg1 arg2 key=value { children }``."""
 

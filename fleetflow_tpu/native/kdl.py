@@ -16,11 +16,12 @@ tests/test_native_kdl.py.
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Any, Optional
 
 import numpy as np
 
-from .lib import load
+from .lib import _REPO_NATIVE, load
 
 __all__ = ["native_parse_document", "kdl_native_available"]
 
@@ -71,6 +72,49 @@ def kdl_native_available() -> bool:
     return lib is not None and _configure(lib)
 
 
+# ---------------------------------------------------------------------------
+# C-level node assembly (native/kdlpy.cpp): same parser, but the KdlNode
+# tree is built by a CPython extension instead of the ctypes-array loop
+# below — the loop was ~290 ms of a 568 ms 10k-service parse (r5). The
+# extension is version-specific and optional: any import/build failure
+# degrades to the ctypes assembly, and FLEET_KDL_ASSEMBLY=ctypes forces
+# the fallback (the parity suite runs both).
+# ---------------------------------------------------------------------------
+
+_ext_mod = None
+_ext_tried = False
+
+
+def _load_ext():
+    global _ext_mod, _ext_tried
+    if _ext_mod is not None or _ext_tried:
+        return _ext_mod
+    _ext_tried = True
+    if os.environ.get("FLEET_KDL_ASSEMBLY", "").lower() in ("ctypes", "py"):
+        return None
+    # lib.load() runs the Makefile (which also builds the ABI-tagged
+    # extension) at most once per process; reuse it so both libraries
+    # share one build. The filename embeds THIS interpreter's EXT_SUFFIX,
+    # so a build from a different Python simply isn't found (clean
+    # degrade) instead of imported (undefined behavior).
+    load()
+    from .lib import ext_filename
+    path = _REPO_NATIVE / ext_filename()
+    if not path.is_file():
+        return None
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ffkdlpy", str(path))
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except (ImportError, OSError):
+        return None
+    _ext_mod = mod
+    return _ext_mod
+
+
 def _unicode_divergence_risk(text: str) -> bool:
     """True when the document could hit a known native/Python classification
     divergence, so the caller must take the Python path.
@@ -109,12 +153,20 @@ def native_parse_document(text: str) -> Optional[list]:
     """Parse KDL text natively; None => caller must use the Python parser
     (either unavailable, or the document needs Python semantics — including
     every parse-error path, so errors carry the canonical message)."""
-    lib = load()
-    if lib is None or not _configure(lib):
-        return None
     if not text.isascii() and _unicode_divergence_risk(text):
         return None
     from ..core.kdl import KdlNode
+
+    ext = _load_ext()
+    if ext is not None:
+        try:
+            return ext.parse_nodes(text, KdlNode)   # None on parse error
+        except Exception:
+            pass    # degrade to the ctypes assembly below
+
+    lib = load()
+    if lib is None or not _configure(lib):
+        return None
 
     raw = text.encode("utf-8", "surrogatepass")
     errbuf = ctypes.create_string_buffer(256)

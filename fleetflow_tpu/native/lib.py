@@ -22,33 +22,67 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def ext_filename() -> str:
+    """ABI-tagged extension filename for THIS interpreter (e.g.
+    ffkdlpy.cpython-312-x86_64-linux-gnu.so): a different interpreter
+    won't find a mismatched build instead of importing it and crashing."""
+    import sysconfig
+    return "ffkdlpy" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+
+
+def _ext_buildable() -> bool:
+    """Python headers present → the extension target can build here."""
+    import sysconfig
+    try:
+        return (Path(sysconfig.get_paths()["include"]) / "Python.h").is_file()
+    except (KeyError, OSError):
+        return False
+
+
+def _stale(target: Path, srcs: list[Path]) -> bool:
+    """True when target is missing or older than any of its sources."""
+    try:
+        if not target.is_file():
+            return True
+        newest = max(p.stat().st_mtime for p in srcs if p.is_file())
+        return target.stat().st_mtime < newest
+    except (OSError, ValueError):
+        return not target.is_file()
+
+
 def _build() -> Optional[Path]:
     target = _REPO_NATIVE / _LIB_NAME
-    # staleness check: a .so older than any source would silently run old
-    # native code after an edit (make would rebuild, but only if invoked —
-    # the library is gitignored and this loader is the path that decides)
-    try:
-        # sources only — make's rule depends on *.cpp, not the Makefile,
-        # so including it here would mark the lib stale forever without
-        # ever triggering a rebuild
-        srcs = list(_REPO_NATIVE.glob("*.cpp"))
-        newest_src = max(p.stat().st_mtime for p in srcs if p.is_file())
-        fresh = target.is_file() and target.stat().st_mtime >= newest_src
-    except (OSError, ValueError):
-        fresh = target.is_file()
-    if fresh:
+    # staleness check PER ARTIFACT: a .so older than any of ITS sources
+    # would silently run old native code after an edit (make would
+    # rebuild, but only if invoked — the libraries are gitignored and this
+    # loader is the path that decides). The ctypes lib and the extension
+    # have different source sets and the extension may be legitimately
+    # unbuildable (no Python headers) — it must not wedge the gate either
+    # way: never built when buildable would silently eat ~290 ms/parse,
+    # and a missing-headers machine must not re-spawn make every process.
+    lib_stale = _stale(target, [_REPO_NATIVE / "placer.cpp",
+                                _REPO_NATIVE / "kdl.cpp"])
+    ext_stale = _ext_buildable() and _stale(
+        _REPO_NATIVE / ext_filename(),
+        [_REPO_NATIVE / "kdlpy.cpp", _REPO_NATIVE / "kdl.cpp"])
+    if not lib_stale and not ext_stale:
         return target
     if (shutil.which(os.environ.get("CXX", "g++")) is None
             or shutil.which("make") is None):
         # a stale library beats none at all (ABI is append-only)
         return target if target.is_file() else None
     try:
-        # make's own mtime rule does the rebuild; a failed rebuild falls
+        # make's own mtime rules do the rebuild; a failed rebuild falls
         # back to whatever library exists (stale beats none) — but NOT
         # silently: a swallowed compile error would let parity tests
-        # green-light code that never compiled
-        proc = subprocess.run(["make", "-C", str(_REPO_NATIVE)],
-                              capture_output=True, text=True)
+        # green-light code that never compiled. PYEXT/PYINC come from the
+        # RUNNING interpreter, not PATH python3, so the built extension
+        # matches the ABI that will import it.
+        import sysconfig
+        args = ["make", "-C", str(_REPO_NATIVE),
+                f"PYEXT={ext_filename()}",
+                f"PYINC={sysconfig.get_paths()['include']}"]
+        proc = subprocess.run(args, capture_output=True, text=True)
         if proc.returncode != 0:
             import warnings
             warnings.warn(

@@ -93,18 +93,27 @@ def prepare_problem(pt: ProblemTensors,
     # The two dense (S, N) planes dominate staging bytes (50 MB at 10k x 1k)
     # and the degenerate cases are common: no placement preferences -> an
     # all-zero `preferred`, no eligibility restrictions -> an all-True
-    # `eligible`.  Materialize those as on-device XLA fills instead of
-    # host->device uploads — over the axon tunnel (~12 MB/s measured r5)
-    # uploading constant planes is seconds of pure waste per staging.
+    # `eligible`.  On accelerators, materialize those as on-device XLA
+    # fills instead of host->device uploads — over the axon tunnel
+    # (~12 MB/s measured r5) uploading constant planes is seconds of pure
+    # waste per staging.  On CPU the "upload" is a memcpy (~10 ms) while
+    # the fill pays a shape-specific compile (~70 ms measured in the
+    # pipeline leg), so the fills are accelerator-only.
+    # keyed on the platform the arrays actually land on — an explicit
+    # `device` can differ from the default backend in either direction
+    use_fills = (device.platform if device is not None
+                 else jax.default_backend()) != "cpu"
     fill_ctx = (jax.default_device(device) if device is not None
                 else contextlib.nullcontext())
     with fill_ctx:
         if pt.preferred is None:
-            preferred_arr = jnp.zeros((pt.S, pt.N), dtype=jnp.float32)
+            preferred_arr = (jnp.zeros((pt.S, pt.N), dtype=jnp.float32)
+                             if use_fills else
+                             put(np.zeros((pt.S, pt.N), dtype=np.float32)))
         else:
             preferred_arr = put(jnp.asarray(pt.preferred, dtype=jnp.float32))
         eligible_np = np.asarray(pt.eligible)
-        if eligible_np.all():
+        if use_fills and eligible_np.all():
             eligible_arr = jnp.ones((pt.S, pt.N), dtype=bool)
         else:
             eligible_arr = put(jnp.asarray(pt.eligible))

@@ -296,3 +296,53 @@ def test_loader_path_uses_wired_parser(tmp_path, monkeypatch):
     flow_py = load_project_from_root_with_stage(str(tmp_path))
     assert flow_native.services.keys() == flow_py.services.keys()
     assert flow_native.name == flow_py.name
+
+
+# -- assembly-path coverage (r5: C-extension node assembly) -----------------
+# native_parse_document prefers the ffkdlpy extension and silently degrades
+# to the ctypes-array assembly; both must stay parity-clean, and a build
+# regression in the extension must be loud, not a silent slowdown.
+
+def _reset_ext(monkeypatch):
+    import fleetflow_tpu.native.kdl as nk
+    monkeypatch.setattr(nk, "_ext_mod", None)
+    monkeypatch.setattr(nk, "_ext_tried", False)
+    return nk
+
+
+def test_extension_assembly_loads(monkeypatch):
+    import sysconfig
+    if not os.path.isfile(os.path.join(sysconfig.get_paths()["include"],
+                                       "Python.h")):
+        pytest.skip("no Python headers; extension cannot build here")
+    nk = _reset_ext(monkeypatch)
+    monkeypatch.delenv("FLEET_KDL_ASSEMBLY", raising=False)
+    assert nk._load_ext() is not None
+
+
+def test_ctypes_assembly_still_parity_clean(monkeypatch):
+    """FLEET_KDL_ASSEMBLY=ctypes must bypass the extension and keep the
+    ctypes-array assembly parity-clean over the whole valid corpus (it is
+    the fallback for machines without Python headers)."""
+    nk = _reset_ext(monkeypatch)
+    monkeypatch.setenv("FLEET_KDL_ASSEMBLY", "ctypes")
+    assert nk._load_ext() is None
+    for text in VALID_CORPUS:
+        native = nk.native_parse_document(text)
+        if native is None:
+            continue
+        assert tree(native) == tree(python_parse(text)), text
+
+
+def test_extension_empty_string_offset_collision(monkeypatch):
+    """The arena gives the empty string the same offset as the next pooled
+    string; the extension's cache must key on (offset, length) — caught
+    live by test_fuzz_parity on '""node'."""
+    nk = _reset_ext(monkeypatch)
+    monkeypatch.delenv("FLEET_KDL_ASSEMBLY", raising=False)
+    if nk._load_ext() is None:
+        pytest.skip("extension not available")
+    text = '""node "" x=""\nnode ""'
+    native = nk.native_parse_document(text)
+    assert native is not None
+    assert tree(native) == tree(python_parse(text))
