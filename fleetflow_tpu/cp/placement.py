@@ -73,7 +73,7 @@ def _server_to_resource(s: Server) -> ServerResource:
 
 class PlacementService:
     def __init__(self, store: Store, *, use_tpu: bool = False,
-                 chains: int = 4, steps: int = 128):
+                 chains=None, steps: int = 128):
         self.store = store
         self.use_tpu = use_tpu
         self._sched_tpu = TpuSolverScheduler(chains=chains, steps=steps)

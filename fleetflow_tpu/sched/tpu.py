@@ -21,8 +21,10 @@ __all__ = ["TpuSolverScheduler"]
 
 
 class TpuSolverScheduler:
-    def __init__(self, *, chains: int = 8, steps: int = 128, seed: int = 0,
+    def __init__(self, *, chains=None, steps: int = 128, seed: int = 0,
                  mesh=None):
+        # chains=None defers to the solver's backend-aware default
+        # (1 on CPU, 2 on accelerators — measured r4/r5)
         self.chains = chains
         self.steps = steps
         self.seed = seed

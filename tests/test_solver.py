@@ -170,12 +170,13 @@ class TestSolve:
         assert not (res2.assignment == dead).any()
         moved = (res2.assignment != res.assignment).mean()
         assert moved < 0.6  # warm start keeps most placements
-        # warm path checks the adaptive exit every warm_block sweeps, so it
-        # stops at the first even sweep count that reaches feasibility
-        # (13/100 services displaced here needs ~6; large fleets with
-        # proportionally smaller churn exit in 2-4, see bench reschedule)
-        assert res2.steps <= 8, res2.steps
-        assert res2.steps % 2 == 0  # exited on a warm_block boundary
+        # warm path checks the adaptive exit every warm_block sweeps
+        # (default 1 since r5's best-ever tracking made the block purely a
+        # latency knob), so it stops at the first sweep that has SEEN
+        # feasibility — a handful here (13/100 services displaced; large
+        # fleets with proportionally smaller churn exit in 1-2, see bench
+        # reschedule)
+        assert 1 <= res2.steps <= 8, res2.steps
 
     def test_warm_block_exits_earlier_than_cold_block(self):
         pt = synthetic_problem(100, 10, seed=3)
