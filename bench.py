@@ -558,6 +558,12 @@ def _sharded_child() -> None:
 
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (997, 100) if small else (9997, 1000)   # ragged: forces padding
+    # explicit shape override, e.g. BENCH_SHARDED_SHAPE=29997x3000 for the
+    # XL runs (docs/profiles/r5-xl-sharded.md) — keeps raggedness the
+    # caller's choice
+    shape = os.environ.get("BENCH_SHARDED_SHAPE", "")
+    if shape:
+        S, N = (int(x) for x in shape.lower().split("x"))
     steps = int(os.environ.get("BENCH_SHARDED_STEPS", "64"))
     block = int(os.environ.get("BENCH_SHARDED_BLOCK", "4"))
     D = 8

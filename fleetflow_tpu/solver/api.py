@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .anneal import (anneal_adaptive_states, anneal_states,
                      state_soft_score, state_violation_stats)
 from .greedy import greedy_place, greedy_place_batched, placement_order
-from .kernels import W_HARD, soft_score, total_cost, violation_stats
+from .kernels import W_HARD, soft_score, violation_stats
 from .problem import DeviceProblem, prepare_problem
 from .repair import RepairResult, repair, verify
 from ..lower.tensors import ProblemTensors
