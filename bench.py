@@ -577,7 +577,18 @@ def _sharded_child() -> None:
 
     from fleetflow_tpu.native.lib import available_nobuild
     t_seed = time.perf_counter()
-    if available_nobuild():
+    # past ~50k services the exact whole-instance FFD dominates the solve
+    # (108.9 s at 100k x 10k, docs/profiles/r5-xl-sharded.md): partition
+    # the service axis and FFD each slice against capacity/parts, letting
+    # the anneal repair the few cross-slice conflicts. BENCH_SHARDED_SEED
+    # = whole|partitioned overrides the size heuristic.
+    seed_mode = os.environ.get("BENCH_SHARDED_SEED", "")
+    partitioned = (seed_mode == "partitioned"
+                   or (seed_mode != "whole" and S >= 50_000))
+    if partitioned:
+        from fleetflow_tpu.solver.greedy import partitioned_seed
+        seed = partitioned_seed(pt, D)
+    elif available_nobuild():
         from fleetflow_tpu.native.lib import native_place
         seed, _ = native_place(pt.demand, pt.capacity, pt.eligible,
                                pt.node_valid, pt.dep_depth, pt.port_ids,
@@ -624,6 +635,7 @@ def _sharded_child() -> None:
         "backend": jax.default_backend(),
         "padded_s": int(padded.S),
         "seed_ms": round(seed_ms, 1),
+        "seed_mode": "partitioned" if partitioned else "whole",
         "sharded_solve_ms": round(seed_ms + anneal_ms, 1),
         "anneal_ms": round(anneal_ms, 1),
         "compile_s": round(compile_s, 1),
