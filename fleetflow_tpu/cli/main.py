@@ -948,6 +948,33 @@ def _cp_dispatch(cp: CpClient, args) -> int:
                                     "name": _need(args.name, "--name")}))
         if args.verb == "sync":
             return show(cp.request("dns", "sync", {}))
+    if sub == "placement":
+        if args.verb == "state":
+            return show(cp.request("placement", "reservations", {}))
+        if args.verb == "explain":
+            out = cp.request("placement", "explain",
+                             {"stage": _need(args.stage, "--stage"),
+                              "service": _need(args.service, "--service")})
+            ch = out["chosen"]
+            rank = (f"rank {out['chosen_rank']}" if out["chosen_rank"]
+                    else "NOT FEASIBLE on its node")
+            print(f"{out['service']} -> {ch['node']} "
+                  f"({rank} of "
+                  f"{out['blocked_counts']['feasible']} feasible / "
+                  f"{out['blocked_counts']['total_nodes']} nodes, "
+                  f"strategy {out['strategy']})")
+            print(f"  score {ch['score']}  strategy_term "
+                  f"{ch['strategy_term']}  preference {ch['preference']}  "
+                  f"coloc_mates {ch['coloc_mates']}")
+            bc = out["blocked_counts"]
+            print(f"  blocked: {bc['ineligible']} ineligible, "
+                  f"{bc['invalid']} offline, {bc['capacity']} full, "
+                  f"{bc['conflicts']} conflicting")
+            for alt in out["alternatives"]:
+                print(f"  alt {alt['node']}: score {alt['score']} "
+                      f"(pref {alt['preference']}, "
+                      f"coloc {alt['coloc_mates']})")
+            return 0
     if sub == "volume":
         if args.verb == "list":
             return show(cp.request("volume", "list", {})["volumes"])
@@ -1292,6 +1319,11 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--ref", default="main")
     q.add_argument("--push", action="store_true")
     q.add_argument("name", nargs="?")
+
+    q = cps.add_parser("placement")
+    q.add_argument("verb", choices=["state", "explain"])
+    q.add_argument("--stage", help="stage key <flow>/<stage> (explain)")
+    q.add_argument("--service", help="service row name (explain)")
 
     q = cps.add_parser("remote")
     q.add_argument("verb", choices=["deploy", "history"])

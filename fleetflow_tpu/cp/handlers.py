@@ -840,6 +840,17 @@ def _placement(state: "AppState"):
             return {"ok": state.placement.commit(p.get("reservation", ""))}
         if method == "release":
             return {"ok": state.placement.release(p.get("reservation", ""))}
+        if method == "explain":
+            # why is this service on its node (solver/explain.py): answered
+            # from the retained instance, but the lock may be held by a
+            # fleet-scale solve — same off-loop rule
+            stage, service = _require(p, "stage", "service")
+            try:
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: state.placement.explain(
+                        stage, service, top_k=int(p.get("top_k", 5))))
+            except KeyError as e:
+                raise ValueError(str(e)) from None
         if method == "reservations":
             # executor: the snapshot takes the PlacementService lock, which
             # a fleet-scale solve can hold for its full duration — same

@@ -322,6 +322,32 @@ class PlacementService:
             return {"stages": self._snapshot_locked(),
                     "reservations": self._reservations_locked()}
 
+    def explain(self, stage_key: str, service: str, top_k: int = 5) -> dict:
+        """Why is `service` where it is in `stage_key`'s latest placement?
+        Per-node hard/soft breakdown from the retained (pt, placement) —
+        solver/explain.py — answered from memory, no re-solve. Raises
+        KeyError for an unknown stage or service."""
+        from ..solver.explain import explain_assignment
+
+        with self._lock:
+            entry = self._last.get(stage_key)
+            if entry is None:
+                raise KeyError(
+                    f"no retained placement for stage {stage_key!r}; "
+                    f"known: {sorted(self._last)}")
+            pt, placement = entry
+            if placement.raw is not None:
+                assignment = np.asarray(placement.raw)
+            else:
+                node_idx = {n: j for j, n in enumerate(pt.node_names)}
+                assignment = np.array(
+                    [node_idx[placement.assignment[nm]]
+                     for nm in pt.service_names], dtype=np.int64)
+            out = explain_assignment(pt, assignment, service, top_k=top_k)
+            out["stage"] = stage_key
+            out["source"] = placement.source
+            return out
+
     # ------------------------------------------------------------------
     # streaming re-solve (BASELINE config 5)
     # ------------------------------------------------------------------

@@ -655,6 +655,31 @@ class WebServer:
                     None, state.placement.placement_state)
             return go()
 
+        @self.route("GET", "/api/placement/explain")
+        def placement_explain(body, query):
+            # why is ?service= on its node in ?stage=<flow/stage>'s latest
+            # placement (solver/explain.py): per-node hard/soft breakdown,
+            # top alternatives, blocked-node counts. Answered from the
+            # retained instance — no re-solve, but same executor rule: the
+            # PlacementService lock may be held by a fleet-scale solve.
+            stage = (query.get("stage") or "").strip()
+            service = (query.get("service") or "").strip()
+            if not stage or not service:
+                return 400, {"error": "stage and service query params required"}
+            try:
+                top_k = int(query.get("top_k", "5"))
+            except ValueError:
+                return 400, {"error": "top_k must be an integer"}
+
+            async def go():
+                try:
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None, lambda: state.placement.explain(
+                            stage, service, top_k=top_k))
+                except KeyError as e:
+                    return 404, {"error": str(e)}
+            return go()
+
 
 _DASHBOARD_HTML = """<!doctype html>
 <html><head><meta charset="utf-8"><title>fleetflow-tpu</title>

@@ -394,6 +394,17 @@ class FleetMcpServer:
                                        {"flow": flow_to_dict(flow),
                                         "stage": stage}))
 
+    @_tool("cp_placement_explain", "Why is a service on its node: per-node "
+           "hard/soft breakdown of the stage's latest placement",
+           {"type": "object", "properties": {
+               "stage": {"type": "string",
+                         "description": "stage key, <flow>/<stage>"},
+               "service": {"type": "string"}},
+            "required": ["stage", "service"]})
+    def cp_placement_explain(self, stage: str, service: str) -> dict:
+        return _text(self.cp().request("placement", "explain",
+                                       {"stage": stage, "service": service}))
+
     @_tool("cp_redeploy", "Redeploy a stage through the control plane",
            {"type": "object", "properties": {"stage": {"type": "string"}},
             "required": ["stage"]})

@@ -1,0 +1,167 @@
+"""Placement explanation: why is service X on node Y, and what else was
+possible?
+
+Operator-facing debugging the reference has no analog for (its placer is
+an opaque dependency partition, engine.rs:67-85): given the lowered
+instance and an assignment, break one service's placement down into the
+solver's own terms — hard feasibility per node (eligibility, validity,
+capacity fit, conflict-group occupancy) and the soft components the
+anneal trades (strategy utilization delta, preference, colocation mates)
+— mirroring anneal._proposal_delta term for term, but on the host in
+numpy over one (1, N) slice, so an explain costs microseconds and needs
+no device.
+
+Surfaced as PlacementService.explain -> REST
+GET /api/placement/explain?stage=&service= -> MCP cp_placement_explain
+-> CLI `fleet cp placement explain`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..lower.tensors import ProblemTensors
+
+__all__ = ["explain_assignment"]
+
+
+def _group_occupancy(ids: np.ndarray, assignment: np.ndarray, N: int,
+                     exclude_row: int) -> np.ndarray:
+    """(N, G) occupancy counts of every conflict id, with `exclude_row`'s
+    own memberships removed (a service never conflicts with itself)."""
+    if ids.size == 0:
+        return np.zeros((N, 1), dtype=np.int64)
+    G = int(ids.max(initial=-1)) + 1
+    if G <= 0:
+        return np.zeros((N, 1), dtype=np.int64)
+    occ = np.zeros((N, G), dtype=np.int64)
+    valid = ids >= 0
+    rows = np.broadcast_to(assignment[:, None], ids.shape)[valid]
+    np.add.at(occ, (rows, ids[valid]), 1)
+    own = ids[exclude_row]
+    own = own[own >= 0]
+    occ[assignment[exclude_row], own] -= 1
+    return occ
+
+
+def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
+                       service: str, top_k: int = 5,
+                       node_valid: Optional[np.ndarray] = None) -> dict:
+    """Explain one service row's placement. Returns a JSON-ready dict:
+    the chosen node's full breakdown, the top_k best alternatives by the
+    same scoring, and per-category counts of hard-blocked nodes."""
+    assignment = np.asarray(assignment)
+    try:
+        i = pt.service_names.index(service)
+    except ValueError:
+        raise KeyError(f"unknown service {service!r}; rows are "
+                       f"{pt.service_names[:8]}...") from None
+    N = pt.capacity.shape[0]
+    valid = (np.asarray(node_valid) if node_valid is not None
+             else pt.node_valid).astype(bool)
+    d = pt.demand[i]                                     # (R,)
+
+    # node load WITHOUT this service
+    load = np.zeros_like(pt.capacity)
+    np.add.at(load, assignment, pt.demand)
+    load[assignment[i]] -= d
+
+    new_load = load + d[None, :]                          # (N, R)
+    fits = (new_load <= pt.capacity + 1e-6).all(axis=1)
+    eligible = pt.eligible[i].astype(bool)
+
+    # conflict occupancy per family, self-excluded
+    conflict_hits = np.zeros(N, dtype=np.int64)
+    families = {}
+    for fam, ids in (("ports", pt.port_ids), ("volumes", pt.volume_ids),
+                     ("anti_affinity", pt.anti_ids)):
+        own = ids[i][ids[i] >= 0] if ids.size else np.empty(0, np.int64)
+        if own.size == 0:
+            families[fam] = np.zeros(N, dtype=np.int64)
+            continue
+        occ = _group_occupancy(ids, assignment, N, i)
+        hits = occ[:, own].sum(axis=1)
+        families[fam] = hits
+        conflict_hits += hits
+    conflict_free = conflict_hits == 0
+
+    # soft components (kernels.soft_score orientation: lower = better)
+    # Every term carries the SAME scale it has in kernels.soft_score's
+    # per-service delta, so the ranking here reproduces the solver's own
+    # trade-offs: preference and colocation enter the objective as means
+    # over S (one service's contribution is -pref/S, -mates/S), and
+    # fill_lowest as (n/N)/S — an unscaled -pref here would overweight
+    # preference by a factor of S and misreport the solver's optimal
+    # choice as suboptimal.
+    S_total = max(pt.demand.shape[0], 1)
+    cap_safe = np.maximum(pt.capacity, 1e-6)
+    u_before = load / cap_safe
+    u_after = new_load / cap_safe
+    d_usq = ((u_after * u_after).sum(axis=1)
+             - (u_before * u_before).sum(axis=1)) / max(N, 1)
+    strat = pt.strategy.value
+    if strat == "pack_into_dedicated":
+        strategy_term = -d_usq
+    elif strat == "fill_lowest":
+        strategy_term = (np.arange(N, dtype=np.float64)
+                         / max(N, 1)) / S_total
+    else:                       # spread_across_pool
+        strategy_term = d_usq
+    pref = (pt.preferred[i] if pt.preferred is not None
+            else np.zeros(N, dtype=np.float32))
+    # colocation mates already on each node (soft bonus per mate)
+    if pt.coloc_ids.size and (pt.coloc_ids[i] >= 0).any():
+        cocc = _group_occupancy(pt.coloc_ids, assignment, N, i)
+        own_c = pt.coloc_ids[i][pt.coloc_ids[i] >= 0]
+        coloc_mates = cocc[:, own_c].sum(axis=1)
+    else:
+        coloc_mates = np.zeros(N, dtype=np.int64)
+
+    score = (strategy_term - pref / S_total - coloc_mates / S_total)
+    ok = eligible & valid & fits & conflict_free
+
+    def node_row(n: int) -> dict:
+        return {
+            "node": pt.node_names[n],
+            "feasible": bool(ok[n]),
+            "eligible": bool(eligible[n]),
+            "valid": bool(valid[n]),
+            "fits_capacity": bool(fits[n]),
+            "conflicts": {fam: int(families[fam][n]) for fam in families},
+            "strategy_term": round(float(strategy_term[n]), 6),
+            "preference": round(float(pref[n]), 6),
+            "coloc_mates": int(coloc_mates[n]),
+            "score": round(float(score[n]), 6),
+            "utilization_after": [round(float(x), 4) for x in u_after[n]],
+        }
+
+    chosen = int(assignment[i])
+    order = np.argsort(np.where(ok, score, np.inf), kind="stable")
+    alternatives = [node_row(int(n)) for n in order[:top_k]
+                    if ok[n] and int(n) != chosen]
+    # a degraded placement (e.g. the node died and the re-solve is still
+    # infeasible) can leave the service on an infeasible node: a "rank"
+    # among np.inf ties would be an index-order artifact, not a position
+    chosen_rank = (int(np.nonzero(order == chosen)[0][0]) + 1
+                   if ok[chosen] else None)
+    return {
+        "service": service,
+        "row": i,
+        "replica_of": (pt.replica_of[i] if pt.replica_of else service),
+        "demand": [round(float(x), 4) for x in d],
+        "strategy": strat,
+        "chosen": node_row(chosen),
+        "chosen_rank": chosen_rank,
+        "alternatives": alternatives[: max(top_k - 1, 0)],
+        "blocked_counts": {
+            "ineligible": int((~eligible).sum()),
+            "invalid": int((~valid).sum()),
+            "capacity": int((eligible & valid & ~fits).sum()),
+            "conflicts": int((eligible & valid & fits
+                              & ~conflict_free).sum()),
+            "feasible": int(ok.sum()),
+            "total_nodes": N,
+        },
+    }

@@ -392,3 +392,56 @@ class TestMcpCostTools:
         doc = json.loads(resp["result"]["content"][0]["text"])
         assert doc["entries"][0]["amount"] == 42.5
         assert calls[1][1] == "list"
+
+
+class TestCliPlacementExplain:
+    def _payload(self, rank):
+        node = {"node": "n1", "feasible": rank is not None,
+                "eligible": True, "valid": True, "fits_capacity": True,
+                "conflicts": {"ports": 0, "volumes": 0,
+                              "anti_affinity": 0},
+                "strategy_term": 0.001, "preference": 0.0,
+                "coloc_mates": 0, "score": 0.001,
+                "utilization_after": [0.2, 0.1, 0.0]}
+        return {"service": "api", "row": 1, "replica_of": "api",
+                "demand": [1, 64, 1], "strategy": "spread_across_pool",
+                "chosen": node, "chosen_rank": rank,
+                "alternatives": [dict(node, node="n2", score=0.002)],
+                "blocked_counts": {"ineligible": 0, "invalid": 1,
+                                   "capacity": 0, "conflicts": 0,
+                                   "feasible": 2, "total_nodes": 3}}
+
+    def _run(self, monkeypatch, capsys, rank):
+        import importlib
+        cli = importlib.import_module("fleetflow_tpu.cli.main")
+        payload = self._payload(rank)
+
+        class FakeCp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def request(self, channel, method, p=None, timeout=60.0):
+                assert (channel, method) == ("placement", "explain")
+                assert p == {"stage": "shop/live", "service": "api"}
+                return payload
+
+        monkeypatch.setattr(cli, "CpClient", lambda endpoint=None: FakeCp())
+        rc = cli.main(["cp", "placement", "explain",
+                       "--stage", "shop/live", "--service", "api"])
+        out = capsys.readouterr().out
+        return rc, out
+
+    def test_explain_prints_rank_and_blockers(self, monkeypatch, capsys):
+        rc, out = self._run(monkeypatch, capsys, rank=1)
+        assert rc == 0
+        assert "api -> n1 (rank 1 of 2 feasible / 3 nodes" in out
+        assert "1 offline" in out
+        assert "alt n2" in out
+
+    def test_explain_flags_infeasible_placement(self, monkeypatch, capsys):
+        rc, out = self._run(monkeypatch, capsys, rank=None)
+        assert rc == 0
+        assert "NOT FEASIBLE on its node" in out

@@ -451,6 +451,33 @@ class TestPlacementChannel:
             await handle.stop()
         run(go())
 
+    def test_explain_over_the_wire(self, project):
+        # r5: placement.explain answers from the retained instance; the
+        # wire face must return the chosen node consistent with the solve
+        # and refuse unknown stages with an error, not a hang
+        async def go():
+            flow = _load_flow(project)
+            handle = await start_cp()
+            agent = await FakeAgent("node-1").connect(handle)  # noqa: F841
+            conn, _ = await connect(handle)
+            from fleetflow_tpu.core.serialize import flow_to_dict
+            out = await conn.request("placement", "solve",
+                                     {"flow": flow_to_dict(flow),
+                                      "stage": "local"})
+            assert out["feasible"]
+            exp = await conn.request("placement", "explain",
+                                     {"stage": f"{flow.name}/local",
+                                      "service": "app"})
+            assert exp["chosen"]["node"] == out["assignment"]["app"]
+            assert exp["chosen"]["feasible"]
+            with pytest.raises(Exception):
+                await conn.request("placement", "explain",
+                                   {"stage": "ghost/live",
+                                    "service": "app"})
+            await conn.close()
+            await handle.stop()
+        run(go())
+
     def test_reservation_two_phase(self, project):
         async def go():
             flow = _load_flow(project)

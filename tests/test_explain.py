@@ -1,0 +1,163 @@
+"""Placement explain (r5): why is service X on node Y.
+
+solver/explain.py unit contract + PlacementService.explain over the
+retained instance (the REST/MCP/CLI faces are thin wrappers over these
+two layers)."""
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core.parser import parse_kdl_string
+from fleetflow_tpu.cp.models import Server, ServerCapacity
+from fleetflow_tpu.cp.placement import PlacementService
+from fleetflow_tpu.cp.store import Store
+from fleetflow_tpu.lower import synthetic_problem
+from fleetflow_tpu.solver import solve
+from fleetflow_tpu.solver.explain import explain_assignment
+
+
+class TestExplainAssignment:
+    def test_chosen_is_feasible_and_consistent(self):
+        pt = synthetic_problem(120, 10, seed=7, n_tenants=2,
+                               port_fraction=0.3, volume_fraction=0.1)
+        res = solve(pt, steps=128, seed=7)
+        assert res.feasible
+        for name in pt.service_names[:10]:
+            out = explain_assignment(pt, res.assignment, name)
+            ch = out["chosen"]
+            # the solver's winner must pass the explainer's own hard gates
+            assert ch["feasible"], (name, ch)
+            assert ch["node"] == pt.node_names[res.assignment[
+                pt.service_names.index(name)]]
+            bc = out["blocked_counts"]
+            assert bc["feasible"] >= 1
+            assert bc["total_nodes"] == pt.N
+            # alternatives are feasible, distinct from chosen, and no
+            # better-scored feasible node was hidden below the chosen rank
+            for alt in out["alternatives"]:
+                assert alt["feasible"] and alt["node"] != ch["node"]
+            assert 1 <= out["chosen_rank"] <= bc["feasible"]
+
+    def test_conflict_counting_excludes_self(self):
+        # two services sharing a host port on separate nodes: each must
+        # see ONE conflicting node (the other's), never its own
+        pt = synthetic_problem(40, 6, seed=9, port_fraction=0.5)
+        res = solve(pt, steps=128, seed=9)
+        assert res.feasible
+        port_rows = np.flatnonzero((pt.port_ids >= 0).any(axis=1))[:5]
+        for i in port_rows:
+            out = explain_assignment(pt, res.assignment,
+                                     pt.service_names[i])
+            assert out["chosen"]["conflicts"]["ports"] == 0  # feasible => 0
+    
+    def test_unknown_service_raises(self):
+        pt = synthetic_problem(10, 3, seed=1)
+        res = solve(pt, steps=32, seed=1)
+        with pytest.raises(KeyError):
+            explain_assignment(pt, res.assignment, "nope")
+
+
+class TestPlacementServiceExplain:
+    CAP = {"cpu": 4.0, "memory": 8192.0, "disk": 99999.0}
+
+    def _flow(self):
+        servers = "\n".join(
+            f'server "{s}" {{ capacity {{ cpu 4; memory 8192; '
+            f'disk 99999 }} }}' for s in ("n0", "n1", "n2"))
+        return parse_kdl_string(f"""
+project "shop"
+{servers}
+service "db" {{ image "postgres"; resources {{ cpu 1; memory 256; disk 1 }} }}
+service "api" {{ image "api"; depends_on "db"; resources {{ cpu 1; memory 128; disk 1 }} }}
+stage "live" {{
+    service "db"
+    service "api"
+    servers "n0" "n1" "n2"
+    placement {{ strategy "spread_across_pool" }}
+}}
+""")
+
+    def _service(self):
+        store = Store()
+        for slug in ("n0", "n1", "n2"):
+            store.create("servers", Server(
+                slug=slug, status="online", tenant="default",
+                capacity=ServerCapacity(**self.CAP)))
+        return PlacementService(store)
+
+    def test_explain_after_solve(self):
+        svc = self._service()
+        pl, _rid = svc.solve_stage(self._flow(), "live")
+        assert pl.feasible
+        out = svc.explain("shop/live", "api")
+        assert out["stage"] == "shop/live"
+        assert out["chosen"]["node"] == pl.assignment["api"]
+        assert out["chosen"]["feasible"]
+        assert out["blocked_counts"]["total_nodes"] == 3
+
+    def test_explain_unknown_stage_and_service(self):
+        svc = self._service()
+        with pytest.raises(KeyError):
+            svc.explain("nope/live", "api")
+        pl, _ = svc.solve_stage(self._flow(), "live")
+        with pytest.raises(KeyError):
+            svc.explain("shop/live", "ghost")
+
+
+class TestScoreParityWithObjective:
+    def test_score_delta_matches_kernels_soft_score(self):
+        """The explainer's per-node score must carry the solver's exact
+        scales: moving service i from node a to node b changes
+        kernels.soft_score by score[b] - score[a] (caught r5: an unscaled
+        preference term overweighted it by a factor of S)."""
+        import jax.numpy as jnp
+
+        from fleetflow_tpu.solver import prepare_problem
+        from fleetflow_tpu.solver.kernels import soft_score
+
+        rng = np.random.default_rng(4)
+        pt = synthetic_problem(60, 8, seed=4, n_tenants=2,
+                               port_fraction=0.2, volume_fraction=0.1)
+        # give the instance a non-trivial preference plane
+        pt = pt.__class__(**{**pt.__dict__,
+                             "preferred": rng.uniform(
+                                 0, 1, (pt.S, pt.N)).astype(np.float32)})
+        res = solve(pt, steps=128, seed=4)
+        assert res.feasible
+        prob = prepare_problem(pt)
+        for name in pt.service_names[:6]:
+            i = pt.service_names.index(name)
+            out = explain_assignment(pt, res.assignment, name)
+            rows = {r["node"]: r for r in
+                    [out["chosen"]] + out["alternatives"]}
+            a = res.assignment[i]
+            base = float(soft_score(prob, jnp.asarray(res.assignment)))
+            for node_name, row in rows.items():
+                b = pt.node_names.index(node_name)
+                if b == a:
+                    continue
+                alt_assign = res.assignment.copy()
+                alt_assign[i] = b
+                moved = float(soft_score(prob, jnp.asarray(alt_assign)))
+                want = moved - base
+                got = row["score"] - out["chosen"]["score"]
+                assert got == pytest.approx(want, abs=2e-3), \
+                    (name, node_name, got, want)
+
+    def test_infeasible_chosen_has_no_rank(self):
+        import dataclasses
+        pt = synthetic_problem(30, 5, seed=6)
+        res = solve(pt, steps=64, seed=6)
+        assert res.feasible
+        i = 0
+        dead = int(res.assignment[i])
+        valid = pt.node_valid.copy()
+        valid[dead] = False
+        pt2 = dataclasses.replace(pt, node_valid=valid)
+        # explain the OLD assignment against the post-churn mask: the
+        # service sits on a dead node, so rank must be None, not an
+        # index-order artifact among inf ties
+        out = explain_assignment(pt2, res.assignment,
+                                 pt.service_names[i])
+        assert out["chosen"]["feasible"] is False
+        assert out["chosen_rank"] is None
