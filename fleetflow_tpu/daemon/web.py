@@ -792,6 +792,25 @@ function table(heads,rows){return '<table><tr>'+heads.map(h=>`<th>${esc(h)}</th>
  .join('')+'</table>'}
 const main=()=>document.getElementById('main');
 function card(html){return `<div class="card">${html}</div>`}
+async function explain(stage,svc){
+ const el=document.querySelector(`[data-explain-out="${CSS.escape(stage)}"]`);
+ if(!el)return;
+ try{
+  const e=await api(`/api/placement/explain?stage=${encodeURIComponent(stage)}&service=${encodeURIComponent(svc)}`);
+  const ch=e.chosen,bc=e.blocked_counts;
+  const rank=e.chosen_rank?`rank ${e.chosen_rank}`:
+   '<span class="warn">NOT FEASIBLE on its node</span>';
+  el.innerHTML=card(`<b>${esc(e.service)}</b> → <code>${esc(ch.node)}</code> `+
+   `(${rank} of ${bc.feasible} feasible / ${bc.total_nodes} nodes, ${esc(e.strategy)})<br>`+
+   `score ${ch.score} · strategy ${ch.strategy_term} · pref ${ch.preference} · `+
+   `coloc ${ch.coloc_mates} · util after [${ch.utilization_after.join(', ')}]<br>`+
+   `blocked: ${bc.ineligible} ineligible, ${bc.invalid} offline, `+
+   `${bc.capacity} full, ${bc.conflicts} conflicting`+
+   (e.alternatives.length?table(['alt node','score','pref','coloc'],
+    e.alternatives.map(a=>[`<code>${esc(a.node)}</code>`,esc(a.score),
+     esc(a.preference),esc(a.coloc_mates)])):''));
+ }catch(err){el.innerHTML=card(`<span class="warn">${esc(String(err))}</span>`)}
+}
 
 // -- views ----------------------------------------------------------------
 const views={
@@ -880,8 +899,10 @@ const views={
   main().innerHTML=(entries.length?entries.map(([k,v])=>
    card(`<b>${esc(k)}</b> · ${badge(v.feasible?'feasible':'infeasible')} · `+
     `${esc(v.source)} · ${esc(v.solve_ms)}ms · violations ${esc(v.violations)}`+
-    table(['service','node'],Object.entries(v.assignment).map(
-     ([s,n])=>[`<code>${esc(s)}</code>`,`<code>${esc(n)}</code>`])))).join(''):
+    table(['service','node',''],Object.entries(v.assignment).map(
+     ([s,n])=>[`<code>${esc(s)}</code>`,`<code>${esc(n)}</code>`,
+      `<button data-explain data-stage="${esc(k)}" data-svc="${esc(s)}">why?</button>`]))+
+    `<div data-explain-out="${esc(k)}"></div>`)).join(''):
    card('<span class="muted">no placements solved yet</span>'))+journal},
  async agents(){
   const a=await api('/api/agents');
@@ -991,6 +1012,8 @@ document.addEventListener('click',async ev=>{
   else if(b.dataset.restart!==undefined){
    const r=await post(`/api/stages/${enc(b.dataset.sid)}/services/${enc(b.dataset.svc)}/restart`);
    alert('restarted: '+JSON.stringify(r.restarted))}
+  else if(b.dataset.explain!==undefined){
+   await explain(b.dataset.stage,b.dataset.svc)}
  }catch(e){alert('action failed: '+e.message)}});
 
 // -- router ---------------------------------------------------------------
