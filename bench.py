@@ -143,18 +143,18 @@ def main() -> None:
     # (block axis + warm legs unmeasured — the tunnel hung mid-sweep on
     # the 512-proposal leg, docs/profiles/r5-tpu-tune.md), so warm-path
     # TPU constants still follow the cold pin.
-    # Block=2 on BOTH backends since best-ever tracking (solver/anneal.py
-    # r5) decoupled block size from quality: the block is now purely the
-    # exit-check granularity, and the r5 TPU artifact shows the old
-    # current-state exit burning 12-14 warm sweeps on feasibility
-    # oscillation that seen-feasible tracking exits at the first feasible
-    # block boundary. TPU block=2 itself is a reasoned default awaiting
-    # tunnel confirmation (scripts/tpu_tune.py measures 2/4/8 first).
+    # Block=1 on BOTH backends since best-ever tracking (solver/anneal.py
+    # r5) decoupled block size from quality: the block is purely the
+    # exit-check granularity, the exit keys on seen-feasibility, and a
+    # feasible seed means ONE polish sweep suffices — measured CPU 10k x
+    # 1k: block=1 ~83 ms vs block=2 ~114 ms with IDENTICAL soft (1.3521)
+    # and 0 violations. TPU block=1 is the same reasoning awaiting tunnel
+    # confirmation (scripts/tpu_tune.py measures the block axis first).
     cpu = backend == "cpu"
     chains = int(os.environ.get("BENCH_CHAINS", "1" if cpu else "2"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     seed_batch = int(os.environ.get("BENCH_SEED_BATCH", "256"))
-    block = int(os.environ.get("BENCH_BLOCK", "2"))
+    block = int(os.environ.get("BENCH_BLOCK", "1"))
     # one polish sweep suffices warm: the pre-repaired seed is already
     # feasible and best-ever tracking keeps anything a longer polish would
     # have kept — measured r5 CPU 10k x 1k: warm_block=1 ~86 ms vs =2

@@ -184,7 +184,7 @@ def _solve(pt: ProblemTensors, *,
            seed_batch: int = 256,
            seed_rounds: int = 2,
            adaptive: bool = True,
-           anneal_block: int = 2,
+           anneal_block: int = 1,
            warm_block: int = 1,
            prerepair: Optional[bool] = None,
            proposals_per_step: Optional[int] = None) -> SolveResult:

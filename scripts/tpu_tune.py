@@ -99,8 +99,8 @@ def main() -> None:
     # then the unmeasured block axis, then the warm legs, then the already-
     # measured r5 rows for cross-checking, and the 512-proposal leg (where
     # the r5 tunnel hung, possibly on its own giant compile) dead last.
-    ref = run_cold(2, 8, 256)      # pinned default (r5 winner)
-    for chains, block, props in [(2, 4, 256), (2, 2, 256)]:
+    ref = run_cold(2, 1, 256)      # pinned default (r5 winner + block=1)
+    for chains, block, props in [(2, 2, 256), (2, 4, 256), (2, 8, 256)]:
         run_cold(chains, block, props)
 
     # warm reschedule: kill the most-loaded node, re-solve from the cold
@@ -111,8 +111,8 @@ def main() -> None:
     pt2 = dataclasses.replace(pt, node_valid=valid)
     import jax.numpy as jnp
     prob2 = dataclasses.replace(prob, node_valid=jnp.asarray(valid))
-    for chains, block, props in [(2, 2, 256), (1, 2, 256), (2, 8, 256),
-                                 (1, 2, 64), (4, 2, 256)]:
+    for chains, block, props in [(2, 1, 256), (1, 1, 256), (2, 2, 256),
+                                 (1, 1, 64), (4, 1, 256)]:
         t_c = time.perf_counter()
         solve(pt2, prob=prob2, chains=chains, steps=128, seed=2,
               init_assignment=ref.assignment, anneal_block=8,
