@@ -322,6 +322,37 @@ class TestDashboard:
             assert body["agents"] == []
             st, body = await http_get(host, port, "/api/placement")
             assert body["stages"] == {}
+            # explain face (r5): 404 with a clear error before any solve,
+            # then a real breakdown once the CP has a retained placement
+            st, body = await http_get(
+                host, port,
+                "/api/placement/explain?stage=shop/live&service=api")
+            assert st == 404 and "no retained placement" in body["error"]
+            from fleetflow_tpu.core.parser import parse_kdl_string
+            from fleetflow_tpu.cp.models import ServerCapacity
+            db.update("servers", db.server_by_slug("n1").id,
+                      status="online",
+                      capacity=ServerCapacity(cpu=4, memory=4096,
+                                              disk=999))
+            pflow = parse_kdl_string(
+                'project "shop"\n'
+                'server "n1" { capacity { cpu 4; memory 4096; disk 999 } }\n'
+                'service "api" { image "x"; '
+                'resources { cpu 1; memory 64; disk 1 } }\n'
+                'stage "live" { service "api"; servers "n1" }')
+            import asyncio as _aio
+            await _aio.get_running_loop().run_in_executor(
+                None, lambda: handle.state.placement.solve_stage(
+                    pflow, "live"))
+            st, body = await http_get(
+                host, port,
+                "/api/placement/explain?stage=shop/live&service=api")
+            assert st == 200 and body["chosen"]["node"] == "n1"
+            assert body["chosen"]["feasible"] and body["chosen_rank"] == 1
+            st, body = await http_get(
+                host, port,
+                "/api/placement/explain?stage=shop/live&service=ghost")
+            assert st == 404
             from fleetflow_tpu.cp.models import WorkerPool
             db.create("worker_pools", WorkerPool(name="builders",
                                                  min_servers=1))
