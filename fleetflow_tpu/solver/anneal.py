@@ -324,6 +324,12 @@ def anneal_states(prob: DeviceProblem, init_assignments: jax.Array,
                   unroll: int = 1) -> ChainState:
     """Run `steps` batched-Metropolis sweeps on C independent chains.
 
+    Returns each chain's FINAL carried state — unlike the adaptive path,
+    there is no best-ever tracking here: callers that rank these states
+    (api adaptive=False, tests comparing carried state against rebuilds)
+    rely on exact final-state semantics, and the production default is
+    the adaptive path.
+
     init_assignments: (C, S) int32; returns refined assignments (C, S).
     Each sweep evaluates `proposals_per_step` moves per chain in parallel
     (one device dispatch), so total proposals = steps x M x C while the
