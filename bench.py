@@ -583,8 +583,12 @@ def _sharded_child() -> None:
     # the anneal repair the few cross-slice conflicts. BENCH_SHARDED_SEED
     # = whole|partitioned overrides the size heuristic.
     seed_mode = os.environ.get("BENCH_SHARDED_SEED", "")
-    partitioned = (seed_mode == "partitioned"
-                   or (seed_mode != "whole" and S >= 50_000))
+    # partitioning requires the native FFD: without it partitioned_seed
+    # silently degrades to the whole-instance host greedy, and the
+    # artifact must not claim a code path that never ran
+    partitioned = (available_nobuild()
+                   and (seed_mode == "partitioned"
+                        or (seed_mode != "whole" and S >= 50_000)))
     if partitioned:
         from fleetflow_tpu.solver.greedy import partitioned_seed
         seed = partitioned_seed(pt, D)

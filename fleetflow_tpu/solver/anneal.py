@@ -378,9 +378,9 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
                            proposals_per_step: int | None = None):
     """Anneal in `block`-sweep chunks, stopping as soon as any chain has
     SEEN an exactly feasible state (or at max_steps). Returns
-    (best_assignments (C, S), best_viols (C,), best_costs (C,),
+    (best_assignments (C, S), best_viols (C,), best_softs (C,),
     sweeps_run scalar), where best is each chain's lexicographically
-    lowest (violations, rank cost) state EVER VISITED, not its final
+    lowest (violations, soft) state EVER VISITED, not its final
     state.
 
     Best-ever tracking (r5): Metropolis acceptance takes uphill soft moves
@@ -413,16 +413,20 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
     decay = (t1 / t0) ** (1.0 / max(max_steps - 1, 1))
 
     def chain_scores(states):
-        """(violations (C,), rank cost (C,)) from carried state — an
+        """(violations (C,), soft (C,)) from carried state — an
         elementwise reduce, not a scatter rebuild (an exact-kernel check
-        here cost ~18 ms per block at 10k x 1k)."""
+        here cost ~18 ms per block at 10k x 1k). Kept as SEPARATE scalars:
+        a folded W_HARD * v + soft float32 rounds the O(1) soft term away
+        entirely once v exceeds ~1e3 (ulp(2e7) = 2), which would turn the
+        soft tie-break among equal-violation states into a no-op on
+        heavily infeasible instances."""
         v = jax.vmap(
             lambda st: state_violation_stats(prob, st)["total"])(states)
         soft = jax.vmap(lambda st: state_soft_score(prob, st))(states)
-        return v, W_HARD * v + soft
+        return v, soft
 
     def sweep(carry, i):
-        (states, keys, best_assign, best_viol, best_cost,
+        (states, keys, best_assign, best_viol, best_soft,
          seen_feasible) = carry
         # clamp: overflow sweeps of a rounded-up final block hold t1
         temp = t0 * decay ** jnp.minimum(
@@ -430,24 +434,25 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
         keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
         states = jax.vmap(
             lambda st, k: _batched_step(prob, st, k, temp, M))(states, keys)
-        viol, cost = chain_scores(states)
-        # lexicographic (violations, cost) — NOT cost alone: the warm-start
-        # migration bonus can push soft below -W_HARD in aggregate (bonus
-        # gap ~ migration_weight x forced moves), where a cost comparison
-        # would prefer a 1-violation maximally-sticky state over a feasible
-        # one; feasibility must dominate unconditionally
+        viol, soft = chain_scores(states)
+        # lexicographic (violations, soft) — NOT a folded cost: the
+        # warm-start migration bonus can push soft below -W_HARD in
+        # aggregate (bonus gap ~ migration_weight x forced moves), where a
+        # folded comparison would prefer a 1-violation maximally-sticky
+        # state over a feasible one; feasibility must dominate
+        # unconditionally, and soft must stay a full-precision tie-break
         better = (viol < best_viol) | ((viol == best_viol)
-                                       & (cost < best_cost))
+                                       & (soft < best_soft))
         best_viol = jnp.where(better, viol, best_viol)
-        best_cost = jnp.where(better, cost, best_cost)
+        best_soft = jnp.where(better, soft, best_soft)
         best_assign = jnp.where(better[:, None], states.assignment,
                                 best_assign)
         seen_feasible = seen_feasible | (viol.min() == 0)
-        return (states, keys, best_assign, best_viol, best_cost,
+        return (states, keys, best_assign, best_viol, best_soft,
                 seen_feasible), None
 
-    viol0, cost0 = chain_scores(states)
-    init = (states, keys, states.assignment, viol0, cost0,
+    viol0, soft0 = chain_scores(states)
+    init = (states, keys, states.assignment, viol0, soft0,
             viol0.min() == 0)
 
     def cond(carry):
@@ -455,22 +460,22 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
         return (~done) & (b < n_blocks)
 
     def body(carry):
-        (states, keys, best_assign, best_viol, best_cost, seen,
+        (states, keys, best_assign, best_viol, best_soft, seen,
          b, _done) = carry
         offsets = b * block + jnp.arange(block, dtype=jnp.int32)
-        (states, keys, best_assign, best_viol, best_cost,
+        (states, keys, best_assign, best_viol, best_soft,
          seen), _ = jax.lax.scan(
-            sweep, (states, keys, best_assign, best_viol, best_cost, seen),
+            sweep, (states, keys, best_assign, best_viol, best_soft, seen),
             offsets)
-        return (states, keys, best_assign, best_viol, best_cost, seen,
+        return (states, keys, best_assign, best_viol, best_soft, seen,
                 b + 1, seen)
 
     # done starts False: even an already-feasible start gets one block of
     # soft polish (the exit trades polish for latency only after that)
-    (_, _, best_assign, best_viol, best_cost, _, b,
+    (_, _, best_assign, best_viol, best_soft, _, b,
      _) = jax.lax.while_loop(cond, body, init + (jnp.int32(0),
                                                  jnp.bool_(False)))
-    return best_assign, best_viol, best_cost, b * block
+    return best_assign, best_viol, best_soft, b * block
 
 
 def anneal_adaptive(prob: DeviceProblem, init_assignments: jax.Array,
@@ -478,7 +483,7 @@ def anneal_adaptive(prob: DeviceProblem, init_assignments: jax.Array,
                     t0: float = 1.0, t1: float = 1e-3,
                     proposals_per_step: int | None = None):
     """Adaptive anneal; returns (assignments (C, S), sweeps_run)."""
-    best_assign, _viol, _cost, sweeps = anneal_adaptive_states(
+    best_assign, _viol, _soft, sweeps = anneal_adaptive_states(
         prob, init_assignments, key, max_steps=max_steps, block=block,
         t0=t0, t1=t1, proposals_per_step=proposals_per_step)
     return best_assign, sweeps

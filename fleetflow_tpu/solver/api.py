@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .anneal import (anneal_adaptive_states, anneal_states,
                      state_soft_score, state_violation_stats)
 from .greedy import greedy_place, greedy_place_batched, placement_order
-from .kernels import W_HARD, soft_score, violation_stats
+from .kernels import soft_score, violation_stats
 from .problem import DeviceProblem, prepare_problem
 from .repair import RepairResult, repair, verify
 from ..lower.tensors import ProblemTensors
@@ -125,20 +125,21 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
         inits = jax.lax.with_sharding_constraint(inits, sharding)
     if adaptive:
         # the adaptive anneal tracks each chain's best-ever state with its
-        # (violations, rank cost); chain ranking is feasibility-first —
-        # a cost argmin alone could prefer an infeasible chain whose
-        # warm-bonused soft undercuts W_HARD (aggregate bonus gap is
-        # unbounded in the fleet size)
-        best_assign_c, best_viol_c, best_cost_c, sweeps_run = \
+        # (violations, soft) as SEPARATE scalars; chain ranking is
+        # feasibility-first — a folded W_HARD*v+soft argmin would both
+        # prefer an infeasible chain whose warm-bonused soft undercuts
+        # W_HARD (aggregate bonus gap is unbounded in the fleet size) AND
+        # round the soft tie-break away in float32 at large v
+        best_assign_c, best_viol_c, best_soft_c, sweeps_run = \
             anneal_adaptive_states(
                 prob_a, inits, k_anneal, max_steps=steps, block=anneal_block,
                 t0=t0, t1=t1,
                 proposals_per_step=proposals_per_step)
-        # exact lexicographic (violations, cost): among minimal-violation
-        # chains (0 when any chain saw feasibility), cheapest cost wins
+        # exact lexicographic (violations, soft): among minimal-violation
+        # chains (0 when any chain saw feasibility), best soft wins
         min_viol = best_viol_c.min()
         best = jnp.argmin(jnp.where(best_viol_c == min_viol,
-                                    best_cost_c, jnp.inf))
+                                    best_soft_c, jnp.inf))
         winner = best_assign_c[best]
     else:
         states = anneal_states(prob_a, inits, k_anneal, steps=steps,
@@ -152,7 +153,11 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
             lambda st: state_violation_stats(prob_a, st)["total"])(states)
         soft_rank = jax.vmap(
             lambda st: state_soft_score(prob_a, st))(states)
-        winner = states.assignment[jnp.argmin(W_HARD * viol + soft_rank)]
+        # same two-stage lexicographic rank as the adaptive path (a folded
+        # W_HARD*viol+soft would drop the soft term in float32 at large v)
+        mv = viol.min()
+        winner = states.assignment[
+            jnp.argmin(jnp.where(viol == mv, soft_rank, jnp.inf))]
     # The WINNER's stats are recomputed with the exact from-scratch kernels
     # (one scatter rebuild, ~5 ms): the carried float32 load accumulates
     # .add(+d)/.add(-d) round-off over thousands of proposals, and the

@@ -8,8 +8,8 @@ solver's own terms — hard feasibility per node (eligibility, validity,
 capacity fit, conflict-group occupancy) and the soft components the
 anneal trades (strategy utilization delta, preference, colocation mates)
 — mirroring anneal._proposal_delta term for term, but on the host in
-numpy over one (1, N) slice, so an explain costs microseconds and needs
-no device.
+numpy over one (1, N) slice plus the service's own conflict groups, so
+an explain costs milliseconds even at fleet scale and needs no device.
 
 Surfaced as PlacementService.explain -> REST
 GET /api/placement/explain?stage=&service= -> MCP cp_placement_explain
@@ -27,23 +27,22 @@ from ..lower.tensors import ProblemTensors
 __all__ = ["explain_assignment"]
 
 
-def _group_occupancy(ids: np.ndarray, assignment: np.ndarray, N: int,
-                     exclude_row: int) -> np.ndarray:
-    """(N, G) occupancy counts of every conflict id, with `exclude_row`'s
-    own memberships removed (a service never conflicts with itself)."""
+def _own_group_hits(ids: np.ndarray, assignment: np.ndarray, N: int,
+                    row: int) -> np.ndarray:
+    """(N,) count of OTHER members of `row`'s conflict groups per node.
+
+    Iterates row's own ids (typically 1-3) and bincounts each group's
+    members — O(K_own * S + N), never the dense (N, G) occupancy plane a
+    mega-scale instance would turn into gigabytes per explain request."""
+    hits = np.zeros(N, dtype=np.int64)
     if ids.size == 0:
-        return np.zeros((N, 1), dtype=np.int64)
-    G = int(ids.max(initial=-1)) + 1
-    if G <= 0:
-        return np.zeros((N, 1), dtype=np.int64)
-    occ = np.zeros((N, G), dtype=np.int64)
-    valid = ids >= 0
-    rows = np.broadcast_to(assignment[:, None], ids.shape)[valid]
-    np.add.at(occ, (rows, ids[valid]), 1)
-    own = ids[exclude_row]
-    own = own[own >= 0]
-    occ[assignment[exclude_row], own] -= 1
-    return occ
+        return hits
+    own = ids[row][ids[row] >= 0]
+    for g in own:
+        members = (ids == g).any(axis=1)
+        members[row] = False   # a service never conflicts with itself
+        hits += np.bincount(assignment[members], minlength=N)
+    return hits
 
 
 def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
@@ -63,26 +62,25 @@ def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
              else pt.node_valid).astype(bool)
     d = pt.demand[i]                                     # (R,)
 
-    # node load WITHOUT this service
-    load = np.zeros_like(pt.capacity)
-    np.add.at(load, assignment, pt.demand)
+    # node load WITHOUT this service — float64 so re-accumulation cannot
+    # drift a packed node across the tolerance the solver itself uses
+    load = np.zeros((N, pt.capacity.shape[1]), dtype=np.float64)
+    np.add.at(load, assignment, pt.demand.astype(np.float64))
     load[assignment[i]] -= d
 
     new_load = load + d[None, :]                          # (N, R)
-    fits = (new_load <= pt.capacity + 1e-6).all(axis=1)
+    # RELATIVE tolerance, same as every solver feasibility check
+    # (kernels/anneal use cap * (1 + 1e-6)): an absolute +1e-6 here made
+    # explain contradict the solver's verdict on exactly-packed nodes
+    fits = (new_load <= pt.capacity * (1 + 1e-6)).all(axis=1)
     eligible = pt.eligible[i].astype(bool)
 
-    # conflict occupancy per family, self-excluded
+    # conflict hits per family, self-excluded
     conflict_hits = np.zeros(N, dtype=np.int64)
     families = {}
     for fam, ids in (("ports", pt.port_ids), ("volumes", pt.volume_ids),
                      ("anti_affinity", pt.anti_ids)):
-        own = ids[i][ids[i] >= 0] if ids.size else np.empty(0, np.int64)
-        if own.size == 0:
-            families[fam] = np.zeros(N, dtype=np.int64)
-            continue
-        occ = _group_occupancy(ids, assignment, N, i)
-        hits = occ[:, own].sum(axis=1)
+        hits = _own_group_hits(ids, assignment, N, i)
         families[fam] = hits
         conflict_hits += hits
     conflict_free = conflict_hits == 0
@@ -112,12 +110,7 @@ def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
     pref = (pt.preferred[i] if pt.preferred is not None
             else np.zeros(N, dtype=np.float32))
     # colocation mates already on each node (soft bonus per mate)
-    if pt.coloc_ids.size and (pt.coloc_ids[i] >= 0).any():
-        cocc = _group_occupancy(pt.coloc_ids, assignment, N, i)
-        own_c = pt.coloc_ids[i][pt.coloc_ids[i] >= 0]
-        coloc_mates = cocc[:, own_c].sum(axis=1)
-    else:
-        coloc_mates = np.zeros(N, dtype=np.int64)
+    coloc_mates = _own_group_hits(pt.coloc_ids, assignment, N, i)
 
     score = (strategy_term - pref / S_total - coloc_mates / S_total)
     ok = eligible & valid & fits & conflict_free
@@ -139,8 +132,11 @@ def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
 
     chosen = int(assignment[i])
     order = np.argsort(np.where(ok, score, np.inf), kind="stable")
-    alternatives = [node_row(int(n)) for n in order[:top_k]
-                    if ok[n] and int(n) != chosen]
+    # top_k best feasible alternatives EXCLUDING chosen (filter first,
+    # then slice — slicing first silently returned top_k-1 whenever the
+    # chosen node wasn't itself among the top_k)
+    alternatives = [node_row(int(n)) for n in order
+                    if ok[n] and int(n) != chosen][:top_k]
     # a degraded placement (e.g. the node died and the re-solve is still
     # infeasible) can leave the service on an infeasible node: a "rank"
     # among np.inf ties would be an index-order artifact, not a position
@@ -154,7 +150,7 @@ def explain_assignment(pt: ProblemTensors, assignment: np.ndarray,
         "strategy": strat,
         "chosen": node_row(chosen),
         "chosen_rank": chosen_rank,
-        "alternatives": alternatives[: max(top_k - 1, 0)],
+        "alternatives": alternatives,
         "blocked_counts": {
             "ineligible": int((~eligible).sum()),
             "invalid": int((~valid).sum()),

@@ -295,19 +295,20 @@ def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
 
 
 def partitioned_seed(pt, parts: int) -> np.ndarray:
-    """Host seed for mega-scale sharded solves: slice the service axis into
-    `parts` contiguous groups and FFD each group against capacity/parts.
+    """Host seed for mega-scale sharded solves: service slices x disjoint
+    round-robin node subsets, one full-capacity FFD per slice.
 
     The exact host FFD is O(S*N) sequential work — 108.9 s at 100k x 10k
     (docs/profiles/r5-xl-sharded.md), outweighing the sharded anneal it
-    feeds. Partitioning divides the work `parts` ways (and on a multi-core
-    host the groups could run concurrently): each group packs into an
-    equal fraction of every node's capacity, so the union respects total
-    capacity up to per-group rounding. What it can miss is CROSS-GROUP
-    conflict-group separation (two groups may drop port-conflicting
-    services on one node) — a handful of violations the sharded anneal's
-    targeted proposals repair in its first sweeps, the same contract as
-    the batched device seed's best-effort tail.
+    feeds. This slices the NODE axis round-robin alongside a contiguous
+    service split: slice g FFDs its services onto its own nodes at full
+    capacity, cutting the work to O(S*N/parts) with a union feasible by
+    construction for both capacity and conflict groups (disjoint nodes
+    cannot share a port). The residue left for the anneal: services whose
+    eligible nodes all fall in other slices (best-effort in-slice, an
+    eligibility violation each) and packing fragmentation across node
+    subsets — the same repair contract as the batched device seed's
+    best-effort tail.
 
     Returns (S,) int32. Uses the native C++ FFD per group when available,
     the pure-numpy host greedy otherwise.
@@ -322,18 +323,44 @@ def partitioned_seed(pt, parts: int) -> np.ndarray:
         # not partitioned — the fallback machine is not the mega-scale one)
         from ..sched.host import greedy_host_place
         return greedy_host_place(pt)[0].astype(_np.int32)
-    parts = max(1, min(parts, S))
-    bounds = _np.linspace(0, S, parts + 1, dtype=int)
-    cap = _np.ascontiguousarray(pt.capacity / float(parts))
+    N = pt.capacity.shape[0]
+    parts = max(1, min(parts, S, N))
+    if parts == 1:
+        seg, _viol = native_place(
+            pt.demand, pt.capacity, pt.eligible, pt.node_valid,
+            pt.dep_depth, pt.port_ids, pt.volume_ids, pt.anti_ids,
+            strategy=pt.strategy.value)
+        return seg
+
+    # Partition NODES, not capacity: slice g owns every (parts)-th node
+    # (round-robin, so tenant-blocked eligibility spreads over slices)
+    # and a contiguous 1/parts of the services, FFD'd onto its own nodes
+    # at FULL capacity. Total FFD work drops from O(S*N) to O(S*N/parts),
+    # the union is feasible by construction for capacity AND conflicts
+    # (slices place on disjoint nodes, so no cross-slice port collision
+    # is even possible), and big services see whole nodes — the two
+    # failure modes of capacity-sharing designs (an equal cap/parts
+    # starves any service over 1/parts of a node; flooring the share at
+    # the slice max lets small services overbook it `parts` times,
+    # measured 22 capacity violations on a feasible 64x16 instance).
+    # What remains for the anneal: services whose eligible nodes all
+    # live in OTHER slices get best-effort in-slice placements (an
+    # eligibility violation each), and packing quality is fragmented
+    # across node subsets — both repaired/polished by the sweeps.
     out = _np.empty(S, dtype=_np.int32)
+    bounds = _np.linspace(0, S, parts + 1, dtype=int)
     for g in range(parts):
         lo, hi = int(bounds[g]), int(bounds[g + 1])
         if hi <= lo:
             continue
+        nodes_g = _np.arange(g, N, parts)
         seg, _viol = native_place(
-            pt.demand[lo:hi], cap, pt.eligible[lo:hi], pt.node_valid,
+            pt.demand[lo:hi],
+            _np.ascontiguousarray(pt.capacity[nodes_g]),
+            _np.ascontiguousarray(pt.eligible[lo:hi][:, nodes_g]),
+            _np.ascontiguousarray(pt.node_valid[nodes_g]),
             pt.dep_depth[lo:hi], pt.port_ids[lo:hi],
             pt.volume_ids[lo:hi], pt.anti_ids[lo:hi],
             strategy=pt.strategy.value)
-        out[lo:hi] = seg
+        out[lo:hi] = nodes_g[seg]
     return out
