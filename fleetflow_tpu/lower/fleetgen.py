@@ -35,7 +35,9 @@ def generate_fleet_kdl(fleet: str, n_services: int, *, seed: int = 0,
                        volume_fraction: float = 0.1,
                        dep_depth_max: int = 5,
                        n_nodes_hint: int = 1000,
-                       port_base: int = 10000) -> str:
+                       port_base: int = 10000,
+                       replica_fraction: float = 0.05,
+                       coloc_fraction: float = 0.05) -> str:
     """KDL text for one tenant fleet: top-level service nodes plus a
     `stage "prod"` listing them.
 
@@ -89,16 +91,29 @@ def generate_fleet_kdl(fleet: str, n_services: int, *, seed: int = 0,
         lines.append('    }')
         if s in dep_of:
             lines.append(f'    depends_on "{names[dep_of[s]]}"')
+        has_port = False
         if rng.random() < port_fraction:
             open_ids = np.flatnonzero(port_members < n_nodes_hint - 1)
             if open_ids.size:          # pool exhausted: skip, stay feasible
                 p = int(open_ids[int(rng.integers(0, open_ids.size))])
                 port_members[p] += 1
                 lines.append(f'    port host={port_base + p} container=8080')
+                has_port = True
         if rng.random() < volume_fraction:
             v = int(rng.integers(0, n_vols))
             lines.append(
                 f'    volume "/data/{fleet}/vol-{v:04d}" "/var/data"')
+        # replica expansion + colocation exercise the remaining constraint
+        # classes at pipeline scale (the solve must handle every KDL
+        # construct the config layer accepts, not just ports/volumes).
+        # Port-publishing services stay replicas=1 — identical host ports
+        # on every replica would be infeasible by construction — and
+        # colocation targets the service's dependency (the natural
+        # "run next to what I call" shape).
+        if not has_port and rng.random() < replica_fraction:
+            lines.append(f'    replicas {int(rng.integers(2, 4))}')
+        if s in dep_of and rng.random() < coloc_fraction:
+            lines.append(f'    colocate_with "{names[dep_of[s]]}"')
         lines.append('}')
     lines.append("")
     lines.append('stage "prod" {')

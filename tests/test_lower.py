@@ -275,10 +275,18 @@ class TestFleetgen:
 
     def test_generated_fleet_parses_and_lowers(self):
         pt, index = self._pipeline()
-        assert pt.S == 240 and pt.N == 24
+        # 240 declared services; replica_fraction expands some into
+        # name#k rows (r5: the generator now exercises replicas/coloc)
+        replicas = sum(1 for n in pt.service_names if "#" in n)
+        assert pt.S == 240 + replicas - (0 if replicas == 0 else
+                                         len({n.split("#")[0]
+                                              for n in pt.service_names
+                                              if "#" in n}))
+        assert pt.N == 24
         # structure made it through the whole pipeline, not just the parse
         assert (pt.port_ids >= 0).any(), "port conflicts lost"
         assert (pt.volume_ids >= 0).any(), "volume conflicts lost"
+        assert (pt.coloc_ids >= 0).any(), "colocation groups lost"
         assert pt.dep_adj.any(), "dependency chains lost"
         assert pt.dep_depth.max() >= 1
         # namespaced row identity maps back to (fleet, stage, service)
