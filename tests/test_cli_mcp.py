@@ -445,3 +445,27 @@ class TestCliPlacementExplain:
         rc, out = self._run(monkeypatch, capsys, rank=None)
         assert rc == 0
         assert "NOT FEASIBLE on its node" in out
+
+
+def test_cli_placement_state_prints_journal(monkeypatch, capsys):
+    import importlib
+    cli = importlib.import_module("fleetflow_tpu.cli.main")
+
+    class FakeCp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def request(self, channel, method, p=None, timeout=60.0):
+            assert (channel, method) == ("placement", "reservations")
+            return {"in_flight": [{"id": "r1", "stage": "shop/live",
+                                   "churn": True,
+                                   "demand_by_node": {"n1": [1, 64, 1]}}],
+                    "committed": []}
+
+    monkeypatch.setattr(cli, "CpClient", lambda endpoint=None: FakeCp())
+    rc = cli.main(["cp", "placement", "state"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "shop/live" in out and "churn" in out

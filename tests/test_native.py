@@ -137,3 +137,18 @@ class TestStaleLibraryRebuild:
             assert so.stat().st_mtime > before, "stale .so was not rebuilt"
         finally:
             lib._lib, lib._tried = None, False
+
+
+def test_ext_filename_is_abi_tagged():
+    """The extension filename must embed THIS interpreter's EXT_SUFFIX so
+    a build from a different Python is not found instead of imported
+    (undefined behavior across C-API minor versions)."""
+    import sysconfig
+
+    from fleetflow_tpu.native.lib import ext_filename
+
+    suffix = sysconfig.get_config_var("EXT_SUFFIX")
+    name = ext_filename()
+    assert name.startswith("ffkdlpy")
+    assert suffix and name.endswith(suffix)
+    assert name != "ffkdlpy.so" or suffix == ".so"
