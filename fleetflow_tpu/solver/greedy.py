@@ -349,10 +349,11 @@ def partitioned_seed(pt, parts: int) -> np.ndarray:
     # across node subsets — both repaired/polished by the sweeps.
     out = _np.empty(S, dtype=_np.int32)
     bounds = _np.linspace(0, S, parts + 1, dtype=int)
-    for g in range(parts):
+
+    def one_slice(g: int) -> None:
         lo, hi = int(bounds[g]), int(bounds[g + 1])
         if hi <= lo:
-            continue
+            return
         nodes_g = _np.arange(g, N, parts)
         seg, _viol = native_place(
             pt.demand[lo:hi],
@@ -363,4 +364,18 @@ def partitioned_seed(pt, parts: int) -> np.ndarray:
             pt.volume_ids[lo:hi], pt.anti_ids[lo:hi],
             strategy=pt.strategy.value)
         out[lo:hi] = nodes_g[seg]
+
+    # slices are independent (disjoint services AND nodes) and ctypes
+    # releases the GIL for the duration of the C call, so a thread pool
+    # gives real concurrency on multi-core hosts; the 1-core dev box just
+    # runs them back to back. Each worker writes a disjoint out[lo:hi].
+    import os as _os
+    workers = min(parts, _os.cpu_count() or 1)
+    if workers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            list(ex.map(one_slice, range(parts)))
+    else:
+        for g in range(parts):
+            one_slice(g)
     return out
