@@ -282,6 +282,14 @@ def lower_stage(flow: Flow, stage_name: str,
     anti_key_ids: dict[str, int] = {}
     coloc_key_ids: dict[str, int] = {}
 
+    # colocation groups are keyed by the TARGET service name, and the
+    # target's own rows are members too: one-sided `a colocate_with b`
+    # otherwise lowers to the singleton group {a}, whose coloc score
+    # cc*(cc-1)/2 is identically 0 — the declared preference would have
+    # no effect at all (found by the r5 close review; the production
+    # example's api colocate-with cache was a no-op)
+    coloc_targets = {k for svc in services for k in svc.colocate_with}
+
     port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
     for i, svc in enumerate(rows):
         pg = []
@@ -301,7 +309,10 @@ def lower_stage(flow: Flow, stage_name: str,
         anti_groups.append(ag)
         cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
               for k in svc.colocate_with]
-        coloc_groups.append(cg)
+        if svc.name in coloc_targets:
+            cg.append(coloc_key_ids.setdefault(svc.name,
+                                               len(coloc_key_ids)))
+        coloc_groups.append(list(dict.fromkeys(cg)))
 
     # ---- eligibility / preference / validity / topology --------------------
     # policy matching is per-NODE (every service row in a stage shares the
