@@ -287,8 +287,15 @@ def lower_stage(flow: Flow, stage_name: str,
     # otherwise lowers to the singleton group {a}, whose coloc score
     # cc*(cc-1)/2 is identically 0 — the declared preference would have
     # no effect at all (found by the r5 close review; the production
-    # example's api colocate-with cache was a no-op)
+    # example's api colocate-with cache was a no-op). anti_affinity gets
+    # the symmetric treatment: its keys are group LABELS (all declarers
+    # of "db-tier" mutually exclude), but when a key names a service,
+    # that service joins the group too, so one-sided target-style
+    # `a anti_affinity "db"` separates a from db instead of silently
+    # doing nothing.
     coloc_targets = {k for svc in services for k in svc.colocate_with}
+    anti_targets = ({} if local else
+                    {k for svc in services for k in svc.anti_affinity})
 
     port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
     for i, svc in enumerate(rows):
@@ -306,7 +313,10 @@ def lower_stage(flow: Flow, stage_name: str,
         ag = ([] if local else
               [anti_key_ids.setdefault(k, len(anti_key_ids))
                for k in svc.anti_affinity])
-        anti_groups.append(ag)
+        if not local and svc.name in anti_targets:
+            ag.append(anti_key_ids.setdefault(svc.name,
+                                              len(anti_key_ids)))
+        anti_groups.append(list(dict.fromkeys(ag)))
         cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
               for k in svc.colocate_with]
         if svc.name in coloc_targets:

@@ -387,3 +387,31 @@ stage "live" {{ service "db"; service "api"; servers "n0" "n1" }}
         # colocation bonus (1 pair / S) separates the gains
         assert gain_coloc == pytest.approx(gain_plain + 1.0 / pt_c.S,
                                            abs=1e-5)
+
+    def test_one_sided_anti_affinity_separates_from_target(self):
+        """Target-style `api anti_affinity "db"` must put db in the group
+        (hard separation enforced by the solver); label-style groups keep
+        working because a label that names no service adds no rows."""
+        from fleetflow_tpu.core.parser import parse_kdl_string
+
+        from fleetflow_tpu.solver import solve
+        flow = parse_kdl_string("""
+project "p"
+server "n0" { capacity { cpu 4; memory 4096; disk 999 } }
+server "n1" { capacity { cpu 4; memory 4096; disk 999 } }
+service "db" { image "pg"; resources { cpu 1; memory 64; disk 1 } }
+service "api" { image "a"; resources { cpu 1; memory 64; disk 1 }
+    anti_affinity "db"
+}
+stage "live" { service "db"; service "api"; servers "n0" "n1" }
+""")
+        pt = lower_stage(flow, "live")
+        by_name = {n: i for i, n in enumerate(pt.service_names)}
+        db_ids = set(pt.anti_ids[by_name["db"]][
+            pt.anti_ids[by_name["db"]] >= 0].tolist())
+        api_ids = set(pt.anti_ids[by_name["api"]][
+            pt.anti_ids[by_name["api"]] >= 0].tolist())
+        assert db_ids and db_ids == api_ids
+        res = solve(pt, steps=64, seed=3)
+        assert res.feasible
+        assert res.assignment[by_name["db"]] != res.assignment[by_name["api"]]
