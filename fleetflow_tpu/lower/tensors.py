@@ -296,6 +296,16 @@ def lower_stage(flow: Flow, stage_name: str,
     coloc_targets = {k for svc in services for k in svc.colocate_with}
     anti_targets = ({} if local else
                     {k for svc in services for k in svc.anti_affinity})
+    unknown_coloc = coloc_targets - {s.name for s in services}
+    if unknown_coloc:
+        # unlike depends_on (hard error), colocation is a soft preference
+        # and static services legitimately drop out of the container rows
+        # — but a typo'd target means the declaration scores nothing, so
+        # say so instead of silently lowering a dead preference
+        from ..obs import get_logger
+        get_logger("lower").warning(
+            "colocate_with targets not in stage %r: %s (preference has "
+            "no effect)", stage_name, sorted(unknown_coloc))
 
     port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
     for i, svc in enumerate(rows):
