@@ -77,6 +77,16 @@ def load_daemon_config(explicit: Optional[str] = None) -> DaemonConfig:
     return cfg.expand()
 
 
+def _truthy(v) -> bool:
+    """KDL keyword booleans (#true/#false) arrive as real bools; bare-word
+    `true`/`false` arrive as STRINGS, and bool("false") is True — an
+    operator writing `tpu-solver false` must get False, not a silent
+    enable."""
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off", "")
+    return bool(v)
+
+
 def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
     for node in parse_document(text):
         n, v = node.name, node.arg(0)
@@ -89,7 +99,7 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.listen_host = str(node.prop("host", node.arg(0, cfg.listen_host)))
             cfg.listen_port = int(node.prop("port", node.arg(1, cfg.listen_port)))
         elif n == "web":
-            cfg.web_enabled = bool(node.prop("enabled", True))
+            cfg.web_enabled = _truthy(node.prop("enabled", True))
             cfg.web_host = str(node.prop("host", node.arg(0, cfg.web_host)))
             cfg.web_port = int(node.prop("port", node.arg(1, cfg.web_port)))
         elif n == "db":
@@ -111,10 +121,10 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
         elif n == "health-interval":
             cfg.health_interval_s = float(v)
         elif n == "health-tailscale":
-            cfg.health_tailscale = bool(v)
+            cfg.health_tailscale = _truthy(v)
         elif n == "heartbeat-stale":
             cfg.heartbeat_stale_s = float(v)
         elif n == "autoscale-interval":
             cfg.autoscale_interval_s = float(v)
         elif n in ("tpu-solver", "use-tpu-solver"):
-            cfg.use_tpu_solver = bool(v)
+            cfg.use_tpu_solver = _truthy(v)

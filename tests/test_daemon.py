@@ -473,3 +473,26 @@ class TestLogTopics:
             await web.stop()
             await handle.stop()
         run(go())
+
+
+def test_bare_word_false_disables_boolean_config_keys(tmp_path):
+    """KDL keyword booleans (#false) arrive as bools but bare-word `false`
+    arrives as the STRING "false" — and bool("false") is True. An operator
+    writing `tpu-solver false` must get False (r5 close review)."""
+    from fleetflow_tpu.daemon.config import load_daemon_config
+
+    cfg_file = tmp_path / "fleetflowd.kdl"
+    cfg_file.write_text(
+        'tpu-solver false\n'
+        'health-tailscale false\n'
+        'web enabled=false\n')
+    cfg = load_daemon_config(str(cfg_file))
+    assert cfg.use_tpu_solver is False
+    assert cfg.health_tailscale is False
+    assert cfg.web_enabled is False
+    cfg_file.write_text(
+        'tpu-solver true\n'
+        'health-tailscale #true\n')
+    cfg = load_daemon_config(str(cfg_file))
+    assert cfg.use_tpu_solver is True
+    assert cfg.health_tailscale is True
