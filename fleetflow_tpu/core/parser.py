@@ -48,6 +48,15 @@ def _as_str(v: Any) -> str:
     return str(v)
 
 
+def _as_bool(v: Any) -> bool:
+    """KDL keyword booleans (#true/#false) arrive as bools; bare-word
+    true/false arrive as STRINGS, and bool("false") is True — a user
+    writing `read-only false` must not get a read-only mount."""
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off", "")
+    return bool(v)
+
+
 def _str_args(node: KdlNode) -> list[str]:
     return [_as_str(a) for a in node.args if a is not None]
 
@@ -130,7 +139,8 @@ def parse_volume(node: KdlNode) -> Volume:
         raise FlowError("volume node needs at least a host path")
     host = args[0]
     container = args[1] if len(args) > 1 else host
-    ro = bool(node.prop("read-only", node.prop("read_only", node.prop("ro", False))))
+    ro = _as_bool(node.prop("read-only",
+                       node.prop("read_only", node.prop("ro", False))))
     return Volume(host=host, container=container, read_only=ro)
 
 
@@ -152,7 +162,7 @@ def _parse_build(node: KdlNode) -> BuildConfig:
         elif c.name == "target":
             b.target = c.first_string()
         elif c.name in ("no_cache", "no-cache"):
-            b.no_cache = bool(c.arg(0, True))
+            b.no_cache = _as_bool(c.arg(0, True))
         elif c.name in ("image_tag", "image-tag", "tag"):
             b.image_tag = c.first_string()
     for k, v in node.props.items():

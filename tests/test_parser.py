@@ -367,3 +367,29 @@ def test_kdl_guide_examples_parse_and_mean_something():
     assert stage.placement is not None
     assert stage.placement.spread_constraint is not None
     assert "sakura" in flow.providers and flow.servers
+
+
+def test_bare_word_false_in_volume_and_build_booleans():
+    """bool("false") is True: `read-only false` must parse writable and
+    `no-cache false` must keep the cache (same class as the daemon
+    config fix; KDL keyword #false already worked)."""
+    from fleetflow_tpu.core.parser import parse_kdl_string
+
+    flow = parse_kdl_string("""
+project "p"
+service "a" {
+    image "x"
+    volume "/h" "/c" read-only=false
+    build { context "."; no-cache false }
+}
+service "b" {
+    image "y"
+    volume "/h2" "/c2" read-only=#true
+    build { context "."; no-cache #true }
+}
+""")
+    a, b = flow.services["a"], flow.services["b"]
+    assert a.volumes[0].read_only is False
+    assert a.build.no_cache is False
+    assert b.volumes[0].read_only is True
+    assert b.build.no_cache is True
