@@ -180,7 +180,9 @@ def _parse_deploy(node: KdlNode) -> DeployConfig:
     if node.args:
         d.type = _as_str(node.arg(0))
     for c in node.children:
-        if c.name == "type":
+        # "provider" is the reference's spelling (service.rs:129-141);
+        # accept both so configs port over unchanged
+        if c.name in ("type", "provider"):
             d.type = c.first_string(d.type)
         elif c.name == "output":
             d.output = c.first_string()
@@ -188,6 +190,16 @@ def _parse_deploy(node: KdlNode) -> DeployConfig:
             d.command = c.first_string()
         elif c.name == "project":
             d.project = c.first_string()
+    for k, v in node.props.items():
+        # reference KDL uses property form: deploy provider="..." output="..."
+        if k in ("type", "provider"):
+            d.type = _as_str(v)
+        elif k == "output":
+            d.output = _as_str(v)
+        elif k == "command":
+            d.command = _as_str(v)
+        elif k == "project":
+            d.project = _as_str(v)
     return d
 
 

@@ -393,3 +393,30 @@ service "b" {
     assert a.build.no_cache is False
     assert b.volumes[0].read_only is True
     assert b.build.no_cache is True
+
+
+def test_deploy_accepts_reference_property_form():
+    """The reference's DeployConfig is property-style with a `provider`
+    key (service.rs:129-141): `deploy provider="cloudflare-pages"
+    output="dist" project="site"` must port over unchanged; our
+    child-node `type` spelling keeps working."""
+    from fleetflow_tpu.core.parser import parse_kdl_string
+
+    flow = parse_kdl_string("""
+project "p"
+service "site" {
+    type "static"
+    image "none"
+    deploy provider="cloudflare-pages" output="dist" project="shop-site"
+}
+service "site2" {
+    type "static"
+    image "none"
+    deploy { provider "s3"; output "build" }
+}
+""")
+    d = flow.services["site"].deploy
+    assert (d.type, d.output, d.project) == ("cloudflare-pages", "dist",
+                                             "shop-site")
+    d2 = flow.services["site2"].deploy
+    assert (d2.type, d2.output) == ("s3", "build")
