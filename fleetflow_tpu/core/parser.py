@@ -218,6 +218,19 @@ def _parse_healthcheck(node: KdlNode) -> HealthCheck:
             h.retries = int(c.arg(0, h.retries))
         elif c.name in ("start_period", "start-period"):
             h.start_period = _duration(c.arg(0), h.start_period)
+    # reference KDL is property-style (service.rs:236-269): healthcheck
+    # test="..." interval=15 ... — dropping these silently kept defaults
+    for k, v in node.props.items():
+        if k in ("test", "command"):
+            h.test = [_as_str(v)]
+        elif k == "interval":
+            h.interval = _duration(v, h.interval)
+        elif k == "timeout":
+            h.timeout = _duration(v, h.timeout)
+        elif k == "retries":
+            h.retries = int(v)
+        elif k in ("start_period", "start-period"):
+            h.start_period = _duration(v, h.start_period)
     return h
 
 
@@ -239,6 +252,12 @@ def _parse_readiness(node: KdlNode) -> ReadinessCheck:
             r.path = _as_str(v)
         elif k == "port":
             r.port = int(v)
+        elif k == "type":
+            r.type = _as_str(v)
+        elif k == "timeout":
+            r.timeout = _duration(v, r.timeout)
+        elif k == "interval":
+            r.interval = _duration(v, r.interval)
     return r
 
 
@@ -253,6 +272,15 @@ def _parse_wait(node: KdlNode) -> WaitConfig:
             w.max_delay = _duration(c.arg(0), w.max_delay)
         elif c.name == "multiplier":
             w.multiplier = float(c.arg(0, w.multiplier))
+    for k, v in node.props.items():
+        if k in ("max_retries", "max-retries", "retries"):
+            w.max_retries = int(v)
+        elif k in ("initial_delay", "initial-delay"):
+            w.initial_delay = _duration(v, w.initial_delay)
+        elif k in ("max_delay", "max-delay"):
+            w.max_delay = _duration(v, w.max_delay)
+        elif k == "multiplier":
+            w.multiplier = float(v)
     return w
 
 

@@ -420,3 +420,30 @@ service "site2" {
                                              "shop-site")
     d2 = flow.services["site2"].deploy
     assert (d2.type, d2.output) == ("s3", "build")
+
+
+def test_health_readiness_wait_accept_reference_property_form():
+    """The reference declares these property-style (service.rs:236-330);
+    dropping the properties silently kept defaults — a ported config's
+    health tuning vanished without a word."""
+    from fleetflow_tpu.core.parser import parse_kdl_string
+
+    flow = parse_kdl_string("""
+project "p"
+service "api" {
+    image "x"
+    healthcheck test="curl -f localhost" interval=15 timeout=5 retries=4 start-period=20
+    readiness path="/healthz" port=9090 timeout=10 interval=1
+    wait max-retries=10 initial-delay=2 max-delay=20 multiplier=1.5
+}
+""")
+    svc = flow.services["api"]
+    h = svc.healthcheck
+    assert (h.test, h.interval, h.timeout, h.retries, h.start_period) == (
+        ["curl -f localhost"], 15.0, 5.0, 4, 20.0)
+    r = svc.readiness
+    assert (r.path, r.port, r.timeout, r.interval) == ("/healthz", 9090,
+                                                       10.0, 1.0)
+    w = svc.wait
+    assert (w.max_retries, w.initial_delay, w.max_delay, w.multiplier) == (
+        10, 2.0, 20.0, 1.5)
