@@ -474,12 +474,17 @@ def _build_images(flow: Flow, services, project_root: Optional[str],
     import dataclasses as _dc
 
     from ..build import BuildResolver, ImageBuilder, ImagePusher
+    flow_registry = flow.registry.url if flow.registry else None
     resolver = BuildResolver(project_root or ".",
-                             registry=registry or (
-                                 flow.registry.url if flow.registry else None))
+                             registry=registry or flow_registry)
     tags = []
     for svc in services:
-        resolved = resolver.resolve(svc)
+        res = resolver
+        if registry is None and svc.registry:
+            # reference precedence: CLI flag > service.registry > stage >
+            # flow (build.rs:203-205)
+            res = BuildResolver(project_root or ".", registry=svc.registry)
+        resolved = res.resolve(svc)
         if tag_for is not None:
             resolved = _dc.replace(resolved, tag=tag_for(svc))
         print(f"building {resolved.tag} from {resolved.context}")

@@ -225,6 +225,9 @@ class Service:
     variables: dict[str, str] = field(default_factory=dict)
     resources: ResourceSpec = field(default_factory=ResourceSpec)
     labels: dict[str, str] = field(default_factory=dict)
+    # per-service push registry (reference service.rs:69; build-tag
+    # precedence flag > service > stage > flow, build.rs:203-205)
+    registry: Optional[str] = None
     # Placement hints (extensions; reference keeps these CP-side)
     colocate_with: list[str] = field(default_factory=list)
     anti_affinity: list[str] = field(default_factory=list)
@@ -275,6 +278,7 @@ class Service:
             healthcheck=_merge_opt(self.healthcheck, other.healthcheck),
             readiness=_merge_opt(self.readiness, other.readiness),
             wait=_merge_opt(self.wait, other.wait),
+            registry=_merge_opt(self.registry, other.registry),
             variables=_merge_map(self.variables, other.variables),
             resources=other.resources if other._resources_set else self.resources,
             labels=_merge_map(self.labels, other.labels),

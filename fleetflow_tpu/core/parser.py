@@ -330,6 +330,12 @@ def parse_service(node: KdlNode) -> Service:
             svc.version = _as_str(v)
         elif k == "type":
             svc.service_type = ServiceType(_as_str(v))
+        elif k == "command":
+            svc.command = _as_str(v)
+        elif k == "restart":
+            svc.restart = RestartPolicy.parse(_as_str(v))
+        elif k == "registry":
+            svc.registry = _as_str(v)
     for c in node.children:
         n = c.name
         if n == "image":
@@ -343,6 +349,8 @@ def parse_service(node: KdlNode) -> Service:
             svc.restart = RestartPolicy.parse(c.first_string("no"))
         elif n in ("service_type", "service-type", "type"):
             svc.service_type = ServiceType(c.first_string("container"))
+        elif n == "registry":
+            svc.registry = c.first_string()
         elif n == "ports":
             svc.ports = [parse_port(p) for p in c.children_named("port")]
         elif n == "port":

@@ -912,3 +912,38 @@ stage "live" { service "a"; servers "w1" }
         assert len(resize) == 1
         assert resize[0].current["disk_id"] == "501"
         assert "resize 40gb -> 80gb" in resize[0].description
+
+
+def test_per_service_registry_precedence(tmp_path):
+    """Reference build.rs:203-205: CLI flag > service.registry > flow
+    registry. The service level was missing entirely — a ported config's
+    per-service push registry was silently ignored."""
+    from fleetflow_tpu.build import BuildResolver
+    from fleetflow_tpu.core.parser import parse_kdl_string
+
+    (tmp_path / "Dockerfile").write_text("FROM scratch\n")
+
+    flow = parse_kdl_string("""
+project "p"
+registry "ghcr.io/org"
+service "a" {
+    image "a"
+    registry "registry.example/team"
+    build { context "." }
+}
+service "b" { image "b"; build { context "." } }
+""")
+    a, b = flow.services["a"], flow.services["b"]
+    assert a.registry == "registry.example/team"
+    assert b.registry is None
+    # service registry wins over flow registry
+    ra = BuildResolver(str(tmp_path), registry=a.registry).resolve(a)
+    assert ra.tag.startswith("registry.example/team/")
+    rb = BuildResolver(
+        str(tmp_path),
+        registry=flow.registry.url if flow.registry else None).resolve(b)
+    assert rb.tag.startswith("ghcr.io/org/")
+    # merge: override's registry wins (last-wins scalar)
+    merged = a.merge(parse_kdl_string(
+        'project "x"\nservice "a" { registry "other.io/x" }').services["a"])
+    assert merged.registry == "other.io/x"
