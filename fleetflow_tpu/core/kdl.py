@@ -37,6 +37,17 @@ class KdlError(ValueError):
         self.col = col
 
 
+def bool_value(v) -> bool:
+    """Coerce a KDL value to bool: keyword booleans (#true/#false) arrive
+    as real bools, but bare-word `true`/`false` arrive as STRINGS — and
+    bool("false") is True, so naive coercion silently enables whatever a
+    config said to disable. One definition, shared by the flow parser and
+    the daemon config."""
+    if isinstance(v, str):
+        return v.strip().lower() not in ("false", "0", "no", "off", "")
+    return bool(v)
+
+
 @dataclass(slots=True)
 class KdlNode:
     """A single KDL node: ``name arg1 arg2 key=value { children }``."""

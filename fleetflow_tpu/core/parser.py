@@ -26,7 +26,7 @@ import os
 from typing import Any, Optional
 
 from .errors import FlowError
-from .kdl import KdlNode, parse_document
+from .kdl import KdlNode, bool_value, parse_document
 from .model import (
     Backend, BuildConfig, CloudProviderDecl, DeployConfig, FallbackPolicy, Flow,
     HealthCheck, PlacementPolicy, PlacementStrategy, Port, Protocol,
@@ -48,13 +48,9 @@ def _as_str(v: Any) -> str:
     return str(v)
 
 
-def _as_bool(v: Any) -> bool:
-    """KDL keyword booleans (#true/#false) arrive as bools; bare-word
-    true/false arrive as STRINGS, and bool("false") is True — a user
-    writing `read-only false` must not get a read-only mount."""
-    if isinstance(v, str):
-        return v.strip().lower() not in ("false", "0", "no", "off", "")
-    return bool(v)
+# one shared definition (core.kdl.bool_value): bare-word false must
+# never coerce truthy anywhere config is read
+_as_bool = bool_value
 
 
 def _str_args(node: KdlNode) -> list[str]:

@@ -181,6 +181,7 @@ def service_to_dict(s: Service) -> dict:
     if s._resources_set:
         d["resources"] = _resources_to_dict(s.resources)
     _put(d, "labels", s.labels, {})
+    _put(d, "registry", s.registry, None)
     _put(d, "colocate_with", s.colocate_with, [])
     _put(d, "anti_affinity", s.anti_affinity, [])
     if s._replicas_set:
@@ -208,6 +209,7 @@ def service_from_dict(d: dict) -> Service:
         variables=d.get("variables", {}),
         resources=_resources_from_dict(d["resources"]) if "resources" in d else ResourceSpec(),
         labels=d.get("labels", {}),
+        registry=d.get("registry"),
         colocate_with=d.get("colocate_with", []),
         anti_affinity=d.get("anti_affinity", []),
         replicas=d.get("replicas", 1),

@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
 
-from ..core.kdl import parse_document
+from ..core.kdl import bool_value, parse_document
 
 __all__ = ["DaemonConfig", "load_daemon_config", "config_search_paths"]
 
@@ -77,14 +77,9 @@ def load_daemon_config(explicit: Optional[str] = None) -> DaemonConfig:
     return cfg.expand()
 
 
-def _truthy(v) -> bool:
-    """KDL keyword booleans (#true/#false) arrive as real bools; bare-word
-    `true`/`false` arrive as STRINGS, and bool("false") is True — an
-    operator writing `tpu-solver false` must get False, not a silent
-    enable."""
-    if isinstance(v, str):
-        return v.strip().lower() not in ("false", "0", "no", "off", "")
-    return bool(v)
+# shared KDL bool coercion (core.kdl.bool_value): bare-word false must
+# never coerce truthy
+_truthy = bool_value
 
 
 def _apply_kdl(cfg: DaemonConfig, text: str) -> None:

@@ -279,7 +279,7 @@ def lower_stage(flow: Flow, stage_name: str,
     # ---- conflict id groups ------------------------------------------------
     port_key_ids: dict[tuple, int] = {}
     vol_key_ids: dict[str, int] = {}
-    anti_key_ids: dict[str, int] = {}
+    anti_key_ids: dict = {}   # str labels + ('pair', ...) tuples
     coloc_key_ids: dict[str, int] = {}
 
     # colocation groups are keyed by the TARGET service name, and the
@@ -294,8 +294,6 @@ def lower_stage(flow: Flow, stage_name: str,
     # `a anti_affinity "db"` separates a from db instead of silently
     # doing nothing.
     coloc_targets = {k for svc in services for k in svc.colocate_with}
-    anti_targets = ({} if local else
-                    {k for svc in services for k in svc.anti_affinity})
     unknown_coloc = coloc_targets - {s.name for s in services}
     if unknown_coloc:
         # unlike depends_on (hard error), colocation is a soft preference
@@ -306,6 +304,31 @@ def lower_stage(flow: Flow, stage_name: str,
         get_logger("lower").warning(
             "colocate_with targets not in stage %r: %s (preference has "
             "no effect)", stage_name, sorted(unknown_coloc))
+
+    # Target-style anti-affinity — a key naming a stage service means
+    # "separate ME from THAT service" — lowers to one 2-member group per
+    # (declarer row, target row) PAIR. Any shared-group formulation
+    # over-constrains someone: a single group per target forces the
+    # target's replicas apart from each other, and a group shared by all
+    # declarer rows forces the declarer's replicas apart too — hard
+    # constraints nobody declared (r5 close review: web anti_affinity
+    # "db" with db replicas=2 on 2 nodes went infeasible). Pair groups
+    # encode exactly the declared relation; `svc anti_affinity "<own
+    # name>"` pairs every replica with every sibling, i.e. requests hard
+    # replica spreading.
+    anti_pair_ids: dict[int, list[int]] = {}
+    if not local:
+        for i, svc in enumerate(rows):
+            for k in svc.anti_affinity:
+                if k not in base_index:
+                    continue
+                for j in base_index[k]:
+                    if j == i:
+                        continue
+                    pair = ("pair", k, min(i, j), max(i, j))
+                    gid = anti_key_ids.setdefault(pair, len(anti_key_ids))
+                    anti_pair_ids.setdefault(i, []).append(gid)
+                    anti_pair_ids.setdefault(j, []).append(gid)
 
     port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
     for i, svc in enumerate(rows):
@@ -320,12 +343,14 @@ def lower_stage(flow: Flow, stage_name: str,
             if ck is not None:
                 vg.append(vol_key_ids.setdefault(ck, len(vol_key_ids)))
         vol_groups.append(vg)
+        # anti_affinity keys that do NOT name a stage service stay
+        # LABEL-style: all declarers of "db-tier" mutually exclude.
+        # Target-style keys (naming a service) are handled via the
+        # pairwise groups prepared above the loop.
         ag = ([] if local else
               [anti_key_ids.setdefault(k, len(anti_key_ids))
-               for k in svc.anti_affinity])
-        if not local and svc.name in anti_targets:
-            ag.append(anti_key_ids.setdefault(svc.name,
-                                              len(anti_key_ids)))
+               for k in svc.anti_affinity if k not in base_index])
+        ag.extend(anti_pair_ids.get(i, ()))
         anti_groups.append(list(dict.fromkeys(ag)))
         cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
               for k in svc.colocate_with]

@@ -415,3 +415,32 @@ stage "live" { service "db"; service "api"; servers "n0" "n1" }
         res = solve(pt, steps=64, seed=3)
         assert res.feasible
         assert res.assignment[by_name["db"]] != res.assignment[by_name["api"]]
+
+    def test_anti_affinity_pairs_leave_replicas_together(self):
+        """`web anti_affinity "db"` with db replicas=2 on 2 nodes must
+        stay feasible: the constraint separates web from every db row,
+        NOT db's replicas from each other (pairwise groups; a shared
+        group forced the siblings apart too and made this infeasible)."""
+        from fleetflow_tpu.core.parser import parse_kdl_string
+
+        from fleetflow_tpu.solver import solve
+        flow = parse_kdl_string("""
+project "p"
+server "n0" { capacity { cpu 4; memory 4096; disk 999 } }
+server "n1" { capacity { cpu 4; memory 4096; disk 999 } }
+service "db" { image "pg"; replicas 2; resources { cpu 1; memory 64; disk 1 } }
+service "web" { image "w"; resources { cpu 1; memory 64; disk 1 }
+    anti_affinity "db"
+}
+stage "live" { service "db"; service "web"; servers "n0" "n1" }
+""")
+        pt = lower_stage(flow, "live")
+        res = solve(pt, steps=128, seed=5)
+        assert res.feasible, res.stats
+        by_name = {n: i for i, n in enumerate(pt.service_names)}
+        web = res.assignment[by_name["web"]]
+        assert res.assignment[by_name["db#0"]] != web
+        assert res.assignment[by_name["db#1"]] != web
+        # and the siblings were NOT forced apart: with 2 nodes and web
+        # alone on one, both db rows must share the other
+        assert res.assignment[by_name["db#0"]] == res.assignment[by_name["db#1"]]

@@ -947,3 +947,18 @@ service "b" { image "b"; build { context "." } }
     merged = a.merge(parse_kdl_string(
         'project "x"\nservice "a" { registry "other.io/x" }').services["a"])
     assert merged.registry == "other.io/x"
+
+
+def test_service_registry_survives_serialize_roundtrip():
+    """DeployRequest/MCP/CP all ship flows as dicts: a field the
+    serializer drops diverges remote builds from local ones (the
+    per-service registry did exactly that when first added)."""
+    from fleetflow_tpu.core.parser import parse_kdl_string
+    from fleetflow_tpu.core.serialize import flow_from_dict, flow_to_dict
+
+    flow = parse_kdl_string("""
+project "p"
+service "a" { image "a"; registry "registry.example/team" }
+""")
+    flow2 = flow_from_dict(flow_to_dict(flow))
+    assert flow2.services["a"].registry == "registry.example/team"
