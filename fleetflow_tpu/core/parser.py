@@ -476,7 +476,13 @@ def parse_provider(node: KdlNode) -> CloudProviderDecl:
             p.zone = c.first_string()
         else:
             p.options[c.name] = c.arg(0) if len(c.args) <= 1 else list(c.args)
-    p.options.update(node.props)
+    # reference KDL is property-style (cloud.rs:10-18): `provider "sakura"
+    # zone="tk1a"` — zone must land on the field, not in options
+    for k, v in node.props.items():
+        if k == "zone":
+            p.zone = _as_str(v)
+        else:
+            p.options[k] = v
     return p
 
 
@@ -533,6 +539,31 @@ def parse_server(node: KdlNode) -> ServerResource:
             s.capacity = _parse_resources(c)
         elif n == "labels":
             s.labels = _parse_server_labels(c)
+    # reference KDL is property-style throughout its server decls
+    # (cloud.rs:23-69): `server "web-1" provider="sakura" plan="2core-4gb"
+    # disk-size=40 ...` — dropping these silently lost the whole inventory
+    for k, v in node.props.items():
+        kk = k.replace("_", "-")
+        if kk == "provider":
+            s.provider = _as_str(v)
+        elif kk == "plan":
+            s.plan = _as_str(v)
+        elif kk == "disk-size":
+            s.disk_size = int(v)
+        elif kk == "os":
+            s.os = _as_str(v)
+        elif kk == "archive":
+            s.archive = _as_str(v)
+        elif kk in ("ssh-key", "ssh-keys"):
+            s.ssh_keys.append(_as_str(v))
+        elif kk in ("ssh-host", "host"):
+            s.ssh_host = _as_str(v)
+        elif kk == "ssh-user":
+            s.ssh_user = _as_str(v)
+        elif kk == "startup-script":
+            s.startup_script = _as_str(v)
+        elif kk == "dns-hostname":
+            s.dns_hostname = _as_str(v)
     return s
 
 

@@ -447,3 +447,28 @@ service "api" {
     w = svc.wait
     assert (w.max_retries, w.initial_delay, w.max_delay, w.multiplier) == (
         10, 2.0, 20.0, 1.5)
+
+
+def test_provider_and_server_accept_reference_property_form():
+    """The reference declares infra property-style (cloud.rs:10-69):
+    provider zone= and server provider=/plan=/disk-size=/... — dropping
+    the properties silently lost the whole server inventory of a ported
+    config."""
+    from fleetflow_tpu.core.parser import parse_kdl_string
+
+    flow = parse_kdl_string("""
+project "p"
+provider "sakura" zone="tk1a" api-token="t"
+server "web-1" provider="sakura" plan="2core-4gb" disk-size=40 os="ubuntu" \
+archive="gold" ssh-host="10.0.0.1" ssh-user="ops" ssh-key="deploy" \
+startup-script="init" dns-hostname="web-1.example"
+""")
+    pr = flow.providers["sakura"]
+    assert pr.zone == "tk1a" and pr.options.get("api-token") == "t"
+    sv = flow.servers["web-1"]
+    assert (sv.provider, sv.plan, sv.disk_size, sv.os) == (
+        "sakura", "2core-4gb", 40, "ubuntu")
+    assert (sv.archive, sv.ssh_host, sv.ssh_user) == ("gold", "10.0.0.1",
+                                                      "ops")
+    assert sv.ssh_keys == ["deploy"]
+    assert sv.startup_script == "init" and sv.dns_hostname == "web-1.example"
