@@ -579,8 +579,11 @@ def _sharded_child() -> None:
     t_seed = time.perf_counter()
     # past ~50k services the exact whole-instance FFD dominates the solve
     # (108.9 s at 100k x 10k, docs/profiles/r5-xl-sharded.md): partition
-    # the service axis and FFD each slice against capacity/parts, letting
-    # the anneal repair the few cross-slice conflicts. BENCH_SHARDED_SEED
+    # into contiguous service slices x disjoint round-robin NODE subsets
+    # and FFD each slice onto its own nodes at FULL capacity (greedy.py
+    # partitioned_seed; capacity-sharing across slices was the rejected
+    # design), letting the anneal repair the residue — out-of-slice
+    # eligibility and packing fragmentation. BENCH_SHARDED_SEED
     # = whole|partitioned overrides the size heuristic.
     seed_mode = os.environ.get("BENCH_SHARDED_SEED", "")
     # partitioning requires the native FFD: without it partitioned_seed

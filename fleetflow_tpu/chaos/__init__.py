@@ -1,0 +1,35 @@
+"""Chaos harness: deterministic fault injection + fleet-wide invariant
+checking (docs/guide/08-chaos-harness.md).
+
+    from fleetflow_tpu.chaos import run_scenario
+    report = run_scenario("rolling-kill", seed=7, services=1000, nodes=100)
+    assert report.ok, report.violations
+
+Same seed -> same schedule -> same event log (`report.digest()`): every
+robustness claim becomes a replayable repro.
+"""
+
+from .faults import (AgentPartition, ContainerExit, DeployFail, Fault,
+                     FaultSchedule, NodeCrash, NodeFlap, Redeploy,
+                     SlowAgent, WorkerKill)
+from .injector import FaultInjector
+from .invariants import FINAL_INVARIANTS, INSTANT_INVARIANTS
+from .runner import ChaosReport, ChaosWorld, VirtualClock, run_schedule
+from .scenarios import SCENARIOS, build_schedule, scenario_names
+
+__all__ = [
+    "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
+    "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
+    "FaultSchedule", "FaultInjector", "ChaosReport", "ChaosWorld",
+    "VirtualClock", "run_schedule", "run_scenario", "SCENARIOS",
+    "build_schedule", "scenario_names", "INSTANT_INVARIANTS",
+    "FINAL_INVARIANTS",
+]
+
+
+def run_scenario(name: str, *, seed: int, services: int, nodes: int,
+                 stages: int = 4, pool_min: int = 2) -> ChaosReport:
+    """Build the named scenario's seeded schedule and replay it."""
+    schedule = build_schedule(name, seed, services, nodes)
+    return run_schedule(schedule, services=services, nodes=nodes,
+                        stages=stages, pool_min=pool_min)

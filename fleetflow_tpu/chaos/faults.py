@@ -1,0 +1,169 @@
+"""Fault algebra: the small, composable vocabulary of things that go
+wrong in a fleet, plus seeded schedule generation.
+
+Jepsen/Chaos-Monkey shape: a *fault* is a declarative, serializable
+description ("node07 crashes at t=60 and revives 240s later"); a
+*schedule* is a seeded, sorted list of faults; the *runner* replays a
+schedule's expanded primitive timeline against a simulated fleet. The
+algebra is deliberately tiny — six fault kinds cover the robustness
+machinery the control plane actually carries (churn re-solves, 2-phase
+reservations, autoscaler reaping, deploy retry/release):
+
+  NodeCrash      node powers off (containers die); optional revival
+  NodeFlap       crash + fast revival (one flap of a flap-storm)
+  AgentPartition CP<->agent link drops; the node keeps running
+  SlowAgent      agent answers, but after `delay` virtual seconds
+  DeployFail     arm the next N service-starts to fail mid-deploy
+  ContainerExit  one running container on a node exits unexpectedly
+  WorkerKill     crash an autoscaler pool worker (target picked at
+                 apply time: the pool's first online worker)
+  Redeploy       operator action: redeploy a stage (Jepsen "client op")
+
+Every fault expands into primitive (time, op, params) events; the
+runner groups same-instant primitives into one burst so coalesced churn
+(`placement.node_events`) is exercised the way production would see it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = [
+    "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
+    "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
+    "FaultSchedule",
+]
+
+# primitive ops the runner executes (the fault algebra's normal form)
+NODE_DOWN = "node_down"
+NODE_UP = "node_up"
+PARTITION_START = "partition_start"
+PARTITION_END = "partition_end"
+SLOW_START = "slow_start"
+SLOW_END = "slow_end"
+ARM_DEPLOY_FAIL = "arm_deploy_fail"
+CONTAINER_EXIT = "container_exit"
+WORKER_KILL = "worker_kill"
+REDEPLOY = "redeploy"
+
+
+@dataclass(frozen=True)
+class Fault:
+    """Base fault: `at` is virtual seconds from scenario start."""
+    at: float
+
+    def expand(self) -> list[tuple[float, str, dict]]:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class NodeCrash(Fault):
+    node: str = ""
+    revive_after: Optional[float] = None   # None = stays dead
+
+    def expand(self):
+        out = [(self.at, NODE_DOWN, {"node": self.node, "wipe": True})]
+        if self.revive_after is not None:
+            out.append((self.at + self.revive_after, NODE_UP,
+                        {"node": self.node}))
+        return out
+
+
+@dataclass(frozen=True)
+class NodeFlap(Fault):
+    node: str = ""
+    down_for: float = 5.0
+
+    def expand(self):
+        return [(self.at, NODE_DOWN, {"node": self.node, "wipe": True}),
+                (self.at + self.down_for, NODE_UP, {"node": self.node})]
+
+
+@dataclass(frozen=True)
+class AgentPartition(Fault):
+    """The CP cannot reach the agent; the node keeps its containers."""
+    node: str = ""
+    duration: float = 60.0
+
+    def expand(self):
+        return [(self.at, PARTITION_START, {"node": self.node}),
+                (self.at + self.duration, PARTITION_END,
+                 {"node": self.node})]
+
+
+@dataclass(frozen=True)
+class SlowAgent(Fault):
+    """Commands to the agent take `delay` virtual seconds; a delay past
+    the command's timeout is a timeout failure."""
+    node: str = ""
+    delay: float = 30.0
+    duration: float = 120.0
+
+    def expand(self):
+        return [(self.at, SLOW_START, {"node": self.node,
+                                       "delay": self.delay}),
+                (self.at + self.duration, SLOW_END, {"node": self.node})]
+
+
+@dataclass(frozen=True)
+class DeployFail(Fault):
+    """Arm the injector: the next `count` service-starts anywhere in the
+    fleet raise at the deploy engine's fault hook."""
+    count: int = 1
+
+    def expand(self):
+        return [(self.at, ARM_DEPLOY_FAIL, {"count": self.count})]
+
+
+@dataclass(frozen=True)
+class ContainerExit(Fault):
+    """One running fleet container on `node` exits (first by sorted
+    name — deterministic); the runner's monitor pass restarts it."""
+    node: str = ""
+
+    def expand(self):
+        return [(self.at, CONTAINER_EXIT, {"node": self.node})]
+
+
+@dataclass(frozen=True)
+class WorkerKill(Fault):
+    """Crash an autoscaler pool worker; the target is resolved at apply
+    time (first online worker of `pool`, sorted by slug)."""
+    pool: str = "workers"
+
+    def expand(self):
+        return [(self.at, WORKER_KILL, {"pool": self.pool})]
+
+
+@dataclass(frozen=True)
+class Redeploy(Fault):
+    """Operator redeploy of a stage (the Jepsen 'client operation' that
+    races whatever else the schedule is doing at this instant)."""
+    stage: str = ""
+
+    def expand(self):
+        return [(self.at, REDEPLOY, {"stage": self.stage})]
+
+
+@dataclass
+class FaultSchedule:
+    """A seeded, replayable fault plan."""
+    scenario: str
+    seed: int
+    faults: list[Fault] = field(default_factory=list)
+    horizon: float = 0.0       # virtual end-of-scenario settle point
+
+    def events(self) -> list[tuple[float, str, dict]]:
+        """Expanded primitive timeline, stably sorted by time (ties keep
+        declaration order, so a schedule is exactly reproducible)."""
+        prims: list[tuple[float, str, dict]] = []
+        for f in self.faults:
+            prims.extend(f.expand())
+        return sorted(prims, key=lambda e: e[0])
+
+    def describe(self) -> list[str]:
+        return [f"t={f.at:>7.1f}s {type(f).__name__} "
+                + " ".join(f"{k}={v}" for k, v in vars(f).items()
+                           if k != "at" and v is not None)
+                for f in sorted(self.faults, key=lambda f: f.at)]

@@ -1,0 +1,188 @@
+"""Fleet-wide invariants: what must hold no matter which faults fired.
+
+Each checker takes the chaos world (duck-typed: `state` AppState, `flow`,
+`stage_keys`, `backends` slug->MockBackend, `clock`) and returns a list
+of violation strings — empty means the invariant holds. The runner calls
+the *instant* checkers after every applied fault burst (single-threaded
+replay means every mutation happens between two check points, so
+per-burst checking is "at any instant") and the *final* checkers once
+the world settles.
+
+Every checker has a deliberately-broken-world canary test
+(tests/test_chaos.py) proving it actually fires — a chaos harness whose
+invariants are vacuously green is worse than no harness.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
+           "check_final", "capacity_accounting", "reservations_terminal",
+           "no_dead_assignments", "pools_at_min", "solver_feasible",
+           "containers_converged"]
+
+_EPS = 1e-6
+
+
+def _alloc_vec(s) -> np.ndarray:
+    a = s.allocated
+    return np.array([a.cpu + a.reserved_cpu, a.memory + a.reserved_memory,
+                     a.disk + a.reserved_disk], dtype=np.float64)
+
+
+def capacity_accounting(world) -> list[str]:
+    """No node is ever double-booked: committed allocation plus every
+    in-flight reservation's demand stays within raw capacity (the 2-phase
+    journal's whole reason to exist, SURVEY hard part (c))."""
+    out: list[str] = []
+    snap = world.state.placement.reservations_snapshot()
+    inflight: dict[str, np.ndarray] = {}
+    for r in snap["in_flight"]:
+        for slug, dem in r["demand_by_node"].items():
+            inflight[slug] = (inflight.get(slug, 0)
+                              + np.asarray(dem, dtype=np.float64))
+    for s in sorted(world.state.store.list("servers"), key=lambda s: s.slug):
+        cap = np.array([s.capacity.cpu, s.capacity.memory, s.capacity.disk],
+                       dtype=np.float64)
+        spoken = _alloc_vec(s) + inflight.get(s.slug, 0)
+        if np.any(spoken > cap * (1 + _EPS) + _EPS):
+            out.append(
+                f"capacity double-booked on {s.slug}: "
+                f"committed+reserved={np.round(spoken, 3).tolist()} > "
+                f"capacity={cap.tolist()}")
+    return out
+
+
+def reservations_terminal(world) -> list[str]:
+    """Every reservation reached a terminal state: committed or released.
+    A settled world has NO in-flight reservations — a leftover one is
+    capacity leaked forever (or a churn hold whose redeploy never came)."""
+    snap = world.state.placement.reservations_snapshot()
+    return [f"reservation {r['id']} for {r['stage']} still in flight "
+            f"(churn={r['churn']}) after settle"
+            for r in snap["in_flight"]]
+
+
+def no_dead_assignments(world, snapshot=None) -> list[str]:
+    """After churn re-solves settle, no service row is assigned to a node
+    that is offline or unschedulable."""
+    out: list[str] = []
+    by_slug = {s.slug: s for s in world.state.store.list("servers")}
+    if snapshot is None:
+        snapshot = world.state.placement.snapshot()
+    for key, view in sorted(snapshot.items()):
+        if not view["feasible"]:
+            out.append(f"stage {key} settled infeasible "
+                       f"({view['violations']} violations)")
+            continue
+        for row, node in sorted(view["assignment"].items()):
+            s = by_slug.get(node)
+            if s is None:
+                out.append(f"{key}: {row} assigned to vanished node {node}")
+            elif not s.schedulable:
+                out.append(f"{key}: {row} assigned to dead node {node} "
+                           f"(status={s.status}, "
+                           f"state={s.scheduling_state})")
+    return out
+
+
+def pools_at_min(world) -> list[str]:
+    """The autoscaler held every worker pool at its floor: at least
+    min_servers members alive (online, or provisioning and younger than
+    the zombie timeout)."""
+    from ..cp.autoscaler import PROVISION_TIMEOUT_S
+    out: list[str] = []
+    now = world.clock.now()
+    for pool in world.state.store.list("worker_pools"):
+        members = world.state.store.list(
+            "servers", lambda s: s.pool == pool.name
+            and s.tenant == pool.tenant)
+        alive = [s for s in members
+                 if s.status == "online"
+                 or (s.status == "provisioning"
+                     and now - s.created_at < PROVISION_TIMEOUT_S)]
+        if len(alive) < pool.min_servers:
+            out.append(f"pool {pool.name} below floor: {len(alive)} alive "
+                       f"< min_servers={pool.min_servers}")
+    return out
+
+
+def solver_feasible(world) -> list[str]:
+    """The final assignment is exactly feasible per the solver's own
+    checker (solver/repair.verify): zero capacity/conflict/eligibility/
+    skew violations against the stage's retained problem."""
+    from ..solver.repair import verify
+    out: list[str] = []
+    for key in world.stage_keys:
+        entry = world.state.placement.retained(key)
+        if entry is None:
+            out.append(f"stage {key}: no retained placement to verify")
+            continue
+        pt, placement = entry
+        if placement.raw is None:
+            out.append(f"stage {key}: placement has no raw assignment")
+            continue
+        stats = verify(pt, np.asarray(placement.raw))
+        if stats["total"] != 0:
+            out.append(f"stage {key}: solver checker found violations "
+                       f"{stats}")
+    return out
+
+
+def containers_converged(world, snapshot=None) -> list[str]:
+    """Desired == observed: every service row of every stage's settled
+    assignment has its container RUNNING on the assigned node's backend
+    (crashed/exited containers were restarted or redeployed)."""
+    from ..runtime.converter import container_name
+    out: list[str] = []
+    if snapshot is None:
+        snapshot = world.state.placement.snapshot()
+    for key, view in sorted(snapshot.items()):
+        if not view["feasible"]:
+            continue   # reported by no_dead_assignments
+        stage_name = key.split("/", 1)[1]
+        for row, node in sorted(view["assignment"].items()):
+            base, _, ridx = row.partition("#")
+            cname = container_name(world.flow.name, stage_name, base)
+            if ridx:
+                cname = f"{cname}-{ridx}"
+            backend = world.backends.get(node)
+            if backend is None:
+                out.append(f"{key}: {row} on {node} which has no backend")
+                continue
+            info = backend.inspect(cname)
+            if info is None or not info.running:
+                state = "missing" if info is None else info.state
+                out.append(f"{key}: container {cname} on {node} is {state}")
+    return out
+
+
+INSTANT_INVARIANTS = {"capacity-accounting": capacity_accounting}
+FINAL_INVARIANTS = {
+    "capacity-accounting": capacity_accounting,
+    "reservations-terminal": reservations_terminal,
+    "no-dead-assignments": no_dead_assignments,
+    "pools-at-min": pools_at_min,
+    "solver-feasible": solver_feasible,
+    "containers-converged": containers_converged,
+}
+
+
+def check_instant(world) -> list[str]:
+    return [f"[{name}] {v}" for name, fn in INSTANT_INVARIANTS.items()
+            for v in fn(world)]
+
+
+def check_final(world) -> list[str]:
+    # one placement snapshot for the whole pass: the two assignment-
+    # walking checkers share it instead of each copying every stage's
+    # view under the placement lock
+    snap = world.state.placement.snapshot()
+    out: list[str] = []
+    for name, fn in FINAL_INVARIANTS.items():
+        found = (fn(world, snapshot=snap)
+                 if fn in (no_dead_assignments, containers_converged)
+                 else fn(world))
+        out.extend(f"[{name}] {v}" for v in found)
+    return out

@@ -1,0 +1,616 @@
+"""Chaos runner: replay a fault schedule against a simulated fleet.
+
+The simulation is the REAL control plane in a box — no network, no
+subprocesses, but the production code paths end to end:
+
+  Store                  in-memory, with the mutation-observer hook
+  PlacementService       real solves, 2-phase reservations, churn holds
+  AgentRegistry          real command correlation + delivery hook
+  handlers.execute_deploy  the real deploy fan-out/commit/release path
+  DeployEngine           real 5-step pipeline per node
+  MockBackend            the fake-docker backend, one per node
+  Autoscaler             real pool reconciler on the virtual clock
+
+Each simulated node is a `SimAgent`: a MockBackend plus a duck-typed
+Connection whose `send_event` executes the command inline (mirroring
+fleet-agent's dispatch) and resolves the registry future — so a deploy
+flows CP -> registry -> "wire" -> agent -> engine -> backend exactly as
+in production, just synchronously and on a virtual clock.
+
+Determinism: one seed fixes the schedule AND the replay. All iteration
+is sorted or insertion-ordered, the event log carries only virtual
+times and stable names (no wall clocks, no uuids), and re-running a
+seed must reproduce the log byte for byte (`ChaosReport.digest()`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.model import (Flow, ResourceSpec, Service, Stage)
+from ..cp.agent_registry import AgentRegistry
+from ..cp.auth import NoAuth
+from ..cp.autoscaler import Autoscaler
+from ..cp.log_router import LogRouter
+from ..cp.models import ServerCapacity, WorkerPool
+from ..cp.placement import PlacementService
+from ..cp.server import AppState
+from ..cp.store import Store
+from ..core.errors import ControlPlaneError
+from ..runtime.backend import MockBackend
+from ..runtime.engine import DeployEngine, DeployRequest
+from ..sched.base import Placement, level_schedule
+from ..lower.tensors import local_node, lower_stage
+from . import faults as F
+from .injector import FaultInjector
+from .invariants import check_final, check_instant
+
+__all__ = ["VirtualClock", "ChaosReport", "ChaosWorld", "run_schedule",
+           "make_flow"]
+
+TENANT = "default"
+POOL_NAME = "workers"
+
+
+class VirtualClock:
+    """Injectable time (the cp/autoscaler pattern), advanced only by the
+    runner — never by real elapsed time. The world's Store stamps record
+    timestamps from this clock too, so every age the autoscaler computes
+    (idle grace, zombie/corpse reaping) is exact virtual arithmetic —
+    identical on any machine, which is what makes the event-log digest
+    reproducible across processes."""
+
+    def __init__(self, start: float = 0.0):
+        self.base = float(start)
+        self._t = self.base
+
+    def now(self) -> float:
+        return self._t
+
+    def offset(self) -> float:
+        return self._t - self.base
+
+    def advance(self, dt: float) -> None:
+        self._t += max(float(dt), 0.0)
+
+    def advance_to(self, offset: float) -> None:
+        self._t = max(self._t, self.base + float(offset))
+
+
+# --------------------------------------------------------------------------
+# synthetic fleet
+# --------------------------------------------------------------------------
+
+def node_slug(i: int) -> str:
+    return f"node{i:03d}"
+
+
+def make_flow(n_services: int, n_stages: int, node_slugs: list[str],
+              seed: int) -> Flow:
+    """Synthetic flow shaped like a production fleet: dependency chains
+    of depth <= 5, mixed demand, and every 20th service running 2
+    replicas with hard self-anti-affinity (replica spreading)."""
+    rng = random.Random(seed)
+    flow = Flow(name="chaosfleet")
+    names = [f"svc{i:04d}" for i in range(n_services)]
+    per_stage = max(1, (n_services + n_stages - 1) // n_stages)
+    for i, name in enumerate(names):
+        svc = Service(
+            name=name, image="chaos-app", version="1",
+            resources=ResourceSpec(
+                cpu=rng.choice((0.05, 0.1, 0.2)),
+                memory=float(rng.choice((32, 64, 128))), disk=0.0),
+        )
+        # chains of 5 within a stage block (stage blocks are contiguous,
+        # so dependencies never cross stages)
+        if i % 5 != 0 and (i - 1) // per_stage == i // per_stage:
+            svc.depends_on = [names[i - 1]]
+        if i % 20 == 10:
+            svc.replicas = 2
+            svc.anti_affinity = [name]     # hard replica spreading
+        flow.services[name] = svc
+    for g in range(n_stages):
+        block = names[g * per_stage:(g + 1) * per_stage]
+        if not block:
+            continue
+        flow.stages[f"app{g}"] = Stage(name=f"app{g}", services=block,
+                                       servers=list(node_slugs))
+    return flow
+
+
+# --------------------------------------------------------------------------
+# simulated agents
+# --------------------------------------------------------------------------
+
+class SimConnection:
+    """Duck-types cp.protocol.Connection for AgentRegistry's use: the
+    'wire' is an inline call into the agent."""
+
+    def __init__(self, agent: "SimAgent"):
+        self.agent = agent
+        self.identity = agent.slug
+        self._closed = False
+
+    async def send_event(self, channel: str, method: str,
+                         payload: dict) -> None:
+        if self._closed:
+            raise ControlPlaneError(
+                f"connection to {self.agent.slug} is closed")
+        await self.agent.on_command(method, payload)
+
+    async def close(self) -> None:
+        self._closed = True
+
+
+class SimAgent:
+    """One node: MockBackend + the agent command dispatch (the subset of
+    fleet-agent's execute_command the chaos scenarios exercise)."""
+
+    def __init__(self, slug: str, world: "ChaosWorld"):
+        self.slug = slug
+        self.world = world
+        # the canned pack delivers deploy faults at the engine hook;
+        # MockBackend.fault_hook remains available for scenario packs
+        # that need op-level (pull/create/start) injection
+        self.backend = MockBackend(auto_pull=True)
+        self.conn = SimConnection(self)
+
+    async def on_command(self, method: str, payload: dict) -> None:
+        request_id = payload.get("request_id")
+        try:
+            result = await self.execute(method, payload.get("payload", {}))
+            reply = {"request_id": request_id, "result": result}
+        except Exception as e:   # mirror agent._on_command: errors ride back
+            reply = {"request_id": request_id, "error": str(e)}
+        if request_id:
+            self.world.state.agent_registry.resolve_result(request_id, reply)
+
+    async def execute(self, method: str, payload: dict) -> dict:
+        if method == "ping":
+            return {"pong": True, "slug": self.slug}
+        if method in ("restart", "start", "stop"):
+            name = payload["container"]
+            getattr(self.backend, method)(name)
+            return {method: name}
+        if method == "deploy.execute":
+            req = DeployRequest.from_dict(payload["request"])
+            if not req.node:
+                req.node = self.slug
+            placement = self.world.cp_placement(req, payload.get("assignment"))
+            engine = DeployEngine(
+                self.backend, sleep=self.world.clock.advance,
+                fault_hook=self.world.injector.engine_hook(self.slug))
+            res = engine.execute(req, placement=placement)
+            if not res.ok:
+                raise RuntimeError(f"failed services: {sorted(res.failed)}")
+            return {"deployed": res.deployed, "removed": res.removed}
+        if method == "deploy.down":
+            req = DeployRequest.from_dict(payload["request"])
+            engine = DeployEngine(self.backend, sleep=self.world.clock.advance)
+            res = engine.down(req.flow, req.stage_name,
+                              req.target_services or None)
+            return {"removed": res.removed}
+        raise ValueError(f"unknown sim agent command {method!r}")
+
+
+# --------------------------------------------------------------------------
+# world + report
+# --------------------------------------------------------------------------
+
+@dataclass
+class ChaosReport:
+    scenario: str
+    seed: int
+    services: int
+    nodes: int
+    stages: int
+    events: list[dict] = field(default_factory=list)
+    violations: list[str] = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def digest(self) -> str:
+        """Canonical hash of the event log — two runs of one seed must
+        produce the same digest (the replayable-repro contract)."""
+        blob = json.dumps(self.events, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def to_dict(self) -> dict:
+        return {"scenario": self.scenario, "seed": self.seed,
+                "services": self.services, "nodes": self.nodes,
+                "stages": self.stages, "ok": self.ok,
+                "digest": self.digest(), "stats": self.stats,
+                "violations": self.violations, "events": self.events}
+
+
+class ChaosWorld:
+    """The simulated fleet: AppState + per-node agents/backends +
+    virtual clock + causally-ordered event log."""
+
+    def __init__(self, flow: Flow, injector: FaultInjector,
+                 clock: VirtualClock, pool_min: int = 0):
+        self.flow = flow
+        self.clock = clock
+        self.injector = injector
+        injector.clock = clock
+        injector.on_fire = lambda kind, target: self.log(
+            "fault-fired", kind=kind, target=target)
+        store = Store(clock=clock.now)
+        self.state = AppState(
+            store=store, auth=NoAuth(), agent_registry=AgentRegistry(),
+            log_router=LogRouter(),
+            placement=PlacementService(store),
+            backend_factory=lambda: MockBackend(auto_pull=True),
+            server_provider_factory=self._provider_factory,
+            deploy_sleep=clock.advance, chaos=injector)
+        self.state.agent_registry.delivery_hook = injector.delivery_hook
+        self.agents: dict[str, SimAgent] = {}
+        self.backends: dict[str, MockBackend] = {}
+        self.events: list[dict] = []
+        self._seq = 0
+        self._levels_cache: dict[str, list[list[str]]] = {}
+        self._server_status: dict[str, str] = {}
+        self._provider_instances: dict[str, str] = {}   # name -> id
+        self.pool_min = pool_min
+        self.stage_keys = [f"{flow.name}/{s}" for s in sorted(flow.stages)]
+        self.autoscaler = Autoscaler(self.state, clock=clock.now)
+        store.subscribe(self._observe)
+
+    # -- event log ---------------------------------------------------------
+
+    def log(self, event: str, **fields) -> None:
+        self._seq += 1
+        entry = {"t": round(self.clock.offset(), 3), "seq": self._seq,
+                 "event": event}
+        entry.update(fields)
+        self.events.append(entry)
+
+    def _observe(self, op: str, table: str, payload) -> None:
+        """Store mutation observer -> causal log (status changes only:
+        allocation puts would flood, and record ids are not stable)."""
+        if table != "servers" or op != "put":
+            return
+        slug, status = payload.slug, payload.status
+        if self._server_status.get(slug) != status:
+            self._server_status[slug] = status
+            self.log("server-status", node=slug, status=status)
+
+    # -- wiring ------------------------------------------------------------
+
+    def _provider_factory(self, name: str, **kw):
+        return _SimProvider(self)
+
+    def connect(self, slug: str) -> SimAgent:
+        """(Re)connect a node's agent: fresh backend, registry entry,
+        heartbeat (exactly what an agent session does on connect)."""
+        agent = SimAgent(slug, self)
+        self.agents[slug] = agent
+        self.backends[slug] = agent.backend
+        self.state.agent_registry.register(slug, agent.conn,
+                                           principal=slug)
+        self.state.store.heartbeat(slug)
+        return agent
+
+    def disconnect(self, slug: str, wipe: bool = True) -> None:
+        """Crash semantics: session gone; `wipe` kills the containers."""
+        agent = self.agents.pop(slug, None)
+        if agent is not None:
+            agent.conn._closed = True
+            self.state.agent_registry.unregister(slug, agent.conn)
+        if wipe:
+            self.backends.pop(slug, None)
+
+    def cp_placement(self, req: DeployRequest,
+                     assignment: Optional[dict]) -> Optional[Placement]:
+        """Mirror of agent._placement_from with a per-stage level cache
+        (the flow is static, so the dependency schedule is too)."""
+        if not assignment:
+            return None
+        levels = self._levels_cache.get(req.stage_name)
+        if levels is None:
+            pt = lower_stage(req.flow, req.stage_name,
+                             nodes=[local_node(req.node or "sim")])
+            levels = level_schedule(pt)
+            self._levels_cache[req.stage_name] = levels
+        return Placement(assignment=dict(assignment), levels=levels,
+                         feasible=True, source="cp-solved")
+
+
+class _SimProvider:
+    """Cloud ServerProvider stand-in for the autoscaler (the FakeProvider
+    test pattern): instant machines, deterministic ids."""
+
+    def __init__(self, world: ChaosWorld):
+        self.world = world
+
+    def list_servers(self):
+        from ..cloud.provider import ServerInfo
+        return [ServerInfo(id=iid, name=name, status="up")
+                for name, iid in sorted(
+                    self.world._provider_instances.items())]
+
+    def create_server(self, spec):
+        from ..cloud.provider import ServerInfo
+        iid = f"sim-{spec.name}"
+        self.world._provider_instances[spec.name] = iid
+        return ServerInfo(id=iid, name=spec.name, status="up",
+                          ip="203.0.113.10")
+
+    def delete_server(self, server_id) -> bool:
+        for name, iid in list(self.world._provider_instances.items()):
+            if iid == server_id:
+                del self.world._provider_instances[name]
+        return True
+
+    def get_server(self, server_id):
+        return None
+
+    def power_on(self, server_id) -> bool:
+        return True
+
+    def power_off(self, server_id) -> bool:
+        return True
+
+
+# --------------------------------------------------------------------------
+# the replay loop
+# --------------------------------------------------------------------------
+
+class _Runner:
+    def __init__(self, schedule: F.FaultSchedule, n_services: int,
+                 n_nodes: int, n_stages: int, pool_min: int):
+        self.schedule = schedule
+        self.n_services = n_services
+        self.n_nodes = n_nodes
+        self.n_stages = n_stages
+        self.pool_min = pool_min
+        self.node_slugs = [node_slug(i) for i in range(n_nodes)]
+        clock = VirtualClock()
+        flow = make_flow(n_services, n_stages, self.node_slugs,
+                         seed=schedule.seed)
+        self.world = ChaosWorld(flow, FaultInjector(), clock,
+                                pool_min=pool_min)
+        self.dirty: set[str] = set()     # stage names needing redeploy
+        self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
+                      "resolves": 0, "restarts": 0, "scale_actions": 0}
+
+    # -- world bootstrap ---------------------------------------------------
+
+    def _bootstrap(self) -> None:
+        w = self.world
+        db = w.state.store
+        for slug in self.node_slugs:
+            db.register_server(slug, tenant=TENANT, hostname=slug)
+            s = db.server_by_slug(slug)
+            db.update("servers", s.id, capacity=ServerCapacity(
+                cpu=4.0, memory=8192.0, disk=40960.0))
+            w.connect(slug)
+        if self.pool_min > 0:
+            # max leaves headroom for replacements while dead records
+            # await the corpse-reap window (a capped pool with several
+            # un-reaped corpses must still reach its floor)
+            db.create("worker_pools", WorkerPool(
+                tenant=TENANT, name=POOL_NAME, min_servers=self.pool_min,
+                max_servers=self.pool_min + 4,
+                preferred_labels={"provider": "sim"}))
+        w.log("world-built", services=self.n_services, nodes=self.n_nodes,
+              stages=self.n_stages, pool_min=self.pool_min)
+
+    # -- deploys -----------------------------------------------------------
+
+    async def _deploy(self, stage_name: str) -> bool:
+        from ..cp.handlers import execute_deploy
+        w = self.world
+        req = DeployRequest(flow=w.flow, stage_name=stage_name)
+        try:
+            await execute_deploy(w.state, req, tenant_name=TENANT)
+        except Exception as e:
+            self.stats["deploys_failed"] += 1
+            w.log("deploy-failed", stage=stage_name,
+                  error=str(e)[:200])
+            return False
+        self.stats["deploys_ok"] += 1
+        w.log("deploy-ok", stage=stage_name)
+        return True
+
+    # -- fault application -------------------------------------------------
+
+    def _resolve_worker(self, pool: str) -> Optional[str]:
+        alive = sorted(s.slug for s in self.world.state.store.list(
+            "servers", lambda s: s.pool == pool and s.status == "online"))
+        return alive[0] if alive else None
+
+    def _apply_container_exit(self, node: str) -> None:
+        w = self.world
+        backend = w.backends.get(node)
+        if backend is None:
+            w.log("container-exit-skipped", node=node, reason="node down")
+            return
+        for name in sorted(backend.containers):
+            info = backend.containers[name]
+            if (info.running and info.labels.get("fleetflow.project")
+                    == w.flow.name):
+                backend.set_state(name, "exited")
+                info.exit_code = 137
+                w.log("container-exit", node=node, container=name)
+                return
+        w.log("container-exit-skipped", node=node, reason="nothing running")
+
+    async def _apply_group(self, group: list[tuple[float, str, dict]]) -> None:
+        w = self.world
+        burst: list[tuple[str, bool]] = []
+        for _t, op, p in group:
+            self.stats["faults"] += 1
+            if op == F.NODE_DOWN:
+                w.log("fault", op=op, node=p["node"])
+                w.disconnect(p["node"], wipe=p.get("wipe", True))
+                burst.append((p["node"], False))
+            elif op == F.NODE_UP:
+                w.log("fault", op=op, node=p["node"])
+                w.connect(p["node"])
+                burst.append((p["node"], True))
+            elif op == F.WORKER_KILL:
+                slug = self._resolve_worker(p["pool"])
+                if slug is None:
+                    w.log("fault-skipped", op=op, reason="no online worker")
+                    continue
+                w.log("fault", op=op, node=slug)
+                w.disconnect(slug)
+                burst.append((slug, False))
+            elif op == F.PARTITION_START:
+                w.log("fault", op=op, node=p["node"])
+                w.injector.partition(p["node"])
+            elif op == F.PARTITION_END:
+                w.log("fault", op=op, node=p["node"])
+                w.injector.heal_partition(p["node"])
+            elif op == F.SLOW_START:
+                w.log("fault", op=op, node=p["node"], delay=p["delay"])
+                w.injector.slow_agent(p["node"], p["delay"])
+            elif op == F.SLOW_END:
+                w.log("fault", op=op, node=p["node"])
+                w.injector.heal_slow(p["node"])
+            elif op == F.ARM_DEPLOY_FAIL:
+                w.log("fault", op=op, count=p["count"])
+                w.injector.arm_deploy_fail(p["count"])
+            elif op == F.CONTAINER_EXIT:
+                self._apply_container_exit(p["node"])
+            elif op == F.REDEPLOY:
+                w.log("redeploy-requested", stage=p["stage"])
+                self.dirty.add(p["stage"])
+            else:
+                raise ValueError(f"unknown primitive op {op!r}")
+        if burst:
+            # coalesced churn: ONE warm re-solve per affected stage
+            # against the final mask (the production node_events path)
+            moved = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: w.state.placement.node_events(burst))
+            self.stats["resolves"] += len(moved)
+            for key, pl in moved:
+                w.log("resolve", stage=key, feasible=pl.feasible,
+                      moved_rows=len(pl.assignment))
+                self.dirty.add(key.split("/", 1)[1])
+
+    # -- reconciliation ----------------------------------------------------
+
+    async def _monitor_pass(self) -> None:
+        """Restart exited fleet containers through the real command path
+        (a partitioned node's restart fails and is retried next pass)."""
+        w = self.world
+        for slug in sorted(w.backends):
+            backend = w.backends[slug]
+            for name in sorted(backend.containers):
+                info = backend.containers[name]
+                if (info.state == "exited"
+                        and info.labels.get("fleetflow.project")
+                        == w.flow.name):
+                    try:
+                        await w.state.agent_registry.send_command(
+                            slug, "restart", {"container": name})
+                        self.stats["restarts"] += 1
+                        w.log("restart-ok", node=slug, container=name)
+                    except ControlPlaneError as e:
+                        w.log("restart-failed", node=slug, container=name,
+                              error=str(e)[:120])
+
+    def _autoscale(self) -> None:
+        w = self.world
+        actions = self.autoscaler_sweep()
+        for a in actions:
+            self.stats["scale_actions"] += 1
+            w.log("scale", pool=a.pool, kind=a.kind, node=a.slug, ok=a.ok)
+        # boot freshly provisioned workers: the machine "comes up" and
+        # its agent connects (status provisioning -> online)
+        booted = False
+        for s in sorted(w.state.store.list(
+                "servers", lambda s: s.status == "provisioning"
+                and s.pool is not None), key=lambda s: s.slug):
+            if not booted:
+                w.clock.advance(1.0)
+                booted = True
+            w.connect(s.slug)
+            w.log("worker-online", node=s.slug)
+
+    def autoscaler_sweep(self):
+        return self.world.autoscaler.run_sweep()
+
+    async def _reconcile(self) -> None:
+        await self._monitor_pass()
+        if self.pool_min > 0:
+            self._autoscale()
+        for stage_name in sorted(self.dirty):
+            if await self._deploy(stage_name):
+                self.dirty.discard(stage_name)
+
+    def _check_instant(self) -> list[str]:
+        found = check_instant(self.world)
+        for v in found:
+            self.world.log("violation", detail=v)
+        return found
+
+    # -- main loop ---------------------------------------------------------
+
+    async def run(self) -> ChaosReport:
+        w = self.world
+        violations: list[str] = []
+        self._bootstrap()
+        await self._reconcile()            # pool to floor before traffic
+        for stage_name in sorted(w.flow.stages):
+            await self._deploy(stage_name)
+        violations += self._check_instant()
+
+        events = self.schedule.events()
+        groups: list[list] = []
+        for ev in events:
+            if groups and abs(groups[-1][0][0] - ev[0]) < 1e-9:
+                groups[-1].append(ev)
+            else:
+                groups.append([ev])
+        for group in groups:
+            w.clock.advance_to(group[0][0])
+            await self._apply_group(group)
+            await self._reconcile()
+            violations += self._check_instant()
+
+        # settle: retry until converged (partitions/slowness have expired
+        # by the schedule's horizon), then judge the final world
+        w.clock.advance_to(max(self.schedule.horizon,
+                               w.clock.offset()))
+        for _round in range(10):
+            await self._reconcile()
+            exited = any(
+                info.state == "exited"
+                and info.labels.get("fleetflow.project") == w.flow.name
+                for slug in sorted(w.backends)
+                for info in w.backends[slug].containers.values())
+            if not self.dirty and not exited:
+                break
+            w.clock.advance(30.0)
+        w.log("settled", rounds=_round + 1, dirty=sorted(self.dirty))
+
+        final = check_final(w)
+        for v in final:
+            w.log("violation", detail=v)
+        violations += final
+        report = ChaosReport(
+            scenario=self.schedule.scenario, seed=self.schedule.seed,
+            services=self.n_services, nodes=self.n_nodes,
+            stages=self.n_stages, events=w.events,
+            violations=violations, stats=dict(self.stats))
+        return report
+
+
+def run_schedule(schedule: F.FaultSchedule, *, services: int, nodes: int,
+                 stages: int = 4, pool_min: int = 2) -> ChaosReport:
+    """Replay one schedule against a freshly built world. Deterministic:
+    the same (schedule, sizes) reproduces the identical event log."""
+    runner = _Runner(schedule, services, nodes, stages, pool_min)
+    return asyncio.run(runner.run())

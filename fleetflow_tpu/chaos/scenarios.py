@@ -1,0 +1,145 @@
+"""Canned scenario pack: seeded schedule generators.
+
+Each scenario is a pure function (seed, services, nodes) -> FaultSchedule
+— the same triple always yields the same schedule, and the runner's
+replay of it the same event log, so every scenario run is a shareable
+repro ("rolling-kill seed 7 at 1000x100").
+
+Sizing rule: scenarios must stay *feasible by construction* — the
+synthetic fleet carries roughly 2x capacity headroom, so schedules keep
+concurrent dead nodes under ~a third of the fleet. An infeasible
+re-solve is a sizing bug in the scenario, not a robustness finding.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+from .faults import (AgentPartition, ContainerExit, DeployFail,
+                     FaultSchedule, NodeCrash, NodeFlap, Redeploy,
+                     SlowAgent, WorkerKill)
+from .runner import node_slug
+
+__all__ = ["SCENARIOS", "build_schedule", "scenario_names"]
+
+
+def _rolling_kill(seed: int, services: int, nodes: int) -> FaultSchedule:
+    """Kill nodes one at a time on a cadence, each revived later; a pool
+    worker dies mid-roll and a few containers exit on survivors. At most
+    ~4 nodes are dead at once."""
+    rng = random.Random(seed)
+    # never make every node a victim: survivors must exist to absorb the
+    # displaced services (and to host the container-exit faults)
+    kills = min(max(2, min(nodes // 10, 8)), nodes - 1)
+    victims = rng.sample(range(nodes), kills)
+    survivors = [n for n in range(nodes) if n not in victims]
+    faults = []
+    t = 30.0
+    for i, v in enumerate(victims):
+        faults.append(NodeCrash(at=t, node=node_slug(v),
+                                revive_after=240.0))
+        if i == kills // 2:
+            faults.append(WorkerKill(at=t + 5.0))
+        if i % 2 == 0:
+            faults.append(ContainerExit(at=t + 10.0,
+                                        node=node_slug(rng.choice(survivors))))
+        t += 60.0
+    return FaultSchedule("rolling-kill", seed, faults, horizon=t + 300.0)
+
+
+def _flap_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
+    """Waves of short node flaps (the churn-coalescing stress): each wave
+    flaps ~20% of the fleet within one instant, down for 5-20s, plus
+    container exits during the instability."""
+    rng = random.Random(seed)
+    per_wave = max(1, min(nodes // 5, nodes - 1))
+    faults = []
+    t = 20.0
+    for _wave in range(3):
+        flappers = rng.sample(range(nodes), per_wave)
+        survivor = node_slug(rng.choice(
+            [n for n in range(nodes) if n not in flappers]))
+        for v in flappers:
+            faults.append(NodeFlap(at=t, node=node_slug(v),
+                                   down_for=float(rng.choice((5, 10, 20)))))
+        faults.append(ContainerExit(at=t + 2.0, node=survivor))
+        faults.append(WorkerKill(at=t + 3.0))
+        t += 90.0
+    # horizon past the autoscaler's corpse-reap window: the killed
+    # workers' offline records must get reaped AND replaced before the
+    # pools-at-min verdict
+    return FaultSchedule("flap-storm", seed, faults, horizon=t + 960.0)
+
+
+def _partition_during_deploy(seed: int, services: int,
+                             nodes: int) -> FaultSchedule:
+    """Partition a slice of the fleet, then redeploy INTO the partition:
+    the deploy must fail cleanly (reservation released, nothing
+    half-committed) and succeed after the partition heals."""
+    rng = random.Random(seed)
+    cut = rng.sample(range(nodes), max(1, min(nodes // 5, nodes - 1)))
+    faults = [AgentPartition(at=10.0, node=node_slug(v), duration=120.0)
+              for v in cut]
+    faults.append(SlowAgent(at=10.0, node=node_slug(
+        rng.choice([n for n in range(nodes) if n not in cut])),
+        delay=30.0, duration=120.0))
+    # redeploy every stage while the partition stands, and again after
+    faults.append(Redeploy(at=20.0, stage="app0"))
+    faults.append(Redeploy(at=200.0, stage="app0"))
+    return FaultSchedule("partition-during-deploy", seed, faults,
+                         horizon=400.0)
+
+
+def _deploy_fail_burst(seed: int, services: int,
+                       nodes: int) -> FaultSchedule:
+    """Arm a burst of injected service-start failures, then redeploy:
+    each failed deploy must release its reservation; once the burst is
+    spent the redeploy lands. A crash mid-burst stacks churn on top."""
+    rng = random.Random(seed)
+    faults = [
+        DeployFail(at=10.0, count=3),
+        Redeploy(at=15.0, stage="app0"),
+        NodeCrash(at=60.0, node=node_slug(rng.randrange(nodes)),
+                  revive_after=180.0),
+        DeployFail(at=90.0, count=2),
+        Redeploy(at=100.0, stage="app0"),
+        Redeploy(at=260.0, stage="app0"),
+    ]
+    return FaultSchedule("deploy-fail-burst", seed, faults, horizon=420.0)
+
+
+SCENARIOS: dict[str, tuple[Callable, str]] = {
+    "rolling-kill": (_rolling_kill,
+                     "serial node kills with revival + a pool worker "
+                     "death + container exits"),
+    "flap-storm": (_flap_storm,
+                   "waves of coalesced short flaps across ~20% of the "
+                   "fleet"),
+    "partition-during-deploy": (_partition_during_deploy,
+                                "deploys into a standing agent partition "
+                                "+ one slow agent"),
+    "deploy-fail-burst": (_deploy_fail_burst,
+                          "injected mid-deploy service failures with a "
+                          "crash stacked on top"),
+}
+
+
+def scenario_names() -> list[str]:
+    return sorted(SCENARIOS)
+
+
+def build_schedule(name: str, seed: int, services: int,
+                   nodes: int) -> FaultSchedule:
+    if nodes < 2 or services < 1:
+        raise ValueError(
+            f"chaos scenarios need at least 2 nodes and 1 service "
+            f"(got nodes={nodes}, services={services}): every scenario "
+            f"keeps survivors to absorb displaced services")
+    try:
+        builder, _desc = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; available: "
+            f"{', '.join(scenario_names())}") from None
+    return builder(seed, services, nodes)

@@ -20,7 +20,7 @@ from typing import Optional
 from ..core.errors import (CloudError, ConfigNotFound, ControlPlaneError,
                            FlowError, SolverError)
 from ..core.loader import load_project
-from ..core.model import Backend, Flow
+from ..core.model import Backend, Flow, Stage
 from ..lower.tensors import lower_stage
 from ..runtime.backend import DockerCliBackend, MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
@@ -207,7 +207,7 @@ def cmd_up(args) -> int:
         try:
             _build_images(flow, buildable,
                           getattr(args, "project_root", None),
-                          tag_for=lambda s: s.image_name())
+                          tag_for=lambda s: s.image_name(), stage=stage)
         except FlowError as e:
             print(f"  {e}", file=sys.stderr)
             _stop_procs(dev_procs)
@@ -465,24 +465,29 @@ def cmd_exec(args) -> int:
 
 def _build_images(flow: Flow, services, project_root: Optional[str],
                   registry: Optional[str] = None, push: bool = False,
-                  tag_for=None) -> list[str]:
+                  tag_for=None, stage: Optional[Stage] = None) -> list[str]:
     """Shared build loop (build.rs orchestrator) used by `fleet build` and
     the pre-deploy build step of `fleet up`. `tag_for(svc)` overrides the
     resolver's (registry-prefixed) tag — the local engine creates from
-    svc.image_name(), the push workflow from the resolver tag. Returns the
-    built tags; raises BuildError/BuildFailed (FlowError) on failure."""
+    svc.image_name(), the push workflow from the resolver tag. `stage`
+    (when the caller has one, e.g. `fleet up`) slots Stage.registry into
+    the precedence chain. Returns the built tags; raises
+    BuildError/BuildFailed (FlowError) on failure."""
     import dataclasses as _dc
 
     from ..build import BuildResolver, ImageBuilder, ImagePusher
     flow_registry = flow.registry.url if flow.registry else None
+    stage_registry = stage.registry if stage is not None else None
     resolver = BuildResolver(project_root or ".",
-                             registry=registry or flow_registry)
+                             registry=registry or stage_registry
+                             or flow_registry)
     tags = []
     for svc in services:
         res = resolver
         if registry is None and svc.registry:
             # reference precedence: CLI flag > service.registry > stage >
-            # flow (build.rs:203-205)
+            # flow (build.rs:203-205); the stage/flow fallback is baked
+            # into `resolver` above
             res = BuildResolver(project_root or ".", registry=svc.registry)
         resolved = res.resolve(svc)
         if tag_for is not None:
@@ -635,6 +640,50 @@ def cmd_solve(args) -> int:
         for node in sorted(by_node):
             print(f"  {node}: {', '.join(sorted(by_node[node]))}")
     return 0 if placement.feasible else 1
+
+
+def cmd_chaos(args) -> int:
+    """Chaos harness: seeded fault injection against a simulated fleet
+    with fleet-wide invariant checking (docs/guide/08-chaos-harness.md).
+    No project
+    config needed — the fleet is synthetic and fully determined by
+    (scenario, seed, sizes)."""
+    from ..chaos import build_schedule, run_schedule, SCENARIOS
+
+    if args.chaos_cmd == "list" or getattr(args, "list", False):
+        for name in sorted(SCENARIOS):
+            print(f"{name:26s} {SCENARIOS[name][1]}")
+        return 0
+    schedule = build_schedule(args.scenario, args.seed, args.services,
+                              args.nodes)
+    if args.show_schedule:
+        for line in schedule.describe():
+            print(line)
+        return 0
+    print(f"chaos {args.scenario}: seed={args.seed} "
+          f"services={args.services} nodes={args.nodes} "
+          f"stages={args.stages} pool_min={args.pool_min}")
+    report = run_schedule(schedule, services=args.services,
+                          nodes=args.nodes, stages=args.stages,
+                          pool_min=args.pool_min)
+    s = report.stats
+    print(f"  {len(report.events)} events | deploys "
+          f"{s['deploys_ok']} ok / {s['deploys_failed']} failed | "
+          f"{s['faults']} faults | {s['resolves']} re-solves | "
+          f"{s['restarts']} restarts | {s['scale_actions']} scale actions")
+    print(f"  event-log digest {report.digest()} "
+          f"(same seed => same digest)")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report.to_dict(), f, indent=1)
+        print(f"  full report -> {args.json}")
+    if report.violations:
+        print(f"  {len(report.violations)} INVARIANT VIOLATION(S):")
+        for v in report.violations:
+            print(f"    {v}")
+        return 1
+    print("  all invariants hold")
+    return 0
 
 
 STARTER_KDL = '''// fleet.kdl — created by `fleet init`
@@ -1348,6 +1397,27 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--dry-run", action="store_true")
 
     p.set_defaults(fn=cmd_cp)
+
+    p = sub.add_parser("chaos", help="seeded fault injection against a "
+                       "simulated fleet (invariant-checked)")
+    chs = p.add_subparsers(dest="chaos_cmd", required=True)
+    q = chs.add_parser("run", help="replay a scenario's fault schedule")
+    q.add_argument("--scenario", default="rolling-kill",
+                   help="scenario name (see `fleet chaos list`)")
+    q.add_argument("--seed", type=int, default=0)
+    q.add_argument("--services", type=int, default=200)
+    q.add_argument("--nodes", type=int, default=20)
+    q.add_argument("--stages", type=int, default=4)
+    q.add_argument("--pool-min", type=int, default=2, dest="pool_min",
+                   help="autoscaler worker-pool floor (0 = no pool)")
+    q.add_argument("--json", help="write the full report (events, "
+                   "violations, digest) to this path")
+    q.add_argument("--show-schedule", action="store_true",
+                   help="print the expanded fault schedule and exit")
+    q.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    chs.add_parser("list", help="list canned scenarios")
+    p.set_defaults(fn=cmd_chaos)
     return ap
 
 

@@ -37,14 +37,32 @@ class KdlError(ValueError):
         self.col = col
 
 
+_BOOL_TRUE = frozenset(("true", "1", "yes", "on"))
+_BOOL_FALSE = frozenset(("false", "0", "no", "off", ""))
+
+
 def bool_value(v) -> bool:
     """Coerce a KDL value to bool: keyword booleans (#true/#false) arrive
     as real bools, but bare-word `true`/`false` arrive as STRINGS — and
     bool("false") is True, so naive coercion silently enables whatever a
     config said to disable. One definition, shared by the flow parser and
-    the daemon config."""
+    the daemon config.
+
+    Only the exact spellings true/1/yes/on and false/0/no/off (any case)
+    are accepted; anything else raises — a typo like `enabled "flase"`
+    must be a loud config error, not a silently-enabled feature (the
+    mirror image of the bool("false") trap this helper exists to stop).
+    """
     if isinstance(v, str):
-        return v.strip().lower() not in ("false", "0", "no", "off", "")
+        s = v.strip().lower()
+        if s in _BOOL_TRUE:
+            return True
+        if s in _BOOL_FALSE:
+            return False
+        raise ValueError(
+            f"invalid boolean value {v!r} (expected one of: "
+            f"{'/'.join(sorted(_BOOL_TRUE))} or "
+            f"{'/'.join(sorted(x for x in _BOOL_FALSE if x))})")
     return bool(v)
 
 

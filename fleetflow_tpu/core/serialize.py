@@ -178,13 +178,20 @@ def service_to_dict(s: Service) -> dict:
     if s.wait is not None:
         d["wait"] = _wait_to_dict(s.wait)
     _put(d, "variables", s.variables, {})
-    if s._resources_set:
+    if s._resources_set or s.resources != ResourceSpec():
+        # same contract as replicas below: explicit declaration OR a
+        # non-default value set programmatically must survive the wire
         d["resources"] = _resources_to_dict(s.resources)
     _put(d, "labels", s.labels, {})
     _put(d, "registry", s.registry, None)
     _put(d, "colocate_with", s.colocate_with, [])
     _put(d, "anti_affinity", s.anti_affinity, [])
-    if s._replicas_set:
+    if s._replicas_set or s.replicas != 1:
+        # _replicas_set tracks an explicit config declaration, but a
+        # programmatically built Flow (tests, chaos harness, API users)
+        # sets the field directly — a replica count must never be lost
+        # over the deploy wire (found by the chaos harness: replica rows
+        # vanished from agent-side lowering after the round-trip)
         d["replicas"] = s.replicas
     return d
 

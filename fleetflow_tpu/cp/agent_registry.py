@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
-from typing import Optional
+from typing import Callable, Optional
 
 from ..core.errors import ControlPlaneError
 from ..obs import get_logger, kv
@@ -43,6 +43,12 @@ class AgentRegistry:
         # to a crashing agent would otherwise stall up to 600 s)
         self._pending_conn: dict[str, Connection] = {}
         self._ids = itertools.count(1)
+        # delivery hook: fn(slug, command) consulted before every command
+        # send. Raising ControlPlaneError surfaces to the caller exactly
+        # like a dead-agent send failure — the chaos harness injects
+        # partitions/latency here; it doubles as an extension point for
+        # per-command routing policy (rate limits, circuit breakers).
+        self.delivery_hook: Optional[Callable[[str, str], None]] = None
 
     # ------------------------------------------------------------------
     def register(self, slug: str, conn: Connection,
@@ -112,6 +118,8 @@ class AgentRegistry:
         conn = self._agents.get(slug)
         if conn is None:
             raise ControlPlaneError(f"agent {slug!r} is not connected")
+        if self.delivery_hook is not None:
+            self.delivery_hook(slug, command)
         request_id = f"req_{next(self._ids)}"
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
@@ -138,6 +146,8 @@ class AgentRegistry:
         conn = self._agents.get(slug)
         if conn is None:
             raise ControlPlaneError(f"agent {slug!r} is not connected")
+        if self.delivery_hook is not None:
+            self.delivery_hook(slug, command)
         await conn.send_event("agent", command,
                               {"request_id": None, "payload": payload or {}})
 

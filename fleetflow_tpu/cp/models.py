@@ -41,10 +41,13 @@ def new_id(prefix: str) -> str:
 
 @dataclass
 class Record:
-    """Base: id + timestamps; subclasses add their fields."""
+    """Base: id + timestamps; subclasses add their fields. Timestamps
+    are assigned by the Store on create/update (from its injectable
+    clock — the chaos harness runs stores on virtual time); a caller
+    that pre-sets created_at explicitly keeps it."""
     id: str = ""
-    created_at: float = field(default_factory=now_ts)
-    updated_at: float = field(default_factory=now_ts)
+    created_at: float = 0.0
+    updated_at: float = 0.0
 
     def to_dict(self) -> dict:
         d = asdict(self)

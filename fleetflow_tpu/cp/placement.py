@@ -306,6 +306,15 @@ class PlacementService:
         with self._lock:
             return self._snapshot_locked()
 
+    def retained(self, stage_key: str
+                 ) -> Optional[tuple[ProblemTensors, Placement]]:
+        """The retained (problem, placement) pair for a stage — what
+        `explain` answers from. The chaos invariant checker re-verifies
+        the final assignment against the solver's own exact checker
+        (solver/repair.verify) through this accessor."""
+        with self._lock:
+            return self._last.get(stage_key)
+
     def reservations_snapshot(self) -> dict:
         """Public view of the 2-phase journal: in-flight reservations
         (including churn holds awaiting a redeploy) and committed

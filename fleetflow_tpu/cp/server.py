@@ -15,7 +15,6 @@ from typing import Callable, Optional
 
 from .agent_registry import AgentRegistry
 from .auth import Claims, NoAuth, make_provider
-from .cert import MeshCa, ensure_mesh_ca, server_ssl_context
 from .log_router import LogRouter
 from .placement import PlacementService
 from .protocol import ProtocolServer
@@ -63,6 +62,10 @@ class AppState:
     deploy_sleep: Callable[[float], None] = time.sleep
     started_at: float = field(default_factory=time.time)
     bg_tasks: set = field(default_factory=set)
+    # chaos-harness injector when this state is driven by the chaos
+    # runner (chaos/injector.py); None in production. An extension point:
+    # anything holding AppState can consult the active fault set.
+    chaos: Optional[object] = None
     # {"issuer", "client_id", "audience"} when the CP runs JwksAuth with a
     # device-flow-capable IdP; the dashboard's browser login uses it
     auth_idp: Optional[dict] = None
@@ -70,7 +73,7 @@ class AppState:
 
 class CpServerHandle:
     def __init__(self, server: ProtocolServer, state: AppState,
-                 host: str, port: int, ca: Optional[MeshCa]):
+                 host: str, port: int, ca: Optional["MeshCa"]):
         self.server = server
         self.state = state
         self.host = host
@@ -160,9 +163,12 @@ async def start(config: ServerConfig, *,
         except Exception:
             return False
 
-    ca: Optional[MeshCa] = None
+    ca: Optional["MeshCa"] = None
     ssl_ctx = None
     if config.tls_dir:
+        # lazy: cert.py needs the `cryptography` package, which plaintext
+        # deployments (and the chaos harness) must not require
+        from .cert import ensure_mesh_ca, server_ssl_context
         ca = ensure_mesh_ca(config.tls_dir)
         ssl_ctx = server_ssl_context(ca, common_name=config.name,
                                      work_dir=config.tls_dir)

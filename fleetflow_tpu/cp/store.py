@@ -61,8 +61,14 @@ class Store:
     def __init__(self, path: Optional[str] = None, *,
                  journal_max_bytes: int = 4 * 1024 * 1024,
                  journal_max_entries: int = 20_000,
-                 fsync: Optional[bool] = None):
+                 fsync: Optional[bool] = None,
+                 clock: Callable[[], float] = now_ts):
         self._lock = threading.RLock()
+        # record timestamps come from this clock (create/update/heartbeat
+        # /finish/resolve stamps): wall time in production, the virtual
+        # clock in the chaos harness — so record ages are deterministic
+        # under replay instead of depending on real elapsed time
+        self._clock = clock
         self._tables: dict[str, dict[str, Record]] = {t: {} for t in _TABLES}
         self._path = Path(path) if path else None
         self._journal_path = (self._path.with_name(self._path.name + ".journal")
@@ -79,6 +85,13 @@ class Store:
         self._compactions = 0
         self._batch_depth = 0
         self._batch_buf: list[str] = []
+        # mutation observers: fn(op, table, rec_or_id) called under the
+        # store lock AFTER each create/update/delete. This is the
+        # change-data-capture hook the chaos harness builds its causal
+        # event log on; it doubles as a general extension point (metrics,
+        # cache invalidation). Observers must be fast and must not
+        # re-enter the store's mutators.
+        self._observers: list[Callable[[str, str, object], None]] = []
         if self._path and self._path.exists():
             self._load()
         if self._journal_path and self._journal_path.exists():
@@ -92,6 +105,20 @@ class Store:
         """Test constructor (db.rs connect_memory:76)."""
         return cls(path=None)
 
+    def subscribe(self, fn: Callable[[str, str, object], None]) -> None:
+        """Register a mutation observer: fn("put"|"del", table, rec|id)."""
+        with self._lock:
+            self._observers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[str, str, object], None]) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
+
+    def _notify(self, op: str, table: str, payload: object) -> None:
+        for fn in self._observers:
+            fn(op, table, payload)
+
     # ------------------------------------------------------------------
     # generic CRUD
     # ------------------------------------------------------------------
@@ -100,10 +127,11 @@ class Store:
         with self._lock:
             if not rec.id:
                 rec.id = new_id(table.rstrip("s"))
-            rec.created_at = rec.created_at or now_ts()
-            rec.updated_at = now_ts()
+            rec.created_at = rec.created_at or self._clock()
+            rec.updated_at = self._clock()
             self._tables[table][rec.id] = rec
             self._log_put(table, rec)
+            self._notify("put", table, rec)
             return rec
 
     def get(self, table: str, rec_id: str) -> Optional[Record]:
@@ -117,8 +145,9 @@ class Store:
                 return None
             for k, v in changes.items():
                 setattr(rec, k, v)
-            rec.updated_at = now_ts()
+            rec.updated_at = self._clock()
             self._log_put(table, rec)
+            self._notify("put", table, rec)
             return rec
 
     def delete(self, table: str, rec_id: str) -> bool:
@@ -126,6 +155,7 @@ class Store:
             gone = self._tables[table].pop(rec_id, None) is not None
             if gone:
                 self._log_del(table, rec_id)
+                self._notify("del", table, rec_id)
             return gone
 
     def list(self, table: str,
@@ -229,7 +259,7 @@ class Store:
         s = self.server_by_slug(slug)
         if s is None:
             return None
-        changes: dict = {"last_heartbeat": now_ts(), "status": "online"}
+        changes: dict = {"last_heartbeat": self._clock(), "status": "online"}
         if version:
             changes["agent_version"] = version
         return self.update("servers", s.id, **changes)
@@ -258,7 +288,7 @@ class Store:
     def finish_deployment(self, dep_id: str, status: DeploymentStatus,
                           log: str = "", error: str = "") -> Optional[Deployment]:
         return self.update("deployments", dep_id, status=status.value,
-                           log=log, error=error, finished_at=now_ts())
+                           log=log, error=error, finished_at=self._clock())
 
     # alerts -----------------------------------------------------------
     def upsert_alert(self, server: str, container: str, kind: str,
@@ -277,7 +307,7 @@ class Store:
                           r.container == container and r.kind == kind and r.active)
         if a is None:
             return False
-        self.update("alerts", a.id, active=False, resolved_at=now_ts())
+        self.update("alerts", a.id, active=False, resolved_at=self._clock())
         return True
 
     def active_alerts(self, tenant: Optional[str] = None) -> list[Alert]:

@@ -313,14 +313,23 @@ def lower_stage(flow: Flow, stage_name: str,
     # declarer rows forces the declarer's replicas apart too — hard
     # constraints nobody declared (r5 close review: web anti_affinity
     # "db" with db replicas=2 on 2 nodes went infeasible). Pair groups
-    # encode exactly the declared relation; `svc anti_affinity "<own
-    # name>"` pairs every replica with every sibling, i.e. requests hard
-    # replica spreading.
+    # encode exactly the declared relation. `svc anti_affinity "<own
+    # name>"` (self-anti, i.e. hard replica spreading) is special-cased:
+    # mutual exclusion among all R replicas is exactly ONE shared group,
+    # and lowering it pairwise would add R(R-1)/2 groups per service —
+    # inflating the dense (N, G) group-counts plane on device at fleet
+    # scale for identical semantics.
     anti_pair_ids: dict[int, list[int]] = {}
     if not local:
         for i, svc in enumerate(rows):
             for k in svc.anti_affinity:
                 if k not in base_index:
+                    continue
+                if k == replica_of[i]:
+                    # self-anti: all replicas of k share one group
+                    gid = anti_key_ids.setdefault(("self", k),
+                                                  len(anti_key_ids))
+                    anti_pair_ids.setdefault(i, []).append(gid)
                     continue
                 for j in base_index[k]:
                     if j == i:

@@ -81,7 +81,7 @@ class MockBackend:
     enabling retry-path tests (the 409/404 recovery logic of up.rs:329-441).
     """
 
-    def __init__(self, auto_pull: bool = False):
+    def __init__(self, auto_pull: bool = False, fault_hook=None):
         self.containers: dict[str, ContainerInfo] = {}
         self.networks: set[str] = set()
         self.images: set[str] = set()
@@ -90,9 +90,16 @@ class MockBackend:
         self._next_id = 0
         self.pruned = 0
         self.auto_pull = auto_pull   # dev mode: any pull "succeeds"
+        # fault_hook(op, name) consulted wherever fail_on is (create/
+        # start/pull); raising BackendError injects a failure without
+        # pre-counting calls — the chaos harness's per-op fault delivery
+        # point into the fake-docker backend.
+        self.fault_hook = fault_hook
 
     # -- helpers ------------------------------------------------------------
     def _maybe_fail(self, op: str, name: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(op, name)
         key = f"{op}:{name}"
         n = self.fail_on.get(key, 0)
         if n > 0:

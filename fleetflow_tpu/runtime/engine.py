@@ -108,11 +108,18 @@ class DeployEngine:
     def __init__(self, backend: ContainerBackend, *,
                  scheduler: Optional[Scheduler] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 project_root: str = "."):
+                 project_root: str = ".",
+                 fault_hook: Optional[Callable[[str, str], None]] = None):
         self.backend = backend
         self.scheduler = scheduler or HostGreedyScheduler()
         self.sleep = sleep
         self.project_root = project_root
+        # fault_hook("start", service_row) is consulted once per service,
+        # right before its create/start; raising BackendError fails that
+        # service through the normal error path (result.failed -> deploy
+        # failure -> reservation release upstream). The chaos harness
+        # injects DeployFail here.
+        self.fault_hook = fault_hook
 
     # ------------------------------------------------------------------
     def execute(self, req: DeployRequest,
@@ -223,6 +230,8 @@ class DeployEngine:
                     cname = f"{cname}-{ridx}"
                 emit(DeployEvent("start", service=base, level=li, message=cname))
                 try:
+                    if self.fault_hook is not None:
+                        self.fault_hook("start", row)
                     cfg = service_to_container_config(
                         svc, flow.name, stage.name,
                         project_root=self.project_root, network=net)

@@ -17,6 +17,10 @@ from urllib.parse import parse_qs
 
 import pytest
 
+# RS256/JWKS needs real RSA: every test here signs or verifies with keys
+# from the cryptography package (absent in some CI containers)
+pytest.importorskip("cryptography")
+
 from fleetflow_tpu.cp.auth import (AuthError, Claims, JwksAuth, TokenAuth,
                                    make_provider)
 

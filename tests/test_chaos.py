@@ -1,0 +1,242 @@
+"""Chaos harness tests.
+
+Three layers:
+  - seeded smoke runs of the canned scenario pack (tier-1 keeps two
+    small ones; the full pack + acceptance scale is `slow`)
+  - determinism: one seed -> byte-identical event logs
+  - CANARY tests: every invariant checker is pointed at a deliberately
+    broken world and must FIRE — no vacuously-green invariants
+plus unit tests for the hook points the injector rides on, and pins for
+bugs the harness found (service serialization dropping replica counts).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from fleetflow_tpu.chaos import run_scenario, scenario_names
+from fleetflow_tpu.chaos.faults import FaultSchedule
+from fleetflow_tpu.chaos.invariants import (capacity_accounting,
+                                            containers_converged,
+                                            no_dead_assignments,
+                                            pools_at_min,
+                                            reservations_terminal,
+                                            solver_feasible)
+from fleetflow_tpu.chaos.runner import _Runner
+from fleetflow_tpu.core.errors import ControlPlaneError
+from fleetflow_tpu.cp.models import ServerAllocated, WorkerPool
+from fleetflow_tpu.cp.store import Store
+
+
+SMOKE = dict(services=60, nodes=10, stages=2, pool_min=2)
+
+
+def _world(services=20, nodes=4, stages=1, pool_min=0, deploy=True):
+    """A small, settled chaos world with no faults applied."""
+    runner = _Runner(FaultSchedule("canary", 1, [], horizon=0.0),
+                     services, nodes, stages, pool_min)
+
+    async def go():
+        runner._bootstrap()
+        if deploy:
+            for st in sorted(runner.world.flow.stages):
+                assert await runner._deploy(st)
+    asyncio.run(go())
+    return runner.world
+
+
+# --------------------------------------------------------------------------
+# smoke (tier-1): 2 scenarios, small fleet, fixed seeds
+# --------------------------------------------------------------------------
+
+class TestSmoke:
+    def test_rolling_kill_smoke(self):
+        r = run_scenario("rolling-kill", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        assert r.stats["faults"] > 0 and r.stats["resolves"] > 0
+
+    def test_deploy_fail_burst_smoke(self):
+        r = run_scenario("deploy-fail-burst", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        # the armed failures must actually have failed deploys (and the
+        # released reservations must not have leaked: r.ok covers that)
+        assert r.stats["deploys_failed"] >= 2
+
+    def test_small_fleets_build_valid_schedules(self):
+        """Scenario builders must never pick survivors from an empty
+        pool: tiny fleets get clamped victim counts, and sub-minimum
+        sizes get a clear error (not an IndexError traceback)."""
+        from fleetflow_tpu.chaos import build_schedule
+        for name in scenario_names():
+            for nodes in (2, 3):
+                schedule = build_schedule(name, 7, 10, nodes)
+                assert schedule.faults
+        with pytest.raises(ValueError, match="at least 2 nodes"):
+            build_schedule("rolling-kill", 7, 10, 1)
+
+    def test_same_seed_reproduces_identical_event_log(self):
+        a = run_scenario("rolling-kill", seed=11, **SMOKE)
+        b = run_scenario("rolling-kill", seed=11, **SMOKE)
+        assert a.events == b.events
+        assert a.digest() == b.digest()
+        c = run_scenario("rolling-kill", seed=12, **SMOKE)
+        assert c.digest() != a.digest()
+
+
+@pytest.mark.slow
+class TestFullPack:
+    @pytest.mark.parametrize("name", scenario_names())
+    def test_scenario_holds_invariants(self, name):
+        r = run_scenario(name, seed=7, services=200, nodes=20,
+                         stages=4, pool_min=2)
+        assert r.ok, r.violations
+
+    def test_acceptance_scale_rolling_kill(self):
+        # the ISSUE acceptance run: 1000 services x 100 nodes on CPU
+        r = run_scenario("rolling-kill", seed=7, services=1000, nodes=100)
+        assert r.ok, r.violations
+
+
+# --------------------------------------------------------------------------
+# canaries: every checker proven live against a broken world
+# --------------------------------------------------------------------------
+
+class TestInvariantCanaries:
+    def test_capacity_accounting_fires_on_double_booking(self):
+        w = _world()
+        assert capacity_accounting(w) == []
+        s = w.state.store.list("servers")[0]
+        w.state.store.update("servers", s.id, allocated=ServerAllocated(
+            cpu=s.capacity.cpu * 2, memory=1.0, disk=0.0))
+        found = capacity_accounting(w)
+        assert found and "double-booked" in found[0]
+
+    def test_reservations_terminal_fires_on_leaked_reservation(self):
+        w = _world()
+        assert reservations_terminal(w) == []
+        _pl, rid = w.state.placement.solve_stage(w.flow, "app0")
+        assert rid is not None     # reserved, never committed/released
+        found = reservations_terminal(w)
+        assert found and "still in flight" in found[0]
+
+    def test_no_dead_assignments_fires_on_offline_node(self):
+        w = _world()
+        assert no_dead_assignments(w) == []
+        key = w.stage_keys[0]
+        node = sorted(w.state.placement.snapshot()[key]
+                      ["assignment"].values())[0]
+        s = w.state.store.server_by_slug(node)
+        w.state.store.update("servers", s.id, status="offline")
+        found = no_dead_assignments(w)
+        assert found and "dead node" in found[0]
+
+    def test_pools_at_min_fires_on_starved_pool(self):
+        w = _world(deploy=False)
+        assert pools_at_min(w) == []
+        w.state.store.create("worker_pools", WorkerPool(
+            tenant="default", name="starved", min_servers=1,
+            preferred_labels={"provider": "sim"}))
+        found = pools_at_min(w)
+        assert found and "below floor" in found[0]
+
+    def test_solver_feasible_fires_on_corrupt_assignment(self):
+        w = _world()
+        assert solver_feasible(w) == []
+        _pt, placement = w.state.placement.retained(w.stage_keys[0])
+        assert placement.raw is not None
+        placement.raw[:] = 0        # cram every row onto node 0
+        found = solver_feasible(w)
+        assert found and "solver checker" in found[0]
+
+    def test_containers_converged_fires_on_exited_container(self):
+        w = _world()
+        assert containers_converged(w) == []
+        key = w.stage_keys[0]
+        view = w.state.placement.snapshot()[key]
+        row, node = sorted(view["assignment"].items())[0]
+        backend = w.backends[node]
+        name = sorted(n for n in backend.containers
+                      if backend.containers[n].running)[0]
+        backend.set_state(name, "exited")
+        found = containers_converged(w)
+        assert found and "exited" in found[0]
+
+
+# --------------------------------------------------------------------------
+# hook points (the injector's delivery surface)
+# --------------------------------------------------------------------------
+
+class TestHooks:
+    def test_store_observer_sees_mutations(self):
+        from fleetflow_tpu.cp.models import Tenant
+        db = Store()
+        seen = []
+        db.subscribe(lambda op, table, payload: seen.append((op, table)))
+        t = db.create("tenants", Tenant(name="a"))
+        db.update("tenants", t.id, display_name="A")
+        db.delete("tenants", t.id)
+        assert seen == [("put", "tenants"), ("put", "tenants"),
+                        ("del", "tenants")]
+        db.unsubscribe(seen.append)   # unknown fn: no-op
+
+    def test_registry_delivery_hook_can_refuse(self):
+        from fleetflow_tpu.cp.agent_registry import AgentRegistry
+
+        class Conn:
+            _closed = False
+
+            async def send_event(self, channel, method, payload):
+                raise AssertionError("hook must fire before the send")
+
+        async def go():
+            reg = AgentRegistry()
+            reg.register("n1", Conn())
+
+            def hook(slug, command):
+                raise ControlPlaneError(f"refused {slug}/{command}")
+            reg.delivery_hook = hook
+            with pytest.raises(ControlPlaneError, match="refused n1/ping"):
+                await reg.send_command("n1", "ping", {})
+        asyncio.run(go())
+
+    def test_engine_fault_hook_fails_service(self):
+        from fleetflow_tpu.core.model import Flow, Service, Stage
+        from fleetflow_tpu.runtime.backend import BackendError, MockBackend
+        from fleetflow_tpu.runtime.engine import DeployEngine, DeployRequest
+        flow = Flow(name="p", services={"a": Service(name="a", image="i",
+                                                     version="1")},
+                    stages={"s": Stage(name="s", services=["a"])})
+
+        def hook(step, row):
+            raise BackendError(f"injected {step} {row}")
+        engine = DeployEngine(MockBackend(auto_pull=True), fault_hook=hook,
+                              sleep=lambda s: None)
+        res = engine.execute(DeployRequest(flow=flow, stage_name="s"))
+        assert res.failed == {"a": "injected start a"}
+
+
+# --------------------------------------------------------------------------
+# pins for bugs the harness found
+# --------------------------------------------------------------------------
+
+class TestFoundByChaos:
+    def test_programmatic_replicas_survive_the_wire(self):
+        """flow_to_dict used to drop replica counts (and non-default
+        resources) unless the parser's _replicas_set flag was on, so a
+        programmatically built Flow lost its replicas on the CP->agent
+        deploy wire and agents silently skipped the replica rows."""
+        from fleetflow_tpu.core.model import Flow, ResourceSpec, Service
+        from fleetflow_tpu.core.serialize import flow_from_dict, flow_to_dict
+        flow = Flow(name="p")
+        svc = Service(name="web", image="i", version="1",
+                      resources=ResourceSpec(cpu=0.7, memory=96.0))
+        svc.replicas = 3
+        svc.anti_affinity = ["web"]
+        flow.services["web"] = svc
+        rt = flow_from_dict(flow_to_dict(flow)).services["web"]
+        assert rt.replicas == 3
+        assert rt.anti_affinity == ["web"]
+        assert rt.resources.cpu == pytest.approx(0.7)
+        assert rt.resources.memory == pytest.approx(96.0)

@@ -427,6 +427,9 @@ class TestDaemonizedStart:
             blocker.close()
 
     def test_start_success_reports_pid_then_stops(self, tmp_path):
+        # the daemon's default config enables mesh TLS (tls_dir), which
+        # needs the cryptography package to mint the CA
+        pytest.importorskip("cryptography")
         import subprocess
         import sys as _sys
         cfg = self._cfg(tmp_path, 0)

@@ -81,6 +81,9 @@ def _install_fake_docker(tmp_path: Path) -> Path:
 
 
 def test_production_example_deploys_end_to_end(tmp_path):
+    # the smoke runs the daemon with mesh TLS + a pinned CA, which needs
+    # the cryptography package to mint certificates
+    pytest.importorskip("cryptography")
     (tmp_path / "home").mkdir()
     project = tmp_path / "shop"
     shutil.copytree(REPO / "examples" / "production", project)

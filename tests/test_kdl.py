@@ -277,3 +277,23 @@ class TestEdgeCorpus:
                     '/* foo', 'port host='):
             with pytest.raises(KdlError):
                 parse_document(bad)
+
+
+class TestBoolValue:
+    """bool_value accepts only exact true/false spellings: a typo like
+    `enabled "flase"` must be a loud config error, never a silently
+    enabled feature (ADVICE r5: the mirror image of bool("false"))."""
+
+    def test_exact_spellings(self):
+        from fleetflow_tpu.core.kdl import bool_value
+        for v in (True, "true", "TRUE", " yes ", "on", "1", 1):
+            assert bool_value(v) is True, v
+        for v in (False, "false", "FALSE", " no ", "off", "0", "", 0, None):
+            assert bool_value(v) is False, v
+
+    def test_typos_raise_instead_of_enabling(self):
+        import pytest
+        from fleetflow_tpu.core.kdl import bool_value
+        for bad in ("flase", "disable", "enabled", "ture", "none"):
+            with pytest.raises(ValueError, match="invalid boolean"):
+                bool_value(bad)

@@ -444,3 +444,37 @@ stage "live" { service "db"; service "web"; servers "n0" "n1" }
         # and the siblings were NOT forced apart: with 2 nodes and web
         # alone on one, both db rows must share the other
         assert res.assignment[by_name["db#0"]] == res.assignment[by_name["db#1"]]
+
+    def test_self_anti_affinity_is_one_shared_group(self):
+        """`db anti_affinity "db"` (hard replica spreading) with R
+        replicas lowers to ONE shared conflict group, not R(R-1)/2
+        pairwise groups (ADVICE r5: the pairwise form inflated the dense
+        (N, G) group-counts plane quadratically for identical
+        semantics), and the spreading semantics are unchanged."""
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        from fleetflow_tpu.solver import solve
+
+        def make(n_nodes):
+            servers = "".join(
+                f'server "n{i}" {{ capacity {{ cpu 4; memory 4096; '
+                f'disk 999 }} }}\n' for i in range(n_nodes))
+            return parse_kdl_string(f"""
+project "p"
+{servers}
+service "db" {{ image "pg"; replicas 4; resources {{ cpu 1; memory 64; disk 1 }}
+    anti_affinity "db"
+}}
+stage "live" {{ service "db"; servers {' '.join(f'"n{i}"' for i in range(n_nodes))} }}
+""")
+        pt = lower_stage(make(4), "live")
+        ids = pt.anti_ids[pt.anti_ids >= 0]
+        # one group, shared by all 4 rows (was 6 pairwise groups)
+        assert ids.size == 4 and len(set(ids.tolist())) == 1
+        # feasibility unchanged: 4 replicas spread over 4 nodes...
+        res = solve(pt, steps=128, seed=5)
+        assert res.feasible, res.stats
+        assert len(set(res.assignment.tolist())) == 4
+        # ...and 4 replicas on 3 nodes stay IMPOSSIBLE (the collapse
+        # must not have weakened the mutual exclusion)
+        res3 = solve(lower_stage(make(3), "live"), steps=128, seed=5)
+        assert not res3.feasible

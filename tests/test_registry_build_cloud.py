@@ -132,6 +132,45 @@ class TestBuild:
         assert resolved.args == {"A": "1", "B": "2"}
         assert resolved.tag == "reg.example.com/app:2"
 
+    def test_build_images_registry_precedence(self, tmp_path, monkeypatch):
+        """_build_images resolves tags with the reference precedence
+        CLI flag > service.registry > stage.registry > flow.registry
+        (build.rs:203-205) — ADVICE r5: Stage.registry used to be
+        silently skipped."""
+        from fleetflow_tpu.cli.main import _build_images
+        from fleetflow_tpu.core.model import RegistryRef, Stage
+        import fleetflow_tpu.build as build_pkg
+
+        ctx = tmp_path / "app"
+        ctx.mkdir()
+        (ctx / "Dockerfile").write_text("FROM scratch\n")
+
+        class NoopBuilder:
+            def build(self, resolved, on_line=None):
+                return resolved.tag
+        monkeypatch.setattr(build_pkg, "ImageBuilder", NoopBuilder)
+
+        def make_svc(name, registry=None):
+            return Service(name=name, image=name, version="1",
+                           registry=registry,
+                           build=BuildConfig(context="app"))
+
+        flow = Flow(name="p", registry=RegistryRef(url="flow.reg"))
+        stage = Stage(name="live", registry="stage.reg")
+        # CLI flag beats everything
+        assert _build_images(flow, [make_svc("a", "svc.reg")],
+                             str(tmp_path), registry="cli.reg",
+                             stage=stage) == ["cli.reg/a:1"]
+        # service beats stage
+        assert _build_images(flow, [make_svc("a", "svc.reg")],
+                             str(tmp_path), stage=stage) == ["svc.reg/a:1"]
+        # stage beats flow
+        assert _build_images(flow, [make_svc("a")],
+                             str(tmp_path), stage=stage) == ["stage.reg/a:1"]
+        # flow is the fallback (no stage in scope)
+        assert _build_images(flow, [make_svc("a")],
+                             str(tmp_path)) == ["flow.reg/a:1"]
+
     def test_resolver_missing_context(self, tmp_path):
         from fleetflow_tpu.build import BuildResolver
         from fleetflow_tpu.build.resolver import BuildError
