@@ -17,7 +17,7 @@ reference unit-tests it (monitor.rs:642-759).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from ..runtime.backend import ContainerBackend, ContainerInfo

@@ -583,35 +583,64 @@ def cmd_deploy(args) -> int:
 # Util commands
 # --------------------------------------------------------------------------
 
+def _run_lint(args, *, fmt: str = "text", strict: bool = False) -> int:
+    """Shared driver for `fleet lint` and `fleet validate`.
+
+    Exit contract (docs/guide/09-lint.md): 0 = clean (warnings allowed
+    unless --strict), 1 = diagnostics at the gating severity, 2 = no
+    config found / unreadable project.
+    """
+    from ..core.discovery import find_project_root
+    from ..lint import Severity, lint_project, severity_counts
+    try:
+        root = find_project_root(getattr(args, "project_root", None))
+    except ConfigNotFound:
+        if fmt == "json":
+            # machine consumers always get a JSON document on stdout
+            print(json.dumps({"ok": False, "errors": 0, "warnings": 0,
+                              "strict": strict, "diagnostics": [],
+                              "reason": "no fleet config found "
+                                        "(.fleetflow/fleet.kdl)"}))
+        print("no fleet config found (.fleetflow/fleet.kdl). "
+              "run `fleet init` to create one.", file=sys.stderr)
+        return 2
+    res = lint_project(root, _stage(args))
+    errors, warnings = severity_counts(res.diagnostics)
+    failing = bool(res.diagnostics) if strict else bool(errors)
+    if fmt == "json":
+        print(json.dumps({
+            "ok": not failing,
+            "errors": errors,
+            "warnings": warnings,
+            "strict": strict,
+            "diagnostics": [d.to_dict() for d in res.diagnostics],
+        }, indent=2))
+        return 1 if failing else 0
+    for d in res.diagnostics:
+        stream = sys.stderr if d.severity is Severity.ERROR else sys.stdout
+        print(d.format(), file=stream)
+    summary = f"{errors} error(s), {warnings} warning(s)"
+    if failing:
+        print(f"lint: {summary}", file=sys.stderr)
+        return 1
+    print(f"config valid ({summary})" if res.diagnostics
+          else "config valid")
+    return 0
+
+
+def cmd_lint(args) -> int:
+    """Static analysis over the project config: coded FF0xx diagnostics
+    with file:line spans, no solver, no backend (docs/guide/09-lint.md)."""
+    return _run_lint(args, fmt=args.format, strict=args.strict)
+
+
 def cmd_validate(args) -> int:
-    flow = _load(args)
-    issues = []
-    for stage_name in sorted(flow.stages):
-        try:
-            stage_obj = flow.stage(stage_name)
-            static, container = _split_stage(flow, stage_obj,
-                                             stage_obj.services)
-            if static and not container:
-                print(f"  stage {stage_name}: static-only "
-                      f"({len(static)} site(s)), nothing to place")
-                continue
-            pt = lower_stage(flow, stage_name)
-            sched = pick_scheduler(pt.S, pt.N, prefer_tpu=False)
-            placement, relaxed = place_with_fallback(sched, pt)
-            status = ("ok" if placement.feasible
-                      else f"INFEASIBLE ({placement.violations} violations)")
-            if relaxed:
-                status += f" (relaxed: {', '.join(relaxed)})"
-            if not placement.feasible:
-                issues.append(stage_name)
-            print(f"  stage {stage_name}: {pt.S} services, {pt.N} nodes, "
-                  f"{status}")
-        except (FlowError, SolverError) as e:
-            issues.append(stage_name)
-            print(f"  stage {stage_name}: ERROR {e}")
-    print("config valid" if not issues else
-          f"issues in stages: {issues}")
-    return 0 if not issues else 1
+    # validate delegates to the lint engine: the placement feasibility it
+    # used to check by solving is lint rule FF013 (placement prelint),
+    # which runs the same host-greedy baseline with fallback relaxation —
+    # plus everything the solver could never tell it (spans, codes, the
+    # structural rule set)
+    return _run_lint(args, fmt="text", strict=False)
 
 
 def cmd_solve(args) -> int:
@@ -1242,7 +1271,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_deploy)
 
     # Util
-    p = sub.add_parser("validate", help="load config + check placements")
+    p = sub.add_parser("lint", help="static analysis of the fleet config "
+                                    "(coded diagnostics with source spans)")
+    stage_args(p, positional=False)
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="diagnostic output format (default: text)")
+    p.add_argument("--strict", action="store_true",
+                   help="treat warnings as errors (exit 1)")
+    p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("validate", help="load config + check placements "
+                                        "(delegates to `fleet lint`)")
     stage_args(p, positional=False)
     p.set_defaults(fn=cmd_validate)
 

@@ -21,7 +21,7 @@ from ..core.errors import CloudError
 from ..core.model import CloudProviderDecl, ServerResource
 from .action import Action, ActionType, ApplyResult, Plan
 from .provider import CloudProvider, register_provider
-from .state import ProviderState, ResourceState
+from .state import ProviderState
 
 __all__ = ["CloudflareDns", "CloudflareProvider", "wrangler_pages_deploy",
            "wrangler_pages_dev"]

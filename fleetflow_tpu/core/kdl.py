@@ -22,6 +22,7 @@ unit-tested (tests/test_kdl.py), mirroring the reference's parser test corpus
 from __future__ import annotations
 
 import os
+from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
@@ -41,7 +42,7 @@ _BOOL_TRUE = frozenset(("true", "1", "yes", "on"))
 _BOOL_FALSE = frozenset(("false", "0", "no", "off", ""))
 
 
-def bool_value(v) -> bool:
+def bool_value(v, node: Optional["KdlNode"] = None) -> bool:
     """Coerce a KDL value to bool: keyword booleans (#true/#false) arrive
     as real bools, but bare-word `true`/`false` arrive as STRINGS — and
     bool("false") is True, so naive coercion silently enables whatever a
@@ -52,6 +53,10 @@ def bool_value(v) -> bool:
     are accepted; anything else raises — a typo like `enabled "flase"`
     must be a loud config error, not a silently-enabled feature (the
     mirror image of the bool("false") trap this helper exists to stop).
+    When the owning `node` is passed and carries a span, the error is a
+    positioned :class:`KdlError`, so a strict-bool failure points at
+    file:line like every other parse error (KdlError IS a ValueError, so
+    existing handlers keep working).
     """
     if isinstance(v, str):
         s = v.strip().lower()
@@ -59,22 +64,42 @@ def bool_value(v) -> bool:
             return True
         if s in _BOOL_FALSE:
             return False
-        raise ValueError(
-            f"invalid boolean value {v!r} (expected one of: "
-            f"{'/'.join(sorted(_BOOL_TRUE))} or "
-            f"{'/'.join(sorted(x for x in _BOOL_FALSE if x))})")
+        msg = (f"invalid boolean value {v!r} (expected one of: "
+               f"{'/'.join(sorted(_BOOL_TRUE))} or "
+               f"{'/'.join(sorted(x for x in _BOOL_FALSE if x))})")
+        if node is not None and node.line:
+            raise KdlError(msg, node.line, node.col)
+        raise ValueError(msg)
     return bool(v)
 
 
 @dataclass(slots=True)
 class KdlNode:
-    """A single KDL node: ``name arg1 arg2 key=value { children }``."""
+    """A single KDL node: ``name arg1 arg2 key=value { children }``.
+
+    ``line``/``col`` are the 1-based source position of the node's name
+    token, recorded by the pure-Python parser (0 = unknown, e.g. nodes
+    built programmatically or by the native fast path). They are excluded
+    from equality so span-carrying and span-less parses of the same text
+    stay ``==`` (the native-parity contract, tests/test_native_kdl.py).
+    """
 
     name: str
     args: list[Any] = field(default_factory=list)
     props: dict[str, Any] = field(default_factory=dict)
     children: list["KdlNode"] = field(default_factory=list)
     type_annotation: Optional[str] = None
+    line: int = field(default=0, compare=False, repr=False)
+    col: int = field(default=0, compare=False, repr=False)
+
+    def __getattr__(self, name: str):
+        # the native assemblers (native/kdl.py ctypes path, native/kdlpy.cpp
+        # via tp_new) bypass __init__ and only set the content fields; with
+        # slots=True an unset span slot would raise on read, so fall back
+        # to 0 ("no span") instead of requiring a lockstep native rebuild
+        if name in ("line", "col"):
+            return 0
+        raise AttributeError(name)
 
     # -- convenience accessors used throughout the config parsers ----------
 
@@ -121,19 +146,36 @@ MAX_DEPTH = 128    # a document nested deeper is hostile or broken — fail
 
 
 class _Parser:
-    def __init__(self, text: str):
+    def __init__(self, text: str, record_spans: bool = False):
         self.text = text
         self.pos = 0
         self.n = len(text)
         self.depth = 0
+        # span recording is opt-in so the want_spans contract holds on
+        # every path: a parse WITHOUT want_spans yields span-less nodes
+        # whether it ran natively or fell back to this parser
+        self.record_spans = record_spans
+        self._nl: Optional[list[int]] = None  # newline index, built lazily
 
-    # -- error helpers ------------------------------------------------------
+    # -- position helpers ---------------------------------------------------
+
+    def _line_col_at(self, pos: int) -> tuple[int, int]:
+        """1-based (line, col) of `pos`, O(log n) via a one-time newline
+        index (the old slice-and-count was O(pos) per lookup — fine for a
+        single error, quadratic once every node records its span)."""
+        if self._nl is None:
+            nl, find = [], self.text.find
+            i = find("\n")
+            while i != -1:
+                nl.append(i)
+                i = find("\n", i + 1)
+            self._nl = nl
+        line = bisect_left(self._nl, pos) + 1
+        col = pos - (self._nl[line - 2] + 1 if line > 1 else 0) + 1
+        return line, col
 
     def _line_col(self) -> tuple[int, int]:
-        upto = self.text[: self.pos]
-        line = upto.count("\n") + 1
-        col = self.pos - (upto.rfind("\n") + 1) + 1
-        return line, col
+        return self._line_col_at(self.pos)
 
     def error(self, msg: str) -> KdlError:
         line, col = self._line_col()
@@ -404,12 +446,15 @@ class _Parser:
             slashdash = True
             self.pos += 2
             self.skip_ws(newlines=True)
+        name_pos = self.pos
         ty = self.parse_type_annotation()
         if self.peek() == '"':
             name = self.parse_string()
         else:
             name = self.parse_identifier()
         node = KdlNode(name=name, type_annotation=ty)
+        if self.record_spans:
+            node.line, node.col = self._line_col_at(name_pos)
 
         while True:
             self.skip_ws(newlines=False)
@@ -516,7 +561,7 @@ class _Parser:
                 nodes.append(n)
 
 
-def parse_document(text: str) -> list[KdlNode]:
+def parse_document(text: str, *, want_spans: bool = False) -> list[KdlNode]:
     """Parse a KDL document into a list of top-level nodes.
 
     Uses the native parser (native/kdl.cpp via ctypes) as the fast path when
@@ -527,8 +572,13 @@ def parse_document(text: str) -> list[KdlNode]:
     and raises the canonical KdlError with codepoint-exact line/col.
     Parity across the full corpus is enforced by tests/test_native_kdl.py.
     Set FLEET_KDL_NATIVE=0 to force pure Python.
+
+    ``want_spans=True`` forces the pure-Python parser so every node carries
+    its 1-based line/col (the native export has no position channel) —
+    the `fleet lint` path, where diagnostics must point at source.
     """
-    if os.environ.get("FLEET_KDL_NATIVE", "1").lower() not in ("0", "false"):
+    if not want_spans and \
+            os.environ.get("FLEET_KDL_NATIVE", "1").lower() not in ("0", "false"):
         global _native_parse
         if _native_parse is None:
             try:
@@ -540,7 +590,7 @@ def parse_document(text: str) -> list[KdlNode]:
             nodes = _native_parse(text)
             if nodes is not None:
                 return nodes
-    return _Parser(text).parse_nodes()
+    return _Parser(text, record_spans=want_spans).parse_nodes()
 
 
 # resolved native fast path: None = not yet tried, False = unavailable

@@ -45,6 +45,10 @@ class LoadDebug:
         self.variables: dict[str, str] = {}
         self.rendered: dict[str, str] = {}
         self.concatenated: str = ""
+        # (start line in the concatenation, line count, source path, start
+        # line in that file) — include-expansion-aware; the lint SourceMap
+        # consumes this verbatim
+        self.segments: list[tuple[int, int, str, int]] = []
 
 
 def _read(path: str) -> str:
@@ -108,14 +112,26 @@ def prepare_template_processor(files: DiscoveredFiles,
 def expand_all_files(files: DiscoveredFiles, tp: TemplateProcessor,
                      debug: Optional[LoadDebug] = None) -> str:
     """Render every discovered file and concatenate in fixed order
-    (reference: loader.rs:137-209)."""
+    (reference: loader.rs:137-209). With a ``debug`` collector, per-file
+    segments (include-expansion-aware) are recorded for the lint
+    SourceMap; when template rendering changes a file's line count the
+    fallback is whole-file granularity for that file."""
     parts: list[str] = []
+    cur_line = 1
     for path in files.all_files():
-        text = read_kdl_with_includes(path)
+        inc_segs: list[tuple[int, int, str, int]] = []
+        text = read_kdl_with_includes(path, segments=inc_segs)
         rendered = tp.render_str(text, source=path)
+        n_rendered = rendered.count("\n") + 1
         if debug is not None:
             debug.files.append(path)
             debug.rendered[path] = rendered
+            if n_rendered == text.count("\n") + 1:
+                debug.segments.extend(
+                    (cur_line + s - 1, n, p, ls) for s, n, p, ls in inc_segs)
+            else:
+                debug.segments.append((cur_line, n_rendered, path, 1))
+        cur_line += n_rendered
         parts.append(rendered)
     out = "\n".join(parts)
     if debug is not None:
@@ -127,9 +143,15 @@ def expand_all_files(files: DiscoveredFiles, tp: TemplateProcessor,
 def load_project_from_root_with_stage(root: str, stage: Optional[str] = None,
                                       environ: Optional[dict[str, str]] = None,
                                       resolve_secrets: bool = True,
-                                      debug: Optional[LoadDebug] = None) -> Flow:
+                                      debug: Optional[LoadDebug] = None,
+                                      want_spans: bool = False) -> Flow:
     """Full pipeline from a known project root (reference: loader.rs:42-74,
-    `#[instrument]` on load_*: loader.rs:24-41)."""
+    `#[instrument]` on load_*: loader.rs:24-41).
+
+    ``want_spans=True`` parses with the span-carrying KDL parser so model
+    objects get source locations (`fleet lint`); pair it with a ``debug``
+    collector to build a SourceMap from the rendered per-file segments.
+    """
     with span(log, "load_project", root=root, stage=stage) as sp:
         files = discover_files_with_stage(root, stage)
         if files.main_file is None:
@@ -139,7 +161,7 @@ def load_project_from_root_with_stage(root: str, stage: Optional[str] = None,
         tp = prepare_template_processor(files, stage, environ, resolve_secrets)
         log.debug("variable context: %d variables", len(tp.variables))
         text = expand_all_files(files, tp, debug)
-        flow = parse_kdl_string(text)
+        flow = parse_kdl_string(text, want_spans=want_spans)
         # expose the final variable context on the flow
         merged = dict(tp.variables)
         merged.update(flow.variables)

@@ -28,8 +28,30 @@ __all__ = [
     "HealthCheck", "ReadinessCheck", "WaitConfig", "RestartPolicy",
     "CloudProviderDecl", "ServerResource", "TenantSpec", "ResourceSpec",
     "ServerLabels", "PlacementPolicy", "ResourceQuota", "SpreadConstraint",
-    "FallbackPolicy", "PlacementStrategy", "RegistryRef",
+    "FallbackPolicy", "PlacementStrategy", "RegistryRef", "SourceLoc",
 ]
+
+
+@dataclass(frozen=True)
+class SourceLoc:
+    """1-based source position of a config declaration.
+
+    Threaded from the KDL parser's node spans (core/kdl.py) through
+    core/parser.py onto the model, so static analysis (fleetflow_tpu/lint)
+    can point a diagnostic at file:line instead of at "somewhere in the
+    flow". ``file`` is None when the text came from a concatenated
+    multi-file load — the lint SourceMap resolves the line back to its
+    file. Excluded from equality/serialization everywhere it is embedded:
+    two configs declaring the same fleet are the same flow regardless of
+    formatting.
+    """
+    line: int = 0
+    col: int = 0
+    file: Optional[str] = None
+
+    def label(self) -> str:
+        f = self.file or "<config>"
+        return f"{f}:{self.line}:{self.col}" if self.line else f
 
 
 # --------------------------------------------------------------------------
@@ -55,6 +77,7 @@ class Port:
     container: int
     protocol: Protocol = Protocol.TCP
     host_ip: Optional[str] = None
+    loc: Optional[SourceLoc] = field(default=None, compare=False, repr=False)
 
     def key(self) -> tuple:
         """Host-side conflict identity: two services binding the same key
@@ -68,6 +91,7 @@ class Volume:
     host: str
     container: str
     read_only: bool = False
+    loc: Optional[SourceLoc] = field(default=None, compare=False, repr=False)
 
     @property
     def is_named(self) -> bool:
@@ -236,6 +260,12 @@ class Service:
     _resources_set: bool = field(default=False, repr=False, compare=False)
     _replicas_set: bool = field(default=False, repr=False, compare=False)
 
+    # source locations (lint spans): the declaration itself, plus one per
+    # depends_on TARGET so a bad reference is reported at the reference
+    loc: Optional[SourceLoc] = field(default=None, repr=False, compare=False)
+    dep_locs: dict[str, SourceLoc] = field(default_factory=dict,
+                                           repr=False, compare=False)
+
     def image_name(self) -> str:
         """Resolve the full image reference (reference: converter.rs:35-46):
         explicit image wins; `image` may already carry a tag; `version`
@@ -287,6 +317,8 @@ class Service:
             replicas=other.replicas if other._replicas_set else self.replicas,
             _resources_set=self._resources_set or other._resources_set,
             _replicas_set=self._replicas_set or other._replicas_set,
+            loc=self.loc or other.loc,
+            dep_locs={**self.dep_locs, **other.dep_locs},
         )
 
 
@@ -373,6 +405,14 @@ class Stage:
     backend: Backend = Backend.DOCKER
     placement: Optional[PlacementPolicy] = None
 
+    # source locations (lint spans): the stage decl, plus one per service /
+    # server REFERENCE so an unknown name is reported where it is written
+    loc: Optional[SourceLoc] = field(default=None, repr=False, compare=False)
+    service_locs: dict[str, SourceLoc] = field(default_factory=dict,
+                                               repr=False, compare=False)
+    server_locs: dict[str, SourceLoc] = field(default_factory=dict,
+                                              repr=False, compare=False)
+
     def resolved_services(self, flow: "Flow") -> list[Service]:
         """Base service defs merged with per-stage overrides, in declared order."""
         out = []
@@ -445,6 +485,7 @@ class ServerResource:
     dns_aliases: list[str] = field(default_factory=list)
     capacity: ResourceSpec = field(default_factory=lambda: ResourceSpec(cpu=2.0, memory=4096.0, disk=40960.0))
     labels: ServerLabels = field(default_factory=ServerLabels)
+    loc: Optional[SourceLoc] = field(default=None, repr=False, compare=False)
 
 
 @dataclass
@@ -508,6 +549,17 @@ class Flow:
     variables: dict[str, str] = field(default_factory=dict)
     tenant: Optional[TenantSpec] = None
 
+    # where each KDL-declared variable was defined (lint spans; variables
+    # merged from .env / process env at load time have no source line)
+    variable_locs: dict[str, SourceLoc] = field(default_factory=dict,
+                                                repr=False, compare=False)
+    # (name, earlier loc, later loc) per top-level service redefinition —
+    # merging is a FEATURE across files (override files), but a same-file
+    # redefinition is usually a copy-paste accident; lint rule FF005 reads
+    # this to tell the two apart via the source map
+    redefinitions: list[tuple] = field(default_factory=list,
+                                       repr=False, compare=False)
+
     def stage(self, name: str) -> Stage:
         try:
             return self.stages[name]
@@ -520,6 +572,8 @@ class Flow:
         """Service redefinition merges onto the existing def (reference:
         parser/mod.rs service-merge-on-redefinition)."""
         if svc.name in self.services:
-            self.services[svc.name] = self.services[svc.name].merge(svc)
+            old = self.services[svc.name]
+            self.redefinitions.append((svc.name, old.loc, svc.loc))
+            self.services[svc.name] = old.merge(svc)
         else:
             self.services[svc.name] = svc

@@ -31,8 +31,8 @@ from .model import (
     Backend, BuildConfig, CloudProviderDecl, DeployConfig, FallbackPolicy, Flow,
     HealthCheck, PlacementPolicy, PlacementStrategy, Port, Protocol,
     ReadinessCheck, RegistryRef, ResourceQuota, ResourceSpec, RestartPolicy,
-    ServerLabels, ServerResource, Service, ServiceType, SpreadConstraint, Stage,
-    TenantSpec, Volume, WaitConfig,
+    ServerLabels, ServerResource, Service, ServiceType, SourceLoc,
+    SpreadConstraint, Stage, TenantSpec, Volume, WaitConfig,
 )
 
 __all__ = [
@@ -51,6 +51,14 @@ def _as_str(v: Any) -> str:
 # one shared definition (core.kdl.bool_value): bare-word false must
 # never coerce truthy anywhere config is read
 _as_bool = bool_value
+
+
+def _loc(node: KdlNode, source: Optional[str] = None) -> Optional[SourceLoc]:
+    """Node span → model SourceLoc (None when the parse carried no spans,
+    e.g. the native fast path or programmatic nodes)."""
+    if not node.line:
+        return None
+    return SourceLoc(line=node.line, col=node.col, file=source)
 
 
 def _str_args(node: KdlNode) -> list[str]:
@@ -96,7 +104,7 @@ def _duration(v: Any, default: float) -> float:
 # Leaf parsers (port.rs, volume.rs)
 # --------------------------------------------------------------------------
 
-def parse_port(node: KdlNode) -> Port:
+def parse_port(node: KdlNode, source: Optional[str] = None) -> Port:
     """`port host=8080 container=80 protocol="udp" host-ip="127.0.0.1"`,
     positional `port 8080 80`, or the compose-style string
     `port "8080:80[/udp]"` / `port "127.0.0.1:8080:80"`
@@ -123,12 +131,13 @@ def parse_port(node: KdlNode) -> Port:
     try:
         return Port(host=int(host), container=int(container),
                     protocol=Protocol.parse(_as_str(proto)),
-                    host_ip=host_ip if host_ip is None else _as_str(host_ip))
+                    host_ip=host_ip if host_ip is None else _as_str(host_ip),
+                    loc=_loc(node, source))
     except (TypeError, ValueError) as e:
         raise FlowError(f"invalid port node {node}: {e}") from None
 
 
-def parse_volume(node: KdlNode) -> Volume:
+def parse_volume(node: KdlNode, source: Optional[str] = None) -> Volume:
     """`volume "./host" "/container" read-only=true` (reference: parser/volume.rs)."""
     args = _str_args(node)
     if not args:
@@ -136,8 +145,10 @@ def parse_volume(node: KdlNode) -> Volume:
     host = args[0]
     container = args[1] if len(args) > 1 else host
     ro = _as_bool(node.prop("read-only",
-                       node.prop("read_only", node.prop("ro", False))))
-    return Volume(host=host, container=container, read_only=ro)
+                       node.prop("read_only", node.prop("ro", False))),
+                  node)
+    return Volume(host=host, container=container, read_only=ro,
+                  loc=_loc(node, source))
 
 
 # --------------------------------------------------------------------------
@@ -158,7 +169,7 @@ def _parse_build(node: KdlNode) -> BuildConfig:
         elif c.name == "target":
             b.target = c.first_string()
         elif c.name in ("no_cache", "no-cache"):
-            b.no_cache = _as_bool(c.arg(0, True))
+            b.no_cache = _as_bool(c.arg(0, True), c)
         elif c.name in ("image_tag", "image-tag", "tag"):
             b.image_tag = c.first_string()
     for k, v in node.props.items():
@@ -313,12 +324,12 @@ def _mem_mb(v: Any) -> float:
     return float(s)
 
 
-def parse_service(node: KdlNode) -> Service:
+def parse_service(node: KdlNode, source: Optional[str] = None) -> Service:
     """Parse a `service "name" { ... }` node (reference: parser/service.rs)."""
     name = node.first_string()
     if not name:
         raise FlowError("service node requires a name argument")
-    svc = Service(name=name)
+    svc = Service(name=name, loc=_loc(node, source))
     for k, v in node.props.items():
         if k == "image":
             svc.image = _as_str(v)
@@ -348,17 +359,23 @@ def parse_service(node: KdlNode) -> Service:
         elif n == "registry":
             svc.registry = c.first_string()
         elif n == "ports":
-            svc.ports = [parse_port(p) for p in c.children_named("port")]
+            svc.ports = [parse_port(p, source) for p in c.children_named("port")]
         elif n == "port":
-            svc.ports.append(parse_port(c))
+            svc.ports.append(parse_port(c, source))
         elif n == "volumes":
-            svc.volumes = [parse_volume(v) for v in c.children_named("volume")]
+            svc.volumes = [parse_volume(v, source)
+                           for v in c.children_named("volume")]
         elif n == "volume":
-            svc.volumes.append(parse_volume(c))
+            svc.volumes.append(parse_volume(c, source))
         elif n in ("env", "environment"):
             svc.environment.update(_env_from_children(c))
         elif n == "depends_on" or n == "depends-on":
-            svc.depends_on.extend(_str_args(c))
+            targets = _str_args(c)
+            svc.depends_on.extend(targets)
+            dloc = _loc(c, source)
+            if dloc is not None:
+                for t in targets:
+                    svc.dep_locs.setdefault(t, dloc)
         elif n == "build":
             svc.build = _parse_build(c)
         elif n == "deploy":
@@ -430,12 +447,12 @@ def _parse_placement(node: KdlNode) -> PlacementPolicy:
     return p
 
 
-def parse_stage(node: KdlNode) -> Stage:
+def parse_stage(node: KdlNode, source: Optional[str] = None) -> Stage:
     """Parse a `stage "name" { ... }` node (reference: parser/stage.rs)."""
     name = node.first_string()
     if not name:
         raise FlowError("stage node requires a name argument")
-    st = Stage(name=name)
+    st = Stage(name=name, loc=_loc(node, source))
     seen = set()   # dedup via set: `in st.services` is O(n) and a
     for c in node.children:                # 10k-service stage paid O(n^2)
         if c.name == "service":
@@ -445,12 +462,18 @@ def parse_stage(node: KdlNode) -> Stage:
             if sname not in seen:
                 seen.add(sname)
                 st.services.append(sname)
+                cloc = _loc(c, source)
+                if cloc is not None:
+                    st.service_locs[sname] = cloc
             if c.children or c.props:
-                st.service_overrides[sname] = parse_service(c)
-        elif c.name == "server":
-            st.servers.extend(_str_args(c))
-        elif c.name == "servers":
-            st.servers.extend(_str_args(c))
+                st.service_overrides[sname] = parse_service(c, source)
+        elif c.name in ("server", "servers"):
+            names = _str_args(c)
+            st.servers.extend(names)
+            cloc = _loc(c, source)
+            if cloc is not None:
+                for sv in names:
+                    st.server_locs.setdefault(sv, cloc)
         elif c.name == "variables":
             st.variables.update(_env_from_children(c))
         elif c.name == "registry":
@@ -497,12 +520,12 @@ def _parse_server_labels(node: KdlNode) -> ServerLabels:
     return lbl
 
 
-def parse_server(node: KdlNode) -> ServerResource:
+def parse_server(node: KdlNode, source: Optional[str] = None) -> ServerResource:
     """Parse a `server "name" { ... }` node (reference: parser/cloud.rs)."""
     name = node.first_string()
     if not name:
         raise FlowError("server node requires a name argument")
-    s = ServerResource(name=name)
+    s = ServerResource(name=name, loc=_loc(node, source))
     for c in node.children:
         n = c.name.replace("_", "-")
         if n == "provider":
@@ -584,7 +607,9 @@ def parse_tenant(node: KdlNode) -> TenantSpec:
 # Top-level dispatch (mod.rs)
 # --------------------------------------------------------------------------
 
-def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
+def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
+                     want_spans: bool = False,
+                     source: Optional[str] = None) -> Flow:
     """Parse KDL text into (or onto) a Flow.
 
     Reference: parser/mod.rs:160,184-299. Top-level nodes: project / stage /
@@ -594,10 +619,15 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
     redefinition merges service lists/overrides. Stage selection happens at
     load time (template pre-pass) and resolve time (Stage.resolved_services),
     not at parse time.
+
+    ``want_spans=True`` forces the span-carrying pure-Python KDL parser so
+    model objects get SourceLoc positions (the `fleet lint` path); ``source``
+    labels those locations with a file name (single-file parses — multi-file
+    concatenations resolve lines through the lint SourceMap instead).
     """
     flow = flow if flow is not None else Flow()
     try:
-        nodes = parse_document(text)
+        nodes = parse_document(text, want_spans=want_spans)
     except Exception as e:
         raise FlowError(f"KDL parse failed: {e}") from e
 
@@ -606,9 +636,9 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
         if n == "project":
             flow.name = node.first_string(flow.name)
         elif n == "service":
-            flow.merge_service(parse_service(node))
+            flow.merge_service(parse_service(node, source))
         elif n == "stage":
-            st = parse_stage(node)
+            st = parse_stage(node, source)
             if st.name in flow.stages:
                 old = flow.stages[st.name]
                 have = set(old.services)   # O(n^2) scan at fleet scale
@@ -623,6 +653,8 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
                     else:
                         old.service_overrides[sname] = ov
                 old.servers = st.servers or old.servers
+                old.service_locs.update(st.service_locs)
+                old.server_locs.update(st.server_locs)
                 old.variables.update(st.variables)
                 old.registry = st.registry or old.registry
                 if st.backend != Backend.DOCKER:
@@ -634,10 +666,14 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
             p = parse_provider(node)
             flow.providers[p.name] = p
         elif n == "server":
-            s = parse_server(node)
+            s = parse_server(node, source)
             flow.servers[s.name] = s
         elif n == "variables":
             flow.variables.update(_env_from_children(node))
+            for c in node.children:
+                vloc = _loc(c, source)
+                if vloc is not None:
+                    flow.variable_locs.setdefault(c.name, vloc)
         elif n == "registry":
             flow.registry = RegistryRef(url=node.first_string(""),
                                         username=node.prop("username"))
@@ -652,11 +688,17 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None) -> Flow:
     return flow
 
 
-def read_kdl_with_includes(path: str, _seen: Optional[set[str]] = None) -> str:
-    """Read a KDL file, expanding `include "glob"` nodes inline with cycle
-    detection (reference: parser/mod.rs:54)."""
+def _read_expanded(path: str, seen: set[str]
+                   ) -> tuple[list[str], list[tuple[int, int, str, int]]]:
+    """Recursive include expansion with segment tracking.
+
+    Returns (output lines, segments), each segment being
+    ``(start index in the output lines (0-based), line count, source path,
+    1-based first line of the run IN that source file)`` — the raw material
+    for the lint SourceMap, so a diagnostic below an `include` still points
+    at its true on-disk line instead of drifting by the expansion's size.
+    """
     real = os.path.realpath(path)
-    seen = _seen if _seen is not None else set()
     if real in seen:
         raise FlowError(f"include cycle detected at {path}")
     seen.add(real)
@@ -667,16 +709,27 @@ def read_kdl_with_includes(path: str, _seen: Optional[set[str]] = None) -> str:
         raise FlowError(f"cannot read {path}: {e}") from e
 
     base = os.path.dirname(real)
-    out_lines: list[str] = []
-    for line in text.splitlines():
+    out: list[str] = []
+    segs: list[tuple[int, int, str, int]] = []
+    run_out = 0     # output index where the current run of own lines began
+    run_src = 1     # 1-based source line where that run began
+
+    def flush(next_src_line: int) -> None:
+        nonlocal run_out, run_src
+        if len(out) > run_out:
+            segs.append((run_out, len(out) - run_out, path, run_src))
+        run_out, run_src = len(out), next_src_line
+
+    for i, line in enumerate(text.splitlines()):
         stripped = line.strip()
         if stripped.startswith("include ") or stripped == "include":
             try:
                 nodes = parse_document(stripped)
             except Exception:
-                out_lines.append(line)
+                out.append(line)
                 continue
             if nodes and nodes[0].name == "include":
+                flush(i + 2)    # the include line itself emits nothing
                 patterns = [str(a) for a in nodes[0].args]
                 for pat in patterns:
                     full = pat if os.path.isabs(pat) else os.path.join(base, pat)
@@ -684,10 +737,29 @@ def read_kdl_with_includes(path: str, _seen: Optional[set[str]] = None) -> str:
                     if not matches and not globmod.has_magic(full):
                         raise FlowError(f"include target not found: {pat}")
                     for m in matches:
-                        out_lines.append(read_kdl_with_includes(m, seen))
+                        sub_lines, sub_segs = _read_expanded(m, seen)
+                        offset = len(out)
+                        segs.extend((offset + s, n, p, ls)
+                                    for s, n, p, ls in sub_segs)
+                        out.extend(sub_lines)
+                run_out = len(out)
                 continue
-        out_lines.append(line)
-    return "\n".join(out_lines)
+        out.append(line)
+    flush(0)
+    return out, segs
+
+
+def read_kdl_with_includes(path: str, _seen: Optional[set[str]] = None,
+                           segments: Optional[list] = None) -> str:
+    """Read a KDL file, expanding `include "glob"` nodes inline with cycle
+    detection (reference: parser/mod.rs:54). Pass a ``segments`` list to
+    receive ``(1-based start line in the returned text, line count, source
+    path, 1-based start line in that file)`` tuples mapping the expanded
+    text back to the files it came from (the lint SourceMap input)."""
+    lines, segs = _read_expanded(path, _seen if _seen is not None else set())
+    if segments is not None:
+        segments.extend((s + 1, n, p, ls) for s, n, p, ls in segs)
+    return "\n".join(lines)
 
 
 def parse_kdl_file(path: str) -> Flow:

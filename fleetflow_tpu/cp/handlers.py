@@ -12,15 +12,15 @@ ProtocolServer.
 from __future__ import annotations
 
 import asyncio
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING
 
 from ..core.serialize import flow_from_dict
 from ..runtime.engine import DeployEngine, DeployRequest
 from .agent_registry import BUILD_TIMEOUT, DEPLOY_TIMEOUT
 from .log_router import LogEntry, topic_for
-from .models import (Alert, BuildJob, BuildStatus, CostEntry, Deployment,
+from .models import (BuildJob, BuildStatus, CostEntry, Deployment,
                      DeploymentStatus, DnsRecord, ObservedContainer, Project,
-                     Server, ServerCapacity, StageRecord, Tenant, TenantUser,
+                     Server, ServerCapacity, Tenant, TenantUser,
                      VolumeRecord, VolumeSnapshot, WorkerPool, now_ts)
 from .protocol import Connection, ProtocolServer
 
@@ -732,6 +732,19 @@ async def execute_deploy(state: "AppState", req: DeployRequest,
     tenant = db.ensure_tenant(tenant_name)
     project = db.ensure_project(tenant.name, req.flow.name)
     stage_cfg = req.flow.stage(req.stage_name)
+    # fail fast on statically-doomed flows BEFORE any record is created or
+    # lowering begins: the lint structural rules (dependency cycles,
+    # dangling depends_on / service references) prove the deploy cannot
+    # succeed on ANY inventory, so the submit is rejected with coded
+    # diagnostics in milliseconds. Inventory-dependent rules are NOT run
+    # here — the CP solves against live agent inventory, not the flow's
+    # declared servers.
+    from ..lint import deploy_blockers
+    blockers = deploy_blockers(req.flow, req.stage_name)
+    if blockers:
+        raise ValueError(
+            "flow rejected by static analysis: "
+            + "; ".join(f"{d.code}: {d.message}" for d in blockers))
     stage = db.ensure_stage(project.id, req.stage_name,
                             backend=stage_cfg.backend.value,
                             servers=stage_cfg.servers)

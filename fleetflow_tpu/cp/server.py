@@ -8,7 +8,6 @@ CpServerHandle supports graceful shutdown (server.rs CpServerHandle).
 
 from __future__ import annotations
 
-import asyncio
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional

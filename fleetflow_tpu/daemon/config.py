@@ -9,7 +9,7 @@ fleetflowd.kdl -> /etc/fleetflow/fleetflowd.kdl.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
 
@@ -94,7 +94,7 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.listen_host = str(node.prop("host", node.arg(0, cfg.listen_host)))
             cfg.listen_port = int(node.prop("port", node.arg(1, cfg.listen_port)))
         elif n == "web":
-            cfg.web_enabled = _truthy(node.prop("enabled", True))
+            cfg.web_enabled = _truthy(node.prop("enabled", True), node)
             cfg.web_host = str(node.prop("host", node.arg(0, cfg.web_host)))
             cfg.web_port = int(node.prop("port", node.arg(1, cfg.web_port)))
         elif n == "db":
@@ -116,10 +116,10 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
         elif n == "health-interval":
             cfg.health_interval_s = float(v)
         elif n == "health-tailscale":
-            cfg.health_tailscale = _truthy(v)
+            cfg.health_tailscale = _truthy(v, node)
         elif n == "heartbeat-stale":
             cfg.heartbeat_stale_s = float(v)
         elif n == "autoscale-interval":
             cfg.autoscale_interval_s = float(v)
         elif n in ("tpu-solver", "use-tpu-solver"):
-            cfg.use_tpu_solver = _truthy(v)
+            cfg.use_tpu_solver = _truthy(v, node)

@@ -37,7 +37,7 @@ import numpy as np
 
 from ..core.errors import SolverError
 from ..core.model import (ServiceType, Flow, PlacementPolicy, PlacementStrategy,
-                          ResourceSpec, ServerResource, Service, Stage)
+                          ResourceSpec, ServerResource, Service)
 
 __all__ = ["ProblemTensors", "lower_stage", "dependency_depths",
            "LOCAL_NODE_NAME", "local_node", "synthetic_problem"]

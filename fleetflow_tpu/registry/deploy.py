@@ -10,7 +10,7 @@ injectable runner so the whole flow is testable without a network.
 from __future__ import annotations
 
 import shlex
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..cloud.ssh import SshTarget, exec_with_timeout

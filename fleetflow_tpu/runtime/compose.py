@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import subprocess
 from pathlib import Path
-from typing import Optional
 
 from ..core.model import Flow, ServiceType, Stage
 

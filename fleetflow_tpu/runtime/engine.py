@@ -27,7 +27,7 @@ from typing import Callable, Optional
 from ..core.errors import FlowError
 from ..core.model import Flow
 from ..core.serialize import flow_from_dict, flow_to_dict
-from ..obs import get_logger, kv, span
+from ..obs import get_logger, kv
 from ..lower.tensors import LOCAL_NODE_NAME, local_node, lower_stage
 from ..sched import (HostGreedyScheduler, Placement, Scheduler,
                      place_with_fallback)
@@ -147,6 +147,22 @@ class DeployEngine:
 
         # ---- step 0: placement (replaces order_by_dependencies) ----------
         if placement is None:
+            # fail fast on statically-doomed flows BEFORE lowering: the
+            # lint structural rules (cycles, dangling references, and for
+            # local single-node execution the host-port pigeonhole) prove
+            # the deploy cannot succeed, so reject in milliseconds with
+            # coded diagnostics instead of failing mid-pipeline. Agents
+            # executing a CP-solved placement skip this — the CP already
+            # gated the submit (cp/handlers.py execute_deploy).
+            from ..lint import deploy_blockers
+            blockers = deploy_blockers(flow, req.stage_name,
+                                       local=req.node is None)
+            if blockers:
+                for d in blockers:
+                    emit(DeployEvent("error", message=d.format()))
+                raise FlowError(
+                    "flow rejected by static analysis: "
+                    + "; ".join(f"{d.code}: {d.message}" for d in blockers))
             # req.node unset = LOCAL execution (fleet up / CP-local deploy,
             # handlers/deploy.rs:470-507): everything runs on THIS machine,
             # so lower onto the single implicit local node — servers the

@@ -20,7 +20,7 @@ import time
 
 import numpy as np
 
-from .base import Placement, assemble_placement, level_schedule
+from .base import Placement, assemble_placement
 from ..core.model import PlacementStrategy
 from ..lower.tensors import ProblemTensors
 

@@ -25,7 +25,6 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .kernels import W_HARD
 from .problem import DeviceProblem
 
 __all__ = ["anneal", "anneal_adaptive", "anneal_states",

@@ -8,7 +8,6 @@ middleware), fleetflow/src/auth.rs:68-263 (Device Flow login).
 
 from __future__ import annotations
 
-import asyncio
 import json
 import threading
 import time

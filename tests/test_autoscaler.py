@@ -4,7 +4,6 @@ provider-failure handling — the elastic worker lifecycle
 
 import asyncio
 
-import pytest
 
 from fleetflow_tpu.cloud.provider import ServerInfo, ServerProvider
 from fleetflow_tpu.cp import ServerConfig, start

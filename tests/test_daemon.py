@@ -9,7 +9,7 @@ import urllib.request
 import pytest
 
 from fleetflow_tpu.cp import ServerConfig, start
-from fleetflow_tpu.daemon.config import DaemonConfig, load_daemon_config
+from fleetflow_tpu.daemon.config import load_daemon_config
 from fleetflow_tpu.daemon.health import HealthChecker
 from fleetflow_tpu.daemon.pidfile import PidFile, PidStatus
 from fleetflow_tpu.daemon.web import WebServer

@@ -161,3 +161,82 @@ class TestScoreParityWithObjective:
                                  pt.service_names[i])
         assert out["chosen"]["feasible"] is False
         assert out["chosen_rank"] is None
+
+
+class TestBlockedCountsContract:
+    """Direct unit contract for the per-category blocked counts — the lint
+    placement prelint (fleetflow_tpu/lint rule FF013) renders these into
+    diagnostics, so their categorization must be exact, not just plausible."""
+
+    def _pt(self):
+        """2 services sharing a host port, 4 nodes: node0 ineligible for
+        svc0, node1 too small for anyone, nodes 2-3 fine."""
+        from fleetflow_tpu.core.model import PlacementStrategy
+        from fleetflow_tpu.lower.tensors import ProblemTensors, _pad_ids
+
+        demand = np.array([[1.0, 100.0, 0.0], [1.0, 100.0, 0.0]],
+                          dtype=np.float32)
+        capacity = np.array([[4.0, 1000.0, 10.0],
+                             [0.5, 50.0, 10.0],       # fits nobody
+                             [4.0, 1000.0, 10.0],
+                             [4.0, 1000.0, 10.0]], dtype=np.float32)
+        eligible = np.ones((2, 4), dtype=bool)
+        eligible[0, 0] = False
+        pt = ProblemTensors(
+            service_names=["a", "b"], node_names=list("wxyz"),
+            demand=demand, capacity=capacity,
+            dep_adj=np.zeros((2, 2), dtype=bool),
+            dep_depth=np.zeros(2, dtype=np.int32),
+            port_ids=_pad_ids([[0], [0]]),      # shared host port
+            volume_ids=_pad_ids([[], []]),
+            anti_ids=_pad_ids([[], []]),
+            coloc_ids=_pad_ids([[], []]),
+            eligible=eligible,
+            node_valid=np.ones(4, dtype=bool),
+            node_topology=np.arange(4, dtype=np.int32),
+            strategy=PlacementStrategy.SPREAD_ACROSS_POOL,
+            replica_of=["a", "b"])
+        pt.validate()
+        return pt
+
+    def test_categories_partition_the_node_set(self):
+        pt = self._pt()
+        asn = np.array([2, 3])          # both on big, distinct nodes
+        out = explain_assignment(pt, asn, "a")
+        bc = out["blocked_counts"]
+        assert bc["total_nodes"] == 4
+        assert bc["ineligible"] == 1    # node w
+        assert bc["capacity"] == 1      # node x
+        assert bc["conflicts"] == 1     # node z holds b's port group
+        assert bc["feasible"] == 1      # only y: a's own current node
+        # the categories partition the full node set exactly
+        assert (bc["ineligible"] + bc["capacity"] + bc["conflicts"]
+                + bc["feasible"] + bc["invalid"]) == bc["total_nodes"]
+
+    def test_conflict_blocked_node_reported_per_family(self):
+        pt = self._pt()
+        asn = np.array([2, 3])
+        out = explain_assignment(pt, asn, "a")
+        rows = {r["node"]: r for r in out["alternatives"]}
+        rows[out["chosen"]["node"]] = out["chosen"]
+        z = explain_assignment(pt, asn, "b")["chosen"]
+        assert z["feasible"]
+        # a sees exactly one port conflict on node z (where b sits)
+        conflicted = [r for r in
+                      (explain_assignment(pt, asn, "a", top_k=4)
+                       ["alternatives"])
+                      if r["conflicts"]["ports"]]
+        assert all(r["node"] == "z" or not r["conflicts"]["ports"]
+                   for r in conflicted)
+
+    def test_infeasible_service_explains_zero_feasible(self):
+        """A service whose every node is blocked must report feasible=0 —
+        the exact shape the lint prelint renders into its diagnostic."""
+        pt = self._pt()
+        pt.eligible[0, :] = False       # a is eligible nowhere
+        asn = np.array([2, 3])
+        out = explain_assignment(pt, asn, "a")
+        assert out["blocked_counts"]["feasible"] == 0
+        assert out["chosen"]["feasible"] is False
+        assert out["chosen_rank"] is None
+        assert out["alternatives"] == []

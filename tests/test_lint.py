@@ -1,0 +1,422 @@
+"""`fleet lint` static analysis: rule catalog, spans, fail-fast wiring.
+
+Golden-fixture discipline (same canary approach as the chaos invariant
+tests): every lint rule has a deliberately-broken fixture under
+tests/lint_fixtures/ carrying an `// expect: CODE severity LINE:COL`
+header, and the test asserts the EXACT code, severity, and span — a rule
+that stops firing, fires twice, or drifts its span trips the canary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import os
+import shutil
+
+import pytest
+
+from fleetflow_tpu.core.errors import FlowError
+from fleetflow_tpu.core.model import (Flow, Port, Service, SourceLoc,
+                                      Stage)
+from fleetflow_tpu.core.parser import parse_kdl_string
+from fleetflow_tpu.lint import (RULES, Diagnostic, Severity, SourceMap,
+                                deploy_blockers, lint_flow, lint_project,
+                                lint_text)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _expectations(path: str) -> list[tuple[str, str, int, int]]:
+    out = []
+    for line in open(path, encoding="utf-8").read().splitlines():
+        if line.startswith("// expect: "):
+            code, sev, span = line[len("// expect: "):].split()
+            ln, col = span.split(":")
+            out.append((code, sev, int(ln), int(col)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# golden fixtures: one broken world per rule
+# --------------------------------------------------------------------------
+
+class TestGoldenFixtures:
+    @pytest.mark.parametrize(
+        "path", sorted(glob.glob(os.path.join(FIXTURES, "*.kdl"))),
+        ids=lambda p: os.path.basename(p)[:-4])
+    def test_fixture_fires_exactly_as_stamped(self, path):
+        if "ff009" in path and shutil.which("op"):
+            pytest.skip("op CLI installed; FF009 cannot fire here")
+        expected = _expectations(path)
+        assert expected, f"{path} has no // expect: header"
+        name = os.path.basename(path)
+        res = lint_text(open(path, encoding="utf-8").read(), name)
+        got = [(d.code, d.severity.value, d.line, d.col)
+               for d in res.diagnostics]
+        assert sorted(got) == sorted(expected), \
+            f"{name}: got {got}, expected {expected}"
+        # every diagnostic resolves to the fixture file (real spans)
+        for d in res.diagnostics:
+            assert d.file == name
+            assert d.line > 0
+
+    def test_every_rule_has_a_fixture(self):
+        """A rule without a failing-world proof is not live."""
+        have = {os.path.basename(p).split("_")[0].upper()
+                for p in glob.glob(os.path.join(FIXTURES, "*.kdl"))}
+        want = {r.code for r in RULES} | {"FF000"}
+        assert want <= have, f"rules without fixtures: {sorted(want - have)}"
+
+    def test_rule_codes_are_unique_and_stable_shape(self):
+        codes = [r.code for r in RULES]
+        assert len(codes) == len(set(codes))
+        assert all(c.startswith("FF0") and len(c) == 5 for c in codes)
+
+
+# --------------------------------------------------------------------------
+# examples must lint clean (the shipped configs hold the bar)
+# --------------------------------------------------------------------------
+
+class TestExamplesClean:
+    @pytest.mark.parametrize("name,stage", [("hello-world", "local"),
+                                            ("production", "local")])
+    def test_example_lints_clean(self, name, stage):
+        res = lint_project(os.path.join(EXAMPLES, name), stage)
+        msgs = [d.format() for d in res.diagnostics
+                if d.severity is Severity.ERROR]
+        assert not msgs, "\n".join(msgs)
+
+
+# --------------------------------------------------------------------------
+# spans: KDL -> model -> diagnostic
+# --------------------------------------------------------------------------
+
+class TestSpans:
+    def test_model_objects_carry_locs(self):
+        flow = parse_kdl_string('''project "t"
+service "web" {
+    image "nginx"
+    ports { port 8080 80 }
+    depends_on "db"
+}
+service "db" { image "postgres" }
+server "n1" { capacity { cpu 4; memory 819; disk 1024 } }
+stage "live" { service "web"; service "db"; servers "n1" }
+''', want_spans=True)
+        web = flow.services["web"]
+        assert web.loc == SourceLoc(2, 1)
+        assert web.dep_locs["db"] == SourceLoc(5, 5)
+        assert web.ports[0].loc == SourceLoc(4, 13)
+        assert flow.servers["n1"].loc == SourceLoc(8, 1)
+        st = flow.stages["live"]
+        assert st.loc == SourceLoc(9, 1)
+        assert st.service_locs["db"] == SourceLoc(9, 31)
+        assert st.server_locs["n1"] == SourceLoc(9, 45)
+
+    def test_spans_absent_without_want_spans(self):
+        flow = parse_kdl_string('service "a" { image "x" }')
+        assert flow.services["a"].loc is None
+
+    def test_spans_absent_on_pure_python_fallback(self, monkeypatch):
+        """The want_spans contract holds on EVERY parse path: forcing the
+        pure-Python parser (no native lib) must still yield span-less
+        nodes when spans were not requested."""
+        monkeypatch.setenv("FLEET_KDL_NATIVE", "0")
+        flow = parse_kdl_string('service "a" { image "x" }')
+        assert flow.services["a"].loc is None
+
+    def test_include_expansion_keeps_spans_exact(self, tmp_path):
+        """A diagnostic BELOW an `include` must point at its true on-disk
+        line — segments from read_kdl_with_includes offset the including
+        file's tail past the expansion."""
+        (tmp_path / "extra").mkdir()
+        (tmp_path / "extra" / "cache.kdl").write_text(
+            'service "cache" {\n    image "redis"\n}\n')
+        main = tmp_path / "fleet.kdl"
+        main.write_text('project "inc"\n'
+                        'include "extra/*.kdl"\n'
+                        'service "web" {\n'
+                        '    image "nginx"\n'
+                        '    depends_on "ghost"\n'      # on-disk line 5
+                        '}\n'
+                        'stage "local" { service "web"; service "cache" }\n')
+        from fleetflow_tpu.core.parser import (parse_kdl_string as _pks,
+                                               read_kdl_with_includes)
+        segs: list = []
+        text = read_kdl_with_includes(str(main), segments=segs)
+        flow = _pks(text, want_spans=True)
+        sm = SourceMap(segments=segs)
+        diags = lint_flow(flow, sm, prelint=False)
+        ff2 = [d for d in diags if d.code == "FF002"]
+        assert len(ff2) == 1
+        assert ff2[0].file == str(main)
+        assert ff2[0].line == 5          # NOT shifted by the include body
+        # and the included file's own lines resolve to the included file
+        f, ln = sm.resolve(text.splitlines().index('service "cache" {') + 1)
+        assert f.endswith("cache.kdl") and ln == 1
+
+    def test_sourcemap_resolves_concatenated_lines(self):
+        sm = SourceMap.from_parts(["a.kdl", "b.kdl"],
+                                  ["l1\nl2\nl3", "m1\nm2"])
+        assert sm.resolve(1) == ("a.kdl", 1)
+        assert sm.resolve(3) == ("a.kdl", 3)
+        assert sm.resolve(4) == ("b.kdl", 1)
+        assert sm.resolve(5) == ("b.kdl", 2)
+
+    def test_multi_file_project_spans_point_at_the_right_file(self, project):
+        root, write = project
+        write("services/broken.kdl", '''service "looper" {
+    image "x"
+    depends_on "looper2"
+}
+service "looper2" {
+    image "x"
+    depends_on "looper"
+}
+stage "cyc" { service "looper"; service "looper2" }
+''')
+        res = lint_project(str(root), "local")
+        cyc = [d for d in res.diagnostics if d.code == "FF001"]
+        assert len(cyc) == 1
+        assert cyc[0].file.endswith("services/broken.kdl")
+        assert cyc[0].line == 3   # the depends_on that closes the cycle
+
+    def test_strict_bool_failure_points_at_line(self):
+        from fleetflow_tpu.core.kdl import KdlError
+        with pytest.raises(KdlError) as e:
+            parse_kdl_string('''service "v" {
+    image "x"
+    volume "./data" "/data" read-only="flase"
+}''', want_spans=True)
+        assert (e.value.line, e.value.col) == (3, 5)
+        assert "invalid boolean" in str(e.value)
+
+    def test_strict_bool_failure_is_a_lint_load_error(self):
+        res = lint_text('''service "v" {
+    image "x"
+    volume "./data" "/data" read-only="flase"
+}''', "bool.kdl")
+        assert [d.code for d in res.diagnostics] == ["FF000"]
+        assert (res.diagnostics[0].line, res.diagnostics[0].col) == (3, 5)
+
+
+# --------------------------------------------------------------------------
+# rule engine over programmatic flows (no spans — must not crash)
+# --------------------------------------------------------------------------
+
+def _flow_with_cycle() -> Flow:
+    flow = Flow(name="t")
+    flow.services["a"] = Service(name="a", image="x", depends_on=["b"])
+    flow.services["b"] = Service(name="b", image="x", depends_on=["a"])
+    flow.stages["live"] = Stage(name="live", services=["a", "b"])
+    return flow
+
+
+class TestRuleEngine:
+    def test_spanless_flow_lints_without_crashing(self):
+        diags = lint_flow(_flow_with_cycle(), prelint=False)
+        assert [d.code for d in diags] == ["FF001"]
+        assert diags[0].line == 0 and diags[0].file is None
+
+    def test_stage_scoping(self):
+        flow = _flow_with_cycle()
+        flow.stages["ok"] = Stage(name="ok", services=["a"])
+        all_diags = lint_flow(flow, prelint=False)
+        only_ok = lint_flow(flow, stage_name="ok", prelint=False)
+        assert any(d.code == "FF001" for d in all_diags)
+        # stage "ok" has a dangling dep (b not in stage) but no cycle
+        assert [d.code for d in only_ok] == ["FF002"]
+
+    def test_prelint_skipped_when_stage_has_structural_errors(self):
+        diags = lint_flow(_flow_with_cycle(), prelint=True)
+        assert not any(d.code == "FF013" for d in diags)
+
+    def test_replica_port_pigeonhole_counts_replicas(self):
+        flow = Flow(name="t")
+        flow.services["web"] = Service(
+            name="web", image="x", replicas=3,
+            ports=[Port(host=8080, container=80)])
+        flow.stages["live"] = Stage(name="live", services=["web"])
+        # no declared servers -> implicit single local node: 3 rows, 1 node
+        diags = lint_flow(flow, prelint=False)
+        assert any(d.code == "FF006" for d in diags)
+
+    def test_stage_override_replicas_feed_the_rules(self):
+        flow = Flow(name="t")
+        flow.services["web"] = Service(
+            name="web", image="x", ports=[Port(host=8080, container=80)])
+        ov = Service(name="web", replicas=4, _replicas_set=True)
+        flow.stages["live"] = Stage(name="live", services=["web"],
+                                    service_overrides={"web": ov})
+        diags = lint_flow(flow, prelint=False)
+        ff6 = [d for d in diags if d.code == "FF006"]
+        assert ff6 and "4 service row(s)" in ff6[0].message
+
+
+# --------------------------------------------------------------------------
+# fail-fast wiring: engine + CP submit reject before lowering
+# --------------------------------------------------------------------------
+
+class TestDeployFailFast:
+    def test_deploy_blockers_structural_subset(self):
+        blockers = deploy_blockers(_flow_with_cycle(), "live")
+        assert [d.code for d in blockers] == ["FF001"]
+        assert all(d.severity is Severity.ERROR for d in blockers)
+
+    def test_deploy_blockers_local_includes_port_pigeonhole(self):
+        flow = Flow(name="t")
+        flow.services["a"] = Service(name="a", image="x",
+                                     ports=[Port(host=80, container=80)])
+        flow.services["b"] = Service(name="b", image="x",
+                                     ports=[Port(host=80, container=80)])
+        flow.stages["live"] = Stage(name="live", services=["a", "b"])
+        assert not deploy_blockers(flow, "live")           # CP: live inventory
+        local = deploy_blockers(flow, "live", local=True)  # one real machine
+        assert [d.code for d in local] == ["FF006"]
+
+    def test_engine_rejects_before_touching_backend(self):
+        from fleetflow_tpu.runtime.backend import MockBackend
+        from fleetflow_tpu.runtime.engine import DeployEngine, DeployRequest
+        backend = MockBackend(auto_pull=True)
+        engine = DeployEngine(backend, sleep=lambda s: None)
+        events = []
+        with pytest.raises(FlowError) as e:
+            engine.execute(DeployRequest(flow=_flow_with_cycle(),
+                                         stage_name="live"),
+                           on_event=events.append)
+        assert "FF001" in str(e.value)
+        assert not backend.list()                     # nothing was created
+        assert any("FF001" in ev.message for ev in events
+                   if ev.step == "error")
+
+    def test_cp_submit_rejects_with_diagnostics(self):
+        from fleetflow_tpu.cp.agent_registry import AgentRegistry
+        from fleetflow_tpu.cp.auth import NoAuth
+        from fleetflow_tpu.cp.handlers import execute_deploy
+        from fleetflow_tpu.cp.log_router import LogRouter
+        from fleetflow_tpu.cp.placement import PlacementService
+        from fleetflow_tpu.cp.server import AppState
+        from fleetflow_tpu.cp.store import Store
+        from fleetflow_tpu.runtime.backend import MockBackend
+        from fleetflow_tpu.runtime.engine import DeployRequest
+        store = Store()
+        state = AppState(store=store, auth=NoAuth(),
+                         agent_registry=AgentRegistry(),
+                         log_router=LogRouter(),
+                         placement=PlacementService(store),
+                         backend_factory=lambda: MockBackend(auto_pull=True),
+                         deploy_sleep=lambda s: None)
+        req = DeployRequest(flow=_flow_with_cycle(), stage_name="live")
+        with pytest.raises(ValueError) as e:
+            asyncio.run(execute_deploy(state, req))
+        assert "FF001" in str(e.value)
+        # rejected BEFORE any deployment record was created
+        assert not state.store.list("deployments")
+
+    def test_cp_submit_ignores_inventory_rules(self):
+        """Declared-server rules must NOT gate the CP (it solves against
+        live agent inventory, not flow.servers) — the chaos harness
+        deploys flows whose stage servers exist only in the CP store."""
+        flow = Flow(name="t")
+        flow.services["a"] = Service(name="a", image="x")
+        flow.stages["live"] = Stage(name="live", services=["a"],
+                                    servers=["cp-only-node"])
+        assert deploy_blockers(flow, "live") == []
+
+
+# --------------------------------------------------------------------------
+# CLI surface: fleet lint [--format text|json] [--strict], validate delegate
+# --------------------------------------------------------------------------
+
+class TestCliLint:
+    def test_clean_project_exits_zero(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, _ = project
+        assert main(["--project-root", str(root), "lint"]) == 0
+        assert "config valid" in capsys.readouterr().out
+
+    def test_broken_project_exits_one_with_spans(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, write = project
+        write("services/bad.kdl",
+              'service "x" { image "i"; depends_on "nope" }\n'
+              'stage "local" { service "x" }\n')
+        rc = main(["--project-root", str(root), "lint"])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "FF002" in err and "services/bad.kdl:1" in err
+
+    def test_json_format(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, write = project
+        write("services/bad.kdl",
+              'service "x" { image "i"; depends_on "nope" }\n'
+              'stage "local" { service "x" }\n')
+        rc = main(["--project-root", str(root), "lint", "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["ok"] is False and out["errors"] == 1
+        d = out["diagnostics"][0]
+        assert d["code"] == "FF002" and d["severity"] == "error"
+        assert d["file"].endswith("services/bad.kdl") and d["line"] == 1
+
+    def test_strict_promotes_warnings(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, write = project
+        write("services/warn.kdl",
+              'service "imageless" { env { A "1" } }\n'
+              'stage "local" { service "imageless" }\n')
+        assert main(["--project-root", str(root), "lint"]) == 0
+        capsys.readouterr()
+        assert main(["--project-root", str(root), "lint", "--strict"]) == 1
+
+    def test_validate_delegates_to_lint(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, write = project
+        write("services/bad.kdl",
+              'service "x" { image "i"; depends_on "nope" }\n'
+              'stage "local" { service "x" }\n')
+        rc = main(["--project-root", str(root), "validate"])
+        assert rc == 1
+        assert "FF002" in capsys.readouterr().err
+
+    def test_missing_config_exits_two(self, tmp_path):
+        from fleetflow_tpu.cli.main import main
+        assert main(["--project-root", str(tmp_path), "lint"]) == 2
+
+    def test_missing_config_json_still_emits_json(self, tmp_path, capsys):
+        """--format json must produce a JSON document on every exit path,
+        or machine consumers hit a parse error instead of a verdict."""
+        from fleetflow_tpu.cli.main import main
+        rc = main(["--project-root", str(tmp_path), "lint",
+                   "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 2 and out["ok"] is False and "reason" in out
+
+
+# --------------------------------------------------------------------------
+# diagnostics plumbing
+# --------------------------------------------------------------------------
+
+class TestDiagnostics:
+    def test_format_shape(self):
+        d = Diagnostic(code="FF001", severity=Severity.ERROR, message="boom",
+                       file="f.kdl", line=3, col=7, stage="live",
+                       hint="fix it")
+        s = d.format()
+        assert s.startswith("f.kdl:3:7: error FF001: boom")
+        assert "[stage live]" in s and "hint: fix it" in s
+
+    def test_to_dict_roundtrip_fields(self):
+        d = Diagnostic(code="FF006", severity=Severity.WARNING, message="m",
+                       file="f", line=1, col=2, rule="slug", stage="s")
+        dd = d.to_dict()
+        assert dd == {"code": "FF006", "severity": "warning", "message": "m",
+                      "rule": "slug", "file": "f", "line": 1, "col": 2,
+                      "stage": "s"}
+
+    def test_spanless_diagnostic_format(self):
+        d = Diagnostic(code="FF009", severity=Severity.WARNING, message="m")
+        assert d.format().startswith("<config>: warning FF009: m")

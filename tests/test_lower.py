@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 from fleetflow_tpu.core import SolverError, parse_kdl_string
-from fleetflow_tpu.core.model import PlacementStrategy
 from fleetflow_tpu.lower import (dependency_depths, lower_stage,
                                  synthetic_problem)
 

@@ -238,10 +238,16 @@ def test_wrapper_sets_every_kdlnode_field():
     from fleetflow_tpu.core.kdl import KdlNode
 
     assert [f.name for f in dataclasses.fields(KdlNode)] == \
-        ["name", "args", "props", "children", "type_annotation"]
+        ["name", "args", "props", "children", "type_annotation",
+         "line", "col"]
     node = native_parse_document("(ty)n 1 k=2 { c }")[0]
     for f in dataclasses.fields(KdlNode):
         assert hasattr(node, f.name)
+    # the span fields are deliberately NOT set by the native assemblers:
+    # KdlNode.__getattr__ falls them back to 0 ("no span"), and only the
+    # pure-Python parser (parse_document(want_spans=True)) records real
+    # positions — spans are a lint-path concern, not a parity concern
+    assert (node.line, node.col) == (0, 0)
 
 
 def test_fuzz_parity():

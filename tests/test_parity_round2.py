@@ -18,7 +18,7 @@ import pytest
 from fleetflow_tpu.agent import Agent, AgentConfig
 from fleetflow_tpu.cloud.provider import ServerInfo, ServerProvider
 from fleetflow_tpu.cp import ServerConfig, start
-from fleetflow_tpu.cp.models import BuildJob, CostEntry, DnsRecord
+from fleetflow_tpu.cp.models import BuildJob, DnsRecord
 from fleetflow_tpu.daemon.web import WebServer
 from fleetflow_tpu.runtime import MockBackend
 

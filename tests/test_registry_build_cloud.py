@@ -2,7 +2,6 @@
 
 import json
 
-import numpy as np
 import pytest
 
 from fleetflow_tpu.core.model import (BuildConfig, Flow, Port, ResourceSpec,

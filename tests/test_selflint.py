@@ -1,0 +1,48 @@
+"""Self-lint: the codebase holds itself to the same static-analysis bar
+`fleet lint` holds fleet configs to.
+
+Three layers, strongest available wins:
+
+  - scripts/selflint.py (stdlib-only) ALWAYS runs: syntax, undefined
+    names, unused module-level imports — the committed clean baseline
+  - `ruff check` (ruff.toml) runs when ruff is installed (the CI tier-1
+    static-analysis step installs it; dev containers may not have it)
+  - `mypy` (mypy.ini, scoped to fleetflow_tpu/lint) likewise
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+
+
+def test_selflint_baseline_clean():
+    """The dependency-free checker must stay at zero findings — a typo'd
+    name or dead import lands here before it lands in production."""
+    proc = _run([sys.executable, os.path.join(REPO, "scripts",
+                                              "selflint.py")])
+    assert proc.returncode == 0, \
+        f"selflint findings:\n{proc.stdout}\n{proc.stderr}"
+
+
+@pytest.mark.skipif(shutil.which("ruff") is None,
+                    reason="ruff not installed (CI installs it)")
+def test_ruff_clean():
+    proc = _run(["ruff", "check", "fleetflow_tpu", "tests", "scripts"])
+    assert proc.returncode == 0, f"ruff findings:\n{proc.stdout}"
+
+
+@pytest.mark.skipif(shutil.which("mypy") is None,
+                    reason="mypy not installed (CI installs it)")
+def test_mypy_lint_package_clean():
+    proc = _run(["mypy", "--config-file", "mypy.ini"])
+    assert proc.returncode == 0, f"mypy findings:\n{proc.stdout}"

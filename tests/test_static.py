@@ -11,7 +11,6 @@ import pytest
 from fleetflow_tpu.cli.main import main
 from fleetflow_tpu.core.errors import FlowError
 from fleetflow_tpu.core.model import DeployConfig, Service, ServiceType
-from fleetflow_tpu.core.parser import parse_kdl_string
 from fleetflow_tpu.runtime import static_site
 from fleetflow_tpu.runtime.static_site import (build_static, deploy_static,
                                                split_static_services,

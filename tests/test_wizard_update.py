@@ -9,12 +9,10 @@ driven with injected IO/fetchers — no terminal, no network.
 import pytest
 
 from fleetflow_tpu.cli.main import main
-from fleetflow_tpu.cli.self_update import (UpdatePlan, is_newer_version,
-                                           pick_asset, plan_update,
-                                           self_update)
+from fleetflow_tpu.cli.self_update import (is_newer_version, pick_asset,
+                                           plan_update, self_update)
 from fleetflow_tpu.cli.wizard import (CONFIG_PATHS, TEMPLATES,
-                                      render_template, resolve_config_path,
-                                      run_wizard)
+                                      render_template, run_wizard)
 from fleetflow_tpu.core.loader import load_project
 
 
