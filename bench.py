@@ -307,7 +307,16 @@ def main() -> None:
         "burst": burst,
         "sharded": sharded,
         "pipeline": pipeline,
+        # the same registry GET /metrics serves, embedded so BENCH_*.json
+        # artifacts carry the counters the endpoint would have shown for
+        # this run (solve durations, sweeps, compiles, acceptance)
+        "metrics": _metrics_snapshot(),
     }))
+
+
+def _metrics_snapshot() -> dict:
+    from fleetflow_tpu.obs.metrics import REGISTRY
+    return REGISTRY.snapshot()
 
 
 def _deactivate_rows(pt, start: int):

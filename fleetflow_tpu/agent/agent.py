@@ -30,7 +30,8 @@ from ..lower.tensors import lower_stage
 from .guard import confine_path, validate_container_name
 from .monitor import AnomalyDetector, inventory_report, snapshot_backend
 from ..cp.protocol import Connection, ProtocolClient
-from ..obs import get_logger, kv
+from ..obs import get_logger, kv, span
+from ..obs.trace import use_trace
 
 __all__ = ["Agent", "AgentConfig"]
 
@@ -259,17 +260,24 @@ class Agent:
             stage = req.flow.stage(req.stage_name)
             if stage.backend is Backend.QUADLET:
                 return await loop.run_in_executor(
-                    None, lambda: self._deploy_quadlet(req, emit))
+                    None, lambda: self._run_traced(
+                        req, lambda: self._deploy_quadlet(req, emit)))
             if stage.backend is Backend.COMPOSE:
                 return await loop.run_in_executor(
-                    None, lambda: self._deploy_compose(req, emit))
+                    None, lambda: self._run_traced(
+                        req, lambda: self._deploy_compose(req, emit)))
 
             placement = self._placement_from(req, payload.get("assignment"))
             engine = DeployEngine(self.backend, sleep=self.sleep)
 
             def run_deploy():
-                return engine.execute(req, on_event=lambda e: emit(str(e)),
-                                      placement=placement)
+                # engine.execute re-enters the trace itself from
+                # req.trace_id; the agent span wraps it so the flight
+                # recorder shows the node-side execution as its own span
+                return self._run_traced(
+                    req, lambda: engine.execute(
+                        req, on_event=lambda e: emit(str(e)),
+                        placement=placement))
 
             res = await loop.run_in_executor(None, run_deploy)
             if not res.ok:
@@ -281,14 +289,28 @@ class Agent:
             req = DeployRequest.from_dict(payload["request"])
             emit = self._live_emitter(loop, f"deploy/{req.stage_name}")
             return await loop.run_in_executor(
-                None, lambda: self._down(
-                    req, bool(payload.get("remove")), emit))
+                None, lambda: self._run_traced(
+                    req, lambda: self._down(
+                        req, bool(payload.get("remove")), emit),
+                    name="agent.down"))
 
         if method == "build":
             return await loop.run_in_executor(
                 None, lambda: self._run_build(payload))
 
         raise ValueError(f"unknown agent command {method!r}")
+
+    def _run_traced(self, req: DeployRequest, fn, name: str = "agent.deploy"):
+        """Run a deploy-shaped command inside the request's trace with an
+        agent-side span. Commands execute on executor threads, where the
+        session loop's contextvars are absent — the trace is re-entered
+        from the id the CP carried in DeployRequest.trace_id, which is
+        what makes one `fleet deploy` correlate across machines."""
+        with use_trace(req.trace_id) as tid:
+            req.trace_id = tid
+            with span(log, name, slug=self.config.slug,
+                      project=req.flow.name, stage=req.stage_name):
+                return fn()
 
     def _live_emitter(self, loop: asyncio.AbstractEventLoop,
                       container: str) -> Callable[[str], None]:

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..obs.metrics import REGISTRY
 from ..runtime.backend import ContainerBackend, ContainerInfo
 
 __all__ = ["ContainerSnapshot", "Anomaly", "detect_anomalies",
@@ -28,6 +29,16 @@ __all__ = ["ContainerSnapshot", "Anomaly", "detect_anomalies",
 
 DEFAULT_RESTART_THRESHOLD = 3   # monitor.rs:26-32
 ALERT_COOLDOWN_S = 300.0
+
+# metric catalog: docs/guide/10-observability.md. Counted at REPORT time
+# (post-cooldown), so the numbers match the alerts the CP actually saw.
+_M_ANOMALIES = REGISTRY.counter(
+    "fleet_agent_anomalies_total",
+    "Container anomalies reported, by kind "
+    "(restart_loop/unexpected_stop/unhealthy)", labels=("kind",))
+_M_RESOLVED = REGISTRY.counter(
+    "fleet_agent_anomalies_resolved_total",
+    "Container anomaly auto-resolves reported, by kind", labels=("kind",))
 
 
 @dataclass(frozen=True)
@@ -140,6 +151,8 @@ class AnomalyDetector:
                 report.append(Anomaly(cname, key[1], "container removed",
                                       resolved=True))
         self._prev = dict(curr)
+        for a in report:
+            (_M_RESOLVED if a.resolved else _M_ANOMALIES).inc(kind=a.kind)
         return report
 
 

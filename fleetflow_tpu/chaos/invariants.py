@@ -20,7 +20,8 @@ import numpy as np
 __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "check_final", "capacity_accounting", "reservations_terminal",
            "no_dead_assignments", "pools_at_min", "solver_feasible",
-           "containers_converged"]
+           "containers_converged", "metrics_monotonic",
+           "agents_gauge_consistent"]
 
 _EPS = 1e-6
 
@@ -158,7 +159,45 @@ def containers_converged(world, snapshot=None) -> list[str]:
     return out
 
 
-INSTANT_INVARIANTS = {"capacity-accounting": capacity_accounting}
+def metrics_monotonic(world) -> list[str]:
+    """Counters never decrease across the run. The metrics registry is the
+    operator's ground truth for rates and totals; a counter that went DOWN
+    between two check points means a subsystem rebuilt or overwrote its
+    series mid-run — exactly the bug a /metrics consumer computing
+    rate() cannot see and cannot recover from. The baseline snapshot rides
+    on the world object, so the first check of a run establishes it and
+    every later check (per fault burst, then final) diffs against the
+    last one."""
+    from ..obs.metrics import REGISTRY
+    snap = REGISTRY.counter_values()
+    prev: dict[str, float] = getattr(world, "_metrics_counters_prev", {})
+    out = [f"counter {key} decreased: {prev[key]} -> {snap[key]}"
+           for key in prev if key in snap and snap[key] < prev[key] - _EPS]
+    world._metrics_counters_prev = snap
+    return out
+
+
+def agents_gauge_consistent(world) -> list[str]:
+    """The fleet_agents_connected gauge matches the agent registry after
+    the run settles (rolling kills + reconnects must net out): a drifting
+    gauge means a register/unregister path skipped its metrics update,
+    and every dashboard and autoscaling signal built on it lies."""
+    from ..obs.metrics import REGISTRY
+    gauge = REGISTRY.get("fleet_agents_connected")
+    if gauge is None:
+        return ["fleet_agents_connected gauge is not registered"]
+    shown = gauge.value()
+    actual = len(world.state.agent_registry.list_connected())
+    if shown != actual:
+        return [f"fleet_agents_connected={shown:g} but the registry holds "
+                f"{actual} live sessions"]
+    return []
+
+
+INSTANT_INVARIANTS = {
+    "capacity-accounting": capacity_accounting,
+    "metrics-monotonic": metrics_monotonic,
+}
 FINAL_INVARIANTS = {
     "capacity-accounting": capacity_accounting,
     "reservations-terminal": reservations_terminal,
@@ -166,6 +205,8 @@ FINAL_INVARIANTS = {
     "pools-at-min": pools_at_min,
     "solver-feasible": solver_feasible,
     "containers-converged": containers_converged,
+    "metrics-monotonic": metrics_monotonic,
+    "agents-gauge-consistent": agents_gauge_consistent,
 }
 
 

@@ -730,6 +730,48 @@ stage "local" {{
 '''
 
 
+def cmd_events(args) -> int:
+    """Pretty-print a flight-recorder file (FLEET_TRACE_FILE JSONL): one
+    line per span event, indented by nesting, grep-ably carrying the
+    trace id. `--trace` narrows to one operation's timeline."""
+    path = args.trace_file or os.environ.get("FLEET_TRACE_FILE", "")
+    if not path:
+        print("no trace file: pass --trace-file or set FLEET_TRACE_FILE",
+              file=sys.stderr)
+        return 2
+    from ..obs.trace import read_trace_file
+    try:
+        events = read_trace_file(path)
+    except FileNotFoundError:
+        print(f"trace file {path!r} not found", file=sys.stderr)
+        return 2
+    if args.trace:
+        events = [e for e in events if e.get("trace") == args.trace]
+    if args.json:
+        print(json.dumps(events, indent=1))
+        return 0
+    depth: dict[str, int] = {}   # span id -> nesting depth within its trace
+    for e in events:
+        kind, span_id = e.get("kind", "?"), e.get("span", "")
+        if kind == "begin":
+            depth[span_id] = depth.get(e.get("parent", ""), -1) + 1
+        pad = "  " * depth.get(span_id, 0)
+        dur = (f" {e['duration_ms']:.1f}ms"
+               if e.get("duration_ms") is not None else "")
+        err = f" error={e['error']!r}" if e.get("error") else ""
+        fields = e.get("fields") or {}
+        fstr = " ".join(f"{k}={v}" for k, v in fields.items() if v is not None)
+        mark = {"begin": "▶", "end": "✓", "fail": "✗"}.get(kind, "?")
+        print(f"{e.get('ts', 0):.3f} {mark} {pad}{e.get('logger', '')} "
+              f"{e.get('name', '')}{dur}{err} "
+              f"[trace={e.get('trace', '')}]"
+              + (f" {fstr}" if fstr else ""))
+    if not events:
+        print("(no events)" + (f" for trace {args.trace}"
+                               if args.trace else ""))
+    return 0
+
+
 def cmd_init(args) -> int:
     """Starter config writer. Interactive wizard on a TTY (the reference's
     ratatui wizard, tui/init.rs:123); direct write with --name or when
@@ -898,6 +940,24 @@ def _cp_dispatch(cp: CpClient, args) -> int:
 
     if sub == "status":
         return show(cp.request("health", "overview"))
+    if sub == "metrics":
+        # the same registry GET /metrics serves, fetched over the channel
+        # protocol and printed as name{labels} value lines (--json for the
+        # full structured snapshot with HELP text and histogram sums)
+        snap = cp.request("health", "metrics")["metrics"]
+        if getattr(args, "json", False):
+            return show(snap)
+        for name, fam in sorted(snap.items()):
+            for v in fam["values"]:
+                labels = ",".join(f'{k}="{val}"'
+                                  for k, val in sorted(v["labels"].items()))
+                sel = f"{{{labels}}}" if labels else ""
+                if fam["type"] == "histogram":
+                    print(f"  {name}{sel} count={v['count']} "
+                          f"sum={v['sum']:.6g}")
+                else:
+                    print(f"  {name}{sel} {v['value']:g}")
+        return 0
     if sub == "tenant":
         verb = args.verb
         if verb == "status":
@@ -1313,6 +1373,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: the user systemd dir)")
     p.set_defaults(fn=cmd_agent)
 
+    p = sub.add_parser("events", help="pretty-print a flight-recorder "
+                       "trace file (FLEET_TRACE_FILE span events)")
+    p.add_argument("--trace-file", help="path to the JSONL flight-recorder "
+                   "file (default: $FLEET_TRACE_FILE)")
+    p.add_argument("--trace", help="only events of this trace id")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON events instead of the timeline view")
+    p.set_defaults(fn=cmd_events)
+
     p = sub.add_parser("init", help="write a starter fleet.kdl")
     p.add_argument("--name")
     p.add_argument("--force", action="store_true")
@@ -1357,6 +1426,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--ttl", type=float, default=86400.0 * 365,
                    help="lifetime in seconds (default: one year)")
     q = cps.add_parser("status")
+    q = cps.add_parser("metrics", help="dump the CP metrics registry "
+                       "(the JSON face of GET /metrics)")
+    q.add_argument("--json", action="store_true",
+                   help="full structured snapshot with HELP text")
     q = cps.add_parser("daemon")
     q.add_argument("daemon_command",
                    choices=["run", "start", "stop", "status"])

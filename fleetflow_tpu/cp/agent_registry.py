@@ -20,9 +20,29 @@ from typing import Callable, Optional
 
 from ..core.errors import ControlPlaneError
 from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
 from .protocol import Connection
 
 log = get_logger("cp.agents")
+
+# metric catalog: docs/guide/10-observability.md
+# The gauge is process-global with last-writer-wins set() semantics: a
+# production daemon has exactly one registry, and in multi-registry
+# processes (tests, chaos worlds run back-to-back) it reflects whichever
+# registry mutated last — which is what the chaos invariant
+# (agents_gauge_consistent) relies on, since every world's bootstrap
+# registers its own agents before any check runs.
+_M_CONNECTED = REGISTRY.gauge(
+    "fleet_agents_connected", "Node agents with a live registered session")
+_M_REGISTRATIONS = REGISTRY.counter(
+    "fleet_agent_registrations_total", "Agent register calls accepted")
+_M_COMMANDS = REGISTRY.counter(
+    "fleet_agent_commands_total", "Commands sent to agents, by command",
+    labels=("command",))
+_M_COMMAND_ERRORS = REGISTRY.counter(
+    "fleet_agent_command_errors_total",
+    "Agent commands that failed, by reason",
+    labels=("reason",))
 
 __all__ = ["AgentRegistry", "DEFAULT_TIMEOUT", "DEPLOY_TIMEOUT",
            "BUILD_TIMEOUT"]
@@ -84,11 +104,14 @@ class AgentRegistry:
                 f"session under a different identity")
         self._agents[slug] = conn
         self._principals[slug] = principal
+        _M_REGISTRATIONS.inc()
+        _M_CONNECTED.set(len(self._agents))
 
     def unregister(self, slug: str, conn: Optional[Connection] = None) -> None:
         if conn is None or self._agents.get(slug) is conn:
             self._agents.pop(slug, None)
             self._principals.pop(slug, None)
+            _M_CONNECTED.set(len(self._agents))
         # fail the dead session's in-flight commands NOW — their results
         # can never arrive, and callers (deploys especially) must not sit
         # out the full per-call timeout against a crashed agent
@@ -120,6 +143,7 @@ class AgentRegistry:
             raise ControlPlaneError(f"agent {slug!r} is not connected")
         if self.delivery_hook is not None:
             self.delivery_hook(slug, command)
+        _M_COMMANDS.inc(command=command)
         request_id = f"req_{next(self._ids)}"
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
@@ -129,9 +153,13 @@ class AgentRegistry:
                 "request_id": request_id, "payload": payload or {}})
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
+            _M_COMMAND_ERRORS.inc(reason="timeout")
             raise ControlPlaneError(
                 f"agent {slug!r} command {command!r} timed out "
                 f"after {timeout:.0f}s") from None
+        except ControlPlaneError:
+            _M_COMMAND_ERRORS.inc(reason="error")
+            raise
         finally:
             self._pending.pop(request_id, None)
             self._pending_conn.pop(request_id, None)
@@ -148,6 +176,7 @@ class AgentRegistry:
             raise ControlPlaneError(f"agent {slug!r} is not connected")
         if self.delivery_hook is not None:
             self.delivery_hook(slug, command)
+        _M_COMMANDS.inc(command=command)
         await conn.send_event("agent", command,
                               {"request_id": None, "payload": payload or {}})
 

@@ -12,9 +12,13 @@ ProtocolServer.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import TYPE_CHECKING
 
 from ..core.serialize import flow_from_dict
+from ..obs import get_logger, span
+from ..obs.metrics import REGISTRY
+from ..obs.trace import new_trace_id, use_trace
 from ..runtime.engine import DeployEngine, DeployRequest
 from .agent_registry import BUILD_TIMEOUT, DEPLOY_TIMEOUT
 from .log_router import LogEntry, topic_for
@@ -28,6 +32,18 @@ if TYPE_CHECKING:
     from .server import AppState
 
 __all__ = ["register_all", "check_all_servers", "dns_sync"]
+
+_log = get_logger("cp.deploy")
+
+# metric catalog: docs/guide/10-observability.md. Channel label only (the
+# method vocabulary is open-ended via agent commands; channels are the
+# fixed 14-way enum) — bounded cardinality by construction.
+_M_REQUEST_S = REGISTRY.histogram(
+    "fleet_cp_request_duration_seconds",
+    "Channel RPC handler latency, by channel", labels=("channel",))
+_M_REQUEST_ERRORS = REGISTRY.counter(
+    "fleet_cp_request_errors_total",
+    "Channel RPC handlers that raised, by channel", labels=("channel",))
 
 
 def check_all_servers(state: "AppState") -> dict:
@@ -87,8 +103,26 @@ def _require(payload: dict, *keys: str) -> list:
 _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "logs.live", "show", "snapshots", "ps", "pool.list",
-    "user.list", "ping", "reservations",
+    "user.list", "ping", "reservations", "metrics",
 })
+def _timed(channel: str, handler):
+    """Wrap a channel handler with the request-latency histogram + error
+    counter (web.rs would get this from tower middleware; here it's 8
+    lines around every channel, the agent session included)."""
+
+    async def timed(conn: Connection, method: str, p: dict):
+        t0 = time.perf_counter()
+        try:
+            return await handler(conn, method, p)
+        except Exception:
+            _M_REQUEST_ERRORS.inc(channel=channel)
+            raise
+        finally:
+            _M_REQUEST_S.observe(time.perf_counter() - t0, channel=channel)
+
+    return timed
+
+
 def _perm_wrap(channel: str, handler):
     """Wrap a channel handler with claims-based permission enforcement."""
 
@@ -114,9 +148,11 @@ def register_all(server: ProtocolServer, state: "AppState") -> None:
             ("server", _server), ("health", _health), ("cost", _cost),
             ("dns", _dns), ("deploy", _deploy), ("volume", _volume),
             ("build", _build), ("placement", _placement)):
-        server.register_channel(channel, _perm_wrap(channel, factory(state)))
+        server.register_channel(
+            channel, _timed(channel, _perm_wrap(channel, factory(state))))
     agent_handler, agent_events = _agent(state)
-    server.register_channel("agent", agent_handler, agent_events)
+    server.register_channel("agent", _timed("agent", agent_handler),
+                            agent_events)
     server.on_disconnect = _on_disconnect(state)
 
 
@@ -470,10 +506,17 @@ def _health(state: "AppState"):
                 "projects": len(db.list("projects")),
                 "deployments": len(db.list("deployments")),
                 "active_alerts": len(db.active_alerts()),
+                # pointer, not payload: `fleet cp status` shows the series
+                # count; the full registry rides health.metrics / /metrics
+                "metrics": {"families": len(REGISTRY.names())},
             }
         if method == "alerts":
             return {"alerts": [a.to_dict()
                                for a in db.active_alerts(p.get("tenant"))]}
+        if method == "metrics":
+            # the same registry the daemon's GET /metrics serves, in JSON
+            # (the channel face for `fleet cp metrics` / MCP consumers)
+            return {"metrics": REGISTRY.snapshot()}
         raise ValueError(f"unknown method health.{method}")
     return handle
 
@@ -727,7 +770,21 @@ async def execute_deploy(state: "AppState", req: DeployRequest,
     """The deploy.execute path (handlers/deploy.rs:280-542), shared by the
     deploy channel and the web redeploy route: record the deployment (with
     the request, so redeploy can replay it), solve placement, fan out to
-    every connected stage agent (or run CP-locally), finish the record."""
+    every connected stage agent (or run CP-locally), finish the record.
+
+    The whole path runs inside ONE trace: minted here (or adopted from the
+    CLI's request), carried to every agent via DeployRequest.trace_id, so
+    the CP span, each agent's engine spans, and all their log lines share
+    a trace_id end to end."""
+    req.trace_id = req.trace_id or new_trace_id()
+    with use_trace(req.trace_id):
+        with span(_log, "deploy.execute", project=req.flow.name,
+                  stage=req.stage_name, tenant=tenant_name) as sp:
+            return await _execute_deploy(state, req, tenant_name, sp)
+
+
+async def _execute_deploy(state: "AppState", req: DeployRequest,
+                          tenant_name: str, sp: dict) -> dict:
     db = state.store
     tenant = db.ensure_tenant(tenant_name)
     project = db.ensure_project(tenant.name, req.flow.name)
@@ -748,11 +805,17 @@ async def execute_deploy(state: "AppState", req: DeployRequest,
     stage = db.ensure_stage(project.id, req.stage_name,
                             backend=stage_cfg.backend.value,
                             servers=stage_cfg.servers)
+    # the stored request is a REPLAY TEMPLATE (stage_redeploy rebuilds it
+    # via from_dict): the trace id must not ride along, or every future
+    # redeploy would inherit this deploy's trace and `fleet events
+    # --trace` would interleave operations that ran days apart
+    stored_req = req.to_dict()
+    stored_req.pop("trace_id", None)
     dep = db.create("deployments", Deployment(
         tenant=tenant.name, project=project.id, stage=stage.id,
         status=DeploymentStatus.RUNNING.value,
         services=[s.name for s in stage_cfg.resolved_services(req.flow)],
-        request=req.to_dict()))
+        request=stored_req))
 
     targets = [s for s in stage_cfg.servers
                if state.agent_registry.is_connected(s)]
@@ -776,7 +839,7 @@ async def execute_deploy(state: "AppState", req: DeployRequest,
                         flow=req.flow, stage_name=req.stage_name,
                         target_services=req.target_services,
                         no_pull=req.no_pull, no_prune=req.no_prune,
-                        node=slug).to_dict(),
+                        node=slug, trace_id=req.trace_id).to_dict(),
                      "assignment": placement.assignment},
                     timeout=DEPLOY_TIMEOUT)
                 for slug in targets], return_exceptions=True)
@@ -803,6 +866,8 @@ async def execute_deploy(state: "AppState", req: DeployRequest,
         for svc in (db.get("deployments", dep.id).services or []):
             db.upsert_service(stage.id, svc, status="deployed")
         db.finish_deployment(dep.id, DeploymentStatus.SUCCEEDED, log=log)
+        sp["deployment"] = dep.id
+        sp["agents"] = len(targets) or None
     except Exception as e:
         db.finish_deployment(dep.id, DeploymentStatus.FAILED,
                              error=str(e))

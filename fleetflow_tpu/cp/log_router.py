@@ -17,12 +17,22 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .models import now_ts
+from ..obs.metrics import REGISTRY
 
 __all__ = ["LogEntry", "LogRouter", "RETAIN_LINES"]
 
 RETAIN_LINES = 200  # log_router.rs:31
 
 _LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
+
+# metric catalog: docs/guide/10-observability.md
+_M_PUBLISHED = REGISTRY.counter(
+    "fleet_log_lines_published_total", "Lines published into the log router")
+_M_DELIVERED = REGISTRY.counter(
+    "fleet_log_lines_delivered_total", "Line deliveries to subscriber queues")
+_M_DROPPED = REGISTRY.counter(
+    "fleet_log_lines_dropped_total",
+    "Lines evicted from full subscriber queues (slow consumers)")
 
 
 @dataclass
@@ -48,6 +58,10 @@ class _Subscriber:
     prefix: str
     min_level: int
     queue: asyncio.Queue
+    # lines evicted from THIS subscriber's full queue — slow-consumer
+    # drops were previously silent (satellite, ISSUE 3); the aggregate
+    # rides fleet_log_lines_dropped_total
+    dropped: int = 0
 
 
 class LogRouter:
@@ -64,6 +78,7 @@ class LogRouter:
         ring = self._retained.setdefault(entry.topic,
                                          deque(maxlen=self.retain))
         ring.append(entry)
+        _M_PUBLISHED.inc()
         delivered = 0
         lvl = _LEVELS.get(entry.level, 2)
         for sub in self._subs.values():
@@ -74,10 +89,14 @@ class LogRouter:
             if sub.queue.full():        # drop oldest, never block
                 try:
                     sub.queue.get_nowait()
+                    sub.dropped += 1
+                    _M_DROPPED.inc()
                 except asyncio.QueueEmpty:
                     pass
             sub.queue.put_nowait(entry)
             delivered += 1
+        if delivered:
+            _M_DELIVERED.inc(delivered)
         return delivered
 
     def publish_line(self, server: str, container: str, line: str,
@@ -97,6 +116,12 @@ class LogRouter:
 
     def unsubscribe(self, sid: int) -> None:
         self._subs.pop(sid, None)
+
+    def subscriber(self, sid: int) -> Optional[_Subscriber]:
+        """The live subscriber record (drop count and filters) — ops
+        surfaces read `.dropped` to tell a slow consumer from a quiet
+        topic."""
+        return self._subs.get(sid)
 
     # ------------------------------------------------------------------
     def retained(self, topic: str, limit: Optional[int] = None) -> list[LogEntry]:

@@ -42,8 +42,24 @@ from .models import (Alert, BuildJob, CostEntry, Deployment, DeploymentStatus,
                      DnsRecord, ObservedContainer, Project, Record, Server,
                      ServiceRecord, StageRecord, Tenant, TenantUser,
                      VolumeRecord, VolumeSnapshot, WorkerPool, new_id, now_ts)
+from ..obs.metrics import REGISTRY
 
 __all__ = ["Store"]
+
+# metric catalog: docs/guide/10-observability.md. Counted via the store's
+# own mutation-observer hook so the change-data-capture path and the
+# metrics path can never disagree about what a mutation is.
+_M_STORE_OPS = REGISTRY.counter(
+    "fleet_store_ops_total", "Store mutations by table and op (put/del)",
+    labels=("table", "op"))
+_M_HEARTBEATS = REGISTRY.counter(
+    "fleet_heartbeats_total", "Agent heartbeats recorded")
+_M_COMPACTIONS = REGISTRY.counter(
+    "fleet_store_compactions_total", "Journal compactions (snapshot writes)")
+
+
+def _count_op(op: str, table: str, _payload: object) -> None:
+    _M_STORE_OPS.inc(table=table, op=op)
 
 R = TypeVar("R", bound=Record)
 
@@ -91,7 +107,7 @@ class Store:
         # event log on; it doubles as a general extension point (metrics,
         # cache invalidation). Observers must be fast and must not
         # re-enter the store's mutators.
-        self._observers: list[Callable[[str, str, object], None]] = []
+        self._observers: list[Callable[[str, str, object], None]] = [_count_op]
         if self._path and self._path.exists():
             self._load()
         if self._journal_path and self._journal_path.exists():
@@ -259,6 +275,7 @@ class Store:
         s = self.server_by_slug(slug)
         if s is None:
             return None
+        _M_HEARTBEATS.inc()
         changes: dict = {"last_heartbeat": self._clock(), "status": "online"}
         if version:
             changes["agent_version"] = version
@@ -440,6 +457,7 @@ class Store:
             self._journal_entries = 0
             self._journal_bytes = 0
             self._compactions += 1
+            _M_COMPACTIONS.inc()
 
     def _load(self) -> None:
         doc = json.loads(self._path.read_text())

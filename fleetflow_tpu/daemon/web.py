@@ -89,6 +89,9 @@ class WebServer:
         "containers": "container", "logs": "container",
         "pools": "server",   # worker pools live on the server channel
         "costs": "cost",
+        # the Prometheus endpoint is an ops/status surface: the health
+        # grant covers it (read:metrics exists in no channel vocabulary)
+        "metrics": "health",
         # channel-less areas must still land in the grant vocabulary
         # (ADVICE r3): the overview is the dashboard's status landing view,
         # so the health grant covers it — read:overview exists in no
@@ -199,6 +202,8 @@ class WebServer:
             result = fn(body=body, query=query, **params)
             if asyncio.iscoroutine(result):
                 result = await result
+            if isinstance(result, bytes):
+                return result   # pre-rendered response (non-JSON surfaces)
             if isinstance(result, tuple):
                 status, payload = result
             else:
@@ -320,6 +325,19 @@ class WebServer:
         @self.route("GET", "/", public=True)
         def dashboard(body, query):
             return 200, _DASHBOARD_HTML
+
+        @self.route("GET", "/metrics")
+        def metrics(body, query):
+            # Prometheus text exposition over the process-wide registry:
+            # solver, placement, deploy, store, log-router, agent-registry
+            # and anomaly series in one scrape. Token-authed like every
+            # non-public route (the _AREA_ALIASES map folds it into the
+            # health grant) — utilization and deploy cadence are
+            # fingerprintable internals, same reasoning as the overview.
+            from ..obs.metrics import REGISTRY
+            return _response(
+                200, REGISTRY.render(),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
 
         @self.route("GET", "/api/me", perm="")   # any authenticated identity
         def me(body, query):

@@ -1,14 +1,23 @@
-"""Structured logging + solve profiling (the `#[instrument]` analog).
+"""Structured logging, metrics, and trace correlation (the `#[instrument]`
+analog, grown into a flight recorder).
 
 The reference instruments its whole load/deploy pipeline with tracing spans
 (fleetflow-core loader.rs:24-41 `#[instrument]`, fleetflowd main.rs tracing
-subscriber configured from env). This module is the Python analog:
+subscriber configured from env). This package is the Python analog, plus
+the aggregation layer the reference leaves to its operators:
 
 - `get_logger("engine")` returns a named logger under the `fleetflow.`
   namespace, configured once from the `FLEET_LOG` environment variable.
 - `span(log, "deploy", stage="live")` is a context manager that logs
   entry at DEBUG, exit at the span's level with a duration, and failures
   at ERROR with the exception — one line per event, `key=value` fields.
+  Every span carries a contextvar trace_id/span_id (obs.trace): ids are
+  minted on entry when absent, rendered by `kv()` into every log line
+  inside the span, and — when `FLEET_TRACE_FILE` is set — recorded as
+  begin/end/fail JSONL events in the flight recorder.
+- `obs.metrics.REGISTRY` is the process-wide metrics registry
+  (Counter/Gauge/Histogram, Prometheus text exposition at the daemon's
+  `GET /metrics`).
 - `profile_trace()` wraps a block in `jax.profiler.trace` when
   `FLEET_PROFILE_DIR` is set (opt-in, zero cost otherwise); point
   TensorBoard or `xprof` at the directory to see the solve timeline.
@@ -17,8 +26,10 @@ subscriber configured from env). This module is the Python analog:
     FLEET_LOG=debug                    # everything under fleetflow.* at DEBUG
     FLEET_LOG=info,solver=debug        # default INFO, fleetflow.solver DEBUG
     FLEET_LOG=engine=debug,cp=warning  # per-module levels, rest untouched
-Unset/empty leaves the `fleetflow` logger un-configured (library mode: the
-host application owns logging config, handlers propagate as usual).
+Levels: trace (5, below DEBUG — registered via logging.addLevelName),
+debug, info, warn[ing], error, off. Unset/empty leaves the `fleetflow`
+logger un-configured (library mode: the host application owns logging
+config, handlers propagate as usual).
 """
 
 from __future__ import annotations
@@ -29,13 +40,28 @@ import os
 import time
 from typing import Iterator, Optional
 
-__all__ = ["get_logger", "span", "configure", "profile_trace", "kv"]
+from . import metrics  # noqa: F401  (re-export: obs.metrics.REGISTRY)
+from .metrics import REGISTRY
+from .trace import (_span_id, _trace_id, _use_span, current_span_id,
+                    current_trace_id, new_span_id, new_trace_id,
+                    record_span_event, use_trace)
+
+__all__ = ["get_logger", "span", "configure", "profile_trace", "kv",
+           "TRACE", "REGISTRY", "metrics", "use_trace", "new_trace_id",
+           "current_trace_id", "current_span_id"]
 
 _ROOT = "fleetflow"
 _configured = False
 
+# A real TRACE level below DEBUG, so FLEET_LOG=solver=trace is
+# distinguishable from solver=debug (the stdlib has no TRACE; the
+# reference's tracing crate does, and the log router's level vocabulary
+# already includes it)
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
 _LEVELS = {
-    "trace": logging.DEBUG,  # no TRACE in stdlib; map down
+    "trace": TRACE,
     "debug": logging.DEBUG,
     "info": logging.INFO,
     "warn": logging.WARNING,
@@ -47,7 +73,15 @@ _LEVELS = {
 
 def kv(**fields) -> str:
     """Render key=value fields the way the reference's tracing output does.
-    Values containing whitespace are quoted; None fields are dropped."""
+    Values containing whitespace are quoted; None fields are dropped.
+    Inside an active trace (obs.use_trace / span), trace=/span= ids are
+    appended so every line of one operation grep-correlates."""
+    tid = _trace_id.get()
+    if tid and "trace" not in fields:
+        fields["trace"] = tid
+        sid = _span_id.get()
+        if sid and "span" not in fields:
+            fields["span"] = sid
     parts = []
     for k, v in fields.items():
         if v is None:
@@ -115,21 +149,38 @@ def span(log: logging.Logger, name: str, level: int = logging.INFO,
          **fields) -> Iterator[dict]:
     """Timed span: DEBUG on entry, `level` with duration_ms on exit, ERROR
     with the exception on failure. The yielded dict collects extra fields to
-    report at exit (span['placed'] = 12)."""
+    report at exit (span['placed'] = 12).
+
+    Trace correlation: joins the active trace (minting a trace_id when none
+    is active), mints a span_id, and records the enclosing span as parent.
+    The ids render via kv() in the span's own lines and every kv() line
+    inside its body, and land in the flight recorder when FLEET_TRACE_FILE
+    is set."""
     extra: dict = {}
-    head = kv(**fields)
-    log.debug("%s started%s", name, f" {head}" if head else "")
-    t0 = time.perf_counter()
-    try:
-        yield extra
-    except Exception as e:
+    parent = _span_id.get()
+    sid = new_span_id()
+    with use_trace() as tid, _use_span(sid):
+        head = kv(**fields)
+        log.debug("%s started%s", name, f" {head}" if head else "")
+        record_span_event("begin", name, log.name, trace=tid, span=sid,
+                          parent=parent, fields=fields or None)
+        t0 = time.perf_counter()
+        try:
+            yield extra
+        except Exception as e:
+            ms = (time.perf_counter() - t0) * 1e3
+            log.error("%s failed %s", name,
+                      kv(duration_ms=f"{ms:.1f}", error=e, **fields, **extra))
+            record_span_event("fail", name, log.name, trace=tid, span=sid,
+                              parent=parent, duration_ms=ms, error=str(e),
+                              fields={**fields, **extra} or None)
+            raise
         ms = (time.perf_counter() - t0) * 1e3
-        log.error("%s failed %s", name,
-                  kv(duration_ms=f"{ms:.1f}", error=e, **fields, **extra))
-        raise
-    ms = (time.perf_counter() - t0) * 1e3
-    log.log(level, "%s %s", name,
-            kv(duration_ms=f"{ms:.1f}", **fields, **extra))
+        log.log(level, "%s %s", name,
+                kv(duration_ms=f"{ms:.1f}", **fields, **extra))
+        record_span_event("end", name, log.name, trace=tid, span=sid,
+                          parent=parent, duration_ms=ms,
+                          fields={**fields, **extra} or None)
 
 
 @contextlib.contextmanager

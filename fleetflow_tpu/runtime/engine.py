@@ -27,7 +27,9 @@ from typing import Callable, Optional
 from ..core.errors import FlowError
 from ..core.model import Flow
 from ..core.serialize import flow_from_dict, flow_to_dict
-from ..obs import get_logger, kv
+from ..obs import get_logger, kv, span
+from ..obs.metrics import REGISTRY
+from ..obs.trace import current_trace_id, new_trace_id, use_trace
 from ..lower.tensors import LOCAL_NODE_NAME, local_node, lower_stage
 from ..sched import (HostGreedyScheduler, Placement, Scheduler,
                      place_with_fallback)
@@ -42,13 +44,17 @@ __all__ = ["DeployEngine", "DeployRequest", "DeployEvent", "DeployResult"]
 @dataclass
 class DeployRequest:
     """Serializable deploy order (engine.rs:17-25). `node` scopes execution
-    to one node's slice of the placement (agents set it to their slug)."""
+    to one node's slice of the placement (agents set it to their slug).
+    `trace_id` carries the deploy's trace across the CP->agent wire, so
+    one `fleet deploy` correlates CLI, CP, and every agent's span/log
+    lines (and flight-recorder events) under a single id."""
     flow: Flow
     stage_name: str
     target_services: list[str] = field(default_factory=list)
     no_pull: bool = False
     no_prune: bool = False
     node: Optional[str] = None
+    trace_id: Optional[str] = None
 
     def to_dict(self) -> dict:
         d: dict = {"flow": flow_to_dict(self.flow), "stage_name": self.stage_name}
@@ -60,6 +66,8 @@ class DeployRequest:
             d["no_prune"] = True
         if self.node:
             d["node"] = self.node
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
         return d
 
     @classmethod
@@ -69,16 +77,21 @@ class DeployRequest:
                    target_services=d.get("target_services", []),
                    no_pull=d.get("no_pull", False),
                    no_prune=d.get("no_prune", False),
-                   node=d.get("node"))
+                   node=d.get("node"),
+                   trace_id=d.get("trace_id"))
 
 
 @dataclass
 class DeployEvent:
-    """Progress callback payload (engine.rs DeployEvent:30-49)."""
+    """Progress callback payload (engine.rs DeployEvent:30-49). Every event
+    carries the deploy's trace_id (set by the engine's emitter) so callback
+    consumers — the CP log router, the CLI printer — can correlate streams
+    from concurrent deploys."""
     step: str            # stop|pull|network|place|start|wait|prune|done|error
     service: Optional[str] = None
     message: str = ""
     level: Optional[int] = None
+    trace_id: Optional[str] = None
 
     def __str__(self) -> str:
         svc = f" {self.service}" if self.service else ""
@@ -102,6 +115,20 @@ class DeployResult:
 EventCb = Callable[[DeployEvent], None]
 
 log = get_logger("engine")
+
+# metric catalog: docs/guide/10-observability.md
+_M_DEPLOYS = REGISTRY.counter(
+    "fleet_deploys_total", "Deploy pipeline runs by outcome",
+    labels=("outcome",))
+_M_DEPLOY_S = REGISTRY.histogram(
+    "fleet_deploy_duration_seconds", "Deploy pipeline wall time")
+_M_DEPLOY_EVENTS = REGISTRY.counter(
+    "fleet_deploy_events_total", "Deploy progress events by pipeline step",
+    labels=("step",))
+_M_DEPLOY_SERVICES = REGISTRY.counter(
+    "fleet_deploy_services_total",
+    "Per-service deploy outcomes (containers deployed/removed/failed)",
+    labels=("result",))
 
 
 class DeployEngine:
@@ -127,13 +154,47 @@ class DeployEngine:
                 placement: Optional[Placement] = None) -> DeployResult:
         """Run the 5-step pipeline. `placement` lets a control plane hand a
         pre-solved plan to node agents so each agent executes only its slice
-        (req.node) without re-solving."""
+        (req.node) without re-solving.
+
+        The whole run executes inside the request's trace — minted here for
+        local deploys, carried over the wire (req.trace_id) for CP-routed
+        ones — so every log line, DeployEvent, and flight-recorder span of
+        one deploy shares one trace_id across CLI, CP, and agents. The
+        explicit use_trace() re-entry also makes the correlation survive
+        run_in_executor thread hops, which don't propagate contextvars."""
+        req.trace_id = req.trace_id or new_trace_id()
+        with use_trace(req.trace_id):
+            t0 = time.perf_counter()
+            try:
+                with span(log, "deploy.execute", project=req.flow.name,
+                          stage=req.stage_name, node=req.node) as sp:
+                    result = self._execute(req, on_event, placement)
+                    sp["deployed"] = len(result.deployed)
+                    sp["failed"] = len(result.failed) or None
+            except Exception:
+                _M_DEPLOYS.inc(outcome="error")
+                _M_DEPLOY_S.observe(time.perf_counter() - t0)
+                raise
+        _M_DEPLOYS.inc(outcome="ok" if result.ok else "failed")
+        _M_DEPLOY_S.observe(result.duration_s)
+        for kind, rows in (("deployed", result.deployed),
+                           ("removed", result.removed),
+                           ("failed", result.failed)):
+            if rows:
+                _M_DEPLOY_SERVICES.inc(len(rows), result=kind)
+        return result
+
+    def _execute(self, req: DeployRequest,
+                 on_event: Optional[EventCb],
+                 placement: Optional[Placement]) -> DeployResult:
         cb = on_event or (lambda e: None)
 
         def emit(e: DeployEvent) -> None:
             # every progress event also lands in the structured log, so a
             # deploy is traceable without a callback (ref: engine.rs events
             # mirrored through #[instrument]-ed tracing)
+            e.trace_id = e.trace_id or current_trace_id() or None
+            _M_DEPLOY_EVENTS.inc(step=e.step)
             (log.error if e.step == "error" else log.debug)(
                 "%s %s", e.step, kv(service=e.service, level=e.level,
                                     msg=e.message or None))

@@ -8,8 +8,30 @@ from typing import Protocol
 import numpy as np
 
 from ..lower.tensors import ProblemTensors
+from ..obs.metrics import REGISTRY
 
-__all__ = ["Placement", "Scheduler", "level_schedule"]
+__all__ = ["Placement", "Scheduler", "level_schedule", "record_placement"]
+
+# one catalog entry per scheduler backend: host-greedy, native-ffd,
+# partitioned, tpu-anneal, relaxation sources — whatever `source` says
+_M_PLACEMENTS = REGISTRY.counter(
+    "fleet_placements_total", "Placements produced, by solver source",
+    labels=("source",))
+_M_PLACE_S = REGISTRY.histogram(
+    "fleet_placement_duration_seconds", "Placement solve wall time by source",
+    labels=("source",))
+_M_PLACE_VIOL = REGISTRY.gauge(
+    "fleet_placement_violations",
+    "Hard violations of the most recent placement, by source",
+    labels=("source",))
+
+
+def record_placement(placement: "Placement") -> None:
+    """Fold one solved Placement into the fleet metrics (every scheduler
+    backend calls this exactly once per solve)."""
+    _M_PLACEMENTS.inc(source=placement.source)
+    _M_PLACE_S.observe(placement.solve_ms / 1e3, source=placement.source)
+    _M_PLACE_VIOL.set(placement.violations, source=placement.source)
 
 
 def level_schedule(pt: ProblemTensors) -> list[list[str]]:
@@ -54,7 +76,7 @@ def assemble_placement(pt: ProblemTensors, assignment: np.ndarray,
                        violations: int, source: str,
                        solve_ms: float) -> Placement:
     """Shared Placement assembly for greedy backends (host + native)."""
-    return Placement(
+    placement = Placement(
         assignment={pt.service_names[i]: pt.node_names[int(assignment[i])]
                     for i in range(pt.S)},
         levels=level_schedule(pt),
@@ -64,6 +86,8 @@ def assemble_placement(pt: ProblemTensors, assignment: np.ndarray,
         solve_ms=solve_ms,
         raw=assignment,
     )
+    record_placement(placement)
+    return placement
 
 
 class Scheduler(Protocol):

@@ -14,7 +14,7 @@ from typing import Optional
 
 import numpy as np
 
-from .base import Placement, level_schedule
+from .base import Placement, level_schedule, record_placement
 from ..lower.tensors import ProblemTensors
 
 __all__ = ["TpuSolverScheduler"]
@@ -71,7 +71,7 @@ class TpuSolverScheduler:
         self._last_assignment = res.assignment
         ms = (time.perf_counter() - t0) * 1e3
 
-        return Placement(
+        placement = Placement(
             assignment={pt.service_names[i]: pt.node_names[int(res.assignment[i])]
                         for i in range(pt.S)},
             levels=level_schedule(pt),
@@ -82,6 +82,8 @@ class TpuSolverScheduler:
             solve_ms=ms,
             raw=res.assignment,
         )
+        record_placement(placement)
+        return placement
 
     def reschedule(self, pt: ProblemTensors) -> Placement:
         """Streaming re-solve after churn: warm-start from the previous

@@ -217,8 +217,11 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
 
 
 def _batched_step(prob: DeviceProblem, state: ChainState,
-                  key: jax.Array, temp: jax.Array, M: int) -> ChainState:
-    """One parallel-Metropolis step: M simultaneous proposals.
+                  key: jax.Array, temp: jax.Array,
+                  M: int) -> tuple[ChainState, jax.Array]:
+    """One parallel-Metropolis step: M simultaneous proposals. Returns the
+    stepped state plus the number of APPLIED moves (post winner-resolution)
+    — the acceptance signal the adaptive path accumulates for telemetry.
 
     Deltas are evaluated against the shared pre-step state, so accepted
     moves that touch the same node interact slightly — the standard
@@ -301,7 +304,7 @@ def _batched_step(prob: DeviceProblem, state: ChainState,
     assignment = jnp.zeros((prob.S + 1,), jnp.int32).at[:prob.S].set(
         state.assignment).at[tgt].set(b_idx.astype(jnp.int32))[:prob.S]
 
-    return ChainState(assignment, load, used, coloc, topo)
+    return ChainState(assignment, load, used, coloc, topo), wi.sum()
 
 
 def default_proposals_per_step(S: int) -> int:
@@ -350,7 +353,7 @@ def anneal_states(prob: DeviceProblem, init_assignments: jax.Array,
         states, keys = carry
         temp = t0 * decay ** i.astype(jnp.float32)
         keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
-        states = jax.vmap(
+        states, _acc = jax.vmap(
             lambda st, k: _batched_step(prob, st, k, temp, M))(states, keys)
         return (states, keys), None
 
@@ -378,9 +381,11 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
     """Anneal in `block`-sweep chunks, stopping as soon as any chain has
     SEEN an exactly feasible state (or at max_steps). Returns
     (best_assignments (C, S), best_viols (C,), best_softs (C,),
-    sweeps_run scalar), where best is each chain's lexicographically
-    lowest (violations, soft) state EVER VISITED, not its final
-    state.
+    sweeps_run scalar, accepted (C,)), where best is each chain's
+    lexicographically lowest (violations, soft) state EVER VISITED, not
+    its final state, and accepted counts the applied Metropolis moves per
+    chain across every sweep that ran — the acceptance telemetry that
+    surfaces through SolveResult and the fleet_solver_* metrics.
 
     Best-ever tracking (r5): Metropolis acceptance takes uphill soft moves
     by design, so a chain's final state can be worse than one it already
@@ -426,13 +431,14 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
 
     def sweep(carry, i):
         (states, keys, best_assign, best_viol, best_soft,
-         seen_feasible) = carry
+         seen_feasible, accepted) = carry
         # clamp: overflow sweeps of a rounded-up final block hold t1
         temp = t0 * decay ** jnp.minimum(
             i, max_steps - 1).astype(jnp.float32)
         keys = jax.vmap(lambda k: jax.random.fold_in(k, i))(keys)
-        states = jax.vmap(
+        states, acc = jax.vmap(
             lambda st, k: _batched_step(prob, st, k, temp, M))(states, keys)
+        accepted = accepted + acc
         viol, soft = chain_scores(states)
         # lexicographic (violations, soft) — NOT a folded cost: the
         # warm-start migration bonus can push soft below -W_HARD in
@@ -448,11 +454,11 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
                                 best_assign)
         seen_feasible = seen_feasible | (viol.min() == 0)
         return (states, keys, best_assign, best_viol, best_soft,
-                seen_feasible), None
+                seen_feasible, accepted), None
 
     viol0, soft0 = chain_scores(states)
     init = (states, keys, states.assignment, viol0, soft0,
-            viol0.min() == 0)
+            viol0.min() == 0, jnp.zeros((C,), jnp.int32))
 
     def cond(carry):
         *_rest, b, done = carry
@@ -460,29 +466,31 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
 
     def body(carry):
         (states, keys, best_assign, best_viol, best_soft, seen,
-         b, _done) = carry
+         accepted, b, _done) = carry
         offsets = b * block + jnp.arange(block, dtype=jnp.int32)
         (states, keys, best_assign, best_viol, best_soft,
-         seen), _ = jax.lax.scan(
-            sweep, (states, keys, best_assign, best_viol, best_soft, seen),
+         seen, accepted), _ = jax.lax.scan(
+            sweep, (states, keys, best_assign, best_viol, best_soft, seen,
+                    accepted),
             offsets)
         return (states, keys, best_assign, best_viol, best_soft, seen,
-                b + 1, seen)
+                accepted, b + 1, seen)
 
     # done starts False: even an already-feasible start gets one block of
     # soft polish (the exit trades polish for latency only after that)
-    (_, _, best_assign, best_viol, best_soft, _, b,
+    (_, _, best_assign, best_viol, best_soft, _, accepted, b,
      _) = jax.lax.while_loop(cond, body, init + (jnp.int32(0),
                                                  jnp.bool_(False)))
-    return best_assign, best_viol, best_soft, b * block
+    return best_assign, best_viol, best_soft, b * block, accepted
 
 
 def anneal_adaptive(prob: DeviceProblem, init_assignments: jax.Array,
                     key: jax.Array, max_steps: int = 128, block: int = 32,
                     t0: float = 1.0, t1: float = 1e-3,
                     proposals_per_step: int | None = None):
-    """Adaptive anneal; returns (assignments (C, S), sweeps_run)."""
-    best_assign, _viol, _soft, sweeps = anneal_adaptive_states(
+    """Adaptive anneal; returns (assignments (C, S), sweeps_run,
+    accepted (C,))."""
+    best_assign, _viol, _soft, sweeps, accepted = anneal_adaptive_states(
         prob, init_assignments, key, max_steps=max_steps, block=block,
         t0=t0, t1=t1, proposals_per_step=proposals_per_step)
-    return best_assign, sweeps
+    return best_assign, sweeps, accepted

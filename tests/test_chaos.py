@@ -18,8 +18,10 @@ import pytest
 
 from fleetflow_tpu.chaos import run_scenario, scenario_names
 from fleetflow_tpu.chaos.faults import FaultSchedule
-from fleetflow_tpu.chaos.invariants import (capacity_accounting,
+from fleetflow_tpu.chaos.invariants import (agents_gauge_consistent,
+                                            capacity_accounting,
                                             containers_converged,
+                                            metrics_monotonic,
                                             no_dead_assignments,
                                             pools_at_min,
                                             reservations_terminal,
@@ -162,6 +164,36 @@ class TestInvariantCanaries:
         backend.set_state(name, "exited")
         found = containers_converged(w)
         assert found and "exited" in found[0]
+
+    def test_metrics_monotonic_fires_on_counter_decrease(self):
+        from fleetflow_tpu.obs.metrics import REGISTRY
+        w = _world()
+        assert metrics_monotonic(w) == []   # first check: baseline only
+        assert metrics_monotonic(w) == []   # nothing moved backwards
+        c = REGISTRY.get("fleet_store_ops_total")
+        # reach past the registry API (which forbids decrements) straight
+        # into a child's cell — the failure mode this canary simulates is
+        # a subsystem rebuilding/overwriting its series mid-run
+        key = next(k for k in c._children if c._children[k][0] > 0)
+        c._children[key][0] -= 1.0
+        try:
+            found = metrics_monotonic(w)
+            assert found and "decreased" in found[0]
+        finally:
+            c._children[key][0] += 1.0   # restore global state
+
+    def test_agents_gauge_consistent_fires_on_drift(self):
+        from fleetflow_tpu.obs.metrics import REGISTRY
+        w = _world()
+        assert agents_gauge_consistent(w) == []
+        g = REGISTRY.get("fleet_agents_connected")
+        real = g.value()
+        g.set(real + 3)
+        try:
+            found = agents_gauge_consistent(w)
+            assert found and "registry holds" in found[0]
+        finally:
+            g.set(real)
 
 
 # --------------------------------------------------------------------------

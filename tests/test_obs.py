@@ -67,6 +67,43 @@ class TestConfigure:
         capture("bogus=nope,debug")
         assert logging.getLogger("fleetflow").level == logging.DEBUG
 
+    def test_trace_is_a_real_level_below_debug(self):
+        """ISSUE 3 satellite: trace maps to the registered TRACE=5 level,
+        distinguishable from debug."""
+        assert obs.TRACE == 5 and obs.TRACE < logging.DEBUG
+        assert obs._LEVELS["trace"] == obs.TRACE
+        assert logging.getLevelName(obs.TRACE) == "TRACE"
+        capture("solver=trace,engine=debug")
+        solver = logging.getLogger("fleetflow.solver")
+        engine = logging.getLogger("fleetflow.engine")
+        assert solver.getEffectiveLevel() == obs.TRACE
+        assert engine.getEffectiveLevel() == logging.DEBUG
+        assert solver.isEnabledFor(obs.TRACE)
+        assert not engine.isEnabledFor(obs.TRACE)
+
+    def test_unknown_level_token_in_pair_is_ignored(self):
+        capture("solver=verbose,info")
+        # solver=verbose is dropped, not treated as a module at INFO
+        assert logging.getLogger("fleetflow.solver").level == logging.NOTSET
+        assert logging.getLogger("fleetflow").level == logging.INFO
+
+    def test_empty_segments_and_whitespace_tolerated(self):
+        capture(" ,, info , solver=debug ,")
+        assert logging.getLogger("fleetflow").level == logging.INFO
+        assert (logging.getLogger("fleetflow.solver").getEffectiveLevel()
+                == logging.DEBUG)
+
+    def test_repeated_force_configure_does_not_stack_handlers(self):
+        """force=True replaces the handler set; N reconfigurations must
+        not produce N duplicate lines per record."""
+        for _ in range(3):
+            configure("info", force=True, stream=io.StringIO())
+        assert len(logging.getLogger("fleetflow").handlers) == 1
+
+    def test_spec_with_only_module_pairs_defaults_root_to_info(self):
+        capture("solver=debug")
+        assert logging.getLogger("fleetflow").level == logging.INFO
+
 
 class TestSpan:
     def test_success_logs_duration_and_fields(self):
@@ -86,6 +123,89 @@ class TestSpan:
                 raise ValueError("boom")
         assert "work failed" in buf.getvalue()
         assert "boom" in buf.getvalue()
+
+    def test_failure_line_carries_collected_extra_fields(self):
+        """The extras collected BEFORE the exception must ride the failure
+        line — they are the forensics for what the span got done."""
+        buf = capture("debug")
+        log = get_logger("t")
+        with pytest.raises(RuntimeError):
+            with span(log, "work", stage="live") as sp:
+                sp["placed"] = 7
+                raise RuntimeError("midway")
+        line = [l for l in buf.getvalue().splitlines()
+                if "work failed" in l][0]
+        assert "placed=7" in line and "stage=live" in line
+        assert "error=midway" in line
+
+    def test_span_lines_carry_trace_and_span_ids(self):
+        buf = capture("debug")
+        log = get_logger("t")
+        with obs.use_trace("feedc0de") :
+            with span(log, "work"):
+                log.info("inner %s", kv(step=1))
+        lines = [l for l in buf.getvalue().splitlines() if "trace=" in l]
+        # span exit + the inner kv line both carry the adopted trace id
+        assert len(lines) >= 2
+        assert all("trace=feedc0de" in l for l in lines)
+        assert any("span=" in l for l in lines)
+
+    def test_kv_outside_any_trace_is_unchanged(self):
+        assert obs.current_trace_id() == ""
+        assert kv(a=1) == "a=1"
+
+    def test_nested_spans_restore_parent_context(self):
+        with obs.use_trace() as tid:
+            with span(get_logger("t"), "outer"):
+                outer_span = obs.current_span_id()
+                with span(get_logger("t"), "inner"):
+                    assert obs.current_span_id() != outer_span
+                    assert obs.current_trace_id() == tid
+                assert obs.current_span_id() == outer_span
+        assert obs.current_trace_id() == ""
+
+
+class TestFlightRecorder:
+    def test_span_events_written_and_parented(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_TRACE_FILE", str(tmp_path / "t.jsonl"))
+        from fleetflow_tpu.obs.trace import read_trace_file
+        log = get_logger("t")
+        with span(log, "outer", stage="live") as sp:
+            sp["n"] = 2
+            with span(log, "inner"):
+                pass
+        events = read_trace_file(str(tmp_path / "t.jsonl"))
+        assert [(e["kind"], e["name"]) for e in events] == [
+            ("begin", "outer"), ("begin", "inner"), ("end", "inner"),
+            ("end", "outer")]
+        outer_b, inner_b, inner_e, outer_e = events
+        assert len({e["trace"] for e in events}) == 1
+        assert inner_b["parent"] == outer_b["span"]
+        assert outer_e["duration_ms"] >= inner_e["duration_ms"]
+        assert outer_e["fields"] == {"stage": "live", "n": 2}
+
+    def test_failed_span_records_fail_event(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_TRACE_FILE", str(tmp_path / "t.jsonl"))
+        from fleetflow_tpu.obs.trace import read_trace_file
+        with pytest.raises(ValueError):
+            with span(get_logger("t"), "doomed"):
+                raise ValueError("nope")
+        events = read_trace_file(str(tmp_path / "t.jsonl"))
+        assert events[-1]["kind"] == "fail"
+        assert events[-1]["error"] == "nope"
+
+    def test_recorder_inactive_without_env(self, monkeypatch):
+        monkeypatch.delenv("FLEET_TRACE_FILE", raising=False)
+        from fleetflow_tpu.obs.trace import flight_recorder
+        assert flight_recorder() is None
+        with span(get_logger("t"), "quiet"):
+            pass   # no file, no error
+
+    def test_reader_skips_torn_final_line(self, tmp_path):
+        from fleetflow_tpu.obs.trace import read_trace_file
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"kind": "begin", "name": "a"}\n{"kind": "en')
+        assert [e["kind"] for e in read_trace_file(str(p))] == ["begin"]
 
 
 class TestDeployTrace:
