@@ -606,7 +606,9 @@ def _run_lint(args, *, fmt: str = "text", strict: bool = False) -> int:
         return 2
     res = lint_project(root, _stage(args))
     errors, warnings = severity_counts(res.diagnostics)
-    failing = bool(res.diagnostics) if strict else bool(errors)
+    # INFO diagnostics (e.g. FF014 bucket-waste advisories) never gate,
+    # even under --strict: they report tuning opportunities, not defects
+    failing = bool(errors or (strict and warnings))
     if fmt == "json":
         print(json.dumps({
             "ok": not failing,

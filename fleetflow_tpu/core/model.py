@@ -414,13 +414,22 @@ class Stage:
                                               repr=False, compare=False)
 
     def resolved_services(self, flow: "Flow") -> list[Service]:
-        """Base service defs merged with per-stage overrides, in declared order."""
+        """Base service defs merged with per-stage overrides, in declared
+        order.  Services with no override and no service-scoped variables
+        are returned AS the flow's own objects (read-only contract: no
+        consumer mutates resolved services; anything that needs to rebind
+        fields copies first, as registry aggregation does) — copying all
+        10k of them cost ~40 ms per fleet-scale lowering."""
         out = []
+        overrides = self.service_overrides
         for name in self.services:
             base = flow.services.get(name)
             if base is None:
                 raise KeyError(f"stage {self.name!r} references unknown service {name!r}")
-            override = self.service_overrides.get(name)
+            override = overrides.get(name)
+            if override is None and not base.variables:
+                out.append(base)
+                continue
             svc = base.merge(override) if override else base.shallow_copy()
             if svc.variables:
                 # service-scoped variables{} become container env; stage-level

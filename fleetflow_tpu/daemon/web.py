@@ -335,6 +335,13 @@ class WebServer:
             # health grant) — utilization and deploy cadence are
             # fingerprintable internals, same reasoning as the overview.
             from ..obs.metrics import REGISTRY
+            # family-defining side-effect imports: the exposition surface
+            # (names/types/HELP, golden-pinned in CI) must not depend on
+            # which subsystems this process happened to exercise first —
+            # these modules register their families at import and are not
+            # otherwise guaranteed to be loaded by a bare daemon
+            from .. import platform as _platform  # noqa: F401
+            from ..registry import aggregate as _aggregate  # noqa: F401
             return _response(
                 200, REGISTRY.render(),
                 content_type="text/plain; version=0.0.4; charset=utf-8")

@@ -28,6 +28,7 @@ __all__ = ["Severity", "Diagnostic", "SourceMap"]
 class Severity(str, enum.Enum):
     ERROR = "error"      # the deploy WILL fail; `fleet up`/CP submit reject
     WARNING = "warning"  # suspicious but deployable; --strict promotes
+    INFO = "info"        # advisory (perf/waste); never fails, even --strict
 
 
 @dataclass

@@ -49,7 +49,7 @@ LOAD_ERROR = Rule(code="FF000", slug="load-error", severity=Severity.ERROR,
                   scope="flow", doc="config failed to load or parse",
                   fn=lambda: iter(()))
 
-_SEVERITY_ORDER = {Severity.ERROR: 0, Severity.WARNING: 1}
+_SEVERITY_ORDER = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
 
 
 def _sorted(diags: list[Diagnostic]) -> list[Diagnostic]:
@@ -58,8 +58,11 @@ def _sorted(diags: list[Diagnostic]) -> list[Diagnostic]:
 
 
 def severity_counts(diags: list[Diagnostic]) -> tuple[int, int]:
+    """(errors, warnings) — INFO diagnostics are advisory and count as
+    neither (they can never gate an exit code)."""
     errors = sum(1 for d in diags if d.severity is Severity.ERROR)
-    return errors, len(diags) - errors
+    warnings = sum(1 for d in diags if d.severity is Severity.WARNING)
+    return errors, warnings
 
 
 class LintResult:
@@ -80,8 +83,15 @@ class LintResult:
     def warnings(self) -> list[Diagnostic]:
         return [d for d in self.diagnostics if d.severity is Severity.WARNING]
 
+    @property
+    def infos(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity is Severity.INFO]
+
     def ok(self, strict: bool = False) -> bool:
-        return not (self.diagnostics if strict else self.errors)
+        # INFO never gates: it reports waste/tuning advice, not defects
+        if strict:
+            return not (self.errors or self.warnings)
+        return not self.errors
 
 
 def lint_flow(flow: Flow, sourcemap: Optional[SourceMap] = None, *,

@@ -27,6 +27,9 @@ Codes are stable (never renumber; retire by leaving a gap):
   FF013  error    placement prelint: the host-greedy baseline (the same
                   scheduler `fleet up` uses) finds no feasible placement;
                   reported per-service via solver/explain.py breakdowns
+  FF014  info     placement bucket waste: the stage's service-row count
+                  sits just past a solver bucket boundary, so bucketed
+                  solves (solver/buckets.py) pad heavily — advisory only
 
 Rules are pure functions over a :class:`LintContext`; `scope` says what
 they iterate ("flow" once, "stage" per stage) and `structural=True` marks
@@ -517,3 +520,36 @@ def check_placement_prelint(r: Rule, ctx: LintContext, stage: Stage):
     yield ctx.diag(r, msg, loc=stage.loc, stage=stage,
                    hint="`fleet cp placement explain` breaks down any "
                         "single service in full")
+
+
+@rule("FF014", "placement-bucket-waste", Severity.INFO, "stage")
+def check_bucket_waste(r: Rule, ctx: LintContext, stage: Stage):
+    """The stage's expanded row count sits just past a solver bucket
+    boundary: bucketed solves (solver/buckets.py, the warm reschedule
+    path) will pad it up to the next tier, annealing that many phantom
+    rows on every re-solve. Advisory (INFO): correctness is untouched —
+    this reports the standing pad-waste and the boundary it straddles so
+    an operator a few replicas past a tier can decide knowingly."""
+    if ctx.local:
+        return          # local execution never hits the bucketed solver
+    from ..solver.buckets import bucket_config, bucket_bounds
+
+    cfg = bucket_config()
+    if not cfg.enabled:
+        return
+    rows = sum(_replicas(s) for s in ctx.container_services(stage))
+    if rows < cfg.minimum:
+        return          # below the first tier, padding is noise-level
+    lower, upper = bucket_bounds(rows, growth=cfg.growth,
+                                 minimum=cfg.minimum, align=cfg.align)
+    waste = 1.0 - rows / upper
+    if waste < 0.15:
+        return
+    yield ctx.diag(
+        r, f"stage {stage.name!r} lowers to {rows} service row(s), just "
+           f"past the {lower}-row solver bucket: bucketed solves pad to "
+           f"{upper} rows ({waste:.0%} phantom pad-waste per re-solve)",
+        loc=stage.loc, stage=stage,
+        hint=f"dropping {rows - lower} row(s) would fit the {lower} "
+             f"bucket; or tune FLEET_BUCKET_GROWTH/FLEET_BUCKET_MIN "
+             f"(docs/guide/11-performance.md)")

@@ -114,30 +114,49 @@ def dependency_depths(dep_adj: np.ndarray,
     # holds the (src, dst) pairs (lower_stage fills dep_adj from them)
     # passes `edges` to skip the full-matrix nonzero scan (~0.25 s at 10k).
     if edges is not None:
-        src = np.fromiter((e[0] for e in edges), dtype=np.int64,
-                          count=len(edges))
-        dst = np.fromiter((e[1] for e in edges), dtype=np.int64,
-                          count=len(edges))
+        # two accepted forms: a (src_array, dst_array) PAIR — required to
+        # actually be arrays, so a tuple of exactly two (src, dst) edge
+        # pairs can never be misread as one — or any sequence of pairs
+        if (isinstance(edges, tuple) and len(edges) == 2
+                and isinstance(edges[0], np.ndarray)
+                and isinstance(edges[1], np.ndarray)):
+            src = edges[0].astype(np.int64, copy=False)
+            dst = edges[1].astype(np.int64, copy=False)
+        else:
+            src = np.fromiter((e[0] for e in edges), dtype=np.int64,
+                              count=len(edges))
+            dst = np.fromiter((e[1] for e in edges), dtype=np.int64,
+                              count=len(edges))
     else:
         src, dst = np.nonzero(dep_adj)      # src depends on dst
     indeg = np.bincount(src, minlength=S).astype(np.int64)
-    dependents: dict[int, list[int]] = {}
-    for s, d in zip(src.tolist(), dst.tolist()):
-        dependents.setdefault(d, []).append(s)
+    # CSR adjacency dst -> [dependents]: each level then processes ALL its
+    # outgoing edges with array gathers/scatters instead of a per-edge
+    # Python loop (the loop was ~45 ms of every 10k-service lowering)
+    order = np.argsort(dst, kind="stable")
+    src_by_dst = src[order]
+    counts = np.bincount(dst, minlength=S)
+    indptr = np.zeros(S + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
     depth = np.zeros(S, dtype=np.int32)
-    queue = np.flatnonzero(indeg == 0).tolist()
-    resolved = len(queue)
-    while queue:
-        nxt: list[int] = []
-        for d in queue:
-            for s in dependents.get(d, ()):
-                if depth[s] < depth[d] + 1:
-                    depth[s] = depth[d] + 1
-                indeg[s] -= 1
-                if indeg[s] == 0:
-                    nxt.append(s)
-        resolved += len(nxt)
-        queue = nxt
+    level = np.flatnonzero(indeg == 0)
+    resolved = int(level.size)
+    while level.size:
+        starts, ends = indptr[level], indptr[level + 1]
+        n_out = ends - starts
+        if not n_out.any():
+            break
+        # flatten this level's CSR ranges: edge i runs from dep d=level[k]
+        # to dependent s=src_by_dst[starts[k] + j]
+        reps = np.repeat(level, n_out)
+        offs = np.arange(int(n_out.sum())) - np.repeat(
+            np.cumsum(n_out) - n_out, n_out)
+        ss = src_by_dst[np.repeat(starts, n_out) + offs]
+        np.maximum.at(depth, ss, depth[reps] + 1)
+        np.subtract.at(indeg, ss, 1)
+        cand = np.unique(ss)
+        level = cand[indeg[cand] == 0]
+        resolved += int(level.size)
     if resolved < S:
         cyc = np.flatnonzero(indeg > 0)
         label = ([names[i] for i in cyc[:5]] if names else cyc[:5].tolist())
@@ -146,14 +165,21 @@ def dependency_depths(dep_adj: np.ndarray,
 
 
 def _pad_ids(groups: list[list[int]], pad_to_multiple: int = 1) -> np.ndarray:
-    """list-of-id-lists → (S, K) int32 padded with -1."""
-    k = max((len(g) for g in groups), default=0)
-    k = max(k, 1)
+    """list-of-id-lists → (S, K) int32 padded with -1 (vectorized: the
+    per-row slice-assign loop cost ~90 ms of every 10k-service lowering)."""
+    n = len(groups)
+    lens = np.fromiter(map(len, groups), dtype=np.int64, count=n)
+    total = int(lens.sum())
+    k = max(int(lens.max(initial=0)), 1)
     if pad_to_multiple > 1:
         k = ((k + pad_to_multiple - 1) // pad_to_multiple) * pad_to_multiple
-    out = np.full((len(groups), k), -1, dtype=np.int32)
-    for i, g in enumerate(groups):
-        out[i, : len(g)] = g
+    out = np.full((n, k), -1, dtype=np.int32)
+    if total:
+        flat = np.fromiter(
+            (g for row in groups for g in row), dtype=np.int32, count=total)
+        rows = np.repeat(np.arange(n), lens)
+        cols = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+        out[rows, cols] = flat
     return out
 
 
@@ -239,42 +265,77 @@ def lower_stage(flow: Flow, stage_name: str,
             nodes = [local_node()]
 
     # ---- replica expansion -------------------------------------------------
-    rows: list[Service] = []
-    row_names: list[str] = []
-    replica_of: list[str] = []
-    base_index: dict[str, list[int]] = {}
-    for svc in services:
-        reps = max(svc.replicas, 1)
-        idxs = []
-        for r in range(reps):
-            idxs.append(len(rows))
-            rows.append(svc)
-            row_names.append(svc.name if reps == 1 else f"{svc.name}#{r}")
-            replica_of.append(svc.name)
-        base_index[svc.name] = idxs
+    if all(s.replicas <= 1 for s in services):
+        # no expansion at all (the fleet-scale aggregation shape): rows
+        # ARE the services, and every per-row list is built in one pass
+        rows = list(services)
+        row_names = [s.name for s in services]
+        replica_of = row_names
+        base_index = {n: [i] for i, n in enumerate(row_names)}
+    else:
+        rows: list[Service] = []
+        row_names, replica_of = [], []
+        base_index = {}
+        for svc in services:
+            reps = max(svc.replicas, 1)
+            name = svc.name
+            if reps == 1:
+                base_index[name] = [len(rows)]
+                rows.append(svc)
+                row_names.append(name)
+                replica_of.append(name)
+                continue
+            idxs = list(range(len(rows), len(rows) + reps))
+            rows.extend([svc] * reps)
+            row_names.extend(f"{name}#{r}" for r in range(reps))
+            replica_of.extend([name] * reps)
+            base_index[name] = idxs
     S, N = len(rows), len(nodes)
     if S == 0:
         raise SolverError(f"stage {stage_name!r} has no services")
 
     # ---- demand / capacity -------------------------------------------------
-    demand = np.array([r.resources.as_tuple() for r in rows], dtype=np.float32)
+    # per BASE service, expanded to rows with np.repeat: replicas share
+    # demand, so the 10k-row as_tuple loop collapses to one per service
+    reps_arr = np.fromiter((max(s.replicas, 1) for s in services),
+                           dtype=np.int64, count=len(services))
+    base_demand = np.array([s.resources.as_tuple() for s in services],
+                           dtype=np.float32).reshape(len(services), _R)
+    demand = np.repeat(base_demand, reps_arr, axis=0)
     capacity = np.array([n.capacity.as_tuple() for n in nodes], dtype=np.float32)
 
     # ---- dependency DAG over expanded rows ---------------------------------
+    # edge endpoints are COLLECTED in python (dict lookups) but written to
+    # the dense matrix in one fancy-index scatter: per-edge scalar
+    # dep_adj[i, j] = True assignments cost ~1 us each in numpy, which at
+    # ~15k edges was a visible slice of every fleet-scale lowering
     dep_adj = np.zeros((S, S), dtype=bool)
-    dep_edges: list[tuple[int, int]] = []
+    esrc: list[int] = []
+    edst: list[int] = []
     for svc in services:
-        for i in base_index[svc.name]:
-            for dep in rows[i].depends_on:
-                if dep in static_names:
-                    continue   # static targets ship before the container loop
-                if dep not in base_index:
-                    raise SolverError(
-                        f"service {rows[i].name!r} depends on unknown service {dep!r}")
-                for j in base_index[dep]:
-                    dep_adj[i, j] = True
-                    dep_edges.append((i, j))
-    dep_depth = dependency_depths(dep_adj, row_names, edges=dep_edges)
+        deps = svc.depends_on
+        if not deps:
+            continue
+        rows_of = base_index[svc.name]
+        single = len(rows_of) == 1
+        for dep in deps:
+            if dep in static_names:
+                continue   # static targets ship before the container loop
+            targets = base_index.get(dep)
+            if targets is None:
+                raise SolverError(
+                    f"service {svc.name!r} depends on unknown service {dep!r}")
+            if single and len(targets) == 1:   # common case: no replicas
+                esrc.append(rows_of[0])
+                edst.append(targets[0])
+            else:
+                for i in rows_of:
+                    esrc.extend([i] * len(targets))
+                    edst.extend(targets)
+    src_a = np.asarray(esrc, dtype=np.int64)
+    dst_a = np.asarray(edst, dtype=np.int64)
+    dep_adj[src_a, dst_a] = True
+    dep_depth = dependency_depths(dep_adj, row_names, edges=(src_a, dst_a))
 
     # ---- conflict id groups ------------------------------------------------
     port_key_ids: dict[tuple, int] = {}
@@ -339,34 +400,48 @@ def lower_stage(flow: Flow, stage_name: str,
                     anti_pair_ids.setdefault(i, []).append(gid)
                     anti_pair_ids.setdefault(j, []).append(gid)
 
+    # Per BASE service (replicas share ports/volumes/labels/colocation, so
+    # the id-assignment loop runs once per service, not once per row —
+    # at 10k rows the per-row version was a visible slice of lower_ms);
+    # only the pairwise anti groups are per-row and merged below.
     port_groups, vol_groups, anti_groups, coloc_groups = [], [], [], []
-    for i, svc in enumerate(rows):
-        pg = []
-        for p in svc.ports:
-            key = p.key()
-            pg.append(port_key_ids.setdefault(key, len(port_key_ids)))
-        port_groups.append(pg)
-        vg = []
-        for v in svc.volumes:
-            ck = v.conflict_key()
-            if ck is not None:
-                vg.append(vol_key_ids.setdefault(ck, len(vol_key_ids)))
-        vol_groups.append(vg)
+    _empty: list[int] = []     # shared by constraint-free rows, never mutated
+    i = 0
+    for svc, reps in zip(services, reps_arr):
+        pg = ([port_key_ids.setdefault(p.key(), len(port_key_ids))
+               for p in svc.ports] if svc.ports else _empty)
+        vg = _empty
+        if svc.volumes:
+            vg = []
+            for v in svc.volumes:
+                ck = v.conflict_key()
+                if ck is not None:
+                    vg.append(vol_key_ids.setdefault(ck, len(vol_key_ids)))
         # anti_affinity keys that do NOT name a stage service stay
         # LABEL-style: all declarers of "db-tier" mutually exclude.
         # Target-style keys (naming a service) are handled via the
         # pairwise groups prepared above the loop.
-        ag = ([] if local else
-              [anti_key_ids.setdefault(k, len(anti_key_ids))
-               for k in svc.anti_affinity if k not in base_index])
-        ag.extend(anti_pair_ids.get(i, ()))
-        anti_groups.append(list(dict.fromkeys(ag)))
-        cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
-              for k in svc.colocate_with]
-        if svc.name in coloc_targets:
-            cg.append(coloc_key_ids.setdefault(svc.name,
-                                               len(coloc_key_ids)))
-        coloc_groups.append(list(dict.fromkeys(cg)))
+        base_ag = ([anti_key_ids.setdefault(k, len(anti_key_ids))
+                    for k in svc.anti_affinity if k not in base_index]
+                   if svc.anti_affinity and not local else _empty)
+        cg = _empty
+        if svc.colocate_with or svc.name in coloc_targets:
+            cg = [coloc_key_ids.setdefault(k, len(coloc_key_ids))
+                  for k in svc.colocate_with]
+            if svc.name in coloc_targets:
+                cg.append(coloc_key_ids.setdefault(svc.name,
+                                                   len(coloc_key_ids)))
+            cg = list(dict.fromkeys(cg))
+        for _ in range(reps):
+            port_groups.append(pg)
+            vol_groups.append(vg)
+            if base_ag or i in anti_pair_ids:
+                ag = base_ag + anti_pair_ids.get(i, [])
+                anti_groups.append(list(dict.fromkeys(ag)))
+            else:
+                anti_groups.append(base_ag)
+            coloc_groups.append(cg)
+            i += 1
 
     # ---- eligibility / preference / validity / topology --------------------
     # policy matching is per-NODE (every service row in a stage shares the
@@ -377,8 +452,14 @@ def lower_stage(flow: Flow, stage_name: str,
                           dtype=bool, count=N)
     node_pref = np.fromiter((_preference_row(policy, n) for n in nodes),
                             dtype=np.float32, count=N)
-    eligible = np.broadcast_to(node_ok, (S, N)).copy()
-    preferred = np.broadcast_to(node_pref, (S, N)).copy()
+    eligible = (np.ones((S, N), dtype=bool) if node_ok.all()
+                else np.broadcast_to(node_ok, (S, N)).copy())
+    # the dense (S, N) f32 preference plane is 40 MB at 10k x 1k; only
+    # materialize it when some node actually scores (node_pref decides —
+    # the plane is a row broadcast, so an all-zero row means an all-zero
+    # plane, which ProblemTensors represents as preferred=None)
+    preferred = (np.broadcast_to(node_pref, (S, N)).copy()
+                 if node_pref.any() else None)
     # quota enforcement (model.rs:40 ResourceQuota, FSC-26 Phase B-3): the
     # stage's aggregate demand must fit the declared ceiling — a violated
     # quota is a config error, reported at lowering with the excess named
@@ -440,7 +521,7 @@ def lower_stage(flow: Flow, stage_name: str,
         strategy=policy.strategy if policy else PlacementStrategy.SPREAD_ACROSS_POOL,
         max_skew=(policy.spread_constraint.max_skew
                   if policy and policy.spread_constraint else 0),
-        preferred=preferred if preferred.any() else None,
+        preferred=preferred,
         relax_order=relax_order,
         replica_of=replica_of,
     )

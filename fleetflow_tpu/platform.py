@@ -259,6 +259,95 @@ def _apply_platform(name: str) -> None:
     jax.config.update("jax_platforms", name)
 
 
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (FLEET_COMPILE_CACHE)
+# ---------------------------------------------------------------------------
+
+_compile_cache_dir: str | None = None
+_compile_cache_tried = False
+
+# registered at import (not lazily inside maybe_enable_compile_cache) so
+# the /metrics exposition surface is identical in every process — the CI
+# golden pins name/type/HELP from boot, before any solve has run
+from .obs.metrics import REGISTRY as _REGISTRY  # noqa: E402
+
+_M_CACHE_ENABLED = _REGISTRY.gauge(
+    "fleet_solver_compile_cache_enabled",
+    "1 when the persistent XLA compilation cache (FLEET_COMPILE_CACHE)"
+    " is active in this process")
+
+
+def maybe_enable_compile_cache(log=None) -> str | None:
+    """Point JAX's persistent compilation cache at $FLEET_COMPILE_CACHE.
+
+    A cold process start then REUSES prior XLA binaries for any shape it
+    has compiled before — the other half of the warm-path story next to
+    shape bucketing (solver/buckets.py): bucketing collapses shape drift
+    onto few executables, the persistent cache carries those executables
+    across process restarts. Unset (the default) leaves JAX's in-memory
+    cache only. Idempotent; safe before or after backend init (entries are
+    keyed on the XLA program AND the device kind, so a cache directory can
+    be shared between CPU-fallback and TPU runs without cross-pollution).
+    Invalidation caveats are documented in docs/guide/11-performance.md:
+    entries key on the jax/jaxlib version and compile flags, so upgrades
+    repopulate rather than misbehave, but the directory is never pruned by
+    us — prune by mtime out-of-band.
+
+    Returns the cache directory when enabled, else None.
+    """
+    global _compile_cache_dir, _compile_cache_tried
+    if _compile_cache_tried:
+        return _compile_cache_dir
+    _compile_cache_tried = True
+    path = os.environ.get("FLEET_COMPILE_CACHE", "").strip()
+    gauge = _M_CACHE_ENABLED
+    if not path:
+        gauge.set(0)
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # the fused solve pipeline is the target: cache every entry, even
+        # fast-compiling ones (a 0.3 s kernel x 30 shapes is still seconds
+        # of cold-start), and skip the default 1 GiB size floor
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        try:
+            # also persist XLA's internal sub-caches (autotune etc.) where
+            # the jax version supports routing them into the directory
+            jax.config.update("jax_persistent_cache_enable_xla_caches",
+                              "all")
+        except Exception:
+            pass
+    except Exception as e:  # unknown option on old jax, unwritable dir, ...
+        gauge.set(0)
+        if log is None:
+            print(f"[fleetflow.platform] compile cache disabled: {e}",
+                  file=sys.stderr, flush=True)
+        else:
+            log(f"compile cache disabled: {e}")
+        return None
+    _compile_cache_dir = path
+    gauge.set(1)
+    return path
+
+
+def compile_cache_info() -> dict:
+    """{'enabled', 'dir', 'entries'} for bench artifacts/metrics surfaces.
+    `entries` counts files currently in the cache directory (best effort)."""
+    d = _compile_cache_dir
+    entries = 0
+    if d:
+        try:
+            entries = sum(1 for n in os.listdir(d)
+                          if not n.startswith("."))
+        except OSError:
+            entries = -1
+    return {"enabled": d is not None, "dir": d, "entries": entries}
+
+
 def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
                     log=None, retries: int | None = None,
                     retry_delay: float | None = None) -> str:
@@ -288,6 +377,10 @@ def ensure_platform(min_devices: int = 1, probe_timeout: float = 180.0,
     if log is None:
         def log(msg):
             print(f"[fleetflow.platform] {msg}", file=sys.stderr, flush=True)
+
+    # every driver entry point passes through here before first device use,
+    # which is exactly when the persistent compile cache must be configured
+    maybe_enable_compile_cache(log)
 
     def decide(backend: str, ndev: int) -> str:
         global _decided, _decided_ndev

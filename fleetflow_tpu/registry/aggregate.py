@@ -17,10 +17,19 @@ config 4: 10k services x 1k nodes "multi-tenant via registry aggregation"):
 
 The result solves as ONE device-resident instance; the assignment maps back
 through `AggregateIndex` to per-fleet, per-node deploy slices.
+
+Churn re-aggregation is cached by CONTENT: pass a `FlowCache` and each
+(fleet, stage)'s parse + namespace work is keyed on a hash of its KDL
+bytes, so a single-fleet edit re-loads one fleet and reuses the other
+N-1 — re-aggregation cost tracks what changed, not fleet count. (The
+combined lowering still runs: it is vectorized in lower/tensors.py and is
+the cheap half at fleet scale.)
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -29,9 +38,16 @@ import numpy as np
 from ..core.loader import load_project_from_root_with_stage
 from ..core.model import Flow, Service, Stage
 from ..lower.tensors import ProblemTensors, lower_stage
+from ..obs.metrics import REGISTRY
 from .model import Registry
 
-__all__ = ["AggregateIndex", "aggregate_fleets"]
+__all__ = ["AggregateIndex", "FlowCache", "aggregate_fleets",
+           "fleet_content_hash"]
+
+_M_CACHE = REGISTRY.counter(
+    "fleet_registry_flow_cache_total",
+    "Flow-cache lookups during registry aggregation, by outcome",
+    labels=("outcome",))
 
 
 @dataclass
@@ -52,20 +68,109 @@ class AggregateIndex:
         return out
 
 
+@dataclass
+class FlowCache:
+    """Content-hash keyed reuse of per-(fleet, stage) aggregation work.
+
+    Entries hold the namespaced Service rows produced by one fleet-stage
+    load. The rows are treated as IMMUTABLE once cached (aggregation only
+    reads them; lowering only reads them), so reuse is reference sharing,
+    not copying. Keyed on the fleet's KDL content hash: a churn event that
+    touches one fleet re-lowers that fleet only."""
+    entries: dict[tuple[str, Optional[str]], tuple[str, list[Service]]] = \
+        field(default_factory=dict)
+    hits: int = 0
+    misses: int = 0
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self.entries)}
+
+
+def fleet_content_hash(path: str) -> str:
+    """Hash of the load inputs for a fleet root: every *.kdl and .env*
+    file under it (names + bytes, sorted walk) plus the allowlisted
+    process env (FLEET_*/CI_*/APP_* — the loader injects those into the
+    template context, so an export must invalidate just like an edit).
+
+    Known blind spot: `include` globs can reference files OUTSIDE the
+    fleet root; edits to those are invisible to this hash. A fleet using
+    out-of-root includes should pass a custom `content_hash` to
+    aggregate_fleets (or skip the cache for that registry)."""
+    from ..core.template import ENV_ALLOWLIST_PREFIXES
+
+    h = hashlib.sha256()
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        files = []
+        for root, dirs, names in os.walk(path):
+            dirs.sort()
+            for n in sorted(names):
+                if n.endswith(".kdl") or n.startswith(".env"):
+                    files.append(os.path.join(root, n))
+    for f in files:
+        h.update(f.encode())
+        try:
+            with open(f, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"<unreadable>")
+    for k in sorted(os.environ):
+        if k.startswith(ENV_ALLOWLIST_PREFIXES):
+            h.update(f"{k}={os.environ[k]}".encode())
+    return h.hexdigest()
+
+
 def _namespace(fleet: str, stage: str, name: str) -> str:
     return f"{fleet}.{stage}.{name}"
+
+
+def _load_rows(loader, path: str, fleet_name: str,
+               stage_name: str) -> list[Service]:
+    """Load one fleet stage and namespace its service rows."""
+    # load PER STAGE: stage-scoped variables, .env.{stage}, and
+    # flow.{stage}.kdl overlays only apply when the loader knows
+    # which stage it is building
+    flow = loader(path, stage_name)
+    stage = flow.stage(stage_name)
+    prefix = f"{fleet_name}.{stage_name}."
+    rename = {s: prefix + s for s in stage.services}
+    rows: list[Service] = []
+    for svc in stage.resolved_services(flow):
+        # shallow_copy + rebind: dataclasses.replace costs ~5x
+        # more and this loop runs once per service row (model.py
+        # shallow_copy docstring)
+        nsvc: Service = svc.shallow_copy()
+        nsvc.name = rename[svc.name]
+        # rebind only what actually rewrites: empty lists stay shared
+        # with the base object (read-only), saving 3 listcomps per row
+        if svc.depends_on:
+            nsvc.depends_on = [rename[d] for d in svc.depends_on
+                               if d in rename]
+        if svc.colocate_with:
+            nsvc.colocate_with = [prefix + c for c in svc.colocate_with]
+        if svc.anti_affinity:
+            nsvc.anti_affinity = [prefix + a for a in svc.anti_affinity]
+        rows.append(nsvc)
+    return rows
 
 
 def aggregate_fleets(
         registry: Registry,
         stages: Optional[dict[str, list[str]]] = None,
         loader: Callable[[str, str], Flow] = None,
+        cache: Optional[FlowCache] = None,
+        content_hash: Callable[[str], str] = fleet_content_hash,
 ) -> tuple[ProblemTensors, AggregateIndex]:
     """Build one placement instance from every registered fleet.
 
     `stages` restricts which stages per fleet (default: every stage named in
     the fleet's routes, else every stage in its config). `loader` is
-    injectable for tests (defaults to the real project loader).
+    injectable for tests (defaults to the real project loader). `cache`
+    (a FlowCache, caller-held across aggregations) skips the load+namespace
+    of any fleet whose `content_hash(path)` is unchanged — single-fleet
+    churn then re-lowers one fleet instead of all of them.
     """
     loader = loader or (lambda path, stage:
                         load_project_from_root_with_stage(path, stage))
@@ -83,36 +188,33 @@ def aggregate_fleets(
         elif routed:
             wanted = sorted(routed)
         else:
-            wanted = None              # resolved after load
-
-        if wanted is None:
             # discover the fleet's stages with a stage-neutral load
             wanted = sorted(loader(entry.path, None).stages)
+
+        fhash = content_hash(entry.path) if cache is not None else None
         for stage_name in wanted:
-            # load PER STAGE: stage-scoped variables, .env.{stage}, and
-            # flow.{stage}.kdl overlays only apply when the loader knows
-            # which stage it is building
-            flow = loader(entry.path, stage_name)
-            stage = flow.stage(stage_name)
-            rename = {s: _namespace(fleet_name, stage_name, s)
-                      for s in stage.services}
-            for svc in stage.resolved_services(flow):
-                new_name = rename[svc.name]
-                # shallow_copy + rebind: dataclasses.replace costs ~5x
-                # more and this loop runs once per service row (model.py
-                # shallow_copy docstring)
-                nsvc: Service = svc.shallow_copy()
-                nsvc.name = new_name
-                nsvc.depends_on = [rename[d] for d in svc.depends_on
-                                   if d in rename]
-                nsvc.colocate_with = [_namespace(fleet_name, stage_name, c)
-                                      for c in svc.colocate_with]
-                nsvc.anti_affinity = [_namespace(fleet_name, stage_name, a)
-                                      for a in svc.anti_affinity]
-                combined.services[new_name] = nsvc
-                combined_stage.services.append(new_name)
-                if stage_name in routed:
-                    pins[new_name] = routed[stage_name]
+            rows = None
+            key = (fleet_name, stage_name)
+            if cache is not None:
+                hit = cache.entries.get(key)
+                if hit is not None and hit[0] == fhash:
+                    rows = hit[1]
+                    cache.hits += 1
+                    _M_CACHE.inc(outcome="hit")
+            if rows is None:
+                rows = _load_rows(loader, entry.path, fleet_name, stage_name)
+                if cache is not None:
+                    cache.entries[key] = (fhash, rows)
+                    cache.misses += 1
+                    _M_CACHE.inc(outcome="miss")
+            services = combined.services
+            stage_list = combined_stage.services
+            pin = routed.get(stage_name)
+            for nsvc in rows:
+                services[nsvc.name] = nsvc
+                stage_list.append(nsvc.name)
+                if pin is not None:
+                    pins[nsvc.name] = pin
 
     combined.stages = {"aggregate": combined_stage}
     pt = lower_stage(combined, "aggregate",
@@ -131,7 +233,14 @@ def aggregate_fleets(
                 eligible[i] = mask
         pt.eligible = eligible
 
-    index = AggregateIndex(rows=[
-        tuple(row.split("#", 1)[0].split(".", 2))   # type: ignore[misc]
-        for row in pt.service_names])
-    return pt, index
+    # pt.replica_of already carries the base (un-#-suffixed) namespaced
+    # name per row; memoize the 3-way split per unique base instead of
+    # re-splitting every replica row (~35 ms at 10k rows)
+    memo: dict[str, tuple[str, str, str]] = {}
+    rows_idx = []
+    for base in pt.replica_of:
+        t = memo.get(base)
+        if t is None:
+            t = memo[base] = tuple(base.split(".", 2))  # type: ignore[misc]
+        rows_idx.append(t)
+    return pt, AggregateIndex(rows=rows_idx)

@@ -22,15 +22,24 @@ __all__ = ["TpuSolverScheduler"]
 
 class TpuSolverScheduler:
     def __init__(self, *, chains=None, steps: int = 128, seed: int = 0,
-                 mesh=None):
+                 mesh=None, bucket: Optional[bool] = None):
         # chains=None defers to the solver's backend-aware default
         # (1 on CPU, 2 on accelerators — measured r4/r5)
         self.chains = chains
         self.steps = steps
         self.seed = seed
         self.mesh = mesh
+        # bucket=None -> ON for the scheduler (this is the churn/reschedule
+        # path the bucketing exists for; FLEET_BUCKET=0 force-disables)
+        self.bucket = bucket
         self._staged = None   # (pt identity, DeviceProblem, valid fingerprint)
         self._last_assignment: Optional[np.ndarray] = None
+
+    def _bucket_enabled(self, pt: ProblemTensors) -> bool:
+        from ..solver.buckets import bucket_config
+        if self.bucket is False:
+            return False
+        return bucket_config().enabled and pt.max_skew == 0
 
     def _stage(self, pt: ProblemTensors):
         """Staged DeviceProblem for pt, reusing the device copy across
@@ -38,12 +47,21 @@ class TpuSolverScheduler:
         pt.node_valid in place (churn), so the mask is fingerprinted and
         pushed as a small device-side delta when it drifts — the round-2 bug
         where a dead node kept its services because the device still saw the
-        stale mask."""
+        stale mask.
+
+        The staging is BUCKETED (solver/buckets.py) unless disabled: the
+        padded DeviceProblem is what lives on device across re-solves, so a
+        fleet drifting within its size tier keeps both the staging and the
+        compiled executable."""
         from ..solver import prepare_problem
+        from ..solver.buckets import bucket_config, pad_problem_tiers
         import jax.numpy as jnp
 
         if self._staged is None or self._staged[0] is not pt:
-            self._staged = (pt, prepare_problem(pt), pt.node_valid.copy())
+            prob = prepare_problem(pt)
+            if self._bucket_enabled(pt):
+                prob, _ = pad_problem_tiers(prob, bucket_config())
+            self._staged = (pt, prob, pt.node_valid.copy())
         elif not np.array_equal(self._staged[2], pt.node_valid):
             prob = dataclasses.replace(
                 self._staged[1], node_valid=jnp.asarray(pt.node_valid))
@@ -67,7 +85,8 @@ class TpuSolverScheduler:
 
         init = self._last_assignment if warm_start else None
         res = solve(pt, prob=prob, chains=self.chains, steps=self.steps,
-                    seed=self.seed, mesh=self.mesh, init_assignment=init)
+                    seed=self.seed, mesh=self.mesh, init_assignment=init,
+                    bucket=self._bucket_enabled(pt))
         self._last_assignment = res.assignment
         ms = (time.perf_counter() - t0) * 1e3
 

@@ -6,6 +6,8 @@ mesh-sharded simulated annealing over dense constraint tensors.
 """
 
 from .anneal import anneal, chain_states_from_assignment
+from .buckets import (BucketConfig, BucketInfo, bucket_config, bucket_size,
+                      pad_problem_tiers, soft_score_host)
 from .sharded import SVC_AXIS, anneal_sharded, pad_problem, shard_problem
 from .api import CHAIN_AXIS, SolveResult, make_chain_inits, solve
 from .greedy import greedy_place, greedy_place_batched, placement_order

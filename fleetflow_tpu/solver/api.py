@@ -27,6 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .anneal import (anneal_adaptive_states, anneal_states,
                      state_soft_score, state_violation_stats)
+from .buckets import (bucket_config, pad_assignment, pad_problem_tiers,
+                      record_bucket, soft_score_host, _env_flag)
 from .greedy import greedy_place, greedy_place_batched, placement_order
 from .kernels import soft_score, violation_stats
 from .problem import DeviceProblem, prepare_problem
@@ -57,6 +59,13 @@ _M_VIOL = REGISTRY.gauge(
 _M_PRE_VIOL = REGISTRY.gauge(
     "fleet_solver_pre_repair_violations",
     "Device-solver violations of the most recent solve before host repair")
+_M_BUCKET = REGISTRY.counter(
+    "fleet_solver_bucket_solves_total",
+    "Bucketed solves by executable reuse (hit = padded shape already "
+    "compiled for in this process)", labels=("hit",))
+_M_PAD_WASTE = REGISTRY.gauge(
+    "fleet_solver_bucket_pad_waste_ratio",
+    "Phantom fraction of the most recent bucketed solve's service rows")
 
 DEFAULT_STEPS = 128   # batched sweeps (anneal.default_proposals_per_step wide)
 
@@ -87,6 +96,9 @@ class SolveResult:
     # -1 = not tracked on the fixed-budget path). With sweeps/chains/
     # proposals_per_step this yields the acceptance rate the anneal ran at.
     accepted_moves: int = -1
+    # shape bucketing applied to this solve (solver/buckets.py), or None
+    # for an exact-shape solve: {"orig_S", "padded_S", "pad_waste", "hit"}
+    bucket: Optional[dict] = None
 
     @property
     def acceptance_rate(self) -> float:
@@ -209,6 +221,10 @@ def solve(pt: ProblemTensors, **kw) -> SolveResult:
     """Solve a placement instance end to end (see _solve for parameters).
     When FLEET_PROFILE_DIR is set the whole solve is captured as a
     jax.profiler trace (obs.profile_trace)."""
+    # idempotent: callers that never pass through platform.ensure_platform
+    # (library embedding, tests) still get FLEET_COMPILE_CACHE honored
+    from ..platform import maybe_enable_compile_cache
+    maybe_enable_compile_cache()
     with profile_trace("solve"):
         return _solve(pt, **kw)
 
@@ -228,7 +244,8 @@ def _solve(pt: ProblemTensors, *,
            anneal_block: int = 1,
            warm_block: int = 1,
            prerepair: Optional[bool] = None,
-           proposals_per_step: Optional[int] = None) -> SolveResult:
+           proposals_per_step: Optional[int] = None,
+           bucket: Optional[bool] = None) -> SolveResult:
     """Solve a placement instance end to end.
 
     `init_assignment` warm-starts from a previous solve (streaming reschedule
@@ -265,6 +282,15 @@ def _solve(pt: ProblemTensors, *,
     on host, and the feasible-by-construction seed means extra chains buy
     nothing; measured r4) and 2 on accelerators (measured r5 on TPU:
     2 chains 102.6 ms vs 4 chains 123.9 ms at equal soft, 10k x 1k).
+
+    `bucket` pads the problem to a shape tier (solver/buckets.py) so
+    fleets whose sizes drift within one tier reuse the compiled
+    executable instead of paying the XLA compile cliff. None defers to
+    the environment (FLEET_BUCKET=1 opts direct solves in; the scheduler
+    path passes True and FLEET_BUCKET=0 force-disables). Bypassed when a
+    spread constraint is active (phantom rows would count into per-domain
+    totals). Violations/soft are always reported against the REAL rows
+    (numpy-exact), and the returned assignment never contains phantoms.
     """
     timings: dict[str, float] = {}
     t = time.perf_counter
@@ -275,6 +301,21 @@ def _solve(pt: ProblemTensors, *,
     if prob is None:
         prob = prepare_problem(pt)
     orig_prob = prob  # soft score is reported against the un-bonused problem
+
+    # ---- shape bucketing (solver/buckets.py) -----------------------------
+    # Round the churn-sensitive extents up to tiers so a fleet drifting a
+    # few services reuses the compiled executable. A caller that staged a
+    # pre-padded DeviceProblem (sched/tpu.py) is honored as-is:
+    # pad_problem_tiers is idempotent, so the staged object passes through
+    # unchanged and re-solves never re-pad.
+    if bucket is None:
+        bucket = _env_flag("FLEET_BUCKET", False) or prob.S != pt.S
+    cfg = bucket_config()
+    binfo = None
+    if bucket and cfg.enabled and pt.max_skew == 0:
+        prob, binfo = pad_problem_tiers(prob, cfg)
+        binfo.orig_S = pt.S   # a pre-padded staging reports the REAL rows
+    bucketed = binfo is not None and prob.S != pt.S
     timings["stage_ms"] = (t() - t_start) * 1e3
 
     t_seed = t()
@@ -304,6 +345,8 @@ def _solve(pt: ProblemTensors, *,
         # split out so a reschedule artifact can say whether host pre-repair
         # or the device anneal ate the time (VERDICT r4 weak #1)
         timings["prerepair_ms"] = (t() - t_pre) * 1e3
+        if bucketed:
+            seed_np = pad_assignment(seed_np, prob.S, pt.node_valid)
         seed_assignment = jnp.asarray(seed_np, dtype=jnp.int32)
         t0 = min(t0, 0.1)  # warm start: refine, don't re-scramble
     else:
@@ -342,6 +385,9 @@ def _solve(pt: ProblemTensors, *,
                         pt.demand, pt.capacity, pt.eligible, pt.node_valid,
                         pt.dep_depth, pt.port_ids, pt.volume_ids,
                         pt.anti_ids, strategy=pt.strategy.value)
+                if bucketed:
+                    host_assignment = pad_assignment(
+                        host_assignment, prob.S, pt.node_valid)
                 seed_assignment = jnp.asarray(host_assignment,
                                               dtype=jnp.int32)
             except (RuntimeError, OSError):
@@ -351,8 +397,16 @@ def _solve(pt: ProblemTensors, *,
                             "falling back to scan")
                 seed_impl = "scan"
         if seed_impl not in ("native", "partitioned"):
-            order = jnp.asarray(placement_order(
-                pt.demand, pt.dep_depth, np.asarray(prob.conflict_ids)))
+            order_np = placement_order(
+                pt.demand, pt.dep_depth,
+                np.asarray(prob.conflict_ids)[: pt.S, :])
+            if bucketed:
+                # phantoms place last: zero demand + eligible everywhere
+                # means the greedy scan parks them on any valid node
+                order_np = np.concatenate(
+                    [np.asarray(order_np),
+                     np.arange(pt.S, prob.S, dtype=np.int64)])
+            order = jnp.asarray(order_np)
             if seed_impl == "scan":
                 seed_assignment = greedy_place(prob, order)
             else:
@@ -368,16 +422,19 @@ def _solve(pt: ProblemTensors, *,
                           - timings.get("prerepair_ms", 0.0))
 
     if proposals_per_step is None:
+        # derived from the PADDED row count: proposals_per_step is a static
+        # jit argument, so deriving it from the exact S would recompile on
+        # every fleet-size drift and defeat the bucketing (the clamps make
+        # this a no-op at fleet scale)
         if jax.default_backend() == "cpu":
             # CPU sweep cost is ~linear in proposals (no free width the way
             # the MXU gives it): a 64-wide sweep costs ~25 ms at 10k x 1k vs
             # ~100 ms at the 256 TPU knee, and with a feasible seed the
             # sweeps only buy soft polish. Measured in VERDICT r2 item 5.
-            proposals_per_step = max(1, min(64, pt.demand.shape[0] // 2))
+            proposals_per_step = max(1, min(64, prob.S // 2))
         else:
             from .anneal import default_proposals_per_step
-            proposals_per_step = default_proposals_per_step(
-                pt.demand.shape[0])
+            proposals_per_step = default_proposals_per_step(prob.S)
 
     t_anneal = t()
     sharding = (NamedSharding(mesh, P(CHAIN_AXIS, None))
@@ -386,6 +443,18 @@ def _solve(pt: ProblemTensors, *,
     # a new variant of the fused pipeline, which is exactly the event an
     # operator watching solve latency needs to see (a recompile can turn a
     # 100 ms reschedule into seconds — VERDICT r4 weak #1)
+    if binfo is not None:
+        # hit = this process already ran the fused pipeline at these
+        # jit-relevant extents, so the dispatch below will not recompile
+        binfo.hit = record_bucket(
+            (prob.S, prob.N, prob.G, prob.Gc, prob.T, prob.strategy,
+             prob.max_skew, prob.conflict_ids.shape[1],
+             prob.coloc_ids.shape[1], chains, steps,
+             bool(warm and migration_weight > 0), adaptive,
+             min(warm_block, anneal_block) if warm else anneal_block,
+             proposals_per_step))
+        _M_BUCKET.inc(hit="true" if binfo.hit else "false")
+        _M_PAD_WASTE.set(binfo.pad_waste)
     cache_before = _refine._cache_size()
     best_assignment, dstats, dsoft, sweeps_run, accepted = _refine(
         prob, seed_assignment, jax.random.PRNGKey(seed),
@@ -399,6 +468,10 @@ def _solve(pt: ProblemTensors, *,
     assignment, dstats, soft, sweeps_run, accepted = jax.device_get(
         (best_assignment, dstats, dsoft, sweeps_run, accepted))
     assignment = np.asarray(assignment)
+    if bucketed:
+        # phantom placements are an implementation detail of the padded
+        # executable; no caller ever sees them
+        assignment = assignment[: pt.S]
     soft = float(soft)
     accepted = int(accepted)
     timings["anneal_ms"] = (t() - t_anneal) * 1e3
@@ -418,8 +491,15 @@ def _solve(pt: ProblemTensors, *,
             rr: RepairResult = repair(pt, assignment)
             assignment, stats, moves = rr.assignment, rr.stats, rr.moves
             # repair changed the winner: re-score its soft objective
-            soft = float(jax.device_get(
-                soft_score(orig_prob, jnp.asarray(assignment))))
+            # (host-exact under bucketing — orig_prob may itself be a
+            # pre-padded staging whose shape no longer matches)
+            if not bucketed:
+                soft = float(jax.device_get(
+                    soft_score(orig_prob, jnp.asarray(assignment))))
+    if bucketed:
+        # report the REAL rows' soft score: the device number was computed
+        # on the padded problem, whose /S mean denominators count phantoms
+        soft = soft_score_host(pt, assignment)
     timings["verify_repair_ms"] = (t() - t_verify) * 1e3
     timings["total_ms"] = (t() - t_start) * 1e3
     _M_SOLVES.inc(backend=jax.default_backend(),
@@ -433,10 +513,12 @@ def _solve(pt: ProblemTensors, *,
     _M_VIOL.set(int(stats["total"]))
     _M_PRE_VIOL.set(pre_repair)
     log.info("solve %s", kv(
-        S=prob.S, N=prob.N, chains=chains, steps=steps,
+        S=pt.S, N=prob.N, chains=chains, steps=steps,
         sweeps=int(sweeps_run),
         accepted=accepted if accepted >= 0 else None,
         compiles=compile_events or None,
+        bucket=prob.S if bucketed else None,
+        bucket_hit=(binfo.hit or None) if binfo is not None else None,
         violations=int(stats["total"]), pre_repair=pre_repair,
         repaired=moves or None, warm=init_assignment is not None or None,
         **{k: f"{v:.1f}" for k, v in timings.items()}))
@@ -447,4 +529,5 @@ def _solve(pt: ProblemTensors, *,
         timings_ms=timings, chains=chains, steps=int(sweeps_run),
         proposals_per_step=proposals_per_step,
         accepted_moves=accepted,
+        bucket=binfo.to_dict() if binfo is not None else None,
     )

@@ -1,0 +1,303 @@
+"""Shape bucketing: the warm-path contract between fleet churn and XLA.
+
+Every distinct (S, N, G, Gc, K, C) shape of a DeviceProblem is a distinct
+XLA program: `_refine` (solver/api.py) is jitted with those extents baked
+in as static/traced shapes, so a fleet drifting from 9,997 to 10,050
+services — the normal churn/reschedule path — recompiles the whole fused
+pipeline and pays the 4.3-5.5 s compile cliff for a 70 ms solve
+(BENCH_r05). This module rounds the churn-sensitive extents UP to a
+geometric tier ladder so every fleet size inside a tier reuses ONE
+compiled executable:
+
+  S   (service rows)        -> next tier (x``growth`` steps from ``minimum``)
+  K   (conflict-id columns) -> next multiple of ``width_multiple``
+  C   (coloc-id columns)    -> next multiple of ``width_multiple``
+  G   (conflict-id count)   -> next tier (static: sizes the (N, G) tables)
+  Gc  (coloc-id count)      -> next tier
+
+N (node pool) is deliberately NOT bucketed: node inventories change by
+operator action, not churn, and padding nodes would need phantom-capacity
+semantics in every kernel. T is tied to N (node_topology defaults to
+arange(N)) and follows it.
+
+Padded service rows are PHANTOMS — the same construction the sharded
+mega-solve uses (`pad_problem`, generalized here from solver/sharded.py):
+zero demand, no conflict/coloc ids, zero preference, eligible everywhere.
+A phantom parked on any *valid* node is provably inert:
+
+  capacity     zero demand adds nothing to any load cell
+  conflicts    no ids -> no (node, group) occupancy -> no pairs
+  eligibility  eligible everywhere; seeds place phantoms on valid nodes
+               and the anneal's W_ELIG (1e6) makes a move onto an invalid
+               node unacceptable at any production temperature
+  soft         zero demand/preference/coloc; only the padded-S mean
+               denominators shift, so callers report the soft score of the
+               REAL rows via `soft_score_host` on the original tensors
+
+The one constraint phantoms cannot be made inert for without threading a
+real-row mask through every kernel is the spread constraint (they would
+count into per-domain totals), so bucketing is bypassed when
+``max_skew > 0`` — exactly the mask the sharded path threads as `n_real`.
+
+Config: `bucket_config()` reads the FLEET_BUCKET* environment once per
+call site; `FLEET_BUCKET=0` disables bucketing everywhere,
+`FLEET_BUCKET_GROWTH` (default 1.25) and `FLEET_BUCKET_MIN` (default 64)
+shape the tier ladder. docs/guide/11-performance.md covers tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["BucketConfig", "BucketInfo", "bucket_config", "bucket_size",
+           "width_bucket", "pad_problem", "pad_problem_tiers",
+           "pad_assignment", "record_bucket", "soft_score_host"]
+
+
+@dataclass(frozen=True)
+class BucketConfig:
+    enabled: bool = True
+    growth: float = 1.25     # geometric tier ratio for S / G / Gc
+    minimum: int = 64        # first S tier; G/Gc ladder starts at 16
+    width_multiple: int = 4  # K / C column rounding
+    align: int = 8           # every S tier is a multiple of this (lanes)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name, "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off", "no")
+
+
+def bucket_config(default_enabled: bool = True) -> BucketConfig:
+    """The process-wide bucketing knobs, read from the environment on each
+    call (cheap; callers on hot paths hold the result)."""
+    try:
+        growth = float(os.environ.get("FLEET_BUCKET_GROWTH", "1.25"))
+    except ValueError:
+        growth = 1.25
+    try:
+        minimum = int(os.environ.get("FLEET_BUCKET_MIN", "64"))
+    except ValueError:
+        minimum = 64
+    return BucketConfig(
+        enabled=_env_flag("FLEET_BUCKET", default_enabled),
+        growth=max(growth, 1.01),
+        minimum=max(minimum, 8),
+    )
+
+
+def bucket_size(n: int, *, growth: float = 1.25, minimum: int = 64,
+                align: int = 8) -> int:
+    """Smallest tier >= n on the geometric ladder minimum, minimum*growth,
+    minimum*growth^2, ... with every tier rounded up to a multiple of
+    ``align``. bucket_size is idempotent: bucket_size(bucket_size(n)) ==
+    bucket_size(n), which is what lets a pre-padded staging pass through
+    `pad_problem_tiers` unchanged."""
+    if n <= 0:
+        return align
+    tier = float(minimum)
+    out = -((-minimum) // align) * align
+    while out < n:
+        tier *= growth
+        out = -((-math.ceil(tier)) // align) * align  # ceil to align
+    return out
+
+
+def bucket_bounds(n: int, *, growth: float = 1.25, minimum: int = 64,
+                  align: int = 8) -> tuple[int, int]:
+    """(previous tier, tier) around n: the tier n pads up to, and the
+    largest smaller tier (0 below the ladder). `fleet lint` FF014 uses the
+    pair to say how far past a boundary a stage's row count sits."""
+    upper = bucket_size(n, growth=growth, minimum=minimum, align=align)
+    lower = 0
+    tier = float(minimum)
+    out = -((-minimum) // align) * align
+    while out < upper:
+        lower = out
+        tier *= growth
+        out = -((-math.ceil(tier)) // align) * align
+    return lower, upper
+
+
+def width_bucket(k: int, multiple: int = 4) -> int:
+    """Id-table column widths round to a small multiple: width drift (a
+    service gaining a second port) must not recompile."""
+    k = max(k, 1)
+    return -((-k) // multiple) * multiple
+
+
+@dataclass
+class BucketInfo:
+    """What padding was applied, for artifacts/metrics/SolveResult."""
+    orig_S: int
+    padded_S: int
+    G: int
+    Gc: int
+    hit: bool = False           # this padded shape was already compiled-for
+
+    @property
+    def pad_waste(self) -> float:
+        """Fraction of service rows that are phantoms."""
+        return 1.0 - self.orig_S / self.padded_S if self.padded_S else 0.0
+
+    def to_dict(self) -> dict:
+        return {"orig_S": self.orig_S, "padded_S": self.padded_S,
+                "pad_waste": round(self.pad_waste, 4), "hit": self.hit}
+
+
+def _pad_rows(a, pad: int, fill):
+    import jax.numpy as jnp
+    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, widths, constant_values=fill)
+
+
+def _pad_cols(a, pad: int, fill):
+    import jax.numpy as jnp
+    return jnp.pad(a, [(0, 0), (0, pad)], constant_values=fill)
+
+
+def pad_problem(prob, multiple: int):
+    """Pad the service axis up to a multiple of ``multiple`` with phantom
+    services (zero demand, no conflict/coloc ids, eligible everywhere, zero
+    preference): they sit wherever the annealer leaves them without
+    touching any constraint or score. Returns (padded problem, original S)
+    — slice the returned assignment back to [:orig_S].
+
+    This is the sharded mega-solve's ragged-S entry point (S must divide
+    over the mesh); `pad_problem_tiers` below is the bucketing entry point
+    (S rounds to a reuse tier). Both build the same phantoms."""
+    S = prob.S
+    pad = (-S) % multiple
+    if pad == 0:
+        return prob, S
+    return dataclasses.replace(
+        prob,
+        demand=_pad_rows(prob.demand, pad, 0.0),
+        conflict_ids=_pad_rows(prob.conflict_ids, pad, -1),
+        coloc_ids=_pad_rows(prob.coloc_ids, pad, -1),
+        eligible=_pad_rows(prob.eligible, pad, True),
+        preferred=_pad_rows(prob.preferred, pad, 0.0),
+        S=S + pad,
+    ), S
+
+
+def pad_problem_tiers(prob, cfg: Optional[BucketConfig] = None):
+    """Round a DeviceProblem up to its bucket: S to the tier ladder, the
+    conflict/coloc id-table widths to ``width_multiple``, and the static
+    G/Gc group counts to their own (smaller-based) tier ladder. Returns
+    (padded problem, BucketInfo). Idempotent: a problem already sitting on
+    its tiers comes back unchanged (same object), so staged re-use across
+    re-solves never re-pads."""
+    cfg = cfg or bucket_config()
+    S_pad = bucket_size(prob.S, growth=cfg.growth, minimum=cfg.minimum,
+                        align=cfg.align)
+    K = prob.conflict_ids.shape[1]
+    C = prob.coloc_ids.shape[1]
+    K_pad = width_bucket(K, cfg.width_multiple)
+    C_pad = width_bucket(C, cfg.width_multiple)
+    # G/Gc ride a COARSER, power-of-two ladder: group counts drift with
+    # fleet content (ports/volumes/colocations come and go service by
+    # service), and any finer ladder crosses a G boundary — and recompiles
+    # — while S sits comfortably in its tier. The cost of the headroom is
+    # scatter-table memory ((N, G) int32), pennies next to a compile.
+    G_pad = bucket_size(prob.G, growth=2.0, minimum=16, align=4)
+    Gc_pad = bucket_size(prob.Gc, growth=2.0, minimum=4,
+                         align=2) if prob.Gc > 0 else 0
+    info = BucketInfo(orig_S=prob.S, padded_S=S_pad, G=G_pad, Gc=Gc_pad)
+    if (S_pad == prob.S and K_pad == K and C_pad == C
+            and G_pad == prob.G and Gc_pad == prob.Gc):
+        return prob, info
+    pad = S_pad - prob.S
+    conflict_ids = prob.conflict_ids
+    coloc_ids = prob.coloc_ids
+    if K_pad > K:
+        conflict_ids = _pad_cols(conflict_ids, K_pad - K, -1)
+    if C_pad > C:
+        coloc_ids = _pad_cols(coloc_ids, C_pad - C, -1)
+    return dataclasses.replace(
+        prob,
+        demand=_pad_rows(prob.demand, pad, 0.0),
+        conflict_ids=_pad_rows(conflict_ids, pad, -1),
+        coloc_ids=_pad_rows(coloc_ids, pad, -1),
+        eligible=_pad_rows(prob.eligible, pad, True),
+        preferred=_pad_rows(prob.preferred, pad, 0.0),
+        S=S_pad, G=G_pad, Gc=Gc_pad,
+    ), info
+
+
+def pad_assignment(assignment: np.ndarray, padded_S: int,
+                   node_valid: np.ndarray) -> np.ndarray:
+    """Extend a real-row assignment with phantom placements on the first
+    VALID node (phantoms on an invalid node would count as eligibility
+    violations in the device stats — the one way a phantom can stop being
+    inert)."""
+    assignment = np.asarray(assignment, dtype=np.int32)
+    pad = padded_S - assignment.shape[0]
+    if pad <= 0:
+        return assignment
+    valid = np.flatnonzero(node_valid)
+    fill = int(valid[0]) if valid.size else 0
+    return np.concatenate(
+        [assignment, np.full(pad, fill, dtype=np.int32)])
+
+
+# -- bucket hit/miss telemetry ---------------------------------------------
+# A "hit" means this process has already solved at a padded shape with the
+# same jit-relevant extents, i.e. the fused pipeline will NOT recompile.
+_seen_lock = threading.Lock()
+_seen_buckets: set[tuple] = set()
+
+
+def record_bucket(key: tuple) -> bool:
+    """Record a padded-shape key; True when it was already seen (hit)."""
+    with _seen_lock:
+        hit = key in _seen_buckets
+        _seen_buckets.add(key)
+        return hit
+
+
+# -- host-side exact soft score --------------------------------------------
+
+def soft_score_host(pt, assignment: np.ndarray) -> float:
+    """numpy mirror of kernels.soft_score against the ORIGINAL (unpadded)
+    ProblemTensors: bucketed solves report the real rows' soft score, not
+    the padded problem's (whose /S mean denominators include phantoms)."""
+    from ..core.model import PlacementStrategy
+
+    assignment = np.asarray(assignment)
+    S, N = pt.S, pt.N
+    load = np.zeros((N, pt.demand.shape[1]), dtype=np.float32)
+    np.add.at(load, assignment, pt.demand.astype(np.float32))
+    u = load / np.maximum(pt.capacity, 1e-6)
+    usq = float((u * u).sum())
+    denom = float(max(N, 1))
+    if pt.strategy == PlacementStrategy.SPREAD_ACROSS_POOL:
+        strat = usq / denom
+    elif pt.strategy == PlacementStrategy.PACK_INTO_DEDICATED:
+        strat = -usq / denom
+    else:
+        strat = float((assignment.astype(np.float32) / denom).mean())
+    if pt.preferred is not None:
+        pref = -float(pt.preferred[np.arange(S), assignment].mean())
+    else:
+        pref = 0.0
+    coloc = 0.0
+    Gc = int(pt.coloc_ids.max(initial=-1)) + 1
+    if Gc > 0:
+        valid = pt.coloc_ids >= 0
+        counts = np.zeros((N, Gc), dtype=np.int64)
+        rows = np.repeat(assignment, pt.coloc_ids.shape[1])[valid.ravel()]
+        cols = pt.coloc_ids.ravel()[valid.ravel()]
+        np.add.at(counts, (rows, cols), 1)
+        c = counts.astype(np.float64)
+        coloc = -float((c * (c - 1.0) / 2.0).sum()) / max(S, 1)
+    return strat + pref + coloc

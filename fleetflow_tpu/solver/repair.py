@@ -150,28 +150,42 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
                         keeper[s] = True
                         seen.update(cells)
             bad |= in_conflict & ~keeper
-        # overloaded nodes: evict smallest services until the node fits
+        # overloaded nodes: evict smallest services until the node fits.
+        # The per-service inner loop is replaced by a cumulative-sum scan:
+        # evicting the smallest k members leaves load[n] - csum[k-1], so
+        # the minimal k is the first index where every resource fits —
+        # same eviction set and order as the sequential loop.
         over = (load > cap * (1 + 1e-6)).any(axis=1)
         for n in np.flatnonzero(over):
             members = np.flatnonzero((assignment == n) & ~bad)
             if members.size == 0:
                 continue
-            sizes = demand[members].sum(axis=1)
-            for m in members[np.argsort(sizes)]:
-                if not (load[n] > cap[n] * (1 + 1e-6)).any():
-                    break
-                bad[m] = True
-                load[n] -= demand[m]
+            dm = demand[members]
+            asc = np.argsort(dm.sum(axis=1))
+            csum = np.cumsum(dm[asc], axis=0)
+            fits_k = (load[n] - csum <= cap[n] * (1 + 1e-6)).all(axis=1)
+            k = (int(np.argmax(fits_k)) + 1 if fits_k.any()
+                 else members.size)
+            bad[members[asc[:k]]] = True
 
         if not bad.any():
             break
 
         # --- relocate, smallest first ------------------------------------
-        # recompute load/counts excluding the evicted services
-        load = np.zeros((N, demand.shape[1]), dtype=np.float64)
-        np.add.at(load, assignment[~bad], demand[~bad])
-        counts = (_group_counts(assignment[~bad], ids[~bad], N, G) if G > 0
-                  else np.zeros((N, 1), dtype=np.int64))
+        # load/counts excluding the evicted services: subtract the |bad|
+        # rows' contributions instead of rebuilding from all S rows (a
+        # warm churn repair has ~14 bad rows against 10k total)
+        nbad = np.flatnonzero(bad)
+        np.add.at(load, assignment[nbad], -demand[nbad])
+        if G > 0:
+            bad_ids = ids[nbad]
+            bvalid = bad_ids >= 0
+            np.add.at(counts,
+                      (np.repeat(assignment[nbad], bad_ids.shape[1])[
+                          bvalid.ravel()],
+                       bad_ids.ravel()[bvalid.ravel()]), -1)
+        else:
+            counts = np.zeros((N, 1), dtype=np.int64)
 
         # Worklist relocation with one-level ejection chains: when a service
         # has no directly-feasible node, it may evict the services blocking
@@ -179,19 +193,38 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
         # marks queued services — their demand/conflicts are already out of
         # load/counts and they must not be seen (or evicted) as residents.
         # Bounded by a global move budget so pathological instances terminate.
+        #
+        # Node membership is LAZY: the worklist touches O(|bad| + evictees)
+        # services, and materializing all N resident sets up-front (a 10k-
+        # iteration Python loop) cost more than the whole repair on warm
+        # churn fixes. Residents are grouped once with an argsort; a node's
+        # set is built on first touch and kept current from then on.
         size = demand.sum(axis=1)
-        node_members: list[set] = [set() for _ in range(N)]
-        for s in np.flatnonzero(~bad):
-            node_members[assignment[s]].add(int(s))
+        _res_rows = np.flatnonzero(~bad)
+        _res_order = _res_rows[np.argsort(assignment[_res_rows],
+                                          kind="stable")]
+        _res_nodes = assignment[_res_order]
+        node_members: dict[int, set] = {}
+
+        def members_of(n: int) -> set:
+            s = node_members.get(n)
+            if s is None:
+                lo = int(np.searchsorted(_res_nodes, n, side="left"))
+                hi = int(np.searchsorted(_res_nodes, n, side="right"))
+                s = set(_res_order[lo:hi].tolist())
+                node_members[n] = s
+            return s
+
         detached = bad.copy()
 
         def plan_eviction(n: int, s: int) -> list | None:
             """Residents of n to evict so s fits (conflicts + capacity);
             None when even a full conflict eviction can't make room."""
-            evict = [r for r in node_members[n]
+            residents = members_of(n)
+            evict = [r for r in residents
                      if id_set(s) & id_set(r)] if id_set(s) else []
             new_load = load[n] + demand[s] - demand[evict].sum(axis=0)
-            rest = sorted((r for r in node_members[n] if r not in evict),
+            rest = sorted((r for r in residents if r not in evict),
                           key=size.__getitem__)
             while (new_load > cap[n] * (1 + 1e-6)).any() and rest:
                 r = rest.pop(0)
@@ -205,12 +238,16 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             load[n] -= demand[r]
             if id_set(r):
                 counts[n, list(id_set(r))] -= 1
-            node_members[n].discard(r)
+            members_of(n).discard(r)
             detached[r] = True
             queue.append(r)
 
         queue = deque(np.flatnonzero(bad)[np.argsort(size[bad])].tolist())
         budget = 4 * S
+        # True once any placement was NOT a direct feasible one (ejection
+        # chain or randomized escape): those can strand or conflict, which
+        # only the next round's full rescan catches
+        evicted_any = False
         while queue and budget > 0:
             s = int(queue.popleft())
             budget -= 1
@@ -232,6 +269,11 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
                     n = int(cand[np.argmin(util)])
                 bounce[s] = 0
             else:
+                # any NON-direct placement forfeits the clean-round
+                # shortcut below, even one that evicts nothing: a
+                # randomized escape may land on an overloaded node the
+                # next round's rescan must re-visit
+                evicted_any = True
                 elig = np.flatnonzero(pt.eligible[s] & pt.node_valid)
                 if elig.size == 0:
                     continue  # truly no node: infeasible service
@@ -239,7 +281,7 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
                     # randomized escape: random eligible node, evict blockers
                     n = int(rng.choice(elig))
                     evict = plan_eviction(n, s) or [
-                        r for r in node_members[n] if id_set(s) & id_set(r)]
+                        r for r in members_of(n) if id_set(s) & id_set(r)]
                 else:
                     # ejection: the eligible node whose blockers are cheapest
                     best = None
@@ -259,9 +301,18 @@ def repair(pt: ProblemTensors, assignment: np.ndarray,
             load[n] += demand[s]
             if my:
                 counts[n, my] += 1
-            node_members[n].add(s)
+            members_of(n).add(s)
             detached[s] = False
             moves += 1
+
+        # Every evictee re-placed and every placement was DIRECT (checked
+        # feasible against live load/counts, which direct placements keep
+        # consistent): the next round's full rescan would find nothing.
+        # Ejection chains and randomized escapes forfeit the shortcut —
+        # they can strand or conflict, which the rescan exists to catch.
+        # verify() below stays the ground truth either way.
+        if not queue and not evicted_any and not detached.any():
+            break
 
     stats = verify(pt, assignment)
     # Ejection leaves un-replaced evictees at stale nodes when the budget

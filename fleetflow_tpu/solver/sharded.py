@@ -51,6 +51,7 @@ except ImportError:                                  # pragma: no cover
 
 from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
                      _soft_rows, violation_total_from_parts)
+from .buckets import pad_problem
 from .problem import DeviceProblem
 
 # the replication-check kwarg was renamed across jax versions
@@ -69,34 +70,10 @@ __all__ = ["anneal_sharded", "pad_problem", "shard_problem",
 
 SVC_AXIS = "svc"
 
-
-def pad_problem(prob: DeviceProblem, multiple: int
-                ) -> tuple[DeviceProblem, int]:
-    """Pad the service axis up to a multiple of `multiple` with phantom
-    services (zero demand, no conflict/coloc ids, eligible everywhere, zero
-    preference): they sit wherever the annealer leaves them without
-    touching any constraint or score. Returns (padded problem, original S)
-    — slice the returned assignment back to [:orig_S]."""
-    import dataclasses
-
-    S = prob.S
-    pad = (-S) % multiple
-    if pad == 0:
-        return prob, S
-
-    def pad_rows(a, fill):
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        return jnp.pad(a, widths, constant_values=fill)
-
-    return dataclasses.replace(
-        prob,
-        demand=pad_rows(prob.demand, 0.0),
-        conflict_ids=pad_rows(prob.conflict_ids, -1),
-        coloc_ids=pad_rows(prob.coloc_ids, -1),
-        eligible=pad_rows(prob.eligible, True),
-        preferred=pad_rows(prob.preferred, 0.0),
-        S=S + pad,
-    ), S
+# pad_problem moved to solver/buckets.py (the bucketing module generalizes
+# it: same phantom construction, plus tier ladders for S/G/Gc and id-table
+# widths); re-exported via __all__ because the sharded entry points and
+# their callers treat it as part of this module's API.
 
 
 def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
