@@ -31,6 +31,7 @@ from .guard import confine_path, validate_container_name
 from .monitor import AnomalyDetector, inventory_report, snapshot_backend
 from ..cp.protocol import Connection, ProtocolClient
 from ..obs import get_logger, kv, span
+from ..obs.metrics import REGISTRY
 from ..obs.trace import use_trace
 
 __all__ = ["Agent", "AgentConfig"]
@@ -38,6 +39,19 @@ __all__ = ["Agent", "AgentConfig"]
 log = get_logger("agent")
 
 RECONNECT_BACKOFF_S = 5.0   # agent.rs:34-45
+
+# metric catalog: docs/guide/10-observability.md. Send failures from the
+# background loops used to vanish silently — a half-dead session (socket
+# up, writes failing) was invisible until the CP's lease expired; now it
+# shows as a rising counter on the node's own /metrics.
+_M_SEND_FAILURES = REGISTRY.counter(
+    "fleet_agent_send_failures_total",
+    "Agent->CP event sends that failed, by originating loop",
+    labels=("loop",))
+_M_IDEM_REPLAYS = REGISTRY.counter(
+    "fleet_agent_idempotent_replays_total",
+    "Commands answered from the idempotency dedupe window instead of "
+    "re-executing (CP redelivery after reconnect/timeout)")
 
 
 @dataclass
@@ -56,6 +70,12 @@ class AgentConfig:
     capacity: dict = field(default_factory=lambda: {
         "cpu": 2.0, "memory": 4096.0, "disk": 40960.0})
     version: str = "0.1.0"
+    # how long a completed command's result stays replayable by its
+    # idempotency key (the CP reconverger redelivers after reconnects and
+    # timeouts; a replay inside the window returns the cached result
+    # instead of re-running the deploy). Sized to outlive the CP's
+    # redelivery backoff ladder.
+    idempotency_window_s: float = 900.0
 
 
 class Agent:
@@ -76,6 +96,15 @@ class Agent:
         self.conn: Optional[Connection] = None
         self._stop = asyncio.Event()
         self._session_tasks: list[asyncio.Task] = []
+        # idempotency dedupe window: key -> (monotonic done-time, result).
+        # Lives on the AGENT (not the session), so a redelivery after a
+        # session bounce still hits it — at-least-once CP delivery with
+        # at-most-once execution inside the window. `_idem_inflight`
+        # covers the gap BEFORE completion: a redelivery arriving while
+        # the original is still executing (CP-side timeout + retry on a
+        # slow deploy) awaits it instead of running a second copy.
+        self._idem: dict[str, tuple[float, dict]] = {}
+        self._idem_inflight: dict[str, asyncio.Future] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -148,36 +177,57 @@ class Agent:
     # ------------------------------------------------------------------
 
     async def _heartbeat_loop(self) -> None:
-        """heartbeat.rs:10-23."""
+        """heartbeat.rs:10-23. A failed send ends the loop (the session
+        is dying; the reconnect loop owns recovery) — but never silently:
+        the failure is logged and counted, so a half-dead session is
+        visible on this node's metrics BEFORE the CP's lease expires."""
         while True:
             try:
                 await self.conn.send_event("agent", "heartbeat",
                                            {"version": self.config.version})
-            except Exception:
+            except Exception as e:
+                _M_SEND_FAILURES.inc(loop="heartbeat")
+                log.debug("heartbeat send failed %s", kv(
+                    slug=self.config.slug, error=e))
                 return
             await asyncio.sleep(self.config.heartbeat_interval_s)
 
     async def _monitor_loop(self) -> None:
-        """monitor.rs run_loop:263: inventory + anomaly detection."""
+        """monitor.rs run_loop:263: inventory + anomaly detection.
+        Failures are survivable here (next interval retries) but must be
+        visible; monitor_once counts its SEND failures separately so the
+        metric stays truthful to its name."""
         while True:
             try:
                 await self.monitor_once()
-            except Exception:
-                pass
+            except Exception as e:
+                log.debug("monitor pass failed %s", kv(
+                    slug=self.config.slug, error=e))
             await asyncio.sleep(self.config.monitor_interval_s)
 
     async def monitor_once(self) -> None:
         snaps = await asyncio.get_running_loop().run_in_executor(
             None, lambda: snapshot_backend(self.backend))
-        await self.conn.send_event("agent", "inventory",
-                                   {"containers": inventory_report(snaps)})
-        for anomaly in self.detector.observe(snaps):
-            await self.conn.send_event("agent", "alert", {
-                "container": anomaly.container,
-                "kind": anomaly.kind,
-                "message": anomaly.message,
-                "resolved": anomaly.resolved,
-            })
+        anomalies = list(self.detector.observe(snaps))
+        try:
+            await self.conn.send_event(
+                "agent", "inventory",
+                {"containers": inventory_report(snaps)})
+            for anomaly in anomalies:
+                await self.conn.send_event("agent", "alert", {
+                    "container": anomaly.container,
+                    "kind": anomaly.kind,
+                    "message": anomaly.message,
+                    "resolved": anomaly.resolved,
+                })
+        except Exception as e:
+            # only the SENDS count here: a local snapshot/detector error
+            # must not look like a half-dead session to an operator
+            # alerting on this family (docs/guide/10-observability.md)
+            _M_SEND_FAILURES.inc(loop="monitor")
+            log.debug("monitor send failed %s", kv(
+                slug=self.config.slug, error=e))
+            raise
 
     # ------------------------------------------------------------------
     # command dispatch (the envelope protocol)
@@ -185,24 +235,91 @@ class Agent:
 
     async def _on_command(self, conn: Connection, method: str,
                           envelope: dict) -> None:
-        """agent.rs command loop :129-208 + envelope :215-254."""
+        """agent.rs command loop :129-208 + envelope :215-254.
+
+        Idempotent redelivery: a payload carrying `idempotency_key` is
+        executed AT MOST ONCE per window — a replay (the CP reconverger
+        re-sends after reconnects and send timeouts) answers with the
+        cached result instead of re-running the deploy. Only successes
+        are cached; a failed command re-executes on redelivery."""
         request_id = envelope.get("request_id")
         payload = envelope.get("payload", {})
+        idem_key = (payload.get("idempotency_key")
+                    if isinstance(payload, dict) else None)
         log.debug("command %s", kv(method=method, request_id=request_id,
                                    slug=self.config.slug))
-        try:
-            result = await self.execute_command(method, payload)
-            reply = {"request_id": request_id, "result": result}
-        except Exception as e:
-            log.error("command failed %s", kv(method=method,
-                                              request_id=request_id, error=e))
-            reply = {"request_id": request_id,
-                     "error": f"{type(e).__name__}: {e}"}
+        cached = self._idem_lookup(idem_key)
+        if cached is None and idem_key:
+            inflight = self._idem_inflight.get(idem_key)
+            if inflight is not None:
+                # the original is still executing: ride its outcome
+                # rather than starting a concurrent duplicate; if it
+                # fails, fall through and re-execute (failures are
+                # never cached)
+                try:
+                    cached = await inflight
+                except Exception:
+                    cached = None
+        if cached is not None:
+            _M_IDEM_REPLAYS.inc()
+            log.info("idempotent replay %s", kv(
+                method=method, key=idem_key, slug=self.config.slug))
+            reply = {"request_id": request_id, "result": cached,
+                     "deduped": True}
+        else:
+            fut: Optional[asyncio.Future] = None
+            if idem_key and idem_key not in self._idem_inflight:
+                fut = asyncio.get_running_loop().create_future()
+                self._idem_inflight[idem_key] = fut
+            try:
+                result = await self.execute_command(method, payload)
+                if idem_key:
+                    self._idem_store(idem_key, result)
+                if fut is not None:
+                    fut.set_result(result)
+                reply = {"request_id": request_id, "result": result}
+            except Exception as e:
+                log.error("command failed %s", kv(
+                    method=method, request_id=request_id, error=e))
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+                    fut.exception()   # mark retrieved: no-waiter GC noise
+                reply = {"request_id": request_id,
+                         "error": f"{type(e).__name__}: {e}"}
+            finally:
+                if fut is not None:
+                    self._idem_inflight.pop(idem_key, None)
         if request_id:
             try:
                 await conn.send_event("agent", "command_result", reply)
-            except Exception:
-                pass
+            except Exception as e:
+                _M_SEND_FAILURES.inc(loop="command_result")
+                log.debug("command_result send failed %s", kv(
+                    request_id=request_id, error=e))
+
+    def _idem_lookup(self, key: Optional[str]) -> Optional[dict]:
+        if not key:
+            return None
+        hit = self._idem.get(key)
+        if hit is None:
+            return None
+        done_at, result = hit
+        if time.monotonic() - done_at > self.config.idempotency_window_s:
+            del self._idem[key]
+            return None
+        return result
+
+    def _idem_store(self, key: str, result: dict) -> None:
+        now = time.monotonic()
+        self._idem[key] = (now, result)
+        # bounded: prune expired entries, then oldest-first past the cap
+        window = self.config.idempotency_window_s
+        for k in [k for k, (t, _) in self._idem.items()
+                  if now - t > window]:
+            del self._idem[k]
+        while len(self._idem) > 256:
+            oldest = min(self._idem, key=lambda k: self._idem[k][0])
+            del self._idem[oldest]
 
     async def execute_command(self, method: str, payload: dict) -> dict:
         loop = asyncio.get_running_loop()

@@ -11,7 +11,7 @@ robustness claim becomes a replayable repro.
 
 from .faults import (AgentPartition, ContainerExit, DeployFail, Fault,
                      FaultSchedule, NodeCrash, NodeFlap, Redeploy,
-                     SlowAgent, WorkerKill)
+                     SilentNodeCrash, SlowAgent, Tick, WorkerKill)
 from .injector import FaultInjector
 from .invariants import FINAL_INVARIANTS, INSTANT_INVARIANTS
 from .runner import ChaosReport, ChaosWorld, VirtualClock, run_schedule
@@ -20,6 +20,7 @@ from .scenarios import SCENARIOS, build_schedule, scenario_names
 __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
+    "SilentNodeCrash", "Tick",
     "FaultSchedule", "FaultInjector", "ChaosReport", "ChaosWorld",
     "VirtualClock", "run_schedule", "run_scenario", "SCENARIOS",
     "build_schedule", "scenario_names", "INSTANT_INVARIANTS",

@@ -32,12 +32,15 @@ from typing import Optional
 __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
-    "FaultSchedule",
+    "SilentNodeCrash", "Tick", "FaultSchedule",
 ]
 
 # primitive ops the runner executes (the fault algebra's normal form)
 NODE_DOWN = "node_down"
 NODE_UP = "node_up"
+NODE_DOWN_SILENT = "node_down_silent"
+NODE_UP_SILENT = "node_up_silent"
+TICK = "tick"
 PARTITION_START = "partition_start"
 PARTITION_END = "partition_end"
 SLOW_START = "slow_start"
@@ -78,6 +81,36 @@ class NodeFlap(Fault):
     def expand(self):
         return [(self.at, NODE_DOWN, {"node": self.node, "wipe": True}),
                 (self.at + self.down_for, NODE_UP, {"node": self.node})]
+
+
+@dataclass(frozen=True)
+class SilentNodeCrash(Fault):
+    """NodeCrash WITHOUT the runner informing the placement service: no
+    node_event, no operator redeploy — the CP must NOTICE the death by
+    itself (missed heartbeats -> lease expiry -> dead verdict,
+    cp/failure_detector.py) and the reconverger must re-place and
+    redeliver the stranded services. The self-healing scenario's whole
+    point: detection is part of the system under test."""
+    node: str = ""
+    revive_after: Optional[float] = None   # None = stays dead
+
+    def expand(self):
+        out = [(self.at, NODE_DOWN_SILENT, {"node": self.node})]
+        if self.revive_after is not None:
+            out.append((self.at + self.revive_after, NODE_UP_SILENT,
+                        {"node": self.node}))
+        return out
+
+
+@dataclass(frozen=True)
+class Tick(Fault):
+    """Pure pacing: advances the clock to `at` and forces a reconcile
+    (heartbeats + detector sweep + heal pass). Lease expiry only fires
+    when a sweep OBSERVES it, so silent-crash schedules interleave ticks
+    to bound the detection latency on the virtual clock."""
+
+    def expand(self):
+        return [(self.at, TICK, {})]
 
 
 @dataclass(frozen=True)

@@ -21,7 +21,7 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "check_final", "capacity_accounting", "reservations_terminal",
            "no_dead_assignments", "pools_at_min", "solver_feasible",
            "containers_converged", "metrics_monotonic",
-           "agents_gauge_consistent"]
+           "agents_gauge_consistent", "selfheal_converged"]
 
 _EPS = 1e-6
 
@@ -159,6 +159,39 @@ def containers_converged(world, snapshot=None) -> list[str]:
     return out
 
 
+def selfheal_converged(world, snapshot=None) -> list[str]:
+    """Self-healing liveness: once churn quiesces (the settle loop keeps
+    advancing the clock until the reconverger drains), every NON-PARKED
+    service is assigned to a live node, and no redelivery debt remains.
+    Parked stages are the reconverger's EXPLICIT admission that capacity
+    is missing — anything else still on a dead node means the heal loop
+    silently dropped work."""
+    rc = getattr(world.state, "reconverger", None)
+    if rc is None:
+        return []
+    out: list[str] = []
+    parked = set(rc.parked_stage_keys())
+    if snapshot is None:
+        snapshot = world.state.placement.snapshot()
+    by_slug = {s.slug: s for s in world.state.store.list("servers")}
+    for key, view in sorted(snapshot.items()):
+        if key in parked:
+            continue
+        if not view["feasible"]:
+            out.append(f"non-parked stage {key} settled infeasible "
+                       f"({view['violations']} violations) — the "
+                       f"reconverger should have parked it")
+            continue
+        for row, node in sorted(view["assignment"].items()):
+            s = by_slug.get(node)
+            if s is None or not s.schedulable:
+                out.append(f"{key}: {row} assigned to dead node {node} "
+                           f"and the stage is not parked")
+    for key in rc.pending_stage_keys():
+        out.append(f"redelivery debt for {key} outstanding after settle")
+    return out
+
+
 def metrics_monotonic(world) -> list[str]:
     """Counters never decrease across the run. The metrics registry is the
     operator's ground truth for rates and totals; a counter that went DOWN
@@ -205,6 +238,7 @@ FINAL_INVARIANTS = {
     "pools-at-min": pools_at_min,
     "solver-feasible": solver_feasible,
     "containers-converged": containers_converged,
+    "selfheal-converged": selfheal_converged,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,
 }
@@ -223,7 +257,8 @@ def check_final(world) -> list[str]:
     out: list[str] = []
     for name, fn in FINAL_INVARIANTS.items():
         found = (fn(world, snapshot=snap)
-                 if fn in (no_dead_assignments, containers_converged)
+                 if fn in (no_dead_assignments, containers_converged,
+                           selfheal_converged)
                  else fn(world))
         out.extend(f"[{name}] {v}" for v in found)
     return out

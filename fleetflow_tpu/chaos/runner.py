@@ -36,9 +36,11 @@ from ..core.model import (Flow, ResourceSpec, Service, Stage)
 from ..cp.agent_registry import AgentRegistry
 from ..cp.auth import NoAuth
 from ..cp.autoscaler import Autoscaler
+from ..cp.failure_detector import FailureDetector, LeaseConfig
 from ..cp.log_router import LogRouter
 from ..cp.models import ServerCapacity, WorkerPool
 from ..cp.placement import PlacementService
+from ..cp.reconverge import ReconvergeConfig, Reconverger
 from ..cp.server import AppState
 from ..cp.store import Store
 from ..core.errors import ControlPlaneError
@@ -237,7 +239,7 @@ class ChaosWorld:
     virtual clock + causally-ordered event log."""
 
     def __init__(self, flow: Flow, injector: FaultInjector,
-                 clock: VirtualClock, pool_min: int = 0):
+                 clock: VirtualClock, pool_min: int = 0, seed: int = 0):
         self.flow = flow
         self.clock = clock
         self.injector = injector
@@ -253,6 +255,19 @@ class ChaosWorld:
             server_provider_factory=self._provider_factory,
             deploy_sleep=clock.advance, chaos=injector)
         self.state.agent_registry.delivery_hook = injector.delivery_hook
+        # the self-healing pair, on the VIRTUAL clock (lease expiry and
+        # retry backoff are exact virtual arithmetic) with seeded jitter —
+        # so every heal decision replays identically across processes
+        self.detector = FailureDetector(LeaseConfig(
+            lease_s=60.0, suspect_grace_s=30.0, flap_window_s=300.0,
+            flap_threshold=3, damp_hold_s=120.0), clock=clock.now)
+        self.reconverger = Reconverger(
+            self.state, self.detector,
+            config=ReconvergeConfig(backoff_base_s=5.0, backoff_max_s=60.0,
+                                    max_attempts=5),
+            clock=clock.now, rng=random.Random(seed ^ 0x5EA1))
+        self.state.failure_detector = self.detector
+        self.state.reconverger = self.reconverger
         self.agents: dict[str, SimAgent] = {}
         self.backends: dict[str, MockBackend] = {}
         self.events: list[dict] = []
@@ -298,6 +313,7 @@ class ChaosWorld:
         self.state.agent_registry.register(slug, agent.conn,
                                            principal=slug)
         self.state.store.heartbeat(slug)
+        self.detector.observe_heartbeat(slug)
         return agent
 
     def disconnect(self, slug: str, wipe: bool = True) -> None:
@@ -306,6 +322,7 @@ class ChaosWorld:
         if agent is not None:
             agent.conn._closed = True
             self.state.agent_registry.unregister(slug, agent.conn)
+        self.detector.observe_disconnect(slug)
         if wipe:
             self.backends.pop(slug, None)
 
@@ -378,10 +395,11 @@ class _Runner:
         flow = make_flow(n_services, n_stages, self.node_slugs,
                          seed=schedule.seed)
         self.world = ChaosWorld(flow, FaultInjector(), clock,
-                                pool_min=pool_min)
+                                pool_min=pool_min, seed=schedule.seed)
         self.dirty: set[str] = set()     # stage names needing redeploy
         self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
-                      "resolves": 0, "restarts": 0, "scale_actions": 0}
+                      "resolves": 0, "restarts": 0, "scale_actions": 0,
+                      "heals": 0}
 
     # -- world bootstrap ---------------------------------------------------
 
@@ -458,6 +476,16 @@ class _Runner:
                 w.log("fault", op=op, node=p["node"])
                 w.connect(p["node"])
                 burst.append((p["node"], True))
+            elif op == F.NODE_DOWN_SILENT:
+                # the self-healing contract: NO node_events, NO redeploy —
+                # the CP must detect the death via lease expiry itself
+                w.log("fault", op=op, node=p["node"])
+                w.disconnect(p["node"])
+            elif op == F.NODE_UP_SILENT:
+                w.log("fault", op=op, node=p["node"])
+                w.connect(p["node"])
+            elif op == F.TICK:
+                pass   # pacing only: the group boundary runs a reconcile
             elif op == F.WORKER_KILL:
                 slug = self._resolve_worker(p["pool"])
                 if slug is None:
@@ -501,6 +529,34 @@ class _Runner:
 
     # -- reconciliation ----------------------------------------------------
 
+    async def _heal_pass(self) -> None:
+        """The production self-healing cadence, replayed: connected
+        agents heartbeat (a partitioned agent's heartbeats don't reach
+        the CP — exactly how its lease starves), then one reconverger
+        step (detector sweep -> coalesced re-solve -> redeliveries).
+        Every outcome lands in the causal event log with virtual times
+        only, keeping the digest reproducible."""
+        w = self.world
+        for slug in sorted(w.agents):
+            if slug in w.injector.partitioned:
+                continue
+            w.state.store.heartbeat(slug)
+            w.detector.observe_heartbeat(slug)
+        summary = await w.reconverger.step()
+        for slug in summary["dead"]:
+            w.log("heal-dead", node=slug)
+        for slug in summary["online"]:
+            w.log("heal-online", node=slug)
+        for r in summary["resolved"]:
+            w.log("heal-resolve", stage=r["stage"], feasible=r["feasible"])
+        for key in summary["redelivered"]:
+            self.stats["heals"] += 1
+            w.log("heal-redeliver", stage=key)
+        for key in summary["retried"]:
+            w.log("heal-retry", stage=key)
+        for key in summary["parked"]:
+            w.log("heal-parked", stage=key)
+
     async def _monitor_pass(self) -> None:
         """Restart exited fleet containers through the real command path
         (a partitioned node's restart fails and is retried next pass)."""
@@ -543,6 +599,7 @@ class _Runner:
         return self.world.autoscaler.run_sweep()
 
     async def _reconcile(self) -> None:
+        await self._heal_pass()
         await self._monitor_pass()
         if self.pool_min > 0:
             self._autoscale()
@@ -591,10 +648,12 @@ class _Runner:
                 and info.labels.get("fleetflow.project") == w.flow.name
                 for slug in sorted(w.backends)
                 for info in w.backends[slug].containers.values())
-            if not self.dirty and not exited:
+            if (not self.dirty and not exited
+                    and not w.reconverger.has_work()):
                 break
             w.clock.advance(30.0)
-        w.log("settled", rounds=_round + 1, dirty=sorted(self.dirty))
+        w.log("settled", rounds=_round + 1, dirty=sorted(self.dirty),
+              healing=w.reconverger.pending_stage_keys())
 
         final = check_final(w)
         for v in final:

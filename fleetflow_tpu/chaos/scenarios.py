@@ -18,7 +18,7 @@ from typing import Callable
 
 from .faults import (AgentPartition, ContainerExit, DeployFail,
                      FaultSchedule, NodeCrash, NodeFlap, Redeploy,
-                     SlowAgent, WorkerKill)
+                     SilentNodeCrash, SlowAgent, Tick, WorkerKill)
 from .runner import node_slug
 
 __all__ = ["SCENARIOS", "build_schedule", "scenario_names"]
@@ -46,6 +46,36 @@ def _rolling_kill(seed: int, services: int, nodes: int) -> FaultSchedule:
                                         node=node_slug(rng.choice(survivors))))
         t += 60.0
     return FaultSchedule("rolling-kill", seed, faults, horizon=t + 300.0)
+
+
+def _rolling_kill_selfheal(seed: int, services: int,
+                           nodes: int) -> FaultSchedule:
+    """Rolling SILENT kills: nodes die without any operator RPC or runner
+    assistance — missed heartbeats are the only signal. The lease-based
+    failure detector must notice each death (suspect -> dead on the
+    virtual clock) and the reconverger must warm re-solve and redeliver
+    the stranded services to survivors (the `selfheal-converged`
+    invariant judges the outcome). Ticks pace the replay so detector
+    sweeps observe lease expiry with bounded latency; each victim
+    revives later, exercising the node-online unpark path."""
+    rng = random.Random(seed)
+    kills = min(max(2, min(nodes // 10, 6)), nodes - 1)
+    victims = rng.sample(range(nodes), kills)
+    faults: list = []
+    t = 30.0
+    for v in victims:
+        faults.append(SilentNodeCrash(at=t, node=node_slug(v),
+                                      revive_after=400.0))
+        t += 120.0
+    horizon = t + 600.0
+    # lease 60s + grace 30s (runner config): 30s ticks bound detection
+    # at ~2 sweeps past expiry
+    tick = 15.0
+    while tick < horizon:
+        faults.append(Tick(at=tick))
+        tick += 30.0
+    return FaultSchedule("rolling-kill-selfheal", seed, faults,
+                         horizon=horizon)
 
 
 def _flap_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
@@ -113,6 +143,11 @@ SCENARIOS: dict[str, tuple[Callable, str]] = {
     "rolling-kill": (_rolling_kill,
                      "serial node kills with revival + a pool worker "
                      "death + container exits"),
+    "rolling-kill-selfheal": (_rolling_kill_selfheal,
+                              "SILENT serial kills: only missed "
+                              "heartbeats signal them — the lease "
+                              "detector + reconverger must heal the "
+                              "fleet unassisted"),
     "flap-storm": (_flap_storm,
                    "waves of coalesced short flaps across ~20% of the "
                    "fleet"),

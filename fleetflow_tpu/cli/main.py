@@ -701,7 +701,8 @@ def cmd_chaos(args) -> int:
     print(f"  {len(report.events)} events | deploys "
           f"{s['deploys_ok']} ok / {s['deploys_failed']} failed | "
           f"{s['faults']} faults | {s['resolves']} re-solves | "
-          f"{s['restarts']} restarts | {s['scale_actions']} scale actions")
+          f"{s['restarts']} restarts | {s.get('heals', 0)} heals | "
+          f"{s['scale_actions']} scale actions")
     print(f"  event-log digest {report.digest()} "
           f"(same seed => same digest)")
     if args.json:
@@ -942,6 +943,45 @@ def _cp_dispatch(cp: CpClient, args) -> int:
 
     if sub == "status":
         return show(cp.request("health", "overview"))
+    if sub == "heal":
+        out = cp.request("health", "heal.status")
+        if not out.get("enabled", False):
+            print("self-healing is disabled on this CP "
+                  "(`self-heal true` in fleetflowd.kdl)")
+            return 1
+        if getattr(args, "json", False):
+            return show(out)
+        det = out.get("detector", {})
+        agents = det.get("agents", {})
+        cfg = det.get("config", {})
+        print(f"lease={cfg.get('lease_s')}s "
+              f"grace={cfg.get('suspect_grace_s')}s "
+              f"flap_threshold={cfg.get('flap_threshold')} "
+              f"damp_hold={cfg.get('damp_hold_s')}s")
+        for slug, a in sorted(agents.items()):
+            damped = " DAMPED" if a.get("damped") else ""
+            print(f"  {slug:<20} {a['state']:<8} "
+                  f"lease_remaining={a['lease_remaining_s']:>8.1f}s "
+                  f"verdicts={a['recent_verdicts']}{damped}")
+        work = out.get("work", [])
+        if work:
+            print("convergence work:")
+            for w in work:
+                state = ("parked" if w["parked"]
+                         else f"retry in {w['retry_in_s']}s")
+                err = f" ({w['last_error']})" if w.get("last_error") else ""
+                print(f"  {w['stage']:<30} {state} attempt={w['attempt']} "
+                      f"reason={w['reason']}{err}")
+        else:
+            print("convergence work: none (fleet converged)")
+        s = out.get("stats", {})
+        print(f"stats: dead={s.get('verdicts_dead', 0)} "
+              f"online={s.get('verdicts_online', 0)} "
+              f"resolves={s.get('resolves', 0)} "
+              f"redeliveries_ok={s.get('redeliveries_ok', 0)} "
+              f"retried={s.get('redeliveries_retried', 0)} "
+              f"parked={s.get('parked', 0)}")
+        return 0
     if sub == "metrics":
         # the same registry GET /metrics serves, fetched over the channel
         # protocol and printed as name{labels} value lines (--json for the
@@ -1428,6 +1468,12 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--ttl", type=float, default=86400.0 * 365,
                    help="lifetime in seconds (default: one year)")
     q = cps.add_parser("status")
+    q = cps.add_parser("heal", help="self-healing status: lease table, "
+                       "pending/parked convergence work "
+                       "(docs/guide/12-self-healing.md)")
+    q.add_argument("verb", choices=["status"])
+    q.add_argument("--json", action="store_true",
+                   help="raw heal.status payload")
     q = cps.add_parser("metrics", help="dump the CP metrics registry "
                        "(the JSON face of GET /metrics)")
     q.add_argument("--json", action="store_true",

@@ -18,7 +18,8 @@ import asyncio
 import itertools
 from typing import Callable, Optional
 
-from ..core.errors import ControlPlaneError
+from ..core.errors import (AgentCommandError, AgentCommandFailed,
+                           AgentUnreachable, ControlPlaneError)
 from ..obs import get_logger, kv
 from ..obs.metrics import REGISTRY
 from .protocol import Connection
@@ -120,8 +121,9 @@ class AgentRegistry:
                 if c is conn:
                     fut = self._pending.get(rid)
                     if fut is not None and not fut.done():
-                        fut.set_exception(ControlPlaneError(
-                            f"agent {slug!r} disconnected mid-command"))
+                        fut.set_exception(AgentUnreachable(
+                            f"agent {slug!r} disconnected mid-command",
+                            reason="disconnected"))
 
     def is_connected(self, slug: str) -> bool:
         return slug in self._agents
@@ -137,12 +139,31 @@ class AgentRegistry:
                            payload: dict | None = None,
                            timeout: float = DEFAULT_TIMEOUT) -> dict:
         """Request/response via the command_result correlation protocol
-        (agent_registry.rs send_command_with_timeout:97-134)."""
+        (agent_registry.rs send_command_with_timeout:97-134).
+
+        Failures are STRUCTURED (core.errors): `AgentUnreachable`
+        (retryable — dead/absent session, timeout, delivery refused; the
+        command may never have arrived) vs `AgentCommandFailed` (fatal —
+        the agent executed it and reported an error). The reconverger and
+        handler callers branch on `.retryable`/type instead of
+        string-matching one opaque exception. Both subclass
+        ControlPlaneError, so pre-existing catch sites keep working."""
         conn = self._agents.get(slug)
         if conn is None:
-            raise ControlPlaneError(f"agent {slug!r} is not connected")
+            _M_COMMAND_ERRORS.inc(reason="not-connected")
+            raise AgentUnreachable(f"agent {slug!r} is not connected",
+                                   reason="not-connected")
         if self.delivery_hook is not None:
-            self.delivery_hook(slug, command)
+            try:
+                self.delivery_hook(slug, command)
+            except AgentCommandError:
+                _M_COMMAND_ERRORS.inc(reason="delivery")
+                raise
+            except ControlPlaneError as e:
+                # hook contract: a raise means "the send failed" — which
+                # is a transport failure, i.e. retryable
+                _M_COMMAND_ERRORS.inc(reason="delivery")
+                raise AgentUnreachable(str(e), reason="delivery") from e
         _M_COMMANDS.inc(command=command)
         request_id = f"req_{next(self._ids)}"
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -154,12 +175,17 @@ class AgentRegistry:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             _M_COMMAND_ERRORS.inc(reason="timeout")
-            raise ControlPlaneError(
+            raise AgentUnreachable(
                 f"agent {slug!r} command {command!r} timed out "
-                f"after {timeout:.0f}s") from None
-        except ControlPlaneError:
-            _M_COMMAND_ERRORS.inc(reason="error")
+                f"after {timeout:.0f}s", reason="timeout") from None
+        except AgentCommandError as e:
+            _M_COMMAND_ERRORS.inc(reason=e.reason)
             raise
+        except ControlPlaneError as e:
+            # a raw send_event failure (socket died under the write) is a
+            # transport failure like any other: classify it retryable
+            _M_COMMAND_ERRORS.inc(reason="send")
+            raise AgentUnreachable(str(e), reason="send") from e
         finally:
             self._pending.pop(request_id, None)
             self._pending_conn.pop(request_id, None)
@@ -173,7 +199,8 @@ class AgentRegistry:
                               payload: dict | None = None) -> None:
         conn = self._agents.get(slug)
         if conn is None:
-            raise ControlPlaneError(f"agent {slug!r} is not connected")
+            raise AgentUnreachable(f"agent {slug!r} is not connected",
+                                   reason="not-connected")
         if self.delivery_hook is not None:
             self.delivery_hook(slug, command)
         _M_COMMANDS.inc(command=command)
@@ -188,7 +215,8 @@ class AgentRegistry:
         if fut is None or fut.done():
             return False
         if payload.get("error"):
-            fut.set_exception(ControlPlaneError(str(payload["error"])))
+            # the agent ran the command and said no: NOT retryable
+            fut.set_exception(AgentCommandFailed(str(payload["error"])))
         else:
             fut.set_result(payload.get("result", payload))
         return True

@@ -224,6 +224,11 @@ class Autoscaler:
                                    ok=False, error="provider delete failed")
             self.state.store.delete("servers", s.id)
             self._last_busy.pop(s.slug, None)
+            detector = getattr(self.state, "failure_detector", None)
+            if detector is not None:
+                # deliberate scale-down: stop tracking the lease (a dead
+                # verdict for a deprovisioned worker would be noise)
+                detector.forget(s.slug)
             self.state.placement.node_event(s.slug, online=False)
             log.info("scaled down %s", kv(pool=pool.name, slug=s.slug))
             return ScaleAction(pool.name, "deprovision", s.slug)

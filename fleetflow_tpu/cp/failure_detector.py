@@ -1,0 +1,260 @@
+"""Lease-based failure detection: missed heartbeats become verdicts.
+
+The CP records every heartbeat (store.heartbeat, fleet_heartbeats_total)
+but nothing ever turned a MISSED heartbeat into a node event — a killed
+agent stranded its services until an operator called placement.node_event
+by hand. This module is the missing half: each agent holds a lease renewed
+by its heartbeats; an expired lease moves the agent through a
+suspect -> dead state machine whose DEAD verdicts the reconverger
+(cp/reconverge.py) turns into coalesced churn re-solves and redeploys.
+Borg makes automatic re-placement after machine failure the defining
+control-plane behavior (Verma et al., EuroSys '15 §3.1); crash-only design
+(Candea & Fox, HotOS '03) wants recovery to be the normal code path — so
+the detector is always on, cheap, and driven by the same sweep whether the
+clock is wall time or the chaos harness's virtual clock.
+
+State machine per agent:
+
+    ALIVE --lease expired / disconnect--> SUSPECT
+    SUSPECT --heartbeat--> ALIVE            (silent revive: no verdict)
+    SUSPECT --grace expired--> DEAD         (verdict: reconverge)
+    DEAD --heartbeat--> ALIVE               (verdict: node online, unpark)
+
+Verdicts are only the DEAD and DEAD->ALIVE transitions — the expensive
+ones, each costing a warm re-solve + redeploy fan-out. SUSPECT is free and
+absorbs fast reconnects (an agent session bounce never reaches the solver).
+
+Flap damping: a bouncing agent (crashlooping host, flapping link) would
+otherwise emit a dead verdict per bounce and trigger a re-solve storm.
+The detector counts verdicts per agent in a rolling window; past
+`flap_threshold` the agent is DAMPED — further dead verdicts are held
+until it has been continuously suspect for `damp_hold_s` (hysteresis: one
+verdict per hold period at most). Revive verdicts are never held: retrying
+parked work against a returned node is cheap and correct.
+
+Thread-safe (heartbeats land on the asyncio loop; sweeps may run on
+executor threads). The clock is injectable and MONOTONIC — wall-clock
+jumps must not kill a fleet (time.monotonic in production, the chaos
+VirtualClock in tests/scenarios).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
+
+log = get_logger("cp.lease")
+
+__all__ = ["LeaseConfig", "LeaseEvent", "FailureDetector",
+           "ALIVE", "SUSPECT", "DEAD"]
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+# metric catalog: docs/guide/10-observability.md
+_M_TRANSITIONS = REGISTRY.counter(
+    "fleet_lease_transitions_total",
+    "Lease state-machine transitions, by target state", labels=("to",))
+_M_AGENTS = REGISTRY.gauge(
+    "fleet_lease_agents", "Agents tracked by the failure detector, by "
+    "lease state", labels=("state",))
+_M_DAMPED = REGISTRY.counter(
+    "fleet_lease_flap_damped_total",
+    "Dead verdicts deferred by flap damping (hysteresis holds)")
+
+
+@dataclass
+class LeaseConfig:
+    """Tuning knobs (docs/guide/12-self-healing.md has the sizing math).
+
+    `lease_s` should be >= 3x the agent heartbeat interval: one lost
+    heartbeat must not start the clock toward a re-solve. The detection
+    budget for a hard-killed node is lease_s + suspect_grace_s (a
+    disconnect fast-paths to SUSPECT, so a crashed session pays only
+    suspect_grace_s)."""
+    lease_s: float = 90.0            # silence this long -> SUSPECT
+    suspect_grace_s: float = 30.0    # suspect this long -> DEAD verdict
+    flap_window_s: float = 600.0     # rolling window for verdict counting
+    flap_threshold: int = 3          # >= verdicts in window -> damped
+    damp_hold_s: float = 180.0       # damped: continuous-suspect hold
+
+
+@dataclass
+class LeaseEvent:
+    """One verdict: `online=False` (DEAD) or `online=True` (revive).
+    `at` is detector-clock time; `state` the new lease state."""
+    slug: str
+    online: bool
+    at: float
+    state: str
+
+
+@dataclass
+class _Lease:
+    deadline: float = 0.0            # heartbeat lease expiry
+    state: str = ALIVE
+    suspect_since: float = 0.0
+    connected: bool = True
+    # verdict timestamps (dead + revive) for flap counting
+    verdicts: deque = field(default_factory=lambda: deque(maxlen=32))
+    damped_logged: bool = False      # one damped log/metric per hold
+
+
+class FailureDetector:
+    def __init__(self, config: Optional[LeaseConfig] = None, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or LeaseConfig()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._leases: dict[str, _Lease] = {}
+        self._pending: list[LeaseEvent] = []   # revives awaiting a sweep
+
+    # ------------------------------------------------------------------
+    # observations (called from the agent channel / registry paths)
+    # ------------------------------------------------------------------
+
+    def observe_heartbeat(self, slug: str) -> None:
+        """Renew the lease. A heartbeat from a SUSPECT agent revives it
+        silently; from a DEAD one it queues a node-online verdict (the
+        reconverger retries parked work against returned capacity)."""
+        now = self.clock()
+        with self._lock:
+            lease = self._leases.get(slug)
+            if lease is None:
+                lease = self._leases[slug] = _Lease()
+                _M_TRANSITIONS.inc(to=ALIVE)
+            lease.deadline = now + self.config.lease_s
+            lease.connected = True
+            if lease.state == ALIVE:
+                return
+            was = lease.state
+            lease.state = ALIVE
+            lease.damped_logged = False
+            _M_TRANSITIONS.inc(to=ALIVE)
+            log.info("agent revived %s", kv(slug=slug, was=was))
+            if was == DEAD:
+                lease.verdicts.append(now)
+                self._pending.append(LeaseEvent(slug, True, now, ALIVE))
+
+    def observe_disconnect(self, slug: str) -> None:
+        """Session gone: fast-path ALIVE -> SUSPECT (the lease no longer
+        means anything — its renewals came over the dead session). A fast
+        reconnect re-heartbeats within the grace and nothing fires."""
+        now = self.clock()
+        with self._lock:
+            lease = self._leases.get(slug)
+            if lease is None:
+                return
+            lease.connected = False
+            if lease.state == ALIVE:
+                lease.state = SUSPECT
+                lease.suspect_since = now
+                _M_TRANSITIONS.inc(to=SUSPECT)
+                log.debug("agent suspect %s", kv(slug=slug,
+                                                 reason="disconnect"))
+
+    def forget(self, slug: str) -> None:
+        """Server deleted/deprovisioned: stop tracking (no verdict — the
+        operator path already ran its own node_event)."""
+        with self._lock:
+            self._leases.pop(slug, None)
+
+    # ------------------------------------------------------------------
+    # the sweep (called by the reconverger loop / chaos runner)
+    # ------------------------------------------------------------------
+
+    def _flapping(self, lease: _Lease, now: float) -> bool:
+        cutoff = now - self.config.flap_window_s
+        return sum(1 for t in lease.verdicts
+                   if t > cutoff) >= self.config.flap_threshold
+
+    def sweep(self) -> list[LeaseEvent]:
+        """Advance every lease against the clock; return the verdicts
+        (DEAD + queued revives) since the last sweep, sorted by slug for
+        deterministic replay."""
+        now = self.clock()
+        cfg = self.config
+        out: list[LeaseEvent] = []
+        with self._lock:
+            out, self._pending = self._pending, []
+            for slug in sorted(self._leases):
+                lease = self._leases[slug]
+                if lease.state == ALIVE and now > lease.deadline:
+                    lease.state = SUSPECT
+                    lease.suspect_since = now
+                    _M_TRANSITIONS.inc(to=SUSPECT)
+                    log.info("agent suspect %s", kv(
+                        slug=slug, reason="lease-expired",
+                        lease_s=cfg.lease_s))
+                if lease.state != SUSPECT:
+                    continue
+                suspect_for = now - lease.suspect_since
+                if suspect_for < cfg.suspect_grace_s:
+                    continue
+                if self._flapping(lease, now) and suspect_for < cfg.damp_hold_s:
+                    if not lease.damped_logged:
+                        lease.damped_logged = True
+                        _M_DAMPED.inc()
+                        log.warning("dead verdict damped %s", kv(
+                            slug=slug, hold_s=cfg.damp_hold_s,
+                            window_s=cfg.flap_window_s))
+                    continue
+                lease.state = DEAD
+                lease.damped_logged = False
+                lease.verdicts.append(now)
+                _M_TRANSITIONS.inc(to=DEAD)
+                log.warning("agent dead %s", kv(
+                    slug=slug, suspect_for_s=round(suspect_for, 1)))
+                out.append(LeaseEvent(slug, False, now, DEAD))
+            counts = {ALIVE: 0, SUSPECT: 0, DEAD: 0}
+            for lease in self._leases.values():
+                counts[lease.state] += 1
+            for state, n in counts.items():
+                _M_AGENTS.set(n, state=state)
+        out.sort(key=lambda e: e.slug)
+        return out
+
+    def requeue(self, events: list[LeaseEvent]) -> None:
+        """The reconverger failed to process these verdicts (e.g. the
+        re-solve burst crashed): put them back so the next sweep hands
+        them out again — a verdict must never be silently lost."""
+        with self._lock:
+            self._pending.extend(events)
+
+    # ------------------------------------------------------------------
+    # introspection (fleet cp heal status)
+    # ------------------------------------------------------------------
+
+    def state_of(self, slug: str) -> Optional[str]:
+        with self._lock:
+            lease = self._leases.get(slug)
+            return lease.state if lease else None
+
+    def status(self) -> dict:
+        now = self.clock()
+        with self._lock:
+            agents = {}
+            for slug in sorted(self._leases):
+                lease = self._leases[slug]
+                agents[slug] = {
+                    "state": lease.state,
+                    "connected": lease.connected,
+                    "lease_remaining_s": round(lease.deadline - now, 3),
+                    "recent_verdicts": len(lease.verdicts),
+                    "damped": (lease.state == SUSPECT
+                               and self._flapping(lease, now)),
+                }
+            return {"config": {
+                        "lease_s": self.config.lease_s,
+                        "suspect_grace_s": self.config.suspect_grace_s,
+                        "flap_window_s": self.config.flap_window_s,
+                        "flap_threshold": self.config.flap_threshold,
+                        "damp_hold_s": self.config.damp_hold_s},
+                    "agents": agents}

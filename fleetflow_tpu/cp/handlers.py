@@ -103,7 +103,7 @@ def _require(payload: dict, *keys: str) -> list:
 _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "logs.live", "show", "snapshots", "ps", "pool.list",
-    "user.list", "ping", "reservations", "metrics",
+    "user.list", "ping", "reservations", "metrics", "heal.status",
 })
 def _timed(channel: str, handler):
     """Wrap a channel handler with the request-latency histogram + error
@@ -356,6 +356,9 @@ def _server(state: "AppState"):
                 state.agent_registry.unregister(s.slug)
                 if live is not None:
                     await live.close()
+                if state.failure_detector is not None:
+                    # deliberate removal, not a failure: no dead verdict
+                    state.failure_detector.forget(s.slug)
             return {"deleted": bool(s and db.delete("servers", s.id))}
         if method in ("cordon", "uncordon", "drain"):
             s = db.server_by_slug(p.get("slug", ""))
@@ -517,6 +520,12 @@ def _health(state: "AppState"):
             # the same registry the daemon's GET /metrics serves, in JSON
             # (the channel face for `fleet cp metrics` / MCP consumers)
             return {"metrics": REGISTRY.snapshot()}
+        if method == "heal.status":
+            # self-healing introspection (`fleet cp heal status`): lease
+            # table, pending/parked convergence work, pass counters
+            if state.reconverger is None:
+                return {"enabled": False}
+            return {"enabled": True, **state.reconverger.status()}
         raise ValueError(f"unknown method health.{method}")
     return handle
 
@@ -1067,6 +1076,8 @@ def _agent(state: "AppState"):
             registered[id(conn)] = slug
             db.register_server(slug, hostname=p.get("hostname", slug))
             db.heartbeat(slug, version=p.get("version", ""))
+            if state.failure_detector is not None:
+                state.failure_detector.observe_heartbeat(slug)
             if "capacity" in p:
                 s = db.server_by_slug(slug)
                 db.update("servers", s.id,
@@ -1078,6 +1089,8 @@ def _agent(state: "AppState"):
         slug = registered[id(conn)]
         if method == "heartbeat":
             db.heartbeat(slug, version=p.get("version", ""))
+            if state.failure_detector is not None:
+                state.failure_detector.observe_heartbeat(slug)
             return {"ok": True}
         raise ValueError(f"unknown method agent.{method}")
 
@@ -1092,6 +1105,8 @@ def _agent(state: "AppState"):
             return  # events from unregistered connections are dropped
         if method == "heartbeat":
             db.heartbeat(slug, version=p.get("version", ""))
+            if state.failure_detector is not None:
+                state.failure_detector.observe_heartbeat(slug)
         elif method == "alert":
             kind = p.get("kind", "unknown")
             if p.get("resolved"):
@@ -1132,4 +1147,9 @@ def _on_disconnect(state: "AppState"):
                 s = state.store.server_by_slug(slug)
                 if s is not None:
                     state.store.update("servers", s.id, status="offline")
+                if state.failure_detector is not None:
+                    # fast-path ALIVE -> SUSPECT: the lease's renewals came
+                    # over this (now dead) session. The grace window still
+                    # absorbs a quick reconnect before any verdict fires.
+                    state.failure_detector.observe_disconnect(slug)
     return on_disconnect

@@ -27,7 +27,7 @@ __all__ = [
     "DesiredState", "ServerLabelsRec", "ServerCapacity", "ServerAllocated",
     "Server", "WorkerPool", "DeploymentStatus", "Deployment", "AlertKind",
     "Alert", "ObservedContainer", "VolumeRecord", "VolumeSnapshot",
-    "BuildStatus", "BuildJob", "CostEntry", "DnsRecord",
+    "BuildStatus", "BuildJob", "CostEntry", "DnsRecord", "ParkedWork",
 ]
 
 
@@ -245,6 +245,20 @@ class WorkerPool(Record):
     preferred_labels: dict[str, str] = field(default_factory=dict)
     min_servers: int = 0
     max_servers: int = 0
+
+
+@dataclass
+class ParkedWork(Record):
+    """Self-healing backlog entry (cp/reconverge.py): a stage the
+    reconverger could not converge yet. `parked=True` means blocked on
+    capacity (infeasible re-solve, exhausted retries) and retried on the
+    next node-online verdict; `parked=False` is in-flight redelivery work
+    persisted so a CP restart resumes it instead of forgetting it."""
+    stage_key: str = ""              # "{project}/{stage}"
+    reason: str = ""                 # infeasible|retries-exhausted|...
+    parked: bool = True
+    attempt: int = 0
+    detail: str = ""
 
 
 # --------------------------------------------------------------------------

@@ -28,10 +28,22 @@ import numpy as np
 
 from ..core.model import Flow, ResourceSpec, ServerLabels, ServerResource
 from ..lower.tensors import ProblemTensors, lower_stage
+from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
 from ..sched import (HostGreedyScheduler, Placement, TpuSolverScheduler,
                      place_with_fallback)
 from .models import Server
 from .store import Store
+
+log = get_logger("cp.placement")
+
+# metric catalog: docs/guide/10-observability.md. Churn re-solves that had
+# to abandon the device solver (exception/timeout) for the greedy host
+# path — self-healing must degrade, never stall (cp/reconverge.py).
+_M_CHURN_FALLBACKS = REGISTRY.counter(
+    "fleet_placement_churn_fallbacks_total",
+    "Churn re-solves that fell back to the greedy host scheduler after a "
+    "solver failure")
 
 __all__ = ["PlacementService", "Reservation"]
 
@@ -265,6 +277,31 @@ class PlacementService:
                         return True
             return False
 
+    def commit_retained(self, stage_key: str) -> bool:
+        """Adopt the stage's retained placement as its committed allocation
+        — the reconverger's commit path (cp/reconverge.py): a churn
+        re-solve's assignment was actually redeployed to the surviving
+        agents, so the churn hold graduates to the commitment, superseding
+        the pre-churn one (same supersede semantics as commit())."""
+        with self._lock:
+            entry = self._last.get(stage_key)
+            if entry is None:
+                return False
+            pt, placement = entry
+            if not placement.feasible:
+                return False
+            r = Reservation(
+                id=f"rsv_{next(self._ids)}", stage_key=stage_key,
+                demand_by_node=self._demand_by_node(pt, placement),
+                assignment=dict(placement.assignment), committed=True)
+            prev = self._committed.pop(stage_key, None)
+            if prev is not None:
+                self._apply_allocation(prev, -1.0)
+            self._apply_allocation(r, +1.0)
+            self._committed[stage_key] = r
+            self._drop_churn(stage_key)
+            return True
+
     def release_stage(self, stage_key: str) -> bool:
         """Stage torn down (`fleet down` on a remote stage): return its
         committed capacity."""
@@ -479,14 +516,28 @@ class PlacementService:
                 # services are the ones being re-placed) and substituting
                 # burst-mates' already-re-solved positions.
                 pt = self._refresh_capacity(pt, key, overrides, server_map)
-                if self.use_tpu:
-                    new = self._sched_tpu.reschedule(pt)
-                else:
+                degraded = False
+                try:
+                    if self.use_tpu:
+                        new = self._sched_tpu.reschedule(pt)
+                    else:
+                        new = self._sched_host.place(pt)
+                except Exception as e:
+                    # graceful degradation: a churn re-solve is on the
+                    # self-healing critical path — a solver crash/timeout
+                    # must cost solution quality, not convergence. The
+                    # greedy host path solves the same tensors.
+                    _M_CHURN_FALLBACKS.inc()
+                    degraded = True
+                    log.error("churn solve failed; greedy host fallback %s",
+                              kv(stage=key, error=e))
                     new = self._sched_host.place(pt)
                 if not new.feasible and pt.relax_order:
                     # a stage placed via declared relaxation must keep its
-                    # relaxation through churn re-solves
-                    sched = self._sched_tpu if self.use_tpu else self._sched_host
+                    # relaxation through churn re-solves (and a crashed
+                    # device solver stays benched for the ladder too)
+                    sched = (self._sched_host if degraded or not self.use_tpu
+                             else self._sched_tpu)
                     new, _ = place_with_fallback(sched, pt, initial=new)
                 self._last[key] = (pt, new)
                 if new.feasible:

@@ -14,9 +14,11 @@ from typing import Callable, Optional
 
 from .agent_registry import AgentRegistry
 from .auth import Claims, NoAuth, make_provider
+from .failure_detector import FailureDetector, LeaseConfig
 from .log_router import LogRouter
 from .placement import PlacementService
 from .protocol import ProtocolServer
+from .reconverge import ReconvergeConfig, Reconverger
 from .store import Store
 from ..obs import get_logger, kv
 
@@ -40,6 +42,16 @@ class ServerConfig:
     tls_dir: Optional[str] = None      # mesh-CA dir; None = plaintext
     use_tpu_solver: bool = False
     master_key_env: bool = False       # load SecretBox from env
+    # self-healing (cp/failure_detector.py + cp/reconverge.py): lease-
+    # based failure detection driving automatic re-solve + redeploy.
+    # Tuning guidance: docs/guide/12-self-healing.md
+    self_heal: bool = True
+    lease_s: float = 90.0
+    suspect_grace_s: float = 30.0
+    heal_interval_s: float = 5.0
+    heal_backoff_base_s: float = 2.0
+    heal_backoff_max_s: float = 60.0
+    heal_max_attempts: int = 5
 
 
 @dataclass
@@ -65,6 +77,11 @@ class AppState:
     # runner (chaos/injector.py); None in production. An extension point:
     # anything holding AppState can consult the active fault set.
     chaos: Optional[object] = None
+    # self-healing pair (None when self_heal is off): the lease-based
+    # failure detector fed by agent heartbeats/disconnects, and the
+    # reconverger that turns its verdicts into re-solves + redeploys
+    failure_detector: Optional[FailureDetector] = None
+    reconverger: Optional[Reconverger] = None
     # {"issuer", "client_id", "audience"} when the CP runs JwksAuth with a
     # device-flow-capable IdP; the dashboard's browser login uses it
     auth_idp: Optional[dict] = None
@@ -84,6 +101,8 @@ class CpServerHandle:
         return self.ca.ca_pem if self.ca else None
 
     async def stop(self) -> None:
+        if self.state.reconverger is not None:
+            self.state.reconverger.stop()
         await self.server.stop()
         self.state.store.flush()
 
@@ -171,6 +190,22 @@ async def start(config: ServerConfig, *,
         ca = ensure_mesh_ca(config.tls_dir)
         ssl_ctx = server_ssl_context(ca, common_name=config.name,
                                      work_dir=config.tls_dir)
+
+    if config.self_heal:
+        state.failure_detector = FailureDetector(LeaseConfig(
+            lease_s=config.lease_s,
+            suspect_grace_s=config.suspect_grace_s))
+        state.reconverger = Reconverger(
+            state, state.failure_detector,
+            config=ReconvergeConfig(
+                interval_s=config.heal_interval_s,
+                backoff_base_s=config.heal_backoff_base_s,
+                backoff_max_s=config.heal_backoff_max_s,
+                max_attempts=config.heal_max_attempts))
+        # a restarted CP picks its convergence debt back up BEFORE any
+        # agent reconnects (crash-only: recovery is the boot path)
+        state.reconverger.resume()
+        state.reconverger.spawn()
 
     server = ProtocolServer(name=config.name, authenticate=authenticate,
                             ssl_context=ssl_ctx)

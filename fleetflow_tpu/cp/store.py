@@ -39,8 +39,8 @@ from pathlib import Path
 from typing import Callable, Optional, TypeVar
 
 from .models import (Alert, BuildJob, CostEntry, Deployment, DeploymentStatus,
-                     DnsRecord, ObservedContainer, Project, Record, Server,
-                     ServiceRecord, StageRecord, Tenant, TenantUser,
+                     DnsRecord, ObservedContainer, ParkedWork, Project, Record,
+                     Server, ServiceRecord, StageRecord, Tenant, TenantUser,
                      VolumeRecord, VolumeSnapshot, WorkerPool, new_id, now_ts)
 from ..obs.metrics import REGISTRY
 
@@ -70,6 +70,7 @@ _TABLES: dict[str, type] = {
     "observed_containers": ObservedContainer, "volumes": VolumeRecord,
     "volume_snapshots": VolumeSnapshot, "build_jobs": BuildJob,
     "cost_entries": CostEntry, "dns_records": DnsRecord,
+    "parked_work": ParkedWork,
 }
 
 

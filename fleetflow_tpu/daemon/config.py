@@ -41,6 +41,12 @@ class DaemonConfig:
     heartbeat_stale_s: float = 90.0
     autoscale_interval_s: float = 0.0      # 0 = autoscaler off
     use_tpu_solver: bool = False
+    # self-healing (docs/guide/12-self-healing.md): lease-based failure
+    # detection + automatic re-solve/redeploy of a dead node's services
+    self_heal: bool = True
+    lease_s: float = 90.0
+    suspect_grace_s: float = 30.0
+    heal_interval_s: float = 5.0
     source: Optional[str] = None
 
     def expand(self) -> "DaemonConfig":
@@ -123,3 +129,17 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.autoscale_interval_s = float(v)
         elif n in ("tpu-solver", "use-tpu-solver"):
             cfg.use_tpu_solver = _truthy(v, node)
+        elif n == "self-heal":
+            # `self-heal false` disables; props tune the lease machinery:
+            # `self-heal lease=90 grace=30 interval=5`
+            if v is not None:
+                cfg.self_heal = _truthy(v, node)
+            lease = node.prop("lease")
+            if lease is not None:
+                cfg.lease_s = float(lease)
+            grace = node.prop("grace")
+            if grace is not None:
+                cfg.suspect_grace_s = float(grace)
+            interval = node.prop("interval")
+            if interval is not None:
+                cfg.heal_interval_s = float(interval)

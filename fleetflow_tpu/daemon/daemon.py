@@ -45,7 +45,10 @@ class Daemon:
             auth_issuer=cfg.auth_issuer, auth_audience=cfg.auth_audience,
             auth_client_id=cfg.auth_client_id,
             tls_dir=cfg.tls_dir,
-            use_tpu_solver=cfg.use_tpu_solver))
+            use_tpu_solver=cfg.use_tpu_solver,
+            self_heal=cfg.self_heal, lease_s=cfg.lease_s,
+            suspect_grace_s=cfg.suspect_grace_s,
+            heal_interval_s=cfg.heal_interval_s))
         if cfg.web_enabled:
             self.web = WebServer(self.cp.state)
             self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)

@@ -248,8 +248,15 @@ class DeployEngine:
 
         my_node = req.node or LOCAL_NODE_NAME
         node_names = set(placement.assignment.values())
-        if my_node not in node_names and len(node_names) == 1:
-            my_node = next(iter(node_names))  # single-node: execute it all
+        if (req.node is None and my_node not in node_names
+                and len(node_names) == 1):
+            # LOCAL execution against a placement solved under a different
+            # (synthetic) node name: execute it all. Never for agents —
+            # req.node is this agent's identity, and a single-node
+            # assignment to ANOTHER node means this node's slice is empty
+            # (the CP fans deploy.execute to every stage server; without
+            # this guard each of them would run a full copy)
+            my_node = next(iter(node_names))
         levels = placement.node_levels(my_node)
 
         # replica rows ("web#0") collapse back to their base service for

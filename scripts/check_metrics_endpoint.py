@@ -39,6 +39,9 @@ REQUIRED = (
     "fleet_agents_connected",           # CP agent registry
     "fleet_cp_request_duration_seconds",  # CP handlers
     "fleet_agent_anomalies_total",      # agent monitor
+    "fleet_lease_transitions_total",    # CP failure detector
+    "fleet_reconverge_redeliveries_total",  # CP reconverger
+    "fleet_agent_send_failures_total",  # agent session loops
 )
 
 _SAMPLE = re.compile(
@@ -49,6 +52,7 @@ _SAMPLE = re.compile(
 def scrape() -> str:
     # import the full instrumented surface so the exposition is complete
     # regardless of which subsystems the web server pulls in transitively
+    import fleetflow_tpu.agent.agent      # noqa: F401
     import fleetflow_tpu.agent.monitor    # noqa: F401
     import fleetflow_tpu.solver.api       # noqa: F401
     from fleetflow_tpu.cp.server import ServerConfig, start

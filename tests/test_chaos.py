@@ -86,6 +86,27 @@ class TestSmoke:
         c = run_scenario("rolling-kill", seed=12, **SMOKE)
         assert c.digest() != a.digest()
 
+    def test_rolling_kill_selfheal_smoke(self):
+        """SILENT kills: the runner never calls node_events or redeploys
+        — detection (lease expiry) and recovery (reconverger redelivery)
+        are entirely the CP's own doing, judged by the selfheal-converged
+        liveness invariant."""
+        r = run_scenario("rolling-kill-selfheal", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        assert r.stats["heals"] > 0
+        events = {e["event"] for e in r.events}
+        assert "heal-dead" in events        # lease verdicts fired
+        assert "heal-redeliver" in events   # assignments actually driven
+        assert "heal-online" in events      # revival unpark path exercised
+
+    def test_selfheal_same_seed_same_digest(self):
+        """The heal pass (detector sweeps, backoff jitter, redeliveries)
+        stays inside the deterministic-replay contract."""
+        a = run_scenario("rolling-kill-selfheal", seed=11, **SMOKE)
+        b = run_scenario("rolling-kill-selfheal", seed=11, **SMOKE)
+        assert a.events == b.events
+        assert a.digest() == b.digest()
+
 
 @pytest.mark.slow
 class TestFullPack:
@@ -164,6 +185,33 @@ class TestInvariantCanaries:
         backend.set_state(name, "exited")
         found = containers_converged(w)
         assert found and "exited" in found[0]
+
+    def test_selfheal_converged_fires_on_unparked_dead_assignment(self):
+        from fleetflow_tpu.chaos.invariants import selfheal_converged
+        from fleetflow_tpu.cp.reconverge import _Work
+        w = _world()
+        assert selfheal_converged(w) == []
+        key = w.stage_keys[0]
+        node = sorted(w.state.placement.snapshot()[key]
+                      ["assignment"].values())[0]
+        s = w.state.store.server_by_slug(node)
+        w.state.store.update("servers", s.id, status="offline")
+        found = selfheal_converged(w)
+        assert found and "not parked" in found[0]
+        # parking is the reconverger's EXPLICIT capacity admission — a
+        # parked stage is excluded from the liveness demand
+        w.state.reconverger._park(
+            _Work(stage_key=key, idempotency_key="k", trace_id="t"),
+            "infeasible")
+        assert all(key not in v for v in selfheal_converged(w))
+
+    def test_selfheal_converged_fires_on_leftover_redelivery_debt(self):
+        from fleetflow_tpu.chaos.invariants import selfheal_converged
+        w = _world()
+        assert selfheal_converged(w) == []
+        w.state.reconverger._enqueue("chaosfleet/app0", "tr")
+        found = selfheal_converged(w)
+        assert found and "redelivery debt" in found[0]
 
     def test_metrics_monotonic_fires_on_counter_decrease(self):
         from fleetflow_tpu.obs.metrics import REGISTRY

@@ -89,6 +89,22 @@ tpu-solver #true
         with pytest.raises(FileNotFoundError):
             load_daemon_config(str(tmp_path / "nope.kdl"))
 
+    def test_self_heal_knobs(self, tmp_path):
+        p = tmp_path / "fleetflowd.kdl"
+        p.write_text('self-heal #true lease=45 grace=10 interval=2\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.self_heal is True
+        assert cfg.lease_s == 45.0
+        assert cfg.suspect_grace_s == 10.0
+        assert cfg.heal_interval_s == 2.0
+        p.write_text('self-heal #false\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.self_heal is False
+        # on by default with the documented production timings
+        p.write_text('listen "127.0.0.1" 4510\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.self_heal is True and cfg.lease_s == 90.0
+
 
 class TestConfigPositional:
     def test_listen_and_web_positional_args(self, tmp_path, monkeypatch):
