@@ -52,6 +52,11 @@ _M_IDEM_REPLAYS = REGISTRY.counter(
     "fleet_agent_idempotent_replays_total",
     "Commands answered from the idempotency dedupe window instead of "
     "re-executing (CP redelivery after reconnect/timeout)")
+_M_FENCED = REGISTRY.counter(
+    "fleet_replication_fencing_rejections_total",
+    "Stale-epoch writes refused after a failover, by side (store: "
+    "replicated entries from a zombie ex-primary; cp: rejected "
+    "replication RPCs; agent: fenced agent commands)", labels=("side",))
 
 
 @dataclass
@@ -59,6 +64,13 @@ class AgentConfig:
     """fleet-agent main.rs:40 flags."""
     cp_host: str = "127.0.0.1"
     cp_port: int = 4510
+    # replicated control plane (docs/guide/13-cp-replication.md): every
+    # CP endpoint, primary first. The reconnect loop rotates through
+    # them, so when the primary dies the agent re-homes to whichever
+    # standby promoted — a standby refuses registration until then,
+    # which reads as a failed session and advances the rotation.
+    cp_endpoints: list = field(default_factory=list)  # [(host, port), ...]
+    reconnect_backoff_s: Optional[float] = None   # None = module default
     slug: str = "node"
     token: Optional[str] = None
     ca_pem: Optional[bytes] = None
@@ -105,49 +117,88 @@ class Agent:
         # slow deploy) awaits it instead of running a second copy.
         self._idem: dict[str, tuple[float, dict]] = {}
         self._idem_inflight: dict[str, asyncio.Future] = {}
+        # highest controller epoch this agent has ever seen (welcome
+        # frames + command envelopes). Monotonic: a command or session
+        # from a LOWER epoch comes from a zombie ex-primary and is
+        # refused — the fencing half of CP failover.
+        self._max_epoch = 0
+        self._endpoint_idx = 0
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
+    def _endpoints(self) -> list[tuple[str, int]]:
+        return (list(self.config.cp_endpoints)
+                or [(self.config.cp_host, self.config.cp_port)])
+
+    @property
+    def _backoff_s(self) -> float:
+        # read the module attr at call time: tests (and embedders) tune
+        # RECONNECT_BACKOFF_S globally
+        if self.config.reconnect_backoff_s is not None:
+            return self.config.reconnect_backoff_s
+        return RECONNECT_BACKOFF_S
+
     async def run(self) -> None:
-        """Outer reconnect loop (agent.rs:30-45)."""
+        """Outer reconnect loop (agent.rs:30-45), rotating through every
+        configured CP endpoint so a primary failover re-homes the agent
+        to the promoted standby without operator help."""
         while not self._stop.is_set():
+            endpoints = self._endpoints()
+            host, port = endpoints[self._endpoint_idx % len(endpoints)]
             try:
-                await self.run_session()
+                await self.run_session(host, port)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
                 # any session failure (refused socket, auth reject -> RpcError,
-                # garbage frame -> JSONDecodeError, register timeout) means
-                # "retry after backoff", never "die" (agent.rs:34-45)
+                # standby's not-primary refusal, garbage frame, register
+                # timeout) means "try the next endpoint after backoff",
+                # never "die" (agent.rs:34-45)
                 log.warning("session lost %s", kv(
-                    slug=self.config.slug, error=e,
-                    retry_in_s=RECONNECT_BACKOFF_S))
+                    slug=self.config.slug, cp=f"{host}:{port}", error=e,
+                    retry_in_s=self._backoff_s))
+            self._endpoint_idx += 1
             if self._stop.is_set():
                 break
             try:
-                await asyncio.wait_for(self._stop.wait(), RECONNECT_BACKOFF_S)
+                await asyncio.wait_for(self._stop.wait(), self._backoff_s)
             except asyncio.TimeoutError:
                 pass
 
     def stop(self) -> None:
         self._stop.set()
 
-    async def run_session(self) -> None:
+    async def run_session(self, host: Optional[str] = None,
+                          port: Optional[int] = None) -> None:
         """One connected session (agent.rs run_session:87)."""
+        host = host if host is not None else self.config.cp_host
+        port = port if port is not None else self.config.cp_port
         ssl_ctx: Optional[ssl.SSLContext] = None
         if self.config.ca_pem:
             from ..cp.cert import client_ssl_context
             ssl_ctx = client_ssl_context(self.config.ca_pem)
 
         conn, run_task = await ProtocolClient.connect(
-            self.config.cp_host, self.config.cp_port,
+            host, port,
             identity=self.config.slug, token=self.config.token,
             ssl_context=ssl_ctx,
             event_handlers={"agent": self._on_command})
         self.conn = conn
         try:
+            # fencing gate: a CP advertising an OLDER epoch than this
+            # agent has seen is a zombie ex-primary — refuse the session
+            # and let the rotation find the real primary
+            welcome_epoch = conn.welcome.get("epoch")
+            if welcome_epoch is not None:
+                if int(welcome_epoch) < self._max_epoch:
+                    _M_FENCED.inc(side="agent")
+                    raise RuntimeError(
+                        f"CP {host}:{port} has stale epoch "
+                        f"{welcome_epoch} < {self._max_epoch}: zombie "
+                        f"ex-primary, refusing to register")
+                self._max_epoch = max(self._max_epoch, int(welcome_epoch))
             await conn.request("agent", "register", {
                 "slug": self.config.slug,
                 "hostname": self.config.slug,
@@ -244,6 +295,26 @@ class Agent:
         are cached; a failed command re-executes on redelivery."""
         request_id = envelope.get("request_id")
         payload = envelope.get("payload", {})
+        epoch = envelope.get("epoch")
+        if epoch is not None:
+            if int(epoch) < self._max_epoch:
+                # zombie ex-primary driving a stale command: refuse it
+                # loudly — the error rides back so the sender knows it
+                # has been fenced (docs/guide/13-cp-replication.md)
+                _M_FENCED.inc(side="agent")
+                log.warning("fenced stale command %s", kv(
+                    method=method, epoch=epoch, seen=self._max_epoch,
+                    slug=self.config.slug))
+                if request_id:
+                    try:
+                        await conn.send_event("agent", "command_result", {
+                            "request_id": request_id,
+                            "error": f"fenced: controller epoch {epoch} < "
+                                     f"{self._max_epoch}"})
+                    except Exception:
+                        pass
+                return
+            self._max_epoch = max(self._max_epoch, int(epoch))
         idem_key = (payload.get("idempotency_key")
                     if isinstance(payload, dict) else None)
         log.debug("command %s", kv(method=method, request_id=request_id,

@@ -32,7 +32,7 @@ from typing import Optional
 __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
-    "SilentNodeCrash", "Tick", "FaultSchedule",
+    "SilentNodeCrash", "Tick", "PrimaryKill", "FaultSchedule",
 ]
 
 # primitive ops the runner executes (the fault algebra's normal form)
@@ -49,6 +49,7 @@ ARM_DEPLOY_FAIL = "arm_deploy_fail"
 CONTAINER_EXIT = "container_exit"
 WORKER_KILL = "worker_kill"
 REDEPLOY = "redeploy"
+CP_KILL = "cp_kill"
 
 
 @dataclass(frozen=True)
@@ -177,6 +178,31 @@ class Redeploy(Fault):
 
     def expand(self):
         return [(self.at, REDEPLOY, {"stage": self.stage})]
+
+
+@dataclass(frozen=True)
+class PrimaryKill(Fault):
+    """Kill the control-plane PRIMARY itself (cp-failover scenario,
+    docs/guide/13-cp-replication.md): the warm standby — fed by the
+    store's replication stream — must promote (epoch bump, fencing),
+    resume the dead primary's convergence debt, and re-home the agents.
+    `phase` picks the crash window:
+
+      burst       die in the same instant nodes are dying silently — the
+                  verdicts exist nowhere yet; the new primary must
+                  re-detect through its primed leases
+      redelivery  die BETWEEN enqueuing redelivery work and delivering
+                  it — the parked_work rows are on the standby via
+                  replication, and the new primary must finish exactly
+                  once
+      compaction  force a journal compaction (snapshot + truncate), then
+                  die — proving the shipped stream and the local journal
+                  lifecycle are independent
+    """
+    phase: str = "burst"     # burst | redelivery | compaction
+
+    def expand(self):
+        return [(self.at, CP_KILL, {"phase": self.phase})]
 
 
 @dataclass

@@ -21,7 +21,8 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "check_final", "capacity_accounting", "reservations_terminal",
            "no_dead_assignments", "pools_at_min", "solver_feasible",
            "containers_converged", "metrics_monotonic",
-           "agents_gauge_consistent", "selfheal_converged"]
+           "agents_gauge_consistent", "selfheal_converged",
+           "cp_failover_converged"]
 
 _EPS = 1e-6
 
@@ -192,6 +193,57 @@ def selfheal_converged(world, snapshot=None) -> list[str]:
     return out
 
 
+def cp_failover_converged(world, snapshot=None) -> list[str]:
+    """Control-plane failover safety (docs/guide/13-cp-replication.md):
+    after every primary kill + settle, nothing the dead primary owed the
+    fleet may be lost. Concretely:
+
+      * the fencing epoch advanced exactly once per failover, and every
+        zombie write from a dead primary was refused (fenced);
+      * every convergence-debt row (parked_work) the dead primary had
+        persisted either converged under the new primary or is still
+        explicitly parked — never silently dropped;
+      * no idempotency-keyed redelivery executed more than once on any
+        agent — the dedupe windows survived the re-home.
+
+    Liveness (every non-parked service on a live node, zero redelivery
+    debt) is judged by `selfheal-converged` against the SAME world — the
+    promoted primary simply has to pass the standard bar."""
+    failovers = getattr(world, "cp_failovers", 0)
+    if not failovers:
+        return []
+    out: list[str] = []
+    epoch = world.state.store.epoch
+    if epoch != 1 + failovers:
+        out.append(f"fencing epoch {epoch} after {failovers} failovers "
+                   f"(expected {1 + failovers}): a promotion skipped or "
+                   f"repeated its epoch bump")
+    if world.fencing_rejections < failovers:
+        out.append(f"only {world.fencing_rejections} fenced zombie writes "
+                   f"for {failovers} failovers: a dead primary wrote "
+                   f"through the fence")
+    rc = getattr(world.state, "reconverger", None)
+    parked_now = set(rc.parked_stage_keys()) if rc is not None else set()
+    if snapshot is None:
+        snapshot = world.state.placement.snapshot()
+    by_slug = {s.slug: s for s in world.state.store.list("servers")}
+    for key, _was_parked in sorted(world.prekill_work):
+        if key in parked_now:
+            continue
+        view = snapshot.get(key)
+        converged = (view is not None and view["feasible"] and all(
+            by_slug.get(n) is not None and by_slug[n].schedulable
+            for n in view["assignment"].values()))
+        if not converged:
+            out.append(f"convergence debt for {key} lost across failover: "
+                       f"neither converged nor parked on the new primary")
+    for _key, (stage, runs) in sorted(world.idem_executions.items()):
+        if runs > 1:
+            out.append(f"idempotency window lost: a keyed redelivery for "
+                       f"{stage} executed {runs} times")
+    return out
+
+
 def metrics_monotonic(world) -> list[str]:
     """Counters never decrease across the run. The metrics registry is the
     operator's ground truth for rates and totals; a counter that went DOWN
@@ -239,6 +291,7 @@ FINAL_INVARIANTS = {
     "solver-feasible": solver_feasible,
     "containers-converged": containers_converged,
     "selfheal-converged": selfheal_converged,
+    "cp-failover-converged": cp_failover_converged,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,
 }
@@ -258,7 +311,7 @@ def check_final(world) -> list[str]:
     for name, fn in FINAL_INVARIANTS.items():
         found = (fn(world, snapshot=snap)
                  if fn in (no_dead_assignments, containers_converged,
-                           selfheal_converged)
+                           selfheal_converged, cp_failover_converged)
                  else fn(world))
         out.extend(f"[{name}] {v}" for v in found)
     return out

@@ -29,7 +29,9 @@ import asyncio
 import hashlib
 import json
 import random
+import tempfile
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Optional
 
 from ..core.model import (Flow, ResourceSpec, Service, Stage)
@@ -41,8 +43,9 @@ from ..cp.log_router import LogRouter
 from ..cp.models import ServerCapacity, WorkerPool
 from ..cp.placement import PlacementService
 from ..cp.reconverge import ReconvergeConfig, Reconverger
+from ..cp.replication import StandbyReplica
 from ..cp.server import AppState
-from ..cp.store import Store
+from ..cp.store import ReplicationFenced, Store
 from ..core.errors import ControlPlaneError
 from ..runtime.backend import MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
@@ -161,6 +164,11 @@ class SimAgent:
         # that need op-level (pull/create/start) injection
         self.backend = MockBackend(auto_pull=True)
         self.conn = SimConnection(self)
+        # idempotency dedupe window (the agent/agent.py semantics): a
+        # replayed key answers from the cache instead of re-executing.
+        # Survives CP failover — the agent process outlives its CP — but
+        # not a node crash (world.connect builds a fresh SimAgent).
+        self.idem: dict[str, dict] = {}
 
     async def on_command(self, method: str, payload: dict) -> None:
         request_id = payload.get("request_id")
@@ -183,6 +191,11 @@ class SimAgent:
             req = DeployRequest.from_dict(payload["request"])
             if not req.node:
                 req.node = self.slug
+            key = payload.get("idempotency_key")
+            if key and key in self.idem:
+                self.world.log("idem-replay", node=self.slug,
+                               stage=req.stage_name)
+                return self.idem[key]
             placement = self.world.cp_placement(req, payload.get("assignment"))
             engine = DeployEngine(
                 self.backend, sleep=self.world.clock.advance,
@@ -190,7 +203,17 @@ class SimAgent:
             res = engine.execute(req, placement=placement)
             if not res.ok:
                 raise RuntimeError(f"failed services: {sorted(res.failed)}")
-            return {"deployed": res.deployed, "removed": res.removed}
+            result = {"deployed": res.deployed, "removed": res.removed}
+            if key:
+                self.idem[key] = result
+                # execution census for the cp-failover-converged
+                # invariant: a key executing twice ON ONE AGENT means a
+                # dedupe window was lost across a failover (one key
+                # legitimately fans out to several nodes)
+                rec = self.world.idem_executions.setdefault(
+                    f"{key}@{self.slug}", [req.stage_name, 0])
+                rec[1] += 1
+            return result
         if method == "deploy.down":
             req = DeployRequest.from_dict(payload["request"])
             engine = DeployEngine(self.backend, sleep=self.world.clock.advance)
@@ -238,33 +261,37 @@ class ChaosWorld:
     """The simulated fleet: AppState + per-node agents/backends +
     virtual clock + causally-ordered event log."""
 
+    LEASE = dict(lease_s=60.0, suspect_grace_s=30.0, flap_window_s=300.0,
+                 flap_threshold=3, damp_hold_s=120.0)
+    RECONV = dict(backoff_base_s=5.0, backoff_max_s=60.0, max_attempts=5)
+
     def __init__(self, flow: Flow, injector: FaultInjector,
-                 clock: VirtualClock, pool_min: int = 0, seed: int = 0):
+                 clock: VirtualClock, pool_min: int = 0, seed: int = 0,
+                 replicated: bool = False,
+                 store_dir: Optional[Path] = None):
         self.flow = flow
         self.clock = clock
         self.injector = injector
+        self._seed = seed
         injector.clock = clock
         injector.on_fire = lambda kind, target: self.log(
             "fault-fired", kind=kind, target=target)
-        store = Store(clock=clock.now)
-        self.state = AppState(
-            store=store, auth=NoAuth(), agent_registry=AgentRegistry(),
-            log_router=LogRouter(),
-            placement=PlacementService(store),
-            backend_factory=lambda: MockBackend(auto_pull=True),
-            server_provider_factory=self._provider_factory,
-            deploy_sleep=clock.advance, chaos=injector)
-        self.state.agent_registry.delivery_hook = injector.delivery_hook
+        # a replicated world's primary keeps a REAL journal (under a
+        # throwaway dir) so the mid-compaction kill exercises the actual
+        # snapshot/truncate lifecycle, not a no-op
+        self.replicated = replicated
+        self._store_dir = store_dir
+        self._store_gen = 1
+        store = Store(self._store_path("cp"), clock=clock.now)
+        self.state = self._build_state(store)
         # the self-healing pair, on the VIRTUAL clock (lease expiry and
         # retry backoff are exact virtual arithmetic) with seeded jitter —
         # so every heal decision replays identically across processes
-        self.detector = FailureDetector(LeaseConfig(
-            lease_s=60.0, suspect_grace_s=30.0, flap_window_s=300.0,
-            flap_threshold=3, damp_hold_s=120.0), clock=clock.now)
+        self.detector = FailureDetector(LeaseConfig(**self.LEASE),
+                                        clock=clock.now)
         self.reconverger = Reconverger(
             self.state, self.detector,
-            config=ReconvergeConfig(backoff_base_s=5.0, backoff_max_s=60.0,
-                                    max_attempts=5),
+            config=ReconvergeConfig(**self.RECONV),
             clock=clock.now, rng=random.Random(seed ^ 0x5EA1))
         self.state.failure_detector = self.detector
         self.state.reconverger = self.reconverger
@@ -279,6 +306,32 @@ class ChaosWorld:
         self.stage_keys = [f"{flow.name}/{s}" for s in sorted(flow.stages)]
         self.autoscaler = Autoscaler(self.state, clock=clock.now)
         store.subscribe(self._observe)
+        # cp-failover bookkeeping (cp-failover-converged invariant)
+        self.cp_failovers = 0
+        self.fencing_rejections = 0
+        self.prekill_work: set[tuple[str, bool]] = set()
+        self.idem_executions: dict[str, list] = {}   # key -> [stage, runs]
+        self.standby: Optional[StandbyReplica] = None
+        self.standby_store: Optional[Store] = None
+        if replicated:
+            self._wire_replication(store)
+
+    def _store_path(self, name: str) -> Optional[str]:
+        if not self.replicated or self._store_dir is None:
+            return None
+        return str(self._store_dir / f"{name}{self._store_gen}.json")
+
+    def _build_state(self, store: Store) -> AppState:
+        state = AppState(
+            store=store, auth=NoAuth(), agent_registry=AgentRegistry(),
+            log_router=LogRouter(),
+            placement=PlacementService(store),
+            backend_factory=lambda: MockBackend(auto_pull=True),
+            server_provider_factory=self._provider_factory,
+            deploy_sleep=self.clock.advance, chaos=self.injector)
+        state.agent_registry.delivery_hook = self.injector.delivery_hook
+        state.agent_registry.epoch_source = lambda: store.epoch
+        return state
 
     # -- event log ---------------------------------------------------------
 
@@ -325,6 +378,101 @@ class ChaosWorld:
         self.detector.observe_disconnect(slug)
         if wipe:
             self.backends.pop(slug, None)
+
+    # -- replicated control plane (cp-failover scenario) -------------------
+
+    def _wire_replication(self, primary_store: Store) -> None:
+        """Attach a fresh warm standby to `primary_store`: snapshot
+        catch-up first (the late-joiner path), then the synchronous
+        in-process journal stream. The sink closure stays bound to ITS
+        replica generation — after a failover the dead primary's sink
+        still points at the promoted store, which is exactly how a
+        zombie write meets the fence."""
+        self._store_gen += 1
+        standby_store = Store(self._store_path("standby"),
+                              clock=self.clock.now)
+        replica = StandbyReplica(standby_store)
+        replica.install(primary_store.snapshot_doc())
+
+        def ship(entries, _replica=replica):
+            try:
+                _replica.apply_lines(entries)
+            except ReplicationFenced:
+                self.fencing_rejections += 1
+                self.log("fencing-rejected", entries=len(entries))
+
+        primary_store.replication_sink = ship
+        self.standby = replica
+        self.standby_store = standby_store
+
+    async def cp_failover(self, phase: str) -> None:
+        """Kill the primary CP and promote the warm standby. The old
+        AppState simply stops being `self.state` — its placement book,
+        detector leases, and in-flight reconverger all die with it; only
+        what was REPLICATED survives, which is the whole point."""
+        rc = self.reconverger
+        if phase == "redelivery":
+            # die between enqueuing redelivery work and delivering it:
+            # the sweep consumes the verdicts, parks/enqueues per-stage
+            # work (journaled -> replicated), and then the process dies
+            summary = await rc.step(drive=False)
+            for slug in summary["dead"]:
+                self.log("heal-dead", node=slug)
+            for r in summary["resolved"]:
+                self.log("heal-resolve", stage=r["stage"],
+                         feasible=r["feasible"])
+            for key in summary["parked"]:
+                self.log("heal-parked", stage=key)
+        elif phase == "compaction":
+            # snapshot + journal truncate, then die: the shipped stream
+            # must be unaffected (entries were shipped at append time)
+            self.state.store.flush()
+            self.log("cp-compacted")
+        old_store = self.state.store
+        old_store.unsubscribe(self._observe)
+        # continuity ledger for the cp-failover-converged invariant:
+        # every convergence-debt row the dead primary had persisted must
+        # either converge or still be parked on the new one
+        for rec in old_store.list("parked_work"):
+            self.prekill_work.add((rec.stage_key, bool(rec.parked)))
+        epoch = self.standby.promote()
+        self.cp_failovers += 1
+        self.log("cp-failover", phase=phase, epoch=epoch)
+        store = self.standby_store
+        self.state = self._build_state(store)
+        self.detector = FailureDetector(LeaseConfig(**self.LEASE),
+                                        clock=self.clock.now)
+        self.reconverger = Reconverger(
+            self.state, self.detector,
+            config=ReconvergeConfig(**self.RECONV), clock=self.clock.now,
+            rng=random.Random(self._seed ^ 0x5EA1 ^ (epoch << 8)))
+        self.state.failure_detector = self.detector
+        self.state.reconverger = self.reconverger
+        # crash-only boot: resume the dead primary's convergence debt,
+        # then prime a lease for every known server — a node that died
+        # with the old primary must still expire to a verdict here
+        resumed = self.reconverger.resume()
+        for s in store.list("servers"):
+            self.detector.prime(s.slug)
+        self.log("cp-resumed", stages=resumed)
+        # agents re-home (the reconnect loop finds the promoted CP);
+        # their SimAgent objects — and idempotency windows — survive
+        for slug in sorted(self.agents):
+            agent = self.agents[slug]
+            self.state.agent_registry.register(slug, agent.conn,
+                                               principal=slug)
+            store.heartbeat(slug)
+            self.detector.observe_heartbeat(slug)
+        self.autoscaler = Autoscaler(self.state, clock=self.clock.now)
+        store.subscribe(self._observe)
+        # the next generation's standby attaches via snapshot catch-up
+        self._wire_replication(store)
+        self.log("standby-attached", seq=self.standby.last_seq)
+        # zombie proof: the dead primary's process gets one last write
+        # in; its stale epoch must bounce off the promoted store
+        zombies = sorted(s.slug for s in old_store.list("servers"))
+        if zombies:
+            old_store.heartbeat(zombies[0])
 
     def cp_placement(self, req: DeployRequest,
                      assignment: Optional[dict]) -> Optional[Placement]:
@@ -394,12 +542,19 @@ class _Runner:
         clock = VirtualClock()
         flow = make_flow(n_services, n_stages, self.node_slugs,
                          seed=schedule.seed)
-        self.world = ChaosWorld(flow, FaultInjector(), clock,
-                                pool_min=pool_min, seed=schedule.seed)
+        # a schedule that kills the CP primary needs the replicated
+        # control plane (warm standby + journaled primary store)
+        replicated = any(op == F.CP_KILL for _, op, _ in schedule.events())
+        self._tmp = (tempfile.TemporaryDirectory(prefix="fleet-chaos-cp-")
+                     if replicated else None)
+        self.world = ChaosWorld(
+            flow, FaultInjector(), clock, pool_min=pool_min,
+            seed=schedule.seed, replicated=replicated,
+            store_dir=Path(self._tmp.name) if self._tmp else None)
         self.dirty: set[str] = set()     # stage names needing redeploy
         self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
                       "resolves": 0, "restarts": 0, "scale_actions": 0,
-                      "heals": 0}
+                      "heals": 0, "failovers": 0}
 
     # -- world bootstrap ---------------------------------------------------
 
@@ -511,6 +666,10 @@ class _Runner:
                 w.injector.arm_deploy_fail(p["count"])
             elif op == F.CONTAINER_EXIT:
                 self._apply_container_exit(p["node"])
+            elif op == F.CP_KILL:
+                w.log("fault", op=op, phase=p["phase"])
+                await w.cp_failover(p["phase"])
+                self.stats["failovers"] += 1
             elif op == F.REDEPLOY:
                 w.log("redeploy-requested", stage=p["stage"])
                 self.dirty.add(p["stage"])
@@ -672,4 +831,8 @@ def run_schedule(schedule: F.FaultSchedule, *, services: int, nodes: int,
     """Replay one schedule against a freshly built world. Deterministic:
     the same (schedule, sizes) reproduces the identical event log."""
     runner = _Runner(schedule, services, nodes, stages, pool_min)
-    return asyncio.run(runner.run())
+    try:
+        return asyncio.run(runner.run())
+    finally:
+        if runner._tmp is not None:
+            runner._tmp.cleanup()

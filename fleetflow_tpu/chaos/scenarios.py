@@ -17,8 +17,8 @@ import random
 from typing import Callable
 
 from .faults import (AgentPartition, ContainerExit, DeployFail,
-                     FaultSchedule, NodeCrash, NodeFlap, Redeploy,
-                     SilentNodeCrash, SlowAgent, Tick, WorkerKill)
+                     FaultSchedule, NodeCrash, NodeFlap, PrimaryKill,
+                     Redeploy, SilentNodeCrash, SlowAgent, Tick, WorkerKill)
 from .runner import node_slug
 
 __all__ = ["SCENARIOS", "build_schedule", "scenario_names"]
@@ -76,6 +76,53 @@ def _rolling_kill_selfheal(seed: int, services: int,
         tick += 30.0
     return FaultSchedule("rolling-kill-selfheal", seed, faults,
                          horizon=horizon)
+
+
+def _cp_failover(seed: int, services: int, nodes: int) -> FaultSchedule:
+    """Kill the control-plane PRIMARY three times — mid-redelivery,
+    mid-burst, and mid-compaction — while nodes die silently around it.
+    Each kill promotes the warm standby (journal-shipping replication),
+    which must resume the dead primary's convergence debt, re-detect
+    in-flight node deaths through primed leases, and finish every
+    redelivery exactly once; a zombie write from each dead primary must
+    bounce off the fencing epoch. Judged by `cp-failover-converged` on
+    top of the standard invariant pack.
+
+    Timeline choreography (lease 60s + grace 30s on the world clock):
+      * A dies at 95 with NO ticks until the kill at 130, so A's dead
+        verdict fires INSIDE the kill's half-step — genuine
+        mid-redelivery death (PrimaryKill phase="redelivery");
+      * B dies in the same instant as the second kill — the burst is in
+        flight, nobody has observed it; only the new primary's primed
+        leases can find B (phase="burst");
+      * the third kill compacts the journal first (phase="compaction");
+      * C dies and revives afterwards, exercising plain self-healing +
+        unpark on the twice-promoted primary."""
+    rng = random.Random(seed)
+    # survivors must exist: at most nodes-1 victims (tiny fleets get
+    # fewer node kills but always all three primary kills)
+    k = min(3, nodes - 1)
+    victims = [node_slug(v) for v in rng.sample(range(nodes), k)]
+    faults: list = [
+        SilentNodeCrash(at=95.0, node=victims[0], revive_after=500.0),
+        PrimaryKill(at=130.0, phase="redelivery"),
+        PrimaryKill(at=250.0, phase="burst"),
+        PrimaryKill(at=500.0, phase="compaction"),
+    ]
+    if k >= 2:   # dies in the same instant as the burst kill
+        faults.insert(2, SilentNodeCrash(at=250.0, node=victims[1]))
+    if k >= 3:   # plain self-heal + unpark on the final primary
+        faults.append(SilentNodeCrash(at=560.0, node=victims[2],
+                                      revive_after=240.0))
+    horizon = 1000.0
+    # ticks pace detector sweeps — EXCEPT inside (95, 130): a sweep
+    # there would consume A's verdict before the mid-redelivery kill
+    tick = 15.0
+    while tick < horizon:
+        if not (95.0 < tick < 130.0):
+            faults.append(Tick(at=tick))
+        tick += 30.0
+    return FaultSchedule("cp-failover", seed, faults, horizon=horizon)
 
 
 def _flap_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
@@ -148,6 +195,11 @@ SCENARIOS: dict[str, tuple[Callable, str]] = {
                               "heartbeats signal them — the lease "
                               "detector + reconverger must heal the "
                               "fleet unassisted"),
+    "cp-failover": (_cp_failover,
+                    "kill the CP PRIMARY mid-redelivery, mid-burst and "
+                    "mid-compaction — the journal-shipping standby must "
+                    "promote, fence the zombie, and finish every "
+                    "redelivery exactly once"),
     "flap-storm": (_flap_storm,
                    "waves of coalesced short flaps across ~20% of the "
                    "fleet"),

@@ -714,6 +714,13 @@ def cmd_chaos(args) -> int:
         for v in report.violations:
             print(f"    {v}")
         return 1
+    if getattr(args, "expect_digest", None) \
+            and report.digest() != args.expect_digest:
+        # CI pins the digest: a drifted event log on the SAME seed means
+        # replay determinism broke (or the scenario changed without the
+        # pin being updated) — either way, fail loudly
+        print(f"  DIGEST MISMATCH: expected {args.expect_digest}")
+        return 1
     print("  all invariants hold")
     return 0
 
@@ -943,6 +950,29 @@ def _cp_dispatch(cp: CpClient, args) -> int:
 
     if sub == "status":
         return show(cp.request("health", "overview"))
+    if sub == "replication":
+        # replication topology at a glance: role, fencing epoch, journal
+        # seq, and per-standby lag (docs/guide/13-cp-replication.md)
+        out = cp.request("replication", "status")
+        if getattr(args, "json", False):
+            return show(out)
+        print(f"role={out.get('role')} epoch={out.get('epoch')} "
+              f"seq={out.get('seq')}")
+        if out.get("role") == "standby":
+            print(f"  primary {out.get('primary')} | applied "
+                  f"{out.get('applied', 0)} entries | "
+                  f"{out.get('snapshot_catchups', 0)} snapshot catch-ups")
+            lease = out.get("primary_lease") or {}
+            if lease:
+                print(f"  primary lease: {lease.get('state')} "
+                      f"(remaining {lease.get('lease_remaining_s')}s)")
+        for sb in out.get("standbys", []):
+            print(f"  standby {sb['identity']:<20} acked={sb['acked_seq']} "
+                  f"lag={sb['lag']}")
+        if out.get("role") == "primary" and not out.get("standbys"):
+            print("  no standbys attached (single point of failure: see "
+                  "docs/guide/13-cp-replication.md)")
+        return 0
     if sub == "heal":
         out = cp.request("health", "heal.status")
         if not out.get("enabled", False):
@@ -951,6 +981,14 @@ def _cp_dispatch(cp: CpClient, args) -> int:
             return 1
         if getattr(args, "json", False):
             return show(out)
+        repl = out.get("replication") or {}
+        if repl:
+            standbys = repl.get("standbys")
+            lag = (f" standbys={len(standbys)} "
+                   f"max_lag={max((s['lag'] for s in standbys), default=0)}"
+                   if standbys is not None else "")
+            print(f"replication: role={repl.get('role')} "
+                  f"epoch={repl.get('epoch')}{lag}")
         det = out.get("detector", {})
         agents = det.get("agents", {})
         cfg = det.get("config", {})
@@ -1478,6 +1516,12 @@ def build_parser() -> argparse.ArgumentParser:
                        "(the JSON face of GET /metrics)")
     q.add_argument("--json", action="store_true",
                    help="full structured snapshot with HELP text")
+    q = cps.add_parser("replication", help="replication status: role, "
+                       "fencing epoch, standby lag "
+                       "(docs/guide/13-cp-replication.md)")
+    q.add_argument("verb", choices=["status"])
+    q.add_argument("--json", action="store_true",
+                   help="raw replication.status payload")
     q = cps.add_parser("daemon")
     q.add_argument("daemon_command",
                    choices=["run", "start", "stop", "status"])
@@ -1572,6 +1616,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="autoscaler worker-pool floor (0 = no pool)")
     q.add_argument("--json", help="write the full report (events, "
                    "violations, digest) to this path")
+    q.add_argument("--expect-digest", dest="expect_digest",
+                   help="fail unless the event-log digest equals this "
+                   "(CI pinning: same seed must replay byte-identically)")
     q.add_argument("--show-schedule", action="store_true",
                    help="print the expanded fault schedule and exit")
     q.add_argument("--list", action="store_true",

@@ -70,6 +70,12 @@ class AgentRegistry:
         # partitions/latency here; it doubles as an extension point for
         # per-command routing policy (rate limits, circuit breakers).
         self.delivery_hook: Optional[Callable[[str, str], None]] = None
+        # fencing (docs/guide/13-cp-replication.md): when set, every
+        # command envelope is stamped with the CP's current epoch; agents
+        # that have seen a newer epoch refuse the command — a zombie
+        # ex-primary cannot drive stale deploys through a window it no
+        # longer owns
+        self.epoch_source: Optional[Callable[[], int]] = None
 
     # ------------------------------------------------------------------
     def register(self, slug: str, conn: Connection,
@@ -169,9 +175,11 @@ class AgentRegistry:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
         self._pending_conn[request_id] = conn
+        envelope = {"request_id": request_id, "payload": payload or {}}
+        if self.epoch_source is not None:
+            envelope["epoch"] = self.epoch_source()
         try:
-            await conn.send_event("agent", command, {
-                "request_id": request_id, "payload": payload or {}})
+            await conn.send_event("agent", command, envelope)
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             _M_COMMAND_ERRORS.inc(reason="timeout")
@@ -204,8 +212,10 @@ class AgentRegistry:
         if self.delivery_hook is not None:
             self.delivery_hook(slug, command)
         _M_COMMANDS.inc(command=command)
-        await conn.send_event("agent", command,
-                              {"request_id": None, "payload": payload or {}})
+        envelope = {"request_id": None, "payload": payload or {}}
+        if self.epoch_source is not None:
+            envelope["epoch"] = self.epoch_source()
+        await conn.send_event("agent", command, envelope)
 
     def resolve_result(self, request_id: str, payload: dict) -> bool:
         """Called by the agent channel handler on an inbound command_result

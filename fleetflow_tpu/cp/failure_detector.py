@@ -143,6 +143,26 @@ class FailureDetector:
                 lease.verdicts.append(now)
                 self._pending.append(LeaseEvent(slug, True, now, ALIVE))
 
+    def prime(self, slug: str) -> None:
+        """Start tracking a known-but-not-yet-heard-from agent: the lease
+        clock starts NOW without a heartbeat. Called at CP boot and on
+        standby promotion for every server record that was online — a
+        node that died together with (or during the absence of) the old
+        primary never heartbeats the new one, so without priming its
+        death would be invisible forever. A live agent's first heartbeat
+        simply renews the primed lease; a dead one expires through the
+        normal SUSPECT -> DEAD path and gets its verdict."""
+        now = self.clock()
+        with self._lock:
+            if slug in self._leases:
+                return
+            lease = self._leases[slug] = _Lease()
+            lease.deadline = now + self.config.lease_s
+            lease.connected = False
+            _M_TRANSITIONS.inc(to=ALIVE)
+            log.debug("lease primed %s", kv(slug=slug,
+                                            lease_s=self.config.lease_s))
+
     def observe_disconnect(self, slug: str) -> None:
         """Session gone: fast-path ALIVE -> SUSPECT (the lease no longer
         means anything — its renewals came over the dead session). A fast

@@ -37,7 +37,7 @@ _log = get_logger("cp.deploy")
 
 # metric catalog: docs/guide/10-observability.md. Channel label only (the
 # method vocabulary is open-ended via agent commands; channels are the
-# fixed 14-way enum) — bounded cardinality by construction.
+# fixed 15-way enum) — bounded cardinality by construction.
 _M_REQUEST_S = REGISTRY.histogram(
     "fleet_cp_request_duration_seconds",
     "Channel RPC handler latency, by channel", labels=("channel",))
@@ -140,6 +140,24 @@ def _perm_wrap(channel: str, handler):
     return wrapped
 
 
+def _role_wrap(state: "AppState", channel: str, handler):
+    """Standby gating (docs/guide/13-cp-replication.md): until promotion
+    a standby answers reads (dashboards pointed at it see the replicated
+    state) but refuses every mutation — there is exactly one writer per
+    epoch, and it is not this process."""
+
+    async def wrapped(conn: Connection, method: str, p: dict):
+        if (state.replication_role != "primary"
+                and method not in _READ_METHODS):
+            raise ValueError(
+                f"standby: not primary — {channel}.{method} must go to "
+                f"the current primary (this CP will serve writes only "
+                f"after promotion)")
+        return await handler(conn, method, p)
+
+    return wrapped
+
+
 def register_all(server: ProtocolServer, state: "AppState") -> None:
     """handlers/mod.rs register_all:21-35."""
     for channel, factory in (
@@ -149,10 +167,16 @@ def register_all(server: ProtocolServer, state: "AppState") -> None:
             ("dns", _dns), ("deploy", _deploy), ("volume", _volume),
             ("build", _build), ("placement", _placement)):
         server.register_channel(
-            channel, _timed(channel, _perm_wrap(channel, factory(state))))
+            channel, _timed(channel, _role_wrap(
+                state, channel, _perm_wrap(channel, factory(state)))))
     agent_handler, agent_events = _agent(state)
     server.register_channel("agent", _timed("agent", agent_handler),
                             agent_events)
+    repl_handler, repl_events = _replication(state)
+    server.register_channel(
+        "replication", _timed("replication",
+                              _perm_wrap("replication", repl_handler)),
+        repl_events)
     server.on_disconnect = _on_disconnect(state)
 
 
@@ -522,10 +546,13 @@ def _health(state: "AppState"):
             return {"metrics": REGISTRY.snapshot()}
         if method == "heal.status":
             # self-healing introspection (`fleet cp heal status`): lease
-            # table, pending/parked convergence work, pass counters
-            if state.reconverger is None:
-                return {"enabled": False}
-            return {"enabled": True, **state.reconverger.status()}
+            # table, pending/parked convergence work, pass counters —
+            # plus the replication block (role/epoch/standby lag) so one
+            # command answers "who is primary and is the standby warm"
+            out = ({"enabled": False} if state.reconverger is None
+                   else {"enabled": True, **state.reconverger.status()})
+            out["replication"] = _replication_status(state)
+            return out
         raise ValueError(f"unknown method health.{method}")
     return handle
 
@@ -1070,6 +1097,13 @@ def _agent(state: "AppState"):
         db = state.store
         _check_agent_perm(conn)
         if method == "register":
+            if state.replication_role != "primary":
+                # re-homing: the agent's rotation lands here while this
+                # standby has not promoted — refuse so it keeps cycling
+                # endpoints until it finds the (possibly new) primary
+                raise ValueError(
+                    "standby: not primary — register with the current "
+                    "primary (agents rotate cp_endpoints automatically)")
             (slug,) = _require(p, "slug")
             state.agent_registry.register(slug, conn,
                                           principal=_principal_of(conn))
@@ -1135,8 +1169,86 @@ def _agent(state: "AppState"):
     return handle, events
 
 
+# --------------------------------------------------------------------------
+# replication channel (journal shipping to standbys, cp/replication.py)
+# --------------------------------------------------------------------------
+
+def _replication_status(state: "AppState") -> dict:
+    if state.replicator is not None:
+        return state.replicator.status()
+    if state.standby is not None:
+        return state.standby.status()
+    return {"role": state.replication_role,
+            "epoch": state.store.epoch, "seq": state.store.seq}
+
+
+def _replication(state: "AppState"):
+    async def handle(conn: Connection, method: str, p: dict) -> dict:
+        if method == "status":
+            return _replication_status(state)
+        if method == "append":
+            # the push face is first of all a fencing door: a zombie
+            # ex-primary that reconnects and tries to keep shipping its
+            # journal is refused by epoch before anything is applied
+            epoch = int(p.get("epoch", 0))
+            if epoch < state.store.epoch:
+                from .store import _M_FENCING
+                _M_FENCING.inc(side="cp")
+                raise ValueError(
+                    f"fenced: entry epoch {epoch} < current epoch "
+                    f"{state.store.epoch} — stale primary")
+            if state.replication_role == "primary":
+                raise ValueError(
+                    "this CP is the primary; it does not accept "
+                    "replication appends (possible split brain)")
+            entries = [(int(s), ln) for s, ln in p.get("entries", [])]
+            applied = state.store.apply_replicated(entries)
+            return {"applied": applied, "seq": state.store.seq}
+        if state.replication_role != "primary" or state.replicator is None:
+            raise ValueError(
+                f"standby: replication.{method} is served by the primary")
+        repl = state.replicator
+        if method == "ping":
+            # the standby's liveness probe doubles as its ack + the
+            # gossip ride-along: the reply carries the full ack table so
+            # every standby can rank itself for election
+            repl.ack(conn, int(p.get("acked_seq", 0)))
+            st = repl.status()
+            return {"pong": True, "epoch": st["epoch"], "seq": st["seq"],
+                    "standbys": st["standbys"]}
+        if method == "subscribe":
+            return repl.attach(conn, str(p.get("identity", conn.identity)),
+                               int(p.get("from_seq", 0)))
+        if method == "snapshot":
+            meta, chunks = repl.snapshot_chunks()
+            conn._snapshot_chunks = chunks   # per-connection stash
+            return meta
+        if method == "snapshot_chunk":
+            chunks = getattr(conn, "_snapshot_chunks", None)
+            if chunks is None:
+                raise ValueError("no snapshot in progress; call "
+                                 "replication.snapshot first")
+            i = int(p.get("chunk", 0))
+            data = chunks[i]
+            if i == len(chunks) - 1:
+                # last chunk served: drop the stash — the connection
+                # lives on for streaming and must not pin a full copy
+                # of fleet state until disconnect
+                conn._snapshot_chunks = None
+            return {"data": data}
+        raise ValueError(f"unknown method replication.{method}")
+
+    async def events(conn: Connection, method: str, p: dict) -> None:
+        if method == "ack" and state.replicator is not None:
+            state.replicator.ack(conn, int(p.get("seq", 0)))
+
+    return handle, events
+
+
 def _on_disconnect(state: "AppState"):
     async def on_disconnect(conn: Connection) -> None:
+        if state.replicator is not None:
+            state.replicator.detach(conn)   # no-op for non-standby conns
         registered: dict[int, str] = getattr(state, "_agent_conn_slugs", {})
         slug = registered.pop(id(conn), None)
         if slug is not None:

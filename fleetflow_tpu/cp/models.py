@@ -28,6 +28,7 @@ __all__ = [
     "Server", "WorkerPool", "DeploymentStatus", "Deployment", "AlertKind",
     "Alert", "ObservedContainer", "VolumeRecord", "VolumeSnapshot",
     "BuildStatus", "BuildJob", "CostEntry", "DnsRecord", "ParkedWork",
+    "PlacementRecord",
 ]
 
 
@@ -259,6 +260,20 @@ class ParkedWork(Record):
     parked: bool = True
     attempt: int = 0
     detail: str = ""
+
+
+@dataclass
+class PlacementRecord(Record):
+    """A stage's COMMITTED placement (cp/placement.py): the assignment the
+    fleet actually runs and the per-node demand it books. Persisted so a
+    restarted or promoted CP rebuilds its capacity ledger from the store
+    instead of double-counting the next commit — the in-memory `_committed`
+    map alone dies with the process, but the `servers.allocated` numbers it
+    explains do not."""
+    stage_key: str = ""                              # "{project}/{stage}"
+    assignment: dict[str, str] = field(default_factory=dict)  # row -> slug
+    # slug -> [cpu, memory, disk] booked by this placement
+    demand_by_node: dict[str, list[float]] = field(default_factory=dict)
 
 
 # --------------------------------------------------------------------------

@@ -31,8 +31,8 @@ from ..lower.tensors import ProblemTensors, lower_stage
 from ..obs import get_logger, kv
 from ..obs.metrics import REGISTRY
 from ..sched import (HostGreedyScheduler, Placement, TpuSolverScheduler,
-                     place_with_fallback)
-from .models import Server
+                     level_schedule, place_with_fallback)
+from .models import PlacementRecord, Server
 from .store import Store
 
 log = get_logger("cp.placement")
@@ -95,6 +95,43 @@ class PlacementService:
         self._committed: dict[str, Reservation] = {}      # stage_key -> last
         self._ids = itertools.count(1)
         self._last: dict[str, tuple[ProblemTensors, Placement]] = {}
+        # the committed book explains servers.allocated: rebuild it from
+        # the store's placements table so a restarted (or promoted
+        # standby, docs/guide/13-cp-replication.md) CP's next commit
+        # SUPERSEDES the old allocation instead of stacking on top of it
+        self._load_committed()
+
+    # ------------------------------------------------------------------
+    # committed-book persistence (crash/failover-safe capacity ledger)
+    # ------------------------------------------------------------------
+
+    def _load_committed(self) -> None:
+        for rec in self.store.list("placements"):
+            self._committed[rec.stage_key] = Reservation(
+                id=f"rsv_{next(self._ids)}", stage_key=rec.stage_key,
+                demand_by_node={slug: np.asarray(d, dtype=np.float64)
+                                for slug, d in rec.demand_by_node.items()},
+                assignment=dict(rec.assignment), committed=True)
+
+    def _persist_committed(self, key: str) -> None:
+        """Mirror the stage's committed reservation into the store (one
+        row per stage, journaled and replicated). Caller holds the lock."""
+        r = self._committed.get(key)
+        rec = self.store.find_one("placements",
+                                  lambda p: p.stage_key == key)
+        if r is None:
+            if rec is not None:
+                self.store.delete("placements", rec.id)
+            return
+        attrs = dict(
+            assignment=dict(r.assignment),
+            demand_by_node={slug: [float(x) for x in np.asarray(d)]
+                            for slug, d in r.demand_by_node.items()})
+        if rec is None:
+            self.store.create("placements",
+                              PlacementRecord(stage_key=key, **attrs))
+        else:
+            self.store.update("placements", rec.id, **attrs)
 
     # ------------------------------------------------------------------
     # inventory lowering
@@ -201,6 +238,57 @@ class PlacementService:
                 rid = self._reserve(key, pt, placement)
         return placement, rid
 
+    def rehydrate(self, stage_key: str, flow: Flow,
+                  tenant: str = "default") -> bool:
+        """Failover/restart recovery: rebuild the stage's retained
+        (problem, placement) entry by ADOPTING its committed assignment
+        from the store's placements table — never by re-solving, which
+        could silently diverge from what the fleet is actually running.
+        Without this, a promoted standby's empty placement book would
+        make every future churn re-solve skip the stage entirely
+        (node_events only moves stages it has retained problems for).
+        Returns False when there is nothing to adopt or the config has
+        drifted past the record (the stage's next real solve rebuilds)."""
+        rec = self.store.find_one("placements",
+                                  lambda p: p.stage_key == stage_key)
+        if rec is None:
+            return False
+        stage_name = stage_key.split("/", 1)[1]
+        with self._lock:
+            if stage_key in self._last:
+                return True
+            committed = self._committed.get(stage_key)
+            # the committed demand is the stage's OWN load: exclude it
+            # from inventory like solve_stage excludes its churn hold,
+            # or the adopted placement double-counts itself
+            exclude = dict(committed.demand_by_node) if committed else None
+            nodes, valid = self._inventory(
+                tenant, flow.stage(stage_name).servers or None,
+                exclude_demand=exclude)
+            pt = lower_stage(flow, stage_name, nodes=nodes)
+            pt.node_valid &= valid
+            node_idx = {n: i for i, n in enumerate(pt.node_names)}
+            raw = np.zeros(pt.S, dtype=np.int64)
+            for i, row in enumerate(pt.service_names):
+                idx = node_idx.get(rec.assignment.get(row, ""), -1)
+                if idx < 0:
+                    return False   # drifted config/inventory: solve anew
+                raw[i] = idx
+            # the adopted rows prove their nodes were valid AT SOLVE
+            # TIME: mark them valid in the retained problem even if the
+            # node is offline in today's inventory, so the failure
+            # detector's verdict flip registers as a CHANGE and triggers
+            # the re-solve that moves the stage off the dead node
+            pt.node_valid = pt.node_valid.copy()
+            pt.node_valid[np.unique(raw)] = True
+            self._last[stage_key] = (pt, Placement(
+                assignment=dict(rec.assignment),
+                levels=level_schedule(pt), feasible=True,
+                source="rehydrated", raw=raw))
+        log.info("placement rehydrated %s", kv(stage=stage_key,
+                                               rows=pt.S))
+        return True
+
     @staticmethod
     def _demand_by_node(pt: ProblemTensors,
                         placement: Placement) -> dict[str, np.ndarray]:
@@ -259,6 +347,7 @@ class PlacementService:
             r.committed = True
             self._committed[r.stage_key] = r
             self._drop_churn(r.stage_key)   # commitment reflects reality now
+            self._persist_committed(r.stage_key)
             return True
 
     def release(self, rid: str, *, undo_commit: bool = False) -> bool:
@@ -274,6 +363,7 @@ class PlacementService:
                         self._apply_allocation(c, -1.0)
                         del self._committed[key]
                         self._drop_churn(key)   # torn down: nothing to hold
+                        self._persist_committed(key)
                         return True
             return False
 
@@ -300,6 +390,7 @@ class PlacementService:
             self._apply_allocation(r, +1.0)
             self._committed[stage_key] = r
             self._drop_churn(stage_key)
+            self._persist_committed(stage_key)
             return True
 
     def release_stage(self, stage_key: str) -> bool:
@@ -311,6 +402,7 @@ class PlacementService:
             if c is None:
                 return False
             self._apply_allocation(c, -1.0)
+            self._persist_committed(stage_key)
             return True
 
     def _snapshot_locked(self) -> dict[str, dict]:

@@ -89,6 +89,9 @@ class Connection:
     _tasks: set = field(default_factory=set)   # strong refs: loop holds weak
     _closed: bool = False
     on_close: Optional[Callable[["Connection"], Awaitable[None]]] = None
+    # the server's welcome frame (client side): carries the peer's
+    # replication role/epoch when the server advertises them
+    welcome: dict = field(default_factory=dict)
 
     def _spawn(self, coro) -> asyncio.Task:
         """ensure_future with a strong reference: the event loop only keeps
@@ -193,11 +196,17 @@ class ProtocolServer:
     def __init__(self, *, name: str = "cp",
                  authenticate: Optional[Callable[[str, Optional[str]], bool]] = None,
                  ssl_context: Optional[ssl.SSLContext] = None,
-                 handshake_timeout: float = 10.0):
+                 handshake_timeout: float = 10.0,
+                 welcome_extra: Optional[Callable[[], dict]] = None):
         self.name = name
         self.authenticate = authenticate
         self.ssl_context = ssl_context
         self.handshake_timeout = handshake_timeout
+        # extra key/values merged into every welcome frame — the CP
+        # advertises its replication role and fencing epoch here, so a
+        # client can refuse a zombie ex-primary BEFORE sending anything
+        # (docs/guide/13-cp-replication.md)
+        self.welcome_extra = welcome_extra
         self.handlers: dict[str, Handler] = {}
         self.event_handlers: dict[str, EventHandler] = {}
         self.connections: set[Connection] = set()
@@ -250,7 +259,10 @@ class ProtocolServer:
         self.connections.add(conn)
         conn.on_close = self._forget
         try:
-            await conn._send({"type": "welcome", "server": self.name})
+            welcome = {"type": "welcome", "server": self.name}
+            if self.welcome_extra is not None:
+                welcome.update(self.welcome_extra())
+            await conn._send(welcome)
             if self.on_connect is not None:
                 await self.on_connect(conn, hello)
         except Exception:
@@ -303,6 +315,7 @@ class ProtocolClient:
                 raise RpcError(welcome.get("error", "handshake rejected"))
             if welcome.get("type") != "welcome":
                 raise RpcError(f"unexpected handshake reply: {welcome}")
+            conn.welcome = welcome
         except BaseException:
             writer.close()   # failed handshake must not leak the socket
             raise

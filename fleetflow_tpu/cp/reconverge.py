@@ -119,7 +119,8 @@ class Reconverger:
         self._task: Optional[asyncio.Task] = None
         self.stats = {"verdicts_dead": 0, "verdicts_online": 0,
                       "resolves": 0, "redeliveries_ok": 0,
-                      "redeliveries_retried": 0, "parked": 0, "resumed": 0}
+                      "redeliveries_retried": 0, "parked": 0, "resumed": 0,
+                      "rebuilt_solves": 0}
 
     # ------------------------------------------------------------------
     # persistence (crash-restart resume)
@@ -129,7 +130,9 @@ class Reconverger:
         """Reload convergence debt a previous CP process left in the
         store: parked stages stay parked; in-flight redelivery work
         retries immediately (the restart may BE the reason it never
-        finished). Called once at server start."""
+        finished). Called once at server start — and again on standby
+        promotion, where "previous process" is the dead primary and the
+        store contents arrived via replication."""
         n = 0
         for rec in self.state.store.list("parked_work"):
             if rec.stage_key in self._work:
@@ -144,8 +147,37 @@ class Reconverger:
         if n:
             self.stats["resumed"] += n
             log.info("resumed convergence backlog %s", kv(stages=n))
+        self._rehydrate_placements()
         self._set_parked_gauge()
         return n
+
+    def _rehydrate_placements(self) -> None:
+        """Rebuild the placement book from replicated records: every
+        committed stage gets its running assignment re-adopted as the
+        retained placement (PlacementService.rehydrate). Without this a
+        freshly promoted/restarted CP cannot re-place those stages when
+        their nodes die later — node_events only moves stages it holds
+        retained problems for."""
+        placement = self.state.placement
+        rehydrate = getattr(placement, "rehydrate", None)
+        if rehydrate is None:   # minimal placement fake (unit tests)
+            return
+        n = 0
+        for rec in self.state.store.list("placements"):
+            if placement.retained(rec.stage_key) is not None:
+                continue
+            req, tenant = self._template(rec.stage_key)
+            if req is None:
+                continue
+            try:
+                if rehydrate(rec.stage_key, req.flow, tenant=tenant):
+                    n += 1
+            except Exception:
+                log.exception("placement rehydration failed %s",
+                              kv(stage=rec.stage_key))
+        if n:
+            self.stats["rehydrated"] = self.stats.get("rehydrated", 0) + n
+            log.info("placement book rehydrated %s", kv(stages=n))
 
     def _persist(self, w: _Work) -> None:
         db = self.state.store
@@ -208,11 +240,16 @@ class Reconverger:
     # the convergence step
     # ------------------------------------------------------------------
 
-    async def step(self) -> dict:
+    async def step(self, drive: bool = True) -> dict:
         """One pass: sweep the detector, turn verdicts into a coalesced
         churn burst, enqueue/park per-stage work, then drive every due
         redelivery. Returns a deterministic summary (the chaos runner
-        logs it into the replayable event log)."""
+        logs it into the replayable event log).
+
+        `drive=False` stops after the verdict/bookkeeping half — the
+        chaos harness uses it to kill a primary BETWEEN enqueuing
+        redelivery work and delivering it (the mid-redelivery crash
+        window the cp-failover scenario must cover)."""
         summary = {"dead": [], "online": [], "resolved": [],
                    "redelivered": [], "retried": [], "parked": []}
         events = self.detector.sweep()
@@ -225,7 +262,8 @@ class Reconverger:
                 log.exception("verdict handling failed; will retry")
                 summary["dead"], summary["online"] = [], []
                 summary["resolved"] = []
-        await self._drive_due(summary)
+        if drive:
+            await self._drive_due(summary)
         return summary
 
     async def _handle_verdicts(self, events: list[LeaseEvent],
@@ -409,9 +447,17 @@ class Reconverger:
         key = w.stage_key
         entry = self.state.placement.retained(key)
         if entry is None:
-            # stage torn down / never solved here: nothing to converge
-            self._retire(w)
-            return False
+            # No retained placement for in-flight work means THIS process
+            # never solved the stage: the work was inherited from a dead
+            # predecessor (CP restart, or a standby promoted mid-
+            # redelivery). Rebuild the retry state from replicated
+            # records: a fresh solve from the stored deployment template
+            # repopulates the retained entry, and the redelivery proceeds
+            # as if the solve had happened here. Only when there is no
+            # template either is the stage truly gone.
+            entry = await self._rebuild_retained(w)
+            if entry is None:
+                return False
         _pt, placement = entry
         if not placement.feasible:
             self._park(w, "infeasible",
@@ -460,6 +506,32 @@ class Reconverger:
         log.info("stage reconverged %s", kv(stage=key,
                                             nodes=",".join(targets)))
         return True
+
+    async def _rebuild_retained(self, w: _Work):
+        """Failover/restart path: re-solve the stage from its stored
+        deployment template so redelivery has a placement to carry.
+        Returns the retained (pt, placement) entry, or None after
+        retiring/parking the work."""
+        key = w.stage_key
+        req, tenant = self._template(key)
+        if req is None:
+            # stage torn down / never solved anywhere: nothing to converge
+            self._retire(w)
+            return None
+        solve = getattr(self.state.placement, "solve_stage", None)
+        if solve is None:   # minimal placement fake (unit tests)
+            self._retire(w)
+            return None
+        with span(log, "heal.rebuild", stage=key, attempt=w.attempt):
+            # reserve=False: commit_retained books the capacity when the
+            # redelivery lands, same as the node_events churn path
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: solve(req.flow, req.stage_name,
+                                    tenant=tenant, reserve=False))
+        self.stats["rebuilt_solves"] += 1
+        log.info("retained placement rebuilt from template %s",
+                 kv(stage=key))
+        return self.state.placement.retained(key)
 
     def _retire(self, w: _Work) -> None:
         self._work.pop(w.stage_key, None)

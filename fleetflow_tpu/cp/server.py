@@ -12,6 +12,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+import asyncio
+
 from .agent_registry import AgentRegistry
 from .auth import Claims, NoAuth, make_provider
 from .failure_detector import FailureDetector, LeaseConfig
@@ -19,6 +21,8 @@ from .log_router import LogRouter
 from .placement import PlacementService
 from .protocol import ProtocolServer
 from .reconverge import ReconvergeConfig, Reconverger
+from .replication import (ReplicationConfig, Replicator, StandbyReplica,
+                          StandbyRunner)
 from .store import Store
 from ..obs import get_logger, kv
 
@@ -52,6 +56,17 @@ class ServerConfig:
     heal_backoff_base_s: float = 2.0
     heal_backoff_max_s: float = 60.0
     heal_max_attempts: int = 5
+    # replication (cp/replication.py, docs/guide/13-cp-replication.md).
+    # A primary needs nothing: standbys dial in on the replication
+    # channel. A standby sets `standby_of` to the primary's host:port;
+    # it streams the journal, watches the primary's lease, and promotes
+    # itself (epoch bump + fencing) when the lease dies.
+    standby_of: Optional[str] = None
+    standby_token: Optional[str] = None      # auth for the primary dial
+    replication_ring: int = 8192             # replayable backlog entries
+    standby_ping_interval_s: float = 2.0
+    standby_lease_s: float = 10.0
+    standby_grace_s: float = 5.0
 
 
 @dataclass
@@ -85,6 +100,13 @@ class AppState:
     # {"issuer", "client_id", "audience"} when the CP runs JwksAuth with a
     # device-flow-capable IdP; the dashboard's browser login uses it
     auth_idp: Optional[dict] = None
+    # replication (docs/guide/13-cp-replication.md): "primary" serves
+    # every channel and ships its journal through `replicator`;
+    # "standby" refuses mutations + agent sessions until its
+    # StandbyRunner promotes it
+    replication_role: str = "primary"
+    replicator: Optional[Replicator] = None
+    standby: Optional[StandbyRunner] = None
 
 
 class CpServerHandle:
@@ -101,6 +123,8 @@ class CpServerHandle:
         return self.ca.ca_pem if self.ca else None
 
     async def stop(self) -> None:
+        if self.state.standby is not None:
+            self.state.standby.stop()
         if self.state.reconverger is not None:
             self.state.reconverger.stop()
         await self.server.stop()
@@ -191,30 +215,88 @@ async def start(config: ServerConfig, *,
         ssl_ctx = server_ssl_context(ca, common_name=config.name,
                                      work_dir=config.tls_dir)
 
-    if config.self_heal:
-        state.failure_detector = FailureDetector(LeaseConfig(
-            lease_s=config.lease_s,
-            suspect_grace_s=config.suspect_grace_s))
-        state.reconverger = Reconverger(
-            state, state.failure_detector,
-            config=ReconvergeConfig(
-                interval_s=config.heal_interval_s,
-                backoff_base_s=config.heal_backoff_base_s,
-                backoff_max_s=config.heal_backoff_max_s,
-                max_attempts=config.heal_max_attempts))
-        # a restarted CP picks its convergence debt back up BEFORE any
-        # agent reconnects (crash-only: recovery is the boot path)
-        state.reconverger.resume()
-        state.reconverger.spawn()
+    repl_config = ReplicationConfig(
+        ring_entries=config.replication_ring,
+        ping_interval_s=config.standby_ping_interval_s,
+        lease_s=config.standby_lease_s,
+        grace_s=config.standby_grace_s)
 
-    server = ProtocolServer(name=config.name, authenticate=authenticate,
-                            ssl_context=ssl_ctx)
+    if config.standby_of:
+        # standby: stream the primary's journal, watch its lease, promote
+        # on death. No self-heal machinery until promotion — a standby
+        # must not issue verdicts about agents it doesn't serve.
+        state.replication_role = "standby"
+        host_s, _, port_s = config.standby_of.rpartition(":")
+        state.standby = StandbyRunner(
+            StandbyReplica(store), host_s, int(port_s),
+            identity=config.name, token=config.standby_token,
+            config=repl_config,
+            on_promote=lambda: _promote(state, config, repl_config))
+        state.standby.spawn()
+    else:
+        state.replicator = Replicator(
+            store, config=repl_config, loop=asyncio.get_running_loop())
+        state.agent_registry.epoch_source = lambda: store.epoch
+        if config.self_heal:
+            _build_self_heal(state, config)
+
+    server = ProtocolServer(
+        name=config.name, authenticate=authenticate, ssl_context=ssl_ctx,
+        # the welcome frame advertises role + fencing epoch, so agents
+        # and CLIs can spot a zombie ex-primary at the handshake
+        welcome_extra=lambda: {"role": state.replication_role,
+                               "epoch": store.epoch})
     from .handlers import register_all
     register_all(server, state)
 
     host, port = await server.start(config.host, config.port)
     log.info("listening %s", kv(
         host=host, port=port, name=config.name,
+        role=state.replication_role,
         tls=bool(config.tls_dir), auth=config.auth_kind,
         db=config.db_path or ":memory:"))
     return CpServerHandle(server, state, host, port, ca)
+
+
+def _build_self_heal(state: AppState, config: ServerConfig) -> None:
+    """The self-healing pair + crash-recovery boot sequence, shared by
+    primary start and standby promotion (crash-only design: recovery IS
+    the boot path)."""
+    state.failure_detector = FailureDetector(LeaseConfig(
+        lease_s=config.lease_s,
+        suspect_grace_s=config.suspect_grace_s))
+    state.reconverger = Reconverger(
+        state, state.failure_detector,
+        config=ReconvergeConfig(
+            interval_s=config.heal_interval_s,
+            backoff_base_s=config.heal_backoff_base_s,
+            backoff_max_s=config.heal_backoff_max_s,
+            max_attempts=config.heal_max_attempts))
+    # a restarted CP picks its convergence debt back up BEFORE any
+    # agent reconnects
+    state.reconverger.resume()
+    # prime a lease for EVERY known server: an agent that died with the
+    # old CP (or while it was down) never heartbeats the new one, and
+    # without a lease its death would be invisible forever — its primed
+    # lease expires to a DEAD verdict, the re-solve moves its stages,
+    # and the stuck redelivery work is superseded. Live agents renew the
+    # primed lease with their first heartbeat; servers with nothing
+    # placed on them make the verdict a no-op.
+    for s in state.store.list("servers"):
+        state.failure_detector.prime(s.slug)
+    state.reconverger.spawn()
+
+
+def _promote(state: AppState, config: ServerConfig,
+             repl_config: ReplicationConfig) -> None:
+    """Standby -> primary flip (StandbyRunner.on_promote): open the
+    gates, start shipping OUR journal to the next generation of
+    standbys, and pick up the dead primary's convergence debt."""
+    state.replication_role = "primary"
+    state.replicator = Replicator(
+        state.store, config=repl_config, loop=asyncio.get_running_loop())
+    state.agent_registry.epoch_source = lambda: state.store.epoch
+    if config.self_heal:
+        _build_self_heal(state, config)
+    log.warning("standby promoted: now serving as primary %s", kv(
+        epoch=state.store.epoch, name=config.name))

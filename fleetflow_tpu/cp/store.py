@@ -28,6 +28,17 @@ throughput cost.
 Thread-safe: one RLock guards all tables (handler tasks run on one asyncio
 loop, but the REST surface and background checkers may call from executor
 threads).
+
+Replication (docs/guide/13-cp-replication.md): every journal entry —
+including the batched/coalesced paths — carries a monotonic sequence
+number (`"q"`) and the store's fencing epoch (`"e"`), and is handed to an
+optional `replication_sink` so a primary CP can stream its journal to warm
+standbys. A standby applies the stream with `apply_replicated` (gap
+detection by sequence, stale-epoch fencing) or bootstraps/catches up from
+`snapshot_doc`/`install_snapshot`. The epoch is bumped exactly once per
+primary promotion (`bump_epoch`) and persists through both the snapshot
+(`_meta`) and a dedicated `{"op": "epoch"}` journal line, so a zombie
+ex-primary's entries are refusable forever after a failover.
 """
 
 from __future__ import annotations
@@ -39,12 +50,24 @@ from pathlib import Path
 from typing import Callable, Optional, TypeVar
 
 from .models import (Alert, BuildJob, CostEntry, Deployment, DeploymentStatus,
-                     DnsRecord, ObservedContainer, ParkedWork, Project, Record,
-                     Server, ServiceRecord, StageRecord, Tenant, TenantUser,
-                     VolumeRecord, VolumeSnapshot, WorkerPool, new_id, now_ts)
+                     DnsRecord, ObservedContainer, ParkedWork, PlacementRecord,
+                     Project, Record, Server, ServiceRecord, StageRecord,
+                     Tenant, TenantUser, VolumeRecord, VolumeSnapshot,
+                     WorkerPool, new_id, now_ts)
+from ..core.errors import ControlPlaneError
 from ..obs.metrics import REGISTRY
 
-__all__ = ["Store"]
+__all__ = ["Store", "ReplicationGap", "ReplicationFenced"]
+
+
+class ReplicationGap(ControlPlaneError):
+    """The replication stream skipped a sequence number: the standby must
+    catch up from a snapshot before applying further entries."""
+
+
+class ReplicationFenced(ControlPlaneError):
+    """A replicated entry carried a stale fencing epoch: it came from a
+    zombie ex-primary and must never be applied."""
 
 # metric catalog: docs/guide/10-observability.md. Counted via the store's
 # own mutation-observer hook so the change-data-capture path and the
@@ -56,6 +79,11 @@ _M_HEARTBEATS = REGISTRY.counter(
     "fleet_heartbeats_total", "Agent heartbeats recorded")
 _M_COMPACTIONS = REGISTRY.counter(
     "fleet_store_compactions_total", "Journal compactions (snapshot writes)")
+_M_FENCING = REGISTRY.counter(
+    "fleet_replication_fencing_rejections_total",
+    "Stale-epoch writes refused after a failover, by side (store: "
+    "replicated entries from a zombie ex-primary; cp: rejected "
+    "replication RPCs; agent: fenced agent commands)", labels=("side",))
 
 
 def _count_op(op: str, table: str, _payload: object) -> None:
@@ -70,7 +98,7 @@ _TABLES: dict[str, type] = {
     "observed_containers": ObservedContainer, "volumes": VolumeRecord,
     "volume_snapshots": VolumeSnapshot, "build_jobs": BuildJob,
     "cost_entries": CostEntry, "dns_records": DnsRecord,
-    "parked_work": ParkedWork,
+    "parked_work": ParkedWork, "placements": PlacementRecord,
 }
 
 
@@ -102,6 +130,16 @@ class Store:
         self._compactions = 0
         self._batch_depth = 0
         self._batch_buf: list[str] = []
+        # replication: every emitted journal entry carries (seq, epoch);
+        # the sink — when set — receives [(seq, line), ...] under the
+        # store lock (same contract as observers: fast, no re-entry).
+        # Batched mutations hand the sink ONE coalesced list on batch
+        # exit, mirroring the single journal write.
+        self._seq = 0
+        self._epoch = 1
+        self.replication_sink: Optional[
+            Callable[[list[tuple[int, str]]], None]] = None
+        self._repl_buf: list[tuple[int, str]] = []
         # mutation observers: fn(op, table, rec_or_id) called under the
         # store lock AFTER each create/update/delete. This is the
         # change-data-capture hook the chaos harness builds its causal
@@ -375,6 +413,10 @@ class Store:
                     if store._batch_depth == 0 and store._batch_buf:
                         lines, store._batch_buf = store._batch_buf, []
                         store._append_lines(lines)
+                    if store._batch_depth == 0 and store._repl_buf:
+                        entries, store._repl_buf = store._repl_buf, []
+                        if store.replication_sink is not None:
+                            store.replication_sink(entries)
                 return False
 
         return _Batch()
@@ -388,15 +430,29 @@ class Store:
                     "compactions": self._compactions}
 
     def _log_put(self, table: str, rec: Record) -> None:
-        if self._journal_path is None:
-            return
-        line = json.dumps({"op": "put", "t": table, "r": rec.to_dict()})
-        self._log_line(line)
+        self._emit({"op": "put", "t": table, "r": rec.to_dict()})
 
     def _log_del(self, table: str, rec_id: str) -> None:
-        if self._journal_path is None:
+        self._emit({"op": "del", "t": table, "id": rec_id})
+
+    def _emit(self, entry: dict) -> None:
+        """Serialize one journal entry with its sequence number and epoch,
+        then hand it to the local journal and/or the replication sink.
+        Caller holds the lock (all mutators do). A store with neither a
+        journal nor a sink skips the serialization entirely."""
+        if self._journal_path is None and self.replication_sink is None:
             return
-        self._log_line(json.dumps({"op": "del", "t": table, "id": rec_id}))
+        self._seq += 1
+        entry["q"] = self._seq
+        entry["e"] = self._epoch
+        line = json.dumps(entry)
+        if self._journal_path is not None:
+            self._log_line(line)
+        if self.replication_sink is not None:
+            if self._batch_depth > 0:
+                self._repl_buf.append((self._seq, line))
+            else:
+                self.replication_sink([(self._seq, line)])
 
     def _log_line(self, line: str) -> None:
         # caller holds the lock (all mutators do)
@@ -429,8 +485,7 @@ class Store:
         # serialize AND write under the lock: concurrent flushes from
         # executor threads must not interleave on the shared tmp file
         with self._lock:
-            doc = {t: [r.to_dict() for r in rows.values()]
-                   for t, rows in self._tables.items()}
+            doc = self._snapshot_doc_locked()
             tmp = self._path.with_suffix(f".tmp{threading.get_ident()}")
             if self._fsync:
                 # the WAL guarantee must survive compaction: the snapshot
@@ -460,8 +515,134 @@ class Store:
             self._compactions += 1
             _M_COMPACTIONS.inc()
 
+    def _snapshot_doc_locked(self) -> dict:
+        doc = {t: [r.to_dict() for r in rows.values()]
+               for t, rows in self._tables.items()}
+        # replication metadata rides the snapshot: a standby installing it
+        # (or this store reloading it) resumes sequence numbering and the
+        # fencing epoch exactly where the journal left off. Old readers
+        # iterate _TABLES only, so the extra key is forward-compatible.
+        doc["_meta"] = {"seq": self._seq, "epoch": self._epoch}
+        return doc
+
+    def snapshot_doc(self) -> dict:
+        """Full-state snapshot for standby catch-up (the same document
+        `flush` writes to disk, including the `_meta` seq/epoch)."""
+        with self._lock:
+            return self._snapshot_doc_locked()
+
+    def install_snapshot(self, doc: dict) -> None:
+        """Replace ALL state with a primary's snapshot (standby bootstrap
+        or catch-up after a stream gap), then persist locally so a standby
+        restart doesn't re-fetch. Sequence numbering and epoch resume from
+        the snapshot's `_meta`."""
+        with self._lock:
+            self._tables = {t: {} for t in _TABLES}
+            self._load_doc(doc)
+            meta = doc.get("_meta") or {}
+            self._seq = int(meta.get("seq", self._seq))
+            self._epoch = int(meta.get("epoch", self._epoch))
+            self.flush()
+
+    # ------------------------------------------------------------------
+    # replication (primary journal shipping -> standby apply)
+    # ------------------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def bump_epoch(self) -> int:
+        """Primary promotion: advance the fencing epoch by one and journal
+        the transition (it replicates and persists like any mutation), so
+        every entry the NEW primary emits outranks the old one's."""
+        with self._lock:
+            self._epoch += 1
+            self._emit({"op": "epoch"})
+            return self._epoch
+
+    def apply_replicated(self, entries: list[tuple[int, str]]) -> int:
+        """Standby-side: apply sequence-numbered journal lines shipped by
+        the primary. Enforces the two stream invariants:
+
+          * gap detection — entries must arrive at exactly seq+1; a skip
+            raises ReplicationGap (the standby re-syncs from a snapshot);
+          * fencing — an entry whose epoch is below this store's raises
+            ReplicationFenced (zombie ex-primary; never applied).
+
+        Applied entries are re-journaled locally (when this store has a
+        path) so a promoted standby is durable without a re-snapshot.
+        Returns the number of entries applied."""
+        applied = 0
+        with self._lock:
+            for seq, line in entries:
+                entry = json.loads(line)
+                epoch = int(entry.get("e", self._epoch))
+                # fencing FIRST: a zombie's entry must be refused loudly
+                # even when its seq falls inside already-applied history
+                if epoch < self._epoch:
+                    _M_FENCING.inc(side="store")
+                    raise ReplicationFenced(
+                        f"entry seq={seq} epoch={epoch} < local epoch "
+                        f"{self._epoch}: refusing zombie write")
+                if seq <= self._seq:
+                    # already applied (a batch queued before a snapshot
+                    # resync): replay is idempotent by sequence — skip
+                    # instead of forcing another full resync
+                    continue
+                if seq != self._seq + 1:
+                    raise ReplicationGap(
+                        f"stream gap: got seq={seq}, expected "
+                        f"{self._seq + 1}")
+                self._apply_entry(entry)
+                self._seq = seq
+                self._epoch = epoch
+                if self._journal_path is not None:
+                    self._log_line(line)
+                applied += 1
+        return applied
+
+    def _apply_entry(self, entry: dict, notify: bool = True) -> None:
+        """Apply one decoded journal entry to the tables (shared by local
+        replay and the replication stream). Caller holds the lock. Local
+        boot replay passes notify=False — observers see live mutations,
+        not recovery; the replication stream notifies (the standby's CDC
+        hooks and metrics see applied entries as the mutations they are)."""
+        op = entry.get("op")
+        if op == "epoch":
+            self._epoch = int(entry.get("e", self._epoch))
+            return
+        table = entry.get("t")
+        cls = _TABLES.get(table)
+        if cls is None:
+            return
+        if op == "put":
+            try:
+                rec = cls.from_dict(entry["r"])
+            except (KeyError, TypeError):
+                return
+            self._tables[table][rec.id] = rec
+            if notify:
+                self._notify("put", table, rec)
+        elif op == "del":
+            rid = entry.get("id")
+            if self._tables[table].pop(rid, None) is not None and notify:
+                self._notify("del", table, rid)
+
     def _load(self) -> None:
         doc = json.loads(self._path.read_text())
+        self._load_doc(doc)
+        meta = doc.get("_meta") or {}
+        self._seq = int(meta.get("seq", 0))
+        self._epoch = int(meta.get("epoch", 1))
+
+    def _load_doc(self, doc: dict) -> None:
         for table, cls in _TABLES.items():
             for row in doc.get(table, []):
                 rec = cls.from_dict(row)
@@ -489,15 +670,8 @@ class Store:
                     "(%d trailing entries NOT applied)",
                     i + 1, len(lines), len(lines) - i - 1)
                 break
-            table = entry.get("t")
-            cls = _TABLES.get(table)
-            if cls is None:
-                continue
-            if entry.get("op") == "put":
-                try:
-                    rec = cls.from_dict(entry["r"])
-                except (KeyError, TypeError):
-                    continue
-                self._tables[table][rec.id] = rec
-            elif entry.get("op") == "del":
-                self._tables[table].pop(entry.get("id"), None)
+            self._apply_entry(entry, notify=False)
+            # resume sequence numbering past the surviving tail (entries
+            # predating the seq field leave the counter where _load set it)
+            if "q" in entry:
+                self._seq = max(self._seq, int(entry["q"]))

@@ -47,6 +47,14 @@ class DaemonConfig:
     lease_s: float = 90.0
     suspect_grace_s: float = 30.0
     heal_interval_s: float = 5.0
+    # replication (docs/guide/13-cp-replication.md): set standby-of to
+    # run this daemon as a warm standby of that primary; it streams the
+    # journal, watches the primary's lease, and promotes itself on death
+    standby_of: Optional[str] = None
+    standby_token: Optional[str] = None
+    standby_ping_interval_s: float = 2.0
+    standby_lease_s: float = 10.0
+    standby_grace_s: float = 5.0
     source: Optional[str] = None
 
     def expand(self) -> "DaemonConfig":
@@ -129,6 +137,22 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             cfg.autoscale_interval_s = float(v)
         elif n in ("tpu-solver", "use-tpu-solver"):
             cfg.use_tpu_solver = _truthy(v, node)
+        elif n == "replication":
+            # `replication standby-of="primary:4510" lease=10 grace=5
+            #  ping=2 token="..."` — omit the node (or standby-of) to run
+            # as a primary; standbys dial the primary's listen port
+            sb = node.prop("standby-of", node.arg(0))
+            if sb is not None:
+                cfg.standby_of = str(sb)
+            token = node.prop("token")
+            if token is not None:
+                cfg.standby_token = str(token)
+            for prop, attr in (("ping", "standby_ping_interval_s"),
+                               ("lease", "standby_lease_s"),
+                               ("grace", "standby_grace_s")):
+                val = node.prop(prop)
+                if val is not None:
+                    setattr(cfg, attr, float(val))
         elif n == "self-heal":
             # `self-heal false` disables; props tune the lease machinery:
             # `self-heal lease=90 grace=30 interval=5`

@@ -48,7 +48,12 @@ class Daemon:
             use_tpu_solver=cfg.use_tpu_solver,
             self_heal=cfg.self_heal, lease_s=cfg.lease_s,
             suspect_grace_s=cfg.suspect_grace_s,
-            heal_interval_s=cfg.heal_interval_s))
+            heal_interval_s=cfg.heal_interval_s,
+            standby_of=cfg.standby_of,
+            standby_token=cfg.standby_token,
+            standby_ping_interval_s=cfg.standby_ping_interval_s,
+            standby_lease_s=cfg.standby_lease_s,
+            standby_grace_s=cfg.standby_grace_s))
         if cfg.web_enabled:
             self.web = WebServer(self.cp.state)
             self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)

@@ -193,6 +193,17 @@ class WebServer:
             if m != method:
                 path_matched = True
                 continue
+            if (method != "GET"
+                    and getattr(self.state, "replication_role",
+                                "primary") != "primary"):
+                # standby gating (docs/guide/13-cp-replication.md): the
+                # web surface mirrors the channel rule — reads are served
+                # from the replicated state, writes belong to the one
+                # primary of this epoch (a write applied here would be
+                # ghost state, or desync the replication seq)
+                raise HttpError(
+                    503, "standby: not primary — send writes to the "
+                         "current primary")
             if not public:
                 claims = self._authorize(headers)
                 if claims is not None and perm and not claims.has(perm):

@@ -36,10 +36,18 @@ def newest_artifact() -> tuple[str, dict]:
     arts = sorted(REPO.glob("BENCH_r*.json"), key=key)
     if not arts:
         raise SystemExit("no BENCH_r*.json artifacts found")
-    path = arts[-1]
-    doc = json.loads(path.read_text())
-    # driver artifacts wrap the bench line under "parsed"
-    return path.name, doc.get("parsed", doc)
+    # newest USABLE artifact: a driver record whose bench line failed to
+    # parse carries `"parsed": null` — walk back to the next artifact
+    # with a real section instead of crashing on the null
+    for path in reversed(arts):
+        doc = json.loads(path.read_text())
+        # driver artifacts wrap the bench line under "parsed"
+        parsed = doc.get("parsed", doc)
+        if isinstance(parsed, dict) and "solve_ms" in parsed:
+            return path.name, parsed
+    raise SystemExit(
+        "no BENCH_r*.json artifact holds a usable bench section "
+        f"(checked {len(arts)}: newest {arts[-1].name} has parsed=null?)")
 
 
 def render(name: str, d: dict) -> str:

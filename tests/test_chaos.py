@@ -107,6 +107,34 @@ class TestSmoke:
         assert a.events == b.events
         assert a.digest() == b.digest()
 
+    def test_cp_failover_smoke(self):
+        """Kill the CP primary three times (mid-redelivery, mid-burst,
+        mid-compaction): the journal-shipping standby promotes each
+        time, resumes the dead primary's convergence debt, and the
+        fleet converges under the final primary — with every zombie
+        write fenced (cp-failover-converged judges all of it)."""
+        r = run_scenario("cp-failover", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        assert r.stats["failovers"] == 3
+        assert r.stats["heals"] > 0
+        events = {e["event"] for e in r.events}
+        assert "cp-failover" in events       # standby promoted
+        assert "cp-resumed" in events        # convergence debt resumed
+        assert "standby-attached" in events  # next-gen standby caught up
+        assert "fencing-rejected" in events  # zombie writes bounced
+        # three promotions = three epoch bumps on top of epoch 1
+        failover_epochs = [e["epoch"] for e in r.events
+                           if e["event"] == "cp-failover"]
+        assert failover_epochs == [2, 3, 4]
+
+    def test_cp_failover_same_seed_same_digest(self):
+        """Failover replay (promotion, resume, rehydration, fencing)
+        stays inside the deterministic-replay contract."""
+        a = run_scenario("cp-failover", seed=11, **SMOKE)
+        b = run_scenario("cp-failover", seed=11, **SMOKE)
+        assert a.events == b.events
+        assert a.digest() == b.digest()
+
 
 @pytest.mark.slow
 class TestFullPack:
@@ -212,6 +240,51 @@ class TestInvariantCanaries:
         w.state.reconverger._enqueue("chaosfleet/app0", "tr")
         found = selfheal_converged(w)
         assert found and "redelivery debt" in found[0]
+
+    def test_cp_failover_converged_fires_on_lost_debt(self):
+        """A convergence-debt row that vanished across failover without
+        its stage converging (and without parking) must fire — that is
+        the 'no parked_work record is lost' half of the acceptance."""
+        from fleetflow_tpu.chaos.invariants import cp_failover_converged
+        w = _world()
+        assert cp_failover_converged(w) == []     # no failovers: vacuous
+        w.cp_failovers = 1
+        w.fencing_rejections = 1
+        w.state.store._epoch = 2                  # one legitimate bump
+        assert cp_failover_converged(w) == []     # clean failover
+        # a stage the dead primary owed work for, now neither converged
+        # (it has no placement at all) nor parked
+        w.prekill_work.add(("chaosfleet/ghost", False))
+        found = cp_failover_converged(w)
+        assert found and "lost across failover" in found[0]
+
+    def test_cp_failover_converged_fires_on_double_execution(self):
+        from fleetflow_tpu.chaos.invariants import cp_failover_converged
+        w = _world()
+        w.cp_failovers = 1
+        w.fencing_rejections = 1
+        w.state.store._epoch = 2
+        w.idem_executions["heal-k1@node000"] = ["app0", 2]
+        found = cp_failover_converged(w)
+        assert found and "idempotency window lost" in found[0]
+
+    def test_cp_failover_converged_fires_on_unfenced_zombie(self):
+        from fleetflow_tpu.chaos.invariants import cp_failover_converged
+        w = _world()
+        w.cp_failovers = 2
+        w.fencing_rejections = 1                  # one zombie got through
+        w.state.store._epoch = 3
+        found = cp_failover_converged(w)
+        assert found and "wrote through the fence" in found[0]
+
+    def test_cp_failover_converged_fires_on_epoch_drift(self):
+        from fleetflow_tpu.chaos.invariants import cp_failover_converged
+        w = _world()
+        w.cp_failovers = 2
+        w.fencing_rejections = 2
+        w.state.store._epoch = 2                  # one bump missing
+        found = cp_failover_converged(w)
+        assert found and "epoch" in found[0]
 
     def test_metrics_monotonic_fires_on_counter_decrease(self):
         from fleetflow_tpu.obs.metrics import REGISTRY
