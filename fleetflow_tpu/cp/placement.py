@@ -226,10 +226,12 @@ class PlacementService:
             if self.use_tpu:
                 warm = (prev is not None
                         and prev[0].S == pt.S and prev[0].N == pt.N)
-                placement = self._sched_tpu.place(pt, warm_start=warm)
+                placement = self._sched_tpu.place(pt, warm_start=warm,
+                                                  stage=key)
                 if not placement.feasible and pt.relax_order:
                     placement, _ = place_with_fallback(
-                        self._sched_tpu, pt, initial=placement)
+                        self._sched_tpu, pt, initial=placement,
+                        place_kwargs={"stage": key})
             else:
                 placement, _ = place_with_fallback(self._sched_host, pt)
             self._last[key] = (pt, placement)
@@ -611,7 +613,19 @@ class PlacementService:
                 degraded = False
                 try:
                     if self.use_tpu:
-                        new = self._sched_tpu.reschedule(pt)
+                        # structured churn instead of a full re-staging:
+                        # validity flips + refreshed capacity ride a
+                        # ProblemDelta, which the scheduler merges into
+                        # its device-resident problem when the bucket
+                        # identity holds (solver/resident.py) — the
+                        # (S, N) problem planes never re-cross the host
+                        # boundary on a reconvergence burst. Content
+                        # drift beyond the delta cold-stages safely.
+                        from ..solver.resident import ProblemDelta
+                        new = self._sched_tpu.reschedule(
+                            pt, delta=ProblemDelta(node_valid=pt.node_valid,
+                                                   capacity=pt.capacity),
+                            stage=key)
                     else:
                         new = self._sched_host.place(pt)
                 except Exception as e:
@@ -630,7 +644,10 @@ class PlacementService:
                     # device solver stays benched for the ladder too)
                     sched = (self._sched_host if degraded or not self.use_tpu
                              else self._sched_tpu)
-                    new, _ = place_with_fallback(sched, pt, initial=new)
+                    new, _ = place_with_fallback(
+                        sched, pt, initial=new,
+                        place_kwargs=({"stage": key}
+                                      if sched is self._sched_tpu else None))
                 self._last[key] = (pt, new)
                 if new.feasible:
                     new_dem = self._demand_by_node(pt, new)

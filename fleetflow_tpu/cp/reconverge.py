@@ -10,6 +10,10 @@ code path):
 
   FailureDetector.sweep() -> LeaseEvents (dead / node-online verdicts)
       -> placement.node_events(coalesced burst)   one warm re-solve/stage
+         (on the TPU scheduler the burst rides a structured ProblemDelta
+         into the device-resident problem — solver/resident.py — so a
+         reconvergence re-solve never re-uploads the problem tensors;
+         `fleet cp heal status` reports the delta/cold staging counts)
       -> redelivery: DeployRequest per surviving node via
          AgentRegistry.send_command, with
            * per-work idempotency keys (agent/agent.py dedupes a replay
@@ -234,6 +238,23 @@ class Reconverger:
                       "last_error": w.last_error[:200]}
                      for _, w in sorted(self._work.items())],
             "stats": dict(self.stats),
+            # how the churn re-solves behind the verdicts were staged:
+            # delta = merged into the device-resident problem (the
+            # sub-10ms warm path, docs/guide/11-performance.md), cold =
+            # full host restaging (content drift / first solve). Host-path
+            # CPs report zeros — the TPU scheduler owns these counters.
+            "resident": self._resident_stats(),
+        }
+
+    @staticmethod
+    def _resident_stats() -> dict:
+        from ..obs.metrics import REGISTRY
+        reuse = REGISTRY.get("fleet_solver_resident_reuse_total")
+        xfers = REGISTRY.get("fleet_solver_host_transfers_total")
+        return {
+            "delta_reuse": int(reuse.value(outcome="delta")) if reuse else 0,
+            "cold_stagings": int(reuse.value(outcome="cold")) if reuse else 0,
+            "host_transfers": int(xfers.value()) if xfers else 0,
         }
 
     # ------------------------------------------------------------------

@@ -54,14 +54,20 @@ def relax_problem(pt: ProblemTensors, what: str) -> Optional[ProblemTensors]:
 
 def place_with_fallback(scheduler: Scheduler, pt: ProblemTensors, *,
                         initial: Optional[Placement] = None,
+                        place_kwargs: Optional[dict] = None,
                         ) -> tuple[Placement, list[str]]:
     """Solve; on infeasibility walk pt.relax_order, relaxing one class at a
     time (cumulative) and re-solving. Returns (placement, relaxed classes).
     The final placement may still be infeasible when even the fully relaxed
     problem has no solution (capacity/conflicts are never relaxed — they
     are physical). `initial` skips the first solve when the caller already
-    has an (infeasible) result for the un-relaxed problem."""
-    placement = initial if initial is not None else scheduler.place(pt)
+    has an (infeasible) result for the un-relaxed problem. `place_kwargs`
+    forwards scheduler-specific keywords through the ladder's re-solves
+    (the TPU scheduler's `stage=` resident-slot key: without it a relaxed
+    re-solve would land in an anonymous slot and the stage's resident warm
+    seed would keep pointing at the pre-relaxation infeasible winner)."""
+    kw = place_kwargs or {}
+    placement = initial if initial is not None else scheduler.place(pt, **kw)
     relaxed: list[str] = []
     for what in pt.relax_order:
         if placement.feasible:
@@ -73,7 +79,7 @@ def place_with_fallback(scheduler: Scheduler, pt: ProblemTensors, *,
         relaxed.append(what)
         log.info("placement infeasible; relaxing %s",
                  kv(what=what, order=",".join(pt.relax_order)))
-        placement = scheduler.place(pt)
+        placement = scheduler.place(pt, **kw)
     if relaxed:
         placement = dataclasses.replace(
             placement, source=f"{placement.source}+relaxed:{','.join(relaxed)}")

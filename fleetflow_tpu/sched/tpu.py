@@ -1,16 +1,21 @@
 """TPU solver scheduler backend: wraps fleetflow_tpu.solver.solve.
 
-Holds the staged DeviceProblem across re-solves so streaming reschedules
-(node churn) pay only the small delta upload, never a full re-stage
+Owns the DEVICE-RESIDENT fleet state (solver/resident.py): the padded
+DeviceProblem and the last committed assignment live on device across
+re-solves, and CP churn arrives as structured `ProblemDelta`s applied by a
+donated on-device merge — warm reschedules never round-trip the host
 (SURVEY.md hard part (d): keep the host<->device boundary out of the
-per-reschedule path).
+per-reschedule path). Content drift the delta cannot express (a relowered
+fleet, new conflict ids, a different shape tier) falls back to cold
+staging, counted in fleet_solver_resident_reuse_total{outcome}.
 """
 
 from __future__ import annotations
 
-import dataclasses
+import os
 import time
-from typing import Optional
+from dataclasses import dataclass
+from typing import Any, Optional
 
 import numpy as np
 
@@ -18,6 +23,18 @@ from .base import Placement, level_schedule, record_placement
 from ..lower.tensors import ProblemTensors
 
 __all__ = ["TpuSolverScheduler"]
+
+
+@dataclass
+class _StageSlot:
+    """Per-stage resident state. The CP drives every stage through ONE
+    scheduler, so resident reuse must be per stage: a single shared slot
+    would make each stage's churn evict the other's device buffers (every
+    multi-stage burst cold-stages) and could warm-seed one stage's anneal
+    from another stage's assignment when their shapes coincide."""
+    resident: Any                                  # solver.resident.ResidentProblem
+    last_assignment: Optional[np.ndarray] = None   # host warm seed for cold fallback
+    key: Optional[str] = None                      # CP stage key, when the caller has one
 
 
 class TpuSolverScheduler:
@@ -32,44 +49,111 @@ class TpuSolverScheduler:
         # bucket=None -> ON for the scheduler (this is the churn/reschedule
         # path the bucketing exists for; FLEET_BUCKET=0 force-disables)
         self.bucket = bucket
-        self._staged = None   # (pt identity, DeviceProblem, valid fingerprint)
-        self._last_assignment: Optional[np.ndarray] = None
+        # MRU pool of per-stage resident slots; bounded so a CP cycling
+        # through many stages cannot pin unbounded device memory
+        self._residents: list[_StageSlot] = []
+        try:
+            self._max_residents = max(
+                1, int(os.environ.get("FLEET_RESIDENT_STAGES") or "8"))
+        except ValueError:
+            self._max_residents = 8
 
     def _bucket_enabled(self, pt: ProblemTensors) -> bool:
         from ..solver.buckets import bucket_config
         if self.bucket is False:
             return False
-        return bucket_config().enabled and pt.max_skew == 0
+        # spread constraints bucket too since phantoms carry a traced
+        # n_real mask (the former max_skew bypass is closed)
+        return bucket_config().enabled
 
-    def _stage(self, pt: ProblemTensors):
-        """Staged DeviceProblem for pt, reusing the device copy across
-        re-solves. Identity alone is NOT enough: the CP's node_event mutates
-        pt.node_valid in place (churn), so the mask is fingerprinted and
-        pushed as a small device-side delta when it drifts — the round-2 bug
-        where a dead node kept its services because the device still saw the
-        stale mask.
+    def _stage(self, pt: ProblemTensors, delta, warm: bool,
+               stage_key: Optional[str] = None):
+        """Resident staging decision: DELTA (on-device merge into the
+        resident buffers) when the bucket identity holds and the drift is
+        expressible, else COLD (full host staging). The old identity-keyed
+        cache re-staged the whole padded problem whenever capacity drifted
+        (every churn burst with commitments); the resident layer turns
+        that into a few-KB upload + one donated dispatch.
 
-        The staging is BUCKETED (solver/buckets.py) unless disabled: the
-        padded DeviceProblem is what lives on device across re-solves, so a
-        fleet drifting within its size tier keeps both the staging and the
-        compiled executable."""
-        from ..solver import prepare_problem
-        from ..solver.buckets import bucket_config, pad_problem_tiers
-        import jax.numpy as jnp
+        Returns (slot, resident_warm): resident_warm=True means the
+        solve seeds from the device-resident previous assignment."""
+        from ..solver.resident import ProblemDelta, ResidentProblem
 
-        if self._staged is None or self._staged[0] is not pt:
-            prob = prepare_problem(pt)
-            if self._bucket_enabled(pt):
-                prob, _ = pad_problem_tiers(prob, bucket_config())
-            self._staged = (pt, prob, pt.node_valid.copy())
-        elif not np.array_equal(self._staged[2], pt.node_valid):
-            prob = dataclasses.replace(
-                self._staged[1], node_valid=jnp.asarray(pt.node_valid))
-            self._staged = (pt, prob, pt.node_valid.copy())
-        return self._staged[1]
+        # warm delta reuse: the slot whose resident staging matches this
+        # pt (compatible() checks shape tier + statics + object identity
+        # on the untouched tensors, so only this stage's own slot can hit)
+        if warm:
+            for i, slot in enumerate(self._residents):
+                rp = slot.resident
+                if rp.assignment is not None and rp.compatible(pt, delta):
+                    if i:
+                        self._residents.insert(0, self._residents.pop(i))
+                    if stage_key is not None:
+                        # a caller may start passing stage keys mid-life:
+                        # stamp the slot so keyed cold reclaims find it
+                        slot.key = stage_key
+                    if delta is not None:
+                        rp.apply_delta(pt, delta)
+                    elif rp.pt is not pt or rp.drifted(pt):
+                        # in-place mutation path (node_event flips
+                        # pt.node_valid, capacity refresh replaces it):
+                        # synthesize the delta
+                        rp.apply_delta(pt, ProblemDelta())
+                    return slot, True
 
-    def place(self, pt: ProblemTensors, *,
-              warm_start: bool = False) -> Placement:
+        # cold (re)staging: reclaim this stage's old slot so its host
+        # assignment can still warm-seed the fallback and the pool keeps
+        # one slot per stage. An explicit stage key (the CP passes its
+        # flow/stage key) is authoritative — two stages of one project can
+        # carry IDENTICAL service name lists, so names alone cannot tell
+        # them apart; without a key, fall back to shape + service-name
+        # match (in-place churn shares the list object, a relowered stage
+        # compares equal)
+        slot = None
+        if stage_key is not None:
+            for i, cand in enumerate(self._residents):
+                if cand.key == stage_key:
+                    slot = self._residents.pop(i)
+                    break
+        if slot is None:
+            # no keyed match: a keyless slot matching shape + names is
+            # this stage from an earlier keyless call — adopt (and stamp)
+            # it rather than leaking a second device-resident copy
+            for i, cand in enumerate(self._residents):
+                old = cand.resident.pt
+                if (cand.key is None
+                        and old is not None and old.S == pt.S
+                        and old.N == pt.N
+                        and (old.service_names is pt.service_names
+                             or old.service_names == pt.service_names)):
+                    slot = self._residents.pop(i)
+                    break
+        if warm and slot is not None and slot.resident.assignment is not None:
+            # this stage HAD resident state but the delta contract broke:
+            # problem tensors will cross the host boundary (the
+            # transfer-guard event)
+            slot.resident.record_warm_fallback()
+        resident = ResidentProblem(pt, bucket=self._bucket_enabled(pt))
+        if slot is None:
+            slot = _StageSlot(resident=resident, key=stage_key)
+        else:
+            slot.resident = resident
+            if stage_key is not None:
+                slot.key = stage_key
+        self._residents.insert(0, slot)
+        del self._residents[self._max_residents:]
+        return slot, False
+
+    def place(self, pt: ProblemTensors, *, warm_start: bool = False,
+              delta=None, overlap_host_work=None,
+              stage: Optional[str] = None) -> Placement:
+        """Solve `pt`. `delta` (solver.resident.ProblemDelta) is the CP's
+        structured churn for a warm reschedule: applied on device when the
+        resident bucket identity holds. `overlap_host_work` runs host-side
+        work (e.g. re-lowering) while the solve is in flight. `stage` is
+        the caller's stable stage key, used to keep one resident slot per
+        stage (two stages of one project can carry identical service
+        names, so the key is the only reliable identity)."""
         # First device use on the CP path: bootstrap the platform the same
         # way bench/__graft_entry__ do (probe the inherited platform
         # out-of-process, fall back to virtual CPU) — a control plane must
@@ -81,13 +165,28 @@ class TpuSolverScheduler:
         from ..solver import solve
 
         t0 = time.perf_counter()
-        prob = self._stage(pt)
+        slot, resident_warm = self._stage(pt, delta, warm_start, stage)
+        rp = slot.resident
 
-        init = self._last_assignment if warm_start else None
-        res = solve(pt, prob=prob, chains=self.chains, steps=self.steps,
+        # cold fallback on a warm request still warm-starts from THIS
+        # stage's last HOST assignment when shapes line up (the
+        # pre-resident behavior; slots are per stage so the seed can
+        # never come from a different stage's placement)
+        init = None
+        if (warm_start and not resident_warm
+                and slot.last_assignment is not None
+                and slot.last_assignment.shape[0] == pt.S):
+            init = slot.last_assignment
+        # bucket flag comes from the slot's OWN staging, not a fresh env
+        # read: rp.prob was padded (or not) under the config captured at
+        # cold-stage time, and a mid-life FLEET_BUCKET flip must not make
+        # _solve skip the phantom-row slice on an already-padded staging
+        res = solve(pt, prob=rp.prob, chains=self.chains, steps=self.steps,
                     seed=self.seed, mesh=self.mesh, init_assignment=init,
-                    bucket=self._bucket_enabled(pt))
-        self._last_assignment = res.assignment
+                    bucket=rp.bucket,
+                    resident=rp, resident_warm=resident_warm,
+                    overlap_host_work=overlap_host_work)
+        slot.last_assignment = res.assignment
         ms = (time.perf_counter() - t0) * 1e3
 
         placement = Placement(
@@ -104,7 +203,11 @@ class TpuSolverScheduler:
         record_placement(placement)
         return placement
 
-    def reschedule(self, pt: ProblemTensors) -> Placement:
+    def reschedule(self, pt: ProblemTensors, *, delta=None,
+                   overlap_host_work=None,
+                   stage: Optional[str] = None) -> Placement:
         """Streaming re-solve after churn: warm-start from the previous
-        assignment so only churn-forced moves happen (BASELINE config 5)."""
-        return self.place(pt, warm_start=True)
+        assignment so only churn-forced moves happen (BASELINE config 5).
+        With a resident staging the warm seed never leaves the device."""
+        return self.place(pt, warm_start=True, delta=delta,
+                          overlap_host_work=overlap_host_work, stage=stage)

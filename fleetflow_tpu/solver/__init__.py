@@ -5,9 +5,10 @@ order_by_dependencies + per-service Docker loop) with greedy seeding +
 mesh-sharded simulated annealing over dense constraint tensors.
 """
 
-from .anneal import anneal, chain_states_from_assignment
+from .anneal import anneal, chain_states_from_assignment, prerepair_state
 from .buckets import (BucketConfig, BucketInfo, bucket_config, bucket_size,
                       pad_problem_tiers, soft_score_host)
+from .resident import ProblemDelta, ResidentProblem, transfer_guard_ctx
 from .sharded import SVC_AXIS, anneal_sharded, pad_problem, shard_problem
 from .api import CHAIN_AXIS, SolveResult, make_chain_inits, solve
 from .greedy import greedy_place, greedy_place_batched, placement_order

@@ -25,11 +25,13 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .kernels import real_row_weights
 from .problem import DeviceProblem
 
 __all__ = ["anneal", "anneal_adaptive", "anneal_states",
            "anneal_adaptive_states", "chain_states_from_assignment",
-           "state_violation_stats", "state_soft_score", "ChainState"]
+           "prerepair_state", "state_violation_stats", "state_soft_score",
+           "ChainState"]
 
 W_CAP = 1e3     # per-unit overflow mass (normalized units)
 W_CONF = 1e4    # per conflicting co-placement
@@ -64,8 +66,88 @@ def chain_states_from_assignment(prob: DeviceProblem,
     coloc = jnp.zeros((prob.N, Gc), jnp.int32).at[cnodes, csafe].add(
         cvalid.astype(jnp.int32))
 
-    topo = jnp.zeros(prob.T, jnp.int32).at[prob.node_topology[assignment]].add(1)
+    # phantom rows (bucket padding, rows >= n_real) carry no topology
+    # weight: a parked phantom must not shift a spread constraint
+    tw = real_row_weights(prob)
+    topo = jnp.zeros(prob.T, jnp.int32).at[prob.node_topology[assignment]].add(tw)
     return ChainState(assignment, load, used, coloc, topo)
+
+
+def prerepair_state(prob: DeviceProblem, st: ChainState,
+                    max_moves: int) -> ChainState:
+    """Fused churn pre-repair: relocate services stranded on invalid or
+    ineligible nodes, one per `lax.while_loop` iteration, entirely on
+    device. This replaces the host `repair.py` pre-pass on the warm path
+    (~27 ms of host numpy + a host->device seed upload at 10k x 1k,
+    BENCH_r05): the resident warm path never leaves the device between the
+    CP's churn delta and the anneal.
+
+    Each iteration picks the first not-yet-attempted stranded service and
+    moves it to the least-utilized node that fits (capacity + conflicts +
+    eligibility), falling back to the least-utilized eligible node when
+    nothing fits cleanly (the anneal's targeted proposals and the host
+    repair backstop keep the zero-violation contract). The loop exits as
+    soon as nothing is stranded, so a quiet warm solve pays one mask
+    reduction; `max_moves` bounds pathological churn. Feasibility of the
+    incoming state is preserved: a clean relocation only ever lands on a
+    node it verified against the live carried state."""
+    ar = jnp.arange(prob.S)
+
+    def stranded_of(st):
+        return (~prob.eligible[ar, st.assignment]
+                | ~prob.node_valid[st.assignment])
+
+    def cond(carry):
+        st, attempted, i = carry
+        return (i < max_moves) & (stranded_of(st) & ~attempted).any()
+
+    def body(carry):
+        st, attempted, i = carry
+        todo = stranded_of(st) & ~attempted
+        s = jnp.argmax(todo)
+        attempted = attempted.at[s].set(True)
+        d = prob.demand[s]
+        ids = prob.conflict_ids[s]
+        valid = ids >= 0
+        safe = jnp.where(valid, ids, 0)
+        cids = prob.coloc_ids[s]
+        cvalid = cids >= 0
+        csafe = jnp.where(cvalid, cids, 0)
+
+        fits = ((st.load + d[None, :])
+                <= prob.capacity * (1 + 1e-6)).all(-1)          # (N,)
+        conf_free = ((st.used[:, safe] * valid).sum(-1) == 0)    # (N,)
+        elig = prob.eligible[s] & prob.node_valid                # (N,)
+        ok = fits & conf_free & elig
+        util = (st.load / jnp.maximum(prob.capacity, 1e-6)).max(-1)
+        # clean candidates rank first; any eligible node beats staying
+        # stranded (W_ELIG dwarfs a capacity/conflict residual); inf when
+        # no eligible valid node exists at all (genuinely unplaceable)
+        score = jnp.where(ok, util, jnp.where(elig, util + 1e6, jnp.inf))
+        b = jnp.argmin(score)
+        can = jnp.isfinite(score[b])
+        a = st.assignment[s]
+        w = can.astype(jnp.float32)
+        wi = can.astype(jnp.int32)
+
+        load = st.load.at[a].add(-d * w).at[b].add(d * w)
+        vi = valid.astype(jnp.int32) * wi
+        used = st.used.at[a, safe].add(-vi).at[b, safe].add(vi)
+        ci = cvalid.astype(jnp.int32) * wi
+        coloc = st.coloc.at[a, csafe].add(-ci).at[b, csafe].add(ci)
+        r = (wi if prob.n_real is None
+             else wi * (s < prob.n_real).astype(jnp.int32))
+        topo = (st.topo.at[prob.node_topology[a]].add(-r)
+                .at[prob.node_topology[b]].add(r))
+        assignment = st.assignment.at[s].set(
+            jnp.where(can, b, a).astype(jnp.int32))
+        return (ChainState(assignment, load, used, coloc, topo),
+                attempted, i + 1)
+
+    st, _, _ = jax.lax.while_loop(
+        cond, body,
+        (st, jnp.zeros(prob.S, dtype=bool), jnp.int32(0)))
+    return st
 
 
 def state_violation_stats(prob: DeviceProblem, st: ChainState) -> dict:
@@ -118,7 +200,10 @@ def violation_total_from_parts(prob: DeviceProblem, load: jax.Array,
 def state_soft_score(prob: DeviceProblem, st: ChainState) -> jax.Array:
     """kernels.soft_score evaluated from the carried state (same formulas,
     no group_counts rebuild). Pass the ORIGINAL problem to report without a
-    warm-start bonus, or the bonused one for ranking consistency."""
+    warm-start bonus, or one carrying `sticky_prev` for ranking
+    consistency: staying on the previous (still eligible+valid) node earns
+    `sticky_w` per service, computed from (S,) gathers instead of a
+    materialized bonus plane."""
     u = st.load / jnp.maximum(prob.capacity, 1e-6)
     usq = (u * u).sum()
     denom = jnp.float32(max(prob.N, 1))
@@ -129,6 +214,14 @@ def state_soft_score(prob: DeviceProblem, st: ChainState) -> jax.Array:
     else:
         strat = (st.assignment.astype(jnp.float32) / denom).mean()
     pref = -prob.preferred[jnp.arange(prob.S), st.assignment].mean()
+    if prob.sticky_prev is not None:
+        prev = prob.sticky_prev
+        anchored = (prob.eligible[jnp.arange(prob.S), prev]
+                    & prob.node_valid[prev])
+        at_prev = ((st.assignment == prev) & anchored)
+        # the materialized plane added sticky_w * S at [s, prev[s]], whose
+        # pref mean contributed -sticky_w per anchored stay — same scale
+        pref = pref - prob.sticky_w * at_prev.sum().astype(jnp.float32)
     if prob.Gc > 0:
         cc = st.coloc.astype(jnp.float32)
         coloc = -(cc * (cc - 1.0) / 2.0).sum() / jnp.float32(max(prob.S, 1))
@@ -197,9 +290,11 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
     elig_b = prob.eligible[s, b] & prob.node_valid[b]
     d_elig = (elig_a.astype(jnp.float32) - elig_b.astype(jnp.float32)) * W_ELIG
 
-    # skew
+    # skew (phantom rows carry no topology weight)
     ta, tb = prob.node_topology[a], prob.node_topology[b]
-    topo2 = state.topo.at[ta].add(-1).at[tb].add(1)
+    r = (jnp.int32(1) if prob.n_real is None
+         else (s < prob.n_real).astype(jnp.int32))
+    topo2 = state.topo.at[ta].add(-r).at[tb].add(r)
     d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, state.topo)
 
     # -- soft deltas ---------------------------------------------------------
@@ -208,6 +303,15 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
     soft_after = _soft_rows(prob, jnp.stack([load_a2, load_b2]),
                             jnp.stack([cap_a, cap_b]))
     d_pref = (prob.preferred[s, a] - prob.preferred[s, b]) / prob.S
+    if prob.sticky_prev is not None:
+        # on-the-fly migration stickiness: the materialized plane's
+        # bonus[s, prev[s]] = sticky_w * S contributed exactly
+        # sticky_w * (at_prev(a) - at_prev(b)) through d_pref's /S
+        prev = prob.sticky_prev[s]
+        anchored = prob.eligible[s, prev] & prob.node_valid[prev]
+        d_pref = d_pref + prob.sticky_w * (
+            ((a == prev) & anchored).astype(jnp.float32)
+            - ((b == prev) & anchored).astype(jnp.float32))
     col_a = ((state.coloc[a, csafe] - 1) * cvalid).sum()
     col_b = (state.coloc[b, csafe] * cvalid).sum()
     d_coloc = (col_a - col_b).astype(jnp.float32) / max(prob.S, 1)
@@ -294,8 +398,10 @@ def _batched_step(prob: DeviceProblem, state: ChainState,
     coloc = (state.coloc.at[a_rows[:, : csafe.shape[1]], csafe].add(-cvalid)
              .at[b_rows[:, : csafe.shape[1]], csafe].add(cvalid))
 
-    topo = (state.topo.at[prob.node_topology[a_idx]].add(-wi)
-            .at[prob.node_topology[b_idx]].add(wi))
+    wt = (wi if prob.n_real is None
+          else wi * (s_idx < prob.n_real).astype(jnp.int32))
+    topo = (state.topo.at[prob.node_topology[a_idx]].add(-wt)
+            .at[prob.node_topology[b_idx]].add(wt))
 
     # .set scatters route non-applied writes to a dump row (value writes
     # from losers must not race the winner's)
@@ -373,11 +479,15 @@ def anneal(prob: DeviceProblem, init_assignments: jax.Array, key: jax.Array,
                          unroll=unroll).assignment
 
 
-@partial(jax.jit, static_argnames=("max_steps", "block", "proposals_per_step"))  # noqa: E501
+@partial(jax.jit, static_argnames=("max_steps", "block",
+                                   "proposals_per_step",
+                                   "exit_on_feasible_init"))
 def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
                            key: jax.Array, max_steps: int = 128,
                            block: int = 32, t0: float = 1.0, t1: float = 1e-3,
-                           proposals_per_step: int | None = None):
+                           proposals_per_step: int | None = None,
+                           init_states: ChainState | None = None,
+                           exit_on_feasible_init: bool = False):
     """Anneal in `block`-sweep chunks, stopping as soon as any chain has
     SEEN an exactly feasible state (or at max_steps). Returns
     (best_assignments (C, S), best_viols (C,), best_softs (C,),
@@ -412,7 +522,12 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
     M = (proposals_per_step if proposals_per_step is not None
          else default_proposals_per_step(S))
     n_blocks = -(-max_steps // block)
-    states = jax.vmap(partial(chain_states_from_assignment, prob))(init_assignments)
+    # init_states skips the per-chain scatter rebuild when the caller
+    # already carries the states (warm fused pre-repair: every chain
+    # starts from the repaired seed, so the prologue's state IS the init)
+    states = (init_states if init_states is not None else
+              jax.vmap(partial(chain_states_from_assignment,
+                               prob))(init_assignments))
     keys = jax.random.split(key, C)
     decay = (t1 / t0) ** (1.0 / max(max_steps - 1, 1))
 
@@ -477,10 +592,17 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
                 accepted, b + 1, seen)
 
     # done starts False: even an already-feasible start gets one block of
-    # soft polish (the exit trades polish for latency only after that)
+    # soft polish (the exit trades polish for latency only after that).
+    # exit_on_feasible_init (the resident warm path) skips even that: the
+    # fused pre-repair prologue hands over a feasible state whose
+    # displaced services already sit on least-utilized fitting nodes, and
+    # migration stickiness rejects nearly every polish proposal anyway —
+    # the sweep was pure latency (~30 ms of the 10k x 1k warm dispatch).
+    start_done = ((viol0.min() == 0) if exit_on_feasible_init
+                  else jnp.bool_(False))
     (_, _, best_assign, best_viol, best_soft, _, accepted, b,
      _) = jax.lax.while_loop(cond, body, init + (jnp.int32(0),
-                                                 jnp.bool_(False)))
+                                                 start_done))
     return best_assign, best_viol, best_soft, b * block, accepted
 
 

@@ -34,10 +34,12 @@ A phantom parked on any *valid* node is provably inert:
                denominators shift, so callers report the soft score of the
                REAL rows via `soft_score_host` on the original tensors
 
-The one constraint phantoms cannot be made inert for without threading a
-real-row mask through every kernel is the spread constraint (they would
-count into per-domain totals), so bucketing is bypassed when
-``max_skew > 0`` — exactly the mask the sharded path threads as `n_real`.
+The one constraint phantoms are not inert for by construction is the
+spread constraint (a parked phantom would count into per-domain totals),
+so padded problems carry a traced ``n_real`` row count — the same mask the
+sharded path threads statically — and the kernels exclude rows >= n_real
+from topology/skew accounting. Bucketing therefore applies at
+``max_skew > 0`` too (it was bypassed there before the mask existed).
 
 Config: `bucket_config()` reads the FLEET_BUCKET* environment once per
 call site; `FLEET_BUCKET=0` disables bucketing everywhere,
@@ -223,6 +225,14 @@ def pad_problem_tiers(prob, cfg: Optional[BucketConfig] = None):
         conflict_ids = _pad_cols(conflict_ids, K_pad - K, -1)
     if C_pad > C:
         coloc_ids = _pad_cols(coloc_ids, C_pad - C, -1)
+    import jax.numpy as jnp
+    # n_real marks rows >= it as phantoms — a TRACED scalar, so fleets
+    # drifting within the tier reuse the compiled executable while the
+    # kernels keep phantoms out of topology/skew accounting (what lets
+    # bucketing apply at max_skew > 0). A pre-set n_real (re-padding an
+    # already-resident problem) is preserved.
+    n_real = (prob.n_real if prob.n_real is not None
+              else jnp.asarray(prob.S, jnp.int32))
     return dataclasses.replace(
         prob,
         demand=_pad_rows(prob.demand, pad, 0.0),
@@ -230,7 +240,7 @@ def pad_problem_tiers(prob, cfg: Optional[BucketConfig] = None):
         coloc_ids=_pad_rows(coloc_ids, pad, -1),
         eligible=_pad_rows(prob.eligible, pad, True),
         preferred=_pad_rows(prob.preferred, pad, 0.0),
-        S=S_pad, G=G_pad, Gc=Gc_pad,
+        S=S_pad, G=G_pad, Gc=Gc_pad, n_real=n_real,
     ), info
 
 

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from .problem import DeviceProblem
 
 __all__ = ["node_loads", "group_counts", "violation_stats", "total_violations",
-           "soft_score", "total_cost", "W_HARD"]
+           "soft_score", "total_cost", "real_row_weights", "W_HARD"]
 
 W_HARD = 1e4  # weight of one hard violation vs the soft score range
 
@@ -59,13 +59,24 @@ def _conflict_pairs(counts: jax.Array) -> jax.Array:
     return (c * (c - 1.0) / 2.0).sum()
 
 
+def real_row_weights(prob: DeviceProblem) -> jax.Array:
+    """(S,) int32: 1 for real service rows, 0 for bucket-padding phantoms
+    (rows >= prob.n_real). All-ones when the problem carries no phantom
+    marker — the common exact-shape case pays nothing."""
+    if prob.n_real is None:
+        return jnp.ones(prob.S, dtype=jnp.int32)
+    return (jnp.arange(prob.S) < prob.n_real).astype(jnp.int32)
+
+
 def _skew_excess(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
     """relu((max - min services per topology domain) - max_skew); 0 when no
-    spread constraint is active."""
+    spread constraint is active. Phantom rows carry no topology weight (a
+    parked phantom must not relax or tighten a spread constraint)."""
     if prob.max_skew <= 0:
         return jnp.float32(0.0)
     topo = prob.node_topology[assignment]                       # (S,)
-    per_domain = jnp.zeros(prob.T, dtype=jnp.int32).at[topo].add(1)
+    per_domain = jnp.zeros(prob.T, dtype=jnp.int32).at[topo].add(
+        real_row_weights(prob))
     skew = per_domain.max() - per_domain.min()
     return jnp.maximum(skew - prob.max_skew, 0).astype(jnp.float32)
 

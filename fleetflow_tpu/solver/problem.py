@@ -57,6 +57,21 @@ class DeviceProblem:
     T: int = field(metadata=dict(static=True))   # number of topology domains
     strategy: int = field(metadata=dict(static=True))
     max_skew: int = field(metadata=dict(static=True))
+    # TRACED count of real (non-phantom) service rows, or None when every
+    # row is real. Rows >= n_real are bucket-padding phantoms; the kernels
+    # exclude them from topology/skew accounting (the sharded path threads
+    # the same mask as a static `n_real` arg). Traced — not static — so a
+    # fleet drifting 9,997 -> 10,050 inside one tier does NOT recompile.
+    n_real: Optional[jax.Array] = None
+    # warm-start migration stickiness, folded into the proposal delta and
+    # the soft ranking ON THE FLY instead of materializing a bonused
+    # (S, N) preferred plane (three full-plane passes, ~37 ms of the r05
+    # warm dispatch at 10k x 1k). sticky_prev is the previous assignment
+    # (S,) i32; sticky_w the per-service bonus (f32 scalar). The bonus
+    # only anchors services whose previous node is still eligible+valid —
+    # churn-forced moves stay free, same semantics as the old plane.
+    sticky_prev: Optional[jax.Array] = None
+    sticky_w: Optional[jax.Array] = None
 
 
 def _unify_conflict_ids(pt: ProblemTensors) -> np.ndarray:

@@ -1,0 +1,394 @@
+"""Device-resident fleet state: the padded placement problem and the last
+committed assignment live ON DEVICE between solves, and CP churn arrives as
+structured deltas applied by a donated, jitted merge kernel.
+
+Before this module the warm path still rebuilt host state every burst:
+`sched/tpu.py` re-staged the padded DeviceProblem whenever capacity drifted
+(identity-keyed cache), `solver/api._solve` uploaded the previous assignment
+from host numpy and ran the churn pre-repair in host numpy (`prerepair_ms`
+~27 ms of the ~101 ms r05 CPU warm reschedule). The paper's thesis is that
+the placement hot loop lives on TPU; this closes the remaining host
+round-trips:
+
+  ResidentProblem      owns the padded, bucketed DeviceProblem + the last
+                       assignment as device buffers across bursts
+  ProblemDelta         what churn actually is: node up/down (valid-mask
+                       flip), capacity drift, demand drift, arrivals into
+                       phantom rows (row scatters + an n_real bump)
+  apply_delta          ONE jitted dispatch, `donate_argnums` on the problem
+                       and assignment buffers (SNIPPETS.md [1]-[3] donation
+                       pattern) — the old buffers are reused in place, and
+                       phantom rows are re-parked on a valid node on device
+
+The warm re-solve itself then runs with every input already resident
+(problem pytree, seed assignment, temperature scalars), provable with
+``FLEET_TRANSFER_GUARD=disallow``: `jax.transfer_guard("disallow")` wraps
+the dispatch and any host->device transfer of problem tensors raises.
+Pre-repair is fused into the anneal entry (`anneal.prerepair_state`), so
+the warm path is: small delta upload -> one donated merge dispatch -> one
+fused solve dispatch -> scalars back.
+
+Delta reuse is gated by bucket identity: the candidate ProblemTensors must
+sit in the same shape tier with the same strategy/skew statics AND share
+(by object identity) every tensor the delta does not cover — content drift
+beyond the delta falls back to cold staging (counted in
+`fleet_solver_resident_reuse_total{outcome="cold"}` and, on warm attempts,
+`fleet_solver_host_transfers_total`). docs/guide/11-performance.md covers
+tuning and transfer-guard debugging.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Any, Optional
+
+import numpy as np
+
+from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
+from .buckets import bucket_config, bucket_size
+
+log = get_logger("solver.resident")
+
+__all__ = ["ProblemDelta", "ResidentProblem", "transfer_guard_ctx"]
+
+# metric catalog: docs/guide/10-observability.md
+_M_REUSE = REGISTRY.counter(
+    "fleet_solver_resident_reuse_total",
+    "Resident-state staging decisions: delta = on-device delta applied to "
+    "the resident problem, cold = full host (re)staging",
+    labels=("outcome",))
+_M_DELTA_MS = REGISTRY.gauge(
+    "fleet_solver_delta_stage_ms",
+    "Milliseconds spent applying on-device churn deltas for the most "
+    "recent warm solve (upload + donated merge dispatch)")
+_M_HOST_XFER = REGISTRY.counter(
+    "fleet_solver_host_transfers_total",
+    "Warm-path solves that had to move problem tensors across the host "
+    "boundary (cold restage on a warm attempt, or a host repair re-upload) "
+    "— each is an event the transfer guard would have caught in disallow "
+    "mode")
+
+
+def transfer_guard_ctx():
+    """The context the resident warm path dispatches under.
+    FLEET_TRANSFER_GUARD= unset/off/allow -> no guard; log -> jax logs every
+    host transfer; disallow -> any host->device transfer raises (the proof
+    mode the resident tests and the bench burst leg run in)."""
+    mode = os.environ.get("FLEET_TRANSFER_GUARD", "").strip().lower()
+    if mode in ("", "0", "off", "false", "allow"):
+        return contextlib.nullcontext()
+    if mode not in ("log", "disallow", "log_explicit", "disallow_explicit"):
+        mode = "disallow"
+    import jax
+    return jax.transfer_guard(mode)
+
+
+@dataclass
+class ProblemDelta:
+    """Structured churn: what changed since the resident staging.
+
+    `node_valid`/`capacity` are FULL small arrays ((N,) / (N, R) — a few KB
+    at fleet scale); row-sparse fields scatter into the big (S, ·) tensors.
+    Fields left None mean "unchanged" (node_valid/capacity then upload from
+    the accompanying ProblemTensors, which is the truth either way). The
+    contract for delta staging: the new ProblemTensors differs from the
+    resident one ONLY by fields this delta covers — anything else (new
+    conflict ids, a relowered fleet) must cold-stage, and
+    `ResidentProblem.compatible` enforces it by object identity."""
+    node_valid: Optional[np.ndarray] = None       # (N,) new validity mask
+    capacity: Optional[np.ndarray] = None         # (N, R) new capacity
+    # demand drift / arrivals: (rows (k,), values (k, R))
+    demand_rows: Optional[tuple[np.ndarray, np.ndarray]] = None
+    # arrival eligibility: (rows (k,), masks (k, N))
+    eligible_rows: Optional[tuple[np.ndarray, np.ndarray]] = None
+    # new real-row count (arrivals activate phantom rows; None = unchanged)
+    n_real: Optional[int] = None
+
+
+def _row_tier(k: int) -> int:
+    """Scatter-row padding tier (8, 32, 128, ...): delta sizes drift burst
+    to burst and each distinct row count would otherwise be a fresh XLA
+    program for the merge kernel."""
+    tier = 8
+    while tier < k:
+        tier *= 4
+    return tier
+
+
+@lru_cache(maxsize=1)
+def _merge_fn():
+    """The donated delta-merge kernel, built lazily so importing
+    ProblemDelta never pays JAX startup (cp/ imports this module on the
+    host path)."""
+    import jax
+    import jax.numpy as jnp
+
+    def merge(prob, assignment, node_valid, capacity, dem_idx, dem_val,
+              elig_idx, elig_rows, n_real, *, has_demand, has_eligible):
+        # scatter rows ride padded tiers; pad slots carry an out-of-range
+        # index and mode="drop" discards them. The static has_* flags keep
+        # the common mask/capacity-only delta from touching the big (S, ·)
+        # planes at all — they alias straight through the donation.
+        demand = (prob.demand.at[dem_idx].set(dem_val, mode="drop")
+                  if has_demand else prob.demand)
+        eligible = (prob.eligible.at[elig_idx].set(elig_rows, mode="drop")
+                    if has_eligible else prob.eligible)
+        # re-park phantom rows on a valid node: the previous winner may
+        # have left them on a node this delta just killed, and a phantom
+        # on an invalid node is the one way it stops being inert
+        first_valid = jnp.argmax(node_valid).astype(jnp.int32)
+        ar = jnp.arange(prob.S)
+        assignment = jnp.where(ar >= n_real, first_valid, assignment)
+        prob = dataclasses.replace(
+            prob, demand=demand, eligible=eligible, node_valid=node_valid,
+            capacity=capacity, n_real=n_real)
+        return prob, assignment
+
+    # donation: the stale problem/assignment buffers are dead the moment
+    # the merge lands, so XLA reuses them in place — no second copy of the
+    # (S, N) planes ever exists (SNIPPETS.md [1]-[3])
+    return jax.jit(merge, donate_argnums=(0, 1),
+                   static_argnames=("has_demand", "has_eligible"))
+
+
+class ResidentProblem:
+    """The device-resident placement state a TpuSolverScheduler owns.
+
+    Lifecycle: `cold_stage(pt)` pads + uploads once; each churn burst calls
+    `apply_delta(pt, delta)` (donated on-device merge); `solver.api._solve`
+    seeds the warm anneal from `self.assignment` (device) and calls
+    `adopt()` with the padded winner. `compatible()` is the bucket-identity
+    gate deciding delta reuse vs cold fallback."""
+
+    def __init__(self, pt, *, bucket: bool = True,
+                 cfg=None):
+        self.cfg = cfg or bucket_config()
+        self.bucket = bool(bucket and self.cfg.enabled)
+        self.pt: Any = None
+        self.prob: Any = None                 # padded DeviceProblem
+        self.assignment: Any = None           # (padded_S,) i32 device array
+        self.n_real: int = 0
+        self._valid_fp: Optional[np.ndarray] = None
+        self._cap_fp: Optional[np.ndarray] = None
+        self._delta_ms: float = 0.0
+        self._scalars: dict[tuple, tuple] = {}
+        self.cold_stage(pt)
+
+    # -- staging -----------------------------------------------------------
+
+    def cold_stage(self, pt) -> None:
+        """Full host staging: prepare + pad + upload. Also the fallback
+        when a delta's compatibility gate fails."""
+        import jax.numpy as jnp
+
+        from .buckets import pad_problem_tiers
+        from .problem import prepare_problem
+
+        prob = prepare_problem(pt)
+        if self.bucket:
+            prob, _ = pad_problem_tiers(prob, self.cfg)
+        if prob.n_real is None:
+            # always traced, even unpadded/on-tier: keeps one treedef for
+            # every resident solve and lets the merge kernel re-park
+            prob = dataclasses.replace(
+                prob, n_real=jnp.asarray(pt.S, jnp.int32))
+        self.pt = pt
+        self.prob = prob
+        self.assignment = None
+        self.n_real = int(pt.S)
+        self._valid_fp = np.asarray(pt.node_valid, dtype=bool).copy()
+        self._cap_fp = np.asarray(pt.capacity, dtype=np.float32).copy()
+        self._delta_ms = 0.0
+        _M_REUSE.inc(outcome="cold")
+
+    def compatible(self, pt, delta: Optional[ProblemDelta] = None) -> bool:
+        """Bucket-identity gate for delta reuse: same shape tier and solver
+        statics, and every tensor the delta does NOT cover is the same
+        OBJECT as the resident staging's (dataclasses.replace shares the
+        untouched arrays, which is exactly how the CP mutates churn).
+        Content drift the delta cannot express -> False -> cold staging."""
+        if self.pt is None or self.prob is None:
+            return False
+        old = self.pt
+        if pt is old:
+            return True
+        if pt.N != old.N:
+            return False
+        if pt.strategy != old.strategy or pt.max_skew != old.max_skew:
+            return False
+        if pt.S != old.S:
+            return self._arrivals_compatible(pt, delta, old)
+        if self.bucket and bucket_size(
+                pt.S, growth=self.cfg.growth, minimum=self.cfg.minimum,
+                align=self.cfg.align) != self.prob.S:
+            return False
+        same = (pt.port_ids is old.port_ids
+                and pt.volume_ids is old.volume_ids
+                and pt.anti_ids is old.anti_ids
+                and pt.coloc_ids is old.coloc_ids
+                and pt.node_topology is old.node_topology
+                and pt.preferred is old.preferred)
+        if delta is None or delta.demand_rows is None:
+            same = same and pt.demand is old.demand
+        if delta is None or delta.eligible_rows is None:
+            same = same and pt.eligible is old.eligible
+        return same
+
+    def _arrivals_compatible(self, pt, delta: Optional[ProblemDelta],
+                             old) -> bool:
+        """Can a GROWN pt (arrivals appended since the resident staging)
+        still ride the delta path? Yes when the new rows activate phantom
+        rows already on device: the fleet stays inside the padded tier,
+        the delta writes the arrivals' demand + eligibility and bumps
+        n_real, and the appended rows bring no new hard-constraint ids
+        (the padded id planes already read -1 there). Anything richer —
+        a crossed tier, an arrival with ports/volumes/anti-affinity, a
+        preference plane — cold-stages."""
+        if delta is None or delta.n_real != pt.S or pt.S <= old.S:
+            return False
+        if not self.bucket or bucket_size(
+                pt.S, growth=self.cfg.growth, minimum=self.cfg.minimum,
+                align=self.cfg.align) != self.prob.S:
+            return False
+        if delta.demand_rows is None or delta.eligible_rows is None:
+            return False
+        new = np.arange(old.S, pt.S)
+        if not (np.isin(new, np.asarray(delta.demand_rows[0])).all()
+                and np.isin(new, np.asarray(delta.eligible_rows[0])).all()):
+            return False
+        if (pt.node_topology is not old.node_topology
+                or pt.preferred is not None or old.preferred is not None):
+            return False
+        for name in ("port_ids", "volume_ids", "anti_ids", "coloc_ids"):
+            a, b = getattr(pt, name), getattr(old, name)
+            if (a.shape[1] != b.shape[1]
+                    or not np.array_equal(a[:old.S], b)
+                    or (a[old.S:] != -1).any()):
+                return False
+        return True
+
+    def apply_delta(self, pt, delta: Optional[ProblemDelta] = None) -> float:
+        """Merge churn into the resident buffers on device; returns the
+        delta-staging wall ms (also accumulated for the next solve's
+        `delta_stage_ms` timing). The caller has already checked
+        `compatible`; node_valid/capacity always re-upload from `pt` (a few
+        KB — the (S, N) problem planes are what never move)."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        delta = delta or ProblemDelta()
+        S = self.prob.S
+        R = self.prob.demand.shape[1]
+        N = self.prob.N
+
+        valid = np.asarray(
+            delta.node_valid if delta.node_valid is not None
+            else pt.node_valid, dtype=bool)
+        cap = np.asarray(
+            delta.capacity if delta.capacity is not None
+            else pt.capacity, dtype=np.float32)
+
+        def pad_rows(rows_vals, width, fill_dtype):
+            idx, vals = rows_vals
+            idx = np.asarray(idx, dtype=np.int32)
+            vals = np.asarray(vals, dtype=fill_dtype)
+            k = _row_tier(max(idx.shape[0], 1))
+            pad = k - idx.shape[0]
+            if pad:
+                idx = np.concatenate([idx, np.full(pad, S, dtype=np.int32)])
+                vals = np.concatenate(
+                    [vals, np.zeros((pad, width), dtype=fill_dtype)])
+            return idx, vals
+
+        has_demand = delta.demand_rows is not None
+        has_eligible = delta.eligible_rows is not None
+        dem_idx, dem_val = (pad_rows(delta.demand_rows, R, np.float32)
+                            if has_demand else (None, None))
+        elig_idx, elig_rows = (pad_rows(delta.eligible_rows, N, bool)
+                               if has_eligible else (None, None))
+        if delta.n_real is not None:
+            self.n_real = int(delta.n_real)
+        n_real = jnp.asarray(self.n_real, jnp.int32)
+
+        # explicit small uploads, then ONE donated merge dispatch; the
+        # warm solve after this runs with everything already resident
+        uploads = jax.device_put(
+            (valid, cap, dem_idx, dem_val, elig_idx, elig_rows))
+        try:
+            self.prob, self.assignment = _merge_fn()(
+                self.prob, self.assignment, *uploads, n_real,
+                has_demand=has_demand, has_eligible=has_eligible)
+        except Exception:
+            # a failed merge leaves donated buffers in an unknown state:
+            # the only safe recovery is a full cold restage
+            log.warning("delta merge failed; cold restaging %s",
+                        kv(S=pt.S, N=pt.N))
+            self.cold_stage(pt)
+            raise
+        self.pt = pt
+        self._valid_fp = valid.copy()
+        self._cap_fp = cap.copy()
+        ms = (time.perf_counter() - t0) * 1e3
+        self._delta_ms += ms
+        _M_DELTA_MS.set(ms)
+        _M_REUSE.inc(outcome="delta")
+        return ms
+
+    def drifted(self, pt) -> bool:
+        """Has node validity or capacity drifted since the last staging?
+        (The implicit-delta check for callers that mutate ProblemTensors in
+        place instead of sending a ProblemDelta.)"""
+        return not (np.array_equal(self._valid_fp, pt.node_valid)
+                    and np.array_equal(
+                        self._cap_fp,
+                        np.asarray(pt.capacity, dtype=np.float32)))
+
+    # -- solve-side hooks (solver/api._solve) ------------------------------
+
+    def consume_delta_ms(self) -> float:
+        ms, self._delta_ms = self._delta_ms, 0.0
+        return ms
+
+    def warm_scalars(self, t0: float, t1: float, mw: float) -> tuple:
+        """Device-staged anneal scalars: traced args to the fused solve
+        must already be resident or the transfer guard fires. Keyed on the
+        values; a scheduler re-uses one config so this stages once."""
+        key = (float(t0), float(t1), float(mw))
+        staged = self._scalars.get(key)
+        if staged is None:
+            import jax.numpy as jnp
+            staged = tuple(jnp.float32(v) for v in key)
+            self._scalars = {key: staged}    # one live config at a time
+        return staged
+
+    def adopt(self, padded_assignment) -> None:
+        """Keep the padded winner (already on device) as the next warm
+        seed — no transfer happens here."""
+        self.assignment = padded_assignment
+
+    def adopt_host(self, assignment: np.ndarray, node_valid, *,
+                   warm: bool = True) -> None:
+        """Host repair rewrote the winner: re-upload the repaired
+        assignment. On the warm path that is a host transfer the disallow
+        guard would have caught — the event the counter exists for (a cold
+        solve's upload is just staging)."""
+        import jax
+
+        from .buckets import pad_assignment
+        padded = pad_assignment(np.asarray(assignment, dtype=np.int32),
+                                self.prob.S, np.asarray(node_valid))
+        self.assignment = jax.device_put(padded)
+        if warm:
+            _M_HOST_XFER.inc()
+
+    def record_warm_fallback(self) -> None:
+        """A warm attempt had to cold-stage: problem tensors crossed the
+        host boundary where the disallow guard would have fired."""
+        _M_HOST_XFER.inc()

@@ -42,6 +42,7 @@ REQUIRED = (
     "fleet_lease_transitions_total",    # CP failure detector
     "fleet_reconverge_redeliveries_total",  # CP reconverger
     "fleet_agent_send_failures_total",  # agent session loops
+    "fleet_solver_resident_reuse_total",    # device-resident warm path
 )
 
 _SAMPLE = re.compile(

@@ -58,14 +58,24 @@ def render(name: str, d: dict) -> str:
          f"**{d['solve_ms']:.0f} ms** on `{backend}`, "
          f"{d['violations']} violations, "
          f"{d.get('moves_repaired', 0)} host-repaired"),
-        ("Warm reschedule after killing the busiest node",
-         (f"{d['reschedule_ms']:.0f} ms median of "
-          f"{len(d['reschedule_runs'])} runs "
-          f"(min {d['reschedule_ms_min']:.0f}, "
-          f"{d['reschedule_compiles']} recompiles), "
-          if "reschedule_runs" in d else
-          f"{d['reschedule_ms']:.0f} ms, ")
-         + f"{d['reschedule_violations']} violations"),
+        (("Warm reschedule, rolling node-churn loop "
+          "(device-resident deltas, transfer-guard pinned)",
+          f"p50 **{d['reschedule_ms']:.0f} ms** / "
+          f"p99 {d['reschedule_p99_ms']:.0f} ms over "
+          f"{d['reschedule_bursts']} bursts "
+          f"({d['reschedule_compiles']} recompiles, "
+          f"{d.get('reschedule_speedup_vs_legacy', '?')}× vs legacy "
+          f"staging), "
+          f"{d['reschedule_violations']} violations")
+         if "reschedule_p99_ms" in d else
+         ("Warm reschedule after killing the busiest node",
+          (f"{d['reschedule_ms']:.0f} ms median of "
+           f"{len(d['reschedule_runs'])} runs "
+           f"(min {d['reschedule_ms_min']:.0f}, "
+           f"{d['reschedule_compiles']} recompiles), "
+           if "reschedule_runs" in d else
+           f"{d['reschedule_ms']:.0f} ms, ")
+          + f"{d['reschedule_violations']} violations")),
     ]
     burst = d.get("burst")
     if burst:

@@ -182,13 +182,21 @@ class TestConfig:
         res = solve(pt, seed=0, bucket=True)
         assert res.bucket is None, "FLEET_BUCKET=0 must force-disable"
 
-    def test_skew_bypass(self):
+    def test_skew_buckets_with_real_row_mask(self):
+        """Spread constraints used to bypass bucketing (phantoms would
+        count into per-domain totals); padded problems now carry a traced
+        n_real and the kernels mask phantom rows out of topology/skew —
+        so the CP churn path gets bucket (and resident) reuse at skew
+        too, with skew accounting identical to the exact-shape solve."""
         pt = synthetic_problem(37, 8, seed=0)
         pt = dataclasses.replace(pt, max_skew=2)
         res = solve(pt, seed=0, bucket=True)
-        assert res.bucket is None, \
-            "spread constraints must bypass bucketing (phantoms count " \
-            "into per-domain totals)"
+        assert res.bucket is not None and res.bucket["padded_S"] > pt.S
+        exact = solve(pt, seed=0)
+        assert res.violations == exact.violations == 0
+        # numpy oracle on the REAL rows agrees with the device verdict
+        assert verify(pt, res.assignment)["total"] == 0
+        assert res.assignment.shape == (pt.S,)
 
     def test_config_defaults(self):
         cfg = bucket_config()
