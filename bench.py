@@ -925,10 +925,212 @@ def _sharded_scenario() -> dict:
     return {"ok": False, "error": "child printed no JSON"}
 
 
+def _sharded_resident_leg(pt, D: int) -> tuple:
+    """Warm-churn loop through the MESH-RESIDENT sharded path (the
+    pod-scale analog of _resident_churn_loop): the padded problem + last
+    assignment live mesh-sharded across bursts (ShardedResident), each
+    burst kills the busiest node and revives the one killed two bursts
+    ago, arrives as a ProblemDelta merged on-mesh by the donated kernel,
+    and every warm re-solve runs under jax.transfer_guard("disallow")
+    with compiles watched — pinned 0 after the warm-up burst
+    (BENCH_SHARDED_ASSERT=1 makes a recompile fail the run, the CI
+    smoke contract).
+
+    Then the quality-vs-devices curve: the SAME cold instance at a FIXED
+    sweep budget with 1 and R temperature lanes (equal per-lane shard
+    width, so equal wall-clock per point): parallel tempering must make
+    the extra devices buy soft-score quality, not just memory."""
+    import dataclasses
+    from collections import deque
+
+    import numpy as np
+
+    from fleetflow_tpu.solver.resident import ProblemDelta
+    from fleetflow_tpu.solver.sharded import (ShardedResident,
+                                              per_device_bytes,
+                                              solve_sharded, tempering_mesh)
+
+    small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
+    try:
+        bursts = int(os.environ.get("BENCH_SHARDED_BURSTS")
+                     or ("4" if small else "6"))
+    except ValueError:
+        bursts = 4
+    try:
+        replicas = max(1, int(os.environ.get("BENCH_SHARDED_REPLICAS")
+                              or "2"))
+    except ValueError:
+        replicas = 2
+    svc = max(1, D // replicas)
+    steps = int(os.environ.get("BENCH_SHARDED_STEPS", "64"))
+    block = int(os.environ.get("BENCH_SHARDED_BLOCK", "4"))
+    pt0 = pt
+
+    mesh = tempering_mesh(replicas, svc)
+    rp = ShardedResident(pt, mesh=mesh)
+    base = solve_sharded(pt, resident=rp, steps=steps, seed=70, block=block)
+
+    N = pt.N
+    dead: deque = deque()
+
+    def next_mask(valid, assignment):
+        loads = np.bincount(assignment, minlength=N).astype(np.float64)
+        loads[~valid] = -1.0
+        victim = int(loads.argmax())
+        valid = valid.copy()
+        valid[victim] = False
+        if len(dead) >= 2:
+            valid[dead.popleft()] = True
+        dead.append(victim)
+        return valid, victim
+
+    # warm-up burst compiles the warm variant (untimed)
+    valid, _ = next_mask(pt.node_valid.copy(), base.assignment)
+    cur = dataclasses.replace(pt, node_valid=valid)
+    rp.apply_delta(cur, ProblemDelta(node_valid=valid))
+    prev = solve_sharded(cur, resident=rp, resident_warm=True,
+                         steps=steps, seed=71, block=block)
+    pt = cur
+
+    runs = []
+    guard_prev = os.environ.get("FLEET_TRANSFER_GUARD")
+    os.environ["FLEET_TRANSFER_GUARD"] = "disallow"
+    try:
+        for i in range(bursts):
+            valid, victim = next_mask(valid, prev.assignment)
+            cur = dataclasses.replace(pt, node_valid=valid)
+            with _watch_compiles() as compiles:
+                t = time.perf_counter()
+                delta_ms = rp.apply_delta(cur,
+                                          ProblemDelta(node_valid=valid))
+                prev = solve_sharded(cur, resident=rp, resident_warm=True,
+                                     steps=steps, seed=80 + i, block=block)
+                ms = (time.perf_counter() - t) * 1e3
+            pt = cur
+            runs.append({"ms": round(ms, 1),
+                         "delta_stage_ms": round(delta_ms, 2),
+                         "sweeps": int(prev.steps),
+                         "violations": prev.violations,
+                         "soft": round(prev.soft, 4),
+                         "compiles": len(compiles)})
+    finally:
+        if guard_prev is None:
+            os.environ.pop("FLEET_TRANSFER_GUARD", None)
+        else:
+            os.environ["FLEET_TRANSFER_GUARD"] = guard_prev
+
+    ms_r = [r["ms"] for r in runs]
+    dev = per_device_bytes(rp.prob, state=True)
+    leg = {
+        "mesh": [replicas, svc],
+        "bursts": bursts,
+        "p50_ms": round(float(np.percentile(ms_r, 50)), 1),
+        "p99_ms": round(float(np.percentile(ms_r, 99)), 1),
+        "min_ms": round(min(ms_r), 1),
+        "delta_stage_ms_p50": round(float(np.percentile(
+            [r["delta_stage_ms"] for r in runs], 50)), 2),
+        "compiles_total": sum(r["compiles"] for r in runs),
+        "violations_max": max(r["violations"] for r in runs),
+        "transfer_guard": "disallow",
+        "per_device_state_mib": round(
+            sum(v for k, v in dev.items() if k.startswith("state_"))
+            / 2**20, 2),
+        "per_device_total_mib": round(sum(dev.values()) / 2**20, 1),
+        "runs": runs,
+    }
+
+    curve = None
+    if os.environ.get("BENCH_SHARDED_CURVE", "1").lower() not in \
+            ("0", "false"):
+        del rp   # free the churn-loop staging before the curve's
+        curve = _quality_vs_devices_curve(pt0, replicas, svc, block)
+    return leg, curve
+
+
+def _quality_vs_devices_curve(pt, replicas: int, svc: int,
+                              block: int) -> dict:
+    """Fixed-budget anneal quality at 1 vs `replicas` temperature lanes,
+    equal per-lane shard width (so equal wall-clock per point; the extra
+    lanes are extra DEVICES). Seeded from the PARTITIONED FFD — the XL
+    seed path, whose slice-local fragmentation leaves real annealing
+    headroom — so the curve measures annealing power per device, not seed
+    quality. Reports a 3-seed median per point: a single PRNG draw would
+    make the monotone-quality claim a coin flip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fleetflow_tpu.solver import prepare_problem
+    from fleetflow_tpu.solver.buckets import pad_assignment, soft_score_host
+    from fleetflow_tpu.solver.repair import verify
+    from fleetflow_tpu.solver.sharded import (anneal_sharded, pad_problem,
+                                              tempering_mesh)
+
+    curve_steps = int(os.environ.get("BENCH_SHARDED_CURVE_STEPS", "48"))
+    try:
+        lad = float(os.environ.get("FLEET_TEMPER_LADDER") or "1.3")
+    except ValueError:
+        lad = 1.3
+    from fleetflow_tpu.native.lib import available_nobuild
+    if available_nobuild():
+        from fleetflow_tpu.solver.greedy import partitioned_seed
+        seed0 = partitioned_seed(pt, max(2 * svc, 4))
+    else:
+        # no native FFD: the whole-instance greedy via one minimal
+        # single-chip pass (near-optimal seed — the curve flattens, which
+        # the artifact then shows honestly)
+        from fleetflow_tpu.solver.api import _solve
+        seed0 = _solve(pt, chains=1, steps=1, seed=0,
+                       adaptive=False).assignment
+    prob = prepare_problem(pt)
+    padded, orig = pad_problem(prob, svc)
+    init = jnp.asarray(pad_assignment(np.asarray(seed0, np.int32),
+                                      padded.S, pt.node_valid))
+    points = []
+    for R in sorted({1, replicas}):
+        m2 = tempering_mesh(R, svc)
+        kw = dict(steps=curve_steps, mesh=m2, adaptive=False, block=block,
+                  n_real=orig, ladder=lad, return_stats=True)
+        r = anneal_sharded(padded, init, jax.random.PRNGKey(0), **kw)
+        r.assignment.block_until_ready()          # compile (untimed)
+        softs, ms, swaps, viol = [], [], (0, 0), 0
+        for ks in range(3):
+            t = time.perf_counter()
+            r = anneal_sharded(padded, init, jax.random.PRNGKey(1 + ks),
+                               **kw)
+            r.assignment.block_until_ready()
+            ms.append((time.perf_counter() - t) * 1e3)
+            a = np.asarray(r.assignment)[:orig]
+            viol = max(viol, int(verify(pt, a)["total"]))
+            softs.append(soft_score_host(pt, a))
+            # accumulate across the 3 seeded runs — the medians above
+            # summarize all of them, so must the mixing diagnostic
+            swaps = (swaps[0] + int(r.swap_accepts),
+                     swaps[1] + int(r.swap_attempts))
+        points.append({
+            "replicas": R, "devices": R * svc,
+            "soft_median": round(float(np.median(softs)), 4),
+            "soft_runs": [round(s, 4) for s in softs],
+            "violations_max": viol,
+            "ms_median": round(float(np.median(ms)), 1),
+            "swap_accepts": swaps[0], "swap_attempts": swaps[1],
+        })
+    base = points[0]["soft_median"]
+    multi = [p["soft_median"] for p in points if p["replicas"] > 1]
+    return {"steps": curve_steps, "ladder": lad,
+            "seed": "partitioned" if available_nobuild() else "greedy",
+            "points": points,
+            "tempering_wins": bool(multi and min(multi) < base)}
+
+
 def _sharded_child() -> None:
     """The 10k-ragged x 1k service-axis SPMD solve over an 8-device mesh
     (solver/sharded.py): FFD seed, adaptive sharded anneal with
-    pad_problem phantoms, exact host verification. Prints one JSON line."""
+    pad_problem phantoms, exact host verification. Plus, this round: the
+    mesh-RESIDENT warm-churn loop (zero-restage re-solves, transfer guard
+    disallow, compiles pinned 0) and the quality-vs-devices tempering
+    curve. Prints one JSON line. The XL invocation is
+    BENCH_SHARDED_SHAPE=100000x10000 (docs/guide/11-performance.md)."""
     from fleetflow_tpu.platform import ensure_platform
     ensure_platform(min_devices=8, probe_timeout=240.0)
     import jax
@@ -989,8 +1191,11 @@ def _sharded_child() -> None:
                                pt.volume_ids, pt.anti_ids,
                                strategy=pt.strategy.value)
     else:                                 # no native .so: greedy fallback
-        from fleetflow_tpu.solver import solve
-        seed = solve(pt, chains=1, steps=1, seed=0).assignment
+        # pure-host greedy, NOT public solve(): at the XL shape solve()
+        # would route back through the sharded path and seed_ms would
+        # time a full nested sharded solve instead of a seed
+        from fleetflow_tpu.sched.host import greedy_host_place
+        seed, _ = greedy_host_place(pt)
     seed_ms = (time.perf_counter() - t_seed) * 1e3
     init = jnp.pad(jnp.asarray(seed, jnp.int32), (0, padded.S - orig_s))
 
@@ -1013,21 +1218,42 @@ def _sharded_child() -> None:
         prob_host, jnp.asarray(a, jnp.int32))))
     # per-device staging footprint: the service-axis tensors must shrink
     # ~1/D while replicated node state stays constant (the module's memory
-    # rationale; the 1/D assertion itself lives in tests/test_sharded.py)
-    bytes_by_field = per_device_bytes(padded)
+    # rationale; the 1/D assertion itself lives in tests/test_sharded.py).
+    # state=True folds in the anneal's chain/tempering working state so
+    # the report is honest about what actually bounds the fleet shape on
+    # a chip, not just the problem tensors.
+    bytes_by_field = per_device_bytes(padded, state=True)
     sharded_fields = {"demand", "conflict_ids", "coloc_ids", "eligible",
                       "preferred"}
     sharded_mib = sum(v for k, v in bytes_by_field.items()
                       if k in sharded_fields) / 2**20
+    state_mib = sum(v for k, v in bytes_by_field.items()
+                    if k.startswith("state_")) / 2**20
     repl_mib = sum(v for k, v in bytes_by_field.items()
-                   if k not in sharded_fields) / 2**20
+                   if k not in sharded_fields
+                   and not k.startswith("state_")) / 2**20
+
+    # free the one-shot staging before the resident leg cold-stages its
+    # own copy: at the XL shape both at once would double the plane bytes
+    padded_s = int(padded.S)
+    del padded, prob_host, init, out
+    resident_leg = curve = None
+    if os.environ.get("BENCH_SHARDED_RESIDENT", "1").lower() not in \
+            ("0", "false"):
+        resident_leg, curve = _sharded_resident_leg(pt, D)
+        if os.environ.get("BENCH_SHARDED_ASSERT", "").lower() in \
+                ("1", "true", "on", "yes"):
+            # the CI smoke contract: warm mesh-resident re-solves reuse
+            # ONE executable — any recompile fails the run
+            assert resident_leg["compiles_total"] == 0, (
+                f"sharded warm re-solves recompiled: {resident_leg}")
 
     print(json.dumps({
         "ok": True,
         "shape": [S, N],
         "devices": D,
         "backend": jax.default_backend(),
-        "padded_s": int(padded.S),
+        "padded_s": padded_s,
         "seed_ms": round(seed_ms, 1),
         "seed_mode": "partitioned" if partitioned else "whole",
         "sharded_solve_ms": round(seed_ms + anneal_ms, 1),
@@ -1038,6 +1264,10 @@ def _sharded_child() -> None:
         "soft_score": round(soft, 4),
         "per_device_sharded_mib": round(sharded_mib, 1),
         "per_device_replicated_mib": round(repl_mib, 1),
+        "per_device_state_mib": round(state_mib, 2),
+        # the pod-scale warm path + the tempering quality curve
+        "resident": resident_leg,
+        "quality_vs_devices": curve,
     }))
 
 

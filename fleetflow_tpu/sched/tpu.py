@@ -67,13 +67,18 @@ class TpuSolverScheduler:
         return bucket_config().enabled
 
     def _stage(self, pt: ProblemTensors, delta, warm: bool,
-               stage_key: Optional[str] = None):
+               stage_key: Optional[str] = None, mesh=None):
         """Resident staging decision: DELTA (on-device merge into the
         resident buffers) when the bucket identity holds and the drift is
         expressible, else COLD (full host staging). The old identity-keyed
         cache re-staged the whole padded problem whenever capacity drifted
         (every churn burst with commitments); the resident layer turns
         that into a few-KB upload + one donated dispatch.
+
+        `mesh` is the pod-scale route (solver.sharded.sharded_route): the
+        slot then holds a mesh-sharded ShardedResident, and slot matching
+        keys on the mesh so a routing flip mid-life can never hand a
+        sharded staging to the single-chip solve or vice versa.
 
         Returns (slot, resident_warm): resident_warm=True means the
         solve seeds from the device-resident previous assignment."""
@@ -85,6 +90,8 @@ class TpuSolverScheduler:
         if warm:
             for i, slot in enumerate(self._residents):
                 rp = slot.resident
+                if rp.mesh != mesh:
+                    continue
                 if rp.assignment is not None and rp.compatible(pt, delta):
                     if i:
                         self._residents.insert(0, self._residents.pop(i))
@@ -133,7 +140,12 @@ class TpuSolverScheduler:
             # problem tensors will cross the host boundary (the
             # transfer-guard event)
             slot.resident.record_warm_fallback()
-        resident = ResidentProblem(pt, bucket=self._bucket_enabled(pt))
+        if mesh is not None:
+            from ..solver.sharded import ShardedResident
+            resident = ShardedResident(pt, mesh=mesh,
+                                       bucket=self._bucket_enabled(pt))
+        else:
+            resident = ResidentProblem(pt, bucket=self._bucket_enabled(pt))
         if slot is None:
             slot = _StageSlot(resident=resident, key=stage_key)
         else:
@@ -163,9 +175,16 @@ class TpuSolverScheduler:
         ensure_platform(min_devices=1)
         # imported lazily so the host path never pays JAX startup
         from ..solver import solve
+        from ..solver.sharded import sharded_route
 
         t0 = time.perf_counter()
-        slot, resident_warm = self._stage(pt, delta, warm_start, stage)
+        # pod-scale route: above the FLEET_SHARDED threshold the stage's
+        # resident state lives mesh-sharded and the solve runs through
+        # solver/sharded.solve_sharded (an explicit scheduler mesh= means
+        # the caller chose chain sharding — leave it alone)
+        sh_mesh = sharded_route(pt) if self.mesh is None else None
+        slot, resident_warm = self._stage(pt, delta, warm_start, stage,
+                                          mesh=sh_mesh)
         rp = slot.resident
 
         # cold fallback on a warm request still warm-starts from THIS
@@ -177,15 +196,24 @@ class TpuSolverScheduler:
                 and slot.last_assignment is not None
                 and slot.last_assignment.shape[0] == pt.S):
             init = slot.last_assignment
-        # bucket flag comes from the slot's OWN staging, not a fresh env
-        # read: rp.prob was padded (or not) under the config captured at
-        # cold-stage time, and a mid-life FLEET_BUCKET flip must not make
-        # _solve skip the phantom-row slice on an already-padded staging
-        res = solve(pt, prob=rp.prob, chains=self.chains, steps=self.steps,
-                    seed=self.seed, mesh=self.mesh, init_assignment=init,
-                    bucket=rp.bucket,
-                    resident=rp, resident_warm=resident_warm,
-                    overlap_host_work=overlap_host_work)
+        if sh_mesh is not None:
+            from ..solver.sharded import solve_sharded
+            res = solve_sharded(pt, resident=rp,
+                                resident_warm=resident_warm,
+                                init_assignment=init, steps=self.steps,
+                                seed=self.seed,
+                                overlap_host_work=overlap_host_work)
+        else:
+            # bucket flag comes from the slot's OWN staging, not a fresh
+            # env read: rp.prob was padded (or not) under the config
+            # captured at cold-stage time, and a mid-life FLEET_BUCKET
+            # flip must not make _solve skip the phantom-row slice on an
+            # already-padded staging
+            res = solve(pt, prob=rp.prob, chains=self.chains,
+                        steps=self.steps, seed=self.seed, mesh=self.mesh,
+                        init_assignment=init, bucket=rp.bucket,
+                        resident=rp, resident_warm=resident_warm,
+                        overlap_host_work=overlap_host_work)
         slot.last_assignment = res.assignment
         ms = (time.perf_counter() - t0) * 1e3
 

@@ -256,20 +256,33 @@ def _soft_rows(prob: DeviceProblem, load_rows: jax.Array,
     return jnp.float32(0.0)
 
 
-def _proposal_delta(prob: DeviceProblem, state: ChainState,
-                    s: jax.Array, b: jax.Array) -> jax.Array:
-    """Annealing-cost delta of moving service s to node b (no apply)."""
-    a = state.assignment[s]
-    d = prob.demand[s]
-    ids = prob.conflict_ids[s]
+def _move_delta_core(prob: DeviceProblem, *, capacity: jax.Array,
+                     node_topology: jax.Array, load: jax.Array,
+                     used: jax.Array, coloc: jax.Array, topo: jax.Array,
+                     a: jax.Array, b: jax.Array, d: jax.Array,
+                     ids: jax.Array, cids: jax.Array, elig_a: jax.Array,
+                     elig_b: jax.Array, d_pref: jax.Array,
+                     r: jax.Array) -> jax.Array:
+    """Annealing-cost delta of moving one service from node `a` to node `b`,
+    shared term for term between the single-device sweep (_proposal_delta)
+    and the service-axis sharded sweep (solver/sharded.py) — "a legal sweep
+    here is a legal sweep there" is enforced by construction, not by
+    parallel maintenance of two copies.
+
+    `prob` supplies only statics (strategy, max_skew, N, S). Tensor inputs
+    are the caller's views: the single-device anneal passes the problem
+    planes + carried ChainState, the sharded sweep passes its shard-local
+    gathers against the replicated node state. `elig_a`/`elig_b` are the
+    node_valid-masked eligibility bits of the two endpoints, `d_pref` the
+    preference delta (including any warm-start stickiness), `r` the row's
+    topology weight (0 for bucket-padding phantoms)."""
     valid = (ids >= 0)
     safe = jnp.where(valid, ids, 0)
-    cids = prob.coloc_ids[s]
     cvalid = (cids >= 0)
     csafe = jnp.where(cvalid, cids, 0)
 
-    cap_a, cap_b = prob.capacity[a], prob.capacity[b]
-    load_a, load_b = state.load[a], state.load[b]
+    cap_a, cap_b = capacity[a], capacity[b]
+    load_a, load_b = load[a], load[b]
 
     # -- hard deltas ---------------------------------------------------------
     # capacity overflow mass on the two touched rows
@@ -281,27 +294,39 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
     d_cap = (over_after - over_before) * W_CAP
 
     # conflicts: occupancy excluding s itself on its current node
-    conf_a = ((state.used[a, safe] - 1) * valid).sum()
-    conf_b = (state.used[b, safe] * valid).sum()
+    conf_a = ((used[a, safe] - 1) * valid).sum()
+    conf_b = (used[b, safe] * valid).sum()
     d_conf = (conf_b - conf_a).astype(jnp.float32) * W_CONF
 
     # eligibility / validity
-    elig_a = prob.eligible[s, a] & prob.node_valid[a]
-    elig_b = prob.eligible[s, b] & prob.node_valid[b]
     d_elig = (elig_a.astype(jnp.float32) - elig_b.astype(jnp.float32)) * W_ELIG
 
     # skew (phantom rows carry no topology weight)
-    ta, tb = prob.node_topology[a], prob.node_topology[b]
-    r = (jnp.int32(1) if prob.n_real is None
-         else (s < prob.n_real).astype(jnp.int32))
-    topo2 = state.topo.at[ta].add(-r).at[tb].add(r)
-    d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, state.topo)
+    ta, tb = node_topology[a], node_topology[b]
+    topo2 = topo.at[ta].add(-r).at[tb].add(r)
+    d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, topo)
 
     # -- soft deltas ---------------------------------------------------------
     soft_before = _soft_rows(prob, jnp.stack([load_a, load_b]),
                              jnp.stack([cap_a, cap_b]))
     soft_after = _soft_rows(prob, jnp.stack([load_a2, load_b2]),
                             jnp.stack([cap_a, cap_b]))
+    col_a = ((coloc[a, csafe] - 1) * cvalid).sum()
+    col_b = (coloc[b, csafe] * cvalid).sum()
+    d_coloc = (col_a - col_b).astype(jnp.float32) / max(prob.S, 1)
+
+    return (d_cap + d_conf + d_elig + d_skew
+            + (soft_after - soft_before) + d_pref + d_coloc)
+
+
+def _proposal_delta(prob: DeviceProblem, state: ChainState,
+                    s: jax.Array, b: jax.Array) -> jax.Array:
+    """Annealing-cost delta of moving service s to node b (no apply)."""
+    a = state.assignment[s]
+    elig_a = prob.eligible[s, a] & prob.node_valid[a]
+    elig_b = prob.eligible[s, b] & prob.node_valid[b]
+    r = (jnp.int32(1) if prob.n_real is None
+         else (s < prob.n_real).astype(jnp.int32))
     d_pref = (prob.preferred[s, a] - prob.preferred[s, b]) / prob.S
     if prob.sticky_prev is not None:
         # on-the-fly migration stickiness: the materialized plane's
@@ -312,12 +337,12 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
         d_pref = d_pref + prob.sticky_w * (
             ((a == prev) & anchored).astype(jnp.float32)
             - ((b == prev) & anchored).astype(jnp.float32))
-    col_a = ((state.coloc[a, csafe] - 1) * cvalid).sum()
-    col_b = (state.coloc[b, csafe] * cvalid).sum()
-    d_coloc = (col_a - col_b).astype(jnp.float32) / max(prob.S, 1)
-
-    return (d_cap + d_conf + d_elig + d_skew
-            + (soft_after - soft_before) + d_pref + d_coloc)
+    return _move_delta_core(
+        prob, capacity=prob.capacity, node_topology=prob.node_topology,
+        load=state.load, used=state.used, coloc=state.coloc, topo=state.topo,
+        a=a, b=b, d=prob.demand[s], ids=prob.conflict_ids[s],
+        cids=prob.coloc_ids[s], elig_a=elig_a, elig_b=elig_b,
+        d_pref=d_pref, r=r)
 
 
 def _batched_step(prob: DeviceProblem, state: ChainState,

@@ -106,6 +106,10 @@ class SolveResult:
     # dispatch (anneal.prerepair_state) instead of the host repair.py pass
     # — the warm path then has no prerepair_ms timing at all
     fused_prerepair: bool = False
+    # pod-scale sharded solves (solver/sharded.solve_sharded) report their
+    # parallel-tempering config + replica-exchange outcome here:
+    # {replicas, ladder, exchange_every, swap_attempts, swap_accepts}
+    tempering: Optional[dict] = None
 
     @property
     def acceptance_rate(self) -> float:
@@ -257,12 +261,23 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
 def solve(pt: ProblemTensors, **kw) -> SolveResult:
     """Solve a placement instance end to end (see _solve for parameters).
     When FLEET_PROFILE_DIR is set the whole solve is captured as a
-    jax.profiler trace (obs.profile_trace)."""
+    jax.profiler trace (obs.profile_trace).
+
+    Pod-scale routing: instances above the FLEET_SHARDED_MIN_CELLS
+    threshold (or any instance under FLEET_SHARDED=1) with >= 2 devices
+    visible solve through the mesh-sharded resident path
+    (solver/sharded.solve_sharded — service-axis sharding + parallel
+    tempering) instead of the single-chip pipeline; explicit staging
+    kwargs (prob/resident/mesh) always pin the call to this path."""
     # idempotent: callers that never pass through platform.ensure_platform
     # (library embedding, tests) still get FLEET_COMPILE_CACHE honored
     from ..platform import maybe_enable_compile_cache
     maybe_enable_compile_cache()
     with profile_trace("solve"):
+        from .sharded import maybe_solve_sharded
+        res = maybe_solve_sharded(pt, **kw)
+        if res is not None:
+            return res
         return _solve(pt, **kw)
 
 

@@ -164,7 +164,19 @@ class ResidentProblem:
     `apply_delta(pt, delta)` (donated on-device merge); `solver.api._solve`
     seeds the warm anneal from `self.assignment` (device) and calls
     `adopt()` with the padded winner. `compatible()` is the bucket-identity
-    gate deciding delta reuse vs cold fallback."""
+    gate deciding delta reuse vs cold fallback.
+
+    The staging primitives (`_merge`, `_put_small`, `_put_n_real`,
+    `_put_assignment`, `_stage_scalars`, `_expected_padded_S`) are hooks:
+    the single-chip default stages onto the default device, and
+    solver/sharded.ShardedResident overrides them to keep the same state
+    mesh-sharded (committed NamedShardings + a sharding-constrained
+    donated merge) for the pod-scale path."""
+
+    # the mesh this staging is committed to (None = single chip); the
+    # scheduler's slot matching keys on it so a routing flip mid-life can
+    # never hand a sharded staging to the single-chip path or vice versa
+    mesh = None
 
     def __init__(self, pt, *, bucket: bool = True,
                  cfg=None):
@@ -190,7 +202,7 @@ class ResidentProblem:
         from .buckets import pad_problem_tiers
         from .problem import prepare_problem
 
-        prob = prepare_problem(pt)
+        prob = prepare_problem(pt, device=self._staging_device())
         if self.bucket:
             prob, _ = pad_problem_tiers(prob, self.cfg)
         if prob.n_real is None:
@@ -224,9 +236,7 @@ class ResidentProblem:
             return False
         if pt.S != old.S:
             return self._arrivals_compatible(pt, delta, old)
-        if self.bucket and bucket_size(
-                pt.S, growth=self.cfg.growth, minimum=self.cfg.minimum,
-                align=self.cfg.align) != self.prob.S:
+        if self.bucket and self._expected_padded_S(pt) != self.prob.S:
             return False
         same = (pt.port_ids is old.port_ids
                 and pt.volume_ids is old.volume_ids
@@ -252,9 +262,7 @@ class ResidentProblem:
         preference plane — cold-stages."""
         if delta is None or delta.n_real != pt.S or pt.S <= old.S:
             return False
-        if not self.bucket or bucket_size(
-                pt.S, growth=self.cfg.growth, minimum=self.cfg.minimum,
-                align=self.cfg.align) != self.prob.S:
+        if not self.bucket or self._expected_padded_S(pt) != self.prob.S:
             return False
         if delta.demand_rows is None or delta.eligible_rows is None:
             return False
@@ -279,9 +287,6 @@ class ResidentProblem:
         `delta_stage_ms` timing). The caller has already checked
         `compatible`; node_valid/capacity always re-upload from `pt` (a few
         KB — the (S, N) problem planes are what never move)."""
-        import jax
-        import jax.numpy as jnp
-
         t0 = time.perf_counter()
         delta = delta or ProblemDelta()
         S = self.prob.S
@@ -315,14 +320,14 @@ class ResidentProblem:
                                if has_eligible else (None, None))
         if delta.n_real is not None:
             self.n_real = int(delta.n_real)
-        n_real = jnp.asarray(self.n_real, jnp.int32)
+        n_real = self._put_n_real()
 
         # explicit small uploads, then ONE donated merge dispatch; the
         # warm solve after this runs with everything already resident
-        uploads = jax.device_put(
+        uploads = self._put_small(
             (valid, cap, dem_idx, dem_val, elig_idx, elig_rows))
         try:
-            self.prob, self.assignment = _merge_fn()(
+            self.prob, self.assignment = self._merge()(
                 self.prob, self.assignment, *uploads, n_real,
                 has_demand=has_demand, has_eligible=has_eligible)
         except Exception:
@@ -340,6 +345,46 @@ class ResidentProblem:
         _M_DELTA_MS.set(ms)
         _M_REUSE.inc(outcome="delta")
         return ms
+
+    # -- staging hooks (overridden by solver/sharded.ShardedResident) ------
+
+    def _expected_padded_S(self, pt) -> int:
+        """The padded S a cold staging of `pt` would produce — the shape
+        half of the bucket-identity gate."""
+        return bucket_size(pt.S, growth=self.cfg.growth,
+                           minimum=self.cfg.minimum, align=self.cfg.align)
+
+    def _staging_device(self):
+        """Where cold_stage materializes the prepared problem. None = the
+        default device (the single-chip contract: staging IS the final
+        placement). The sharded override stages on the host CPU backend so
+        the whole (S, N) planes never materialize on one accelerator
+        before being committed shard-by-shard to the mesh."""
+        return None
+
+    def _merge(self):
+        """The donated delta-merge kernel for this staging's layout."""
+        return _merge_fn()
+
+    def _put_small(self, tree):
+        """Stage the per-burst small uploads (masks, capacity, scatter
+        rows) where the merge kernel expects them."""
+        import jax
+        return jax.device_put(tree)
+
+    def _put_n_real(self):
+        """The traced real-row count, staged for the merge kernel."""
+        import jax.numpy as jnp
+        return jnp.asarray(self.n_real, jnp.int32)
+
+    def _put_assignment(self, padded: np.ndarray):
+        """Upload a padded host assignment as the resident warm seed."""
+        import jax
+        return jax.device_put(padded)
+
+    def _stage_scalars(self, key: tuple) -> tuple:
+        import jax.numpy as jnp
+        return tuple(jnp.float32(v) for v in key)
 
     def drifted(self, pt) -> bool:
         """Has node validity or capacity drifted since the last staging?
@@ -363,8 +408,7 @@ class ResidentProblem:
         key = (float(t0), float(t1), float(mw))
         staged = self._scalars.get(key)
         if staged is None:
-            import jax.numpy as jnp
-            staged = tuple(jnp.float32(v) for v in key)
+            staged = self._stage_scalars(key)
             self._scalars = {key: staged}    # one live config at a time
         return staged
 
@@ -379,12 +423,10 @@ class ResidentProblem:
         assignment. On the warm path that is a host transfer the disallow
         guard would have caught — the event the counter exists for (a cold
         solve's upload is just staging)."""
-        import jax
-
         from .buckets import pad_assignment
         padded = pad_assignment(np.asarray(assignment, dtype=np.int32),
                                 self.prob.S, np.asarray(node_valid))
-        self.assignment = jax.device_put(padded)
+        self.assignment = self._put_assignment(padded)
         if warm:
             _M_HOST_XFER.inc()
 

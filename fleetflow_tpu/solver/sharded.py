@@ -37,11 +37,13 @@ implicit reshard.
 from __future__ import annotations
 
 import inspect
-from functools import partial
-from typing import Optional
+import os
+from functools import lru_cache, partial
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
@@ -49,10 +51,31 @@ try:
 except ImportError:                                  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from .anneal import (W_CAP, W_CONF, W_ELIG, _overflow_mass, _skew_pen,
-                     _soft_rows, violation_total_from_parts)
+from .anneal import (W_CAP, W_CONF, W_ELIG, _move_delta_core, _skew_pen,
+                     violation_total_from_parts)
 from .buckets import pad_problem
 from .problem import DeviceProblem
+from .resident import ResidentProblem, transfer_guard_ctx
+from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
+
+log = get_logger("solver.sharded")
+
+# metric catalog: docs/guide/10-observability.md
+_M_SHARDED = REGISTRY.counter(
+    "fleet_solver_sharded_solves_total",
+    "Pod-scale sharded solves by staging outcome: delta = warm re-solve "
+    "from mesh-resident buffers, cold = full host staging",
+    labels=("outcome",))
+_M_SWAPS = REGISTRY.counter(
+    "fleet_solver_tempering_swaps_total",
+    "Parallel-tempering replica-exchange attempts by outcome",
+    labels=("accepted",))
+_M_SH_BYTES = REGISTRY.gauge(
+    "fleet_solver_sharded_device_bytes",
+    "Per-device bytes of the most recent sharded solve: problem tensors "
+    "(service-axis shards + replicated node state) plus the anneal's "
+    "chain/tempering working state")
 
 # the replication-check kwarg was renamed across jax versions
 _SM_KW = ("check_rep" if "check_rep" in inspect.signature(_shard_map).parameters
@@ -66,9 +89,76 @@ def shard_map(*args, **kw):
     return _shard_map(*args, **kw)
 
 __all__ = ["anneal_sharded", "pad_problem", "shard_problem",
-           "per_device_bytes", "SVC_AXIS"]
+           "per_device_bytes", "SVC_AXIS", "REPLICA_AXIS", "ShardedStats",
+           "tempering_mesh", "tempering_swap_delta", "tempering_swap_accept",
+           "ShardedResident", "solve_sharded", "sharded_route",
+           "maybe_solve_sharded"]
 
 SVC_AXIS = "svc"
+REPLICA_AXIS = "replica"
+
+
+def tempering_mesh(replicas: int = 1, svc_shards: Optional[int] = None,
+                   devices=None) -> Mesh:
+    """Build the (replica, svc) mesh the tempered sharded solve runs on:
+    `replicas` independent annealing lanes, each sharding the service axis
+    over `svc_shards` devices. With replicas=1 this degenerates to the
+    plain service-axis sharded solve (no exchange rounds run)."""
+    if devices is None:
+        devices = jax.devices()
+    replicas = max(int(replicas), 1)
+    if svc_shards is None:
+        svc_shards = max(len(devices) // replicas, 1)
+    need = replicas * svc_shards
+    if len(devices) < need:
+        raise ValueError(f"tempering mesh needs {need} devices "
+                         f"({replicas} replicas x {svc_shards} shards), "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(replicas, svc_shards)
+    return Mesh(arr, (REPLICA_AXIS, SVC_AXIS))
+
+
+def tempering_swap_delta(e_a, e_b, beta_a, beta_b):
+    """Log acceptance ratio of exchanging the configurations of replicas a
+    and b: (β_a − β_b)(E_a − E_b). Positive when the colder replica (larger
+    β) would inherit the lower energy — the exchange that makes a bigger
+    mesh a quality amplifier rather than just more lanes."""
+    return (beta_a - beta_b) * (e_a - e_b)
+
+
+def tempering_swap_accept(e_a, e_b, beta_a, beta_b, u):
+    """Metropolis replica-exchange criterion: accept with probability
+    min(1, exp((β_a − β_b)(E_a − E_b))) given `u` ~ Uniform[0, 1).
+
+    Detailed balance holds by construction: p(swap)/p(unswap) equals the
+    ratio of the joint Boltzmann weights, exp((β_a − β_b)(E_a − E_b)) —
+    tests/test_sharded_resident.py checks the identity numerically. At
+    equal temperatures the criterion always accepts (the swap is a
+    distributional no-op); between lanes whose energy distributions
+    coincide the acceptance fraction tends to ~50% as the β gap grows
+    (only the favorable sign survives)."""
+    return u < jnp.exp(jnp.minimum(
+        tempering_swap_delta(e_a, e_b, beta_a, beta_b), 0.0))
+
+
+class ShardedStats(NamedTuple):
+    """Full return of anneal_sharded(..., return_stats=True): the winning
+    padded assignment plus exact device-side stats (violation parts and
+    soft recomputed from a scratch state rebuild of the winner, the same
+    drift discipline as api._refine) and the tempering swap counters."""
+    assignment: jax.Array       # (S,) i32, padded
+    sweeps: jax.Array           # i32, sweeps actually run
+    capacity: jax.Array         # f32, overloaded (node, resource) cells
+    conflicts: jax.Array        # f32, same-node conflict pairs
+    eligibility: jax.Array      # f32, services on ineligible/invalid nodes
+    skew: jax.Array             # f32, excess spread over max_skew
+    soft: jax.Array             # f32, soft score of the winner (padded rows)
+    swap_attempts: jax.Array    # i32, replica-exchange attempts
+    swap_accepts: jax.Array     # i32, accepted exchanges
+
+    @property
+    def violations(self):
+        return self.capacity + self.conflicts + self.eligibility + self.skew
 
 # pad_problem moved to solver/buckets.py (the bucketing module generalizes
 # it: same phantom construction, plus tier ladders for S/G/Gc and id-table
@@ -97,7 +187,8 @@ def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
     )
 
 
-def per_device_bytes(prob: DeviceProblem) -> dict[str, int]:
+def per_device_bytes(prob: DeviceProblem, *,
+                     state: bool = False) -> dict[str, int]:
     """Bytes of each of `prob`'s tensors resident on ONE device.
 
     For a service-axis-sharded array each device holds an S/D slice; for a
@@ -106,32 +197,58 @@ def per_device_bytes(prob: DeviceProblem) -> dict[str, int]:
     docstring's memory rationale claims scales ~1/D for the dominant (S, N)
     matrices — the evidence for that claim (VERDICT r4 weak #3) comes from
     comparing this across mesh sizes (tests/test_sharded.py) rather than
-    asserting it."""
+    asserting it.
+
+    `state=True` additionally accounts the anneal's per-device WORKING
+    state (`state_*` keys, computed from shapes — the buffers live only
+    inside the dispatch): the carried replicated node state (load (N, R),
+    conflict occupancy (N, G), colocation occupancy (N, Gc), topology
+    counts (T,)) plus the two S/D assignment buffers (Metropolis carry +
+    best-ever). Per-device state is the same on every lane of a tempered
+    mesh (each lane is one more set of devices, not more bytes per
+    device); the exchange rounds ppermute transient double-buffers of the
+    same shapes on top. Without this the bench's per-device memory report
+    undercounts — problem tensors alone are not what bounds the fleet
+    shape on a chip."""
     import dataclasses
 
     out: dict[str, int] = {}
+    s_loc = prob.S
     for f in dataclasses.fields(prob):
         v = getattr(prob, f.name)
-        if not isinstance(v, jax.Array):
+        if not isinstance(v, jax.Array) or v.ndim == 0:
             continue
         shards = v.addressable_shards
         dev = shards[0].device
         out[f.name] = sum(s.data.nbytes for s in shards if s.device == dev)
+        if f.name == "demand":
+            s_loc = shards[0].data.shape[0]
+    if state:
+        R = prob.demand.shape[1]
+        out["state_load"] = prob.N * R * 4
+        out["state_used"] = prob.N * prob.G * 4
+        out["state_coloc"] = prob.N * max(prob.Gc, 1) * 4
+        out["state_topo"] = prob.T * 4
+        out["state_assignment"] = s_loc * 4
+        out["state_best_assignment"] = s_loc * 4
     return out
 
 
 @partial(jax.jit, static_argnames=("steps", "proposals_per_step", "mesh",
-                                   "adaptive", "block", "n_real",
-                                   "return_sweeps"))
+                                   "adaptive", "block", "exchange_every",
+                                   "return_sweeps", "return_stats"))
 def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                    key: jax.Array, steps: int = 64,
                    t0: float = 1.0, t1: float = 1e-3,
                    proposals_per_step: Optional[int] = None,
                    *, mesh: Mesh, adaptive: bool = False,
                    block: int = 16,
-                   n_real: Optional[int] = None,
-                   return_sweeps: bool = False) -> jax.Array:
-    """One annealing chain with the service axis sharded over `mesh`.
+                   n_real=None,
+                   ladder: float = 1.3,
+                   exchange_every: int = 1,
+                   return_sweeps: bool = False,
+                   return_stats: bool = False):
+    """One annealing pass with the service axis sharded over `mesh`.
 
     init_assignment: (S,) int32 (replicated input; resharded internally).
     Returns the refined (S,) assignment. S must be divisible by the mesh
@@ -139,6 +256,10 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
     (assignment, sweeps_run) instead — sweeps_run is the sweep count the
     adaptive early exit actually executed (== steps when adaptive=False),
     so artifacts can report effort, not just latency (VERDICT r4 weak #3).
+    `return_stats=True` returns a ShardedStats carrying exact device-side
+    violation parts + soft of the winner (recomputed from a scratch state
+    rebuild, the same float-drift discipline as api._refine) and the
+    tempering swap counters.
 
     The returned assignment is the lexicographically best (violations,
     soft) state EVER VISITED, not the final Metropolis state (r5, same
@@ -149,12 +270,32 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
     two scalar psums per sweep, noise next to the sweep's four (N,·)
     state-delta psums. `adaptive=True` additionally runs in `block`-sweep
     chunks inside a lax.while_loop and exits at the first block boundary
-    after any sweep visited a feasible state.
+    after any sweep visited a feasible state (any *replica* on a tempered
+    mesh — the exit predicate is pmin'd across lanes so it stays uniform).
 
-    `n_real` (static) marks rows >= n_real as pad_problem phantoms: they
-    are excluded from topology counts, skew deltas, and the feasibility
-    check, so padding cannot distort a spread constraint."""
+    `n_real` (TRACED — tier drift inside a shape bucket must not
+    recompile, the same contract the resident path holds on one chip)
+    marks rows >= n_real as pad_problem phantoms: they are excluded from
+    topology counts, skew deltas, and the feasibility check, so padding
+    cannot distort a spread constraint. None falls back to `prob.n_real`,
+    then to "every row real".
+
+    Parallel tempering: when `mesh` carries a REPLICA_AXIS (see
+    `tempering_mesh`), each replica lane anneals the full problem at
+    temperature `t(i) * ladder**lane` — lane 0 is the cold lane running
+    the base schedule — and every `exchange_every` sweep-blocks
+    neighboring lanes exchange their COMPLETE configurations (assignment
+    shard + replicated node state) via `lax.ppermute` under the
+    Metropolis swap criterion (`tempering_swap_accept`; even/odd pairing
+    alternates per exchange round so the ladder mixes end to end). The
+    final
+    winner is the lexicographically best (violations, soft) state any
+    lane ever visited, broadcast to every lane — adding devices along
+    the replica axis buys solution QUALITY at equal wall-clock, not just
+    divided memory."""
     D = mesh.shape[SVC_AXIS]
+    has_rep = REPLICA_AXIS in mesh.shape
+    n_rep = mesh.shape.get(REPLICA_AXIS, 1) if has_rep else 1
     S, N = prob.S, prob.N
     R = prob.demand.shape[1]
     Gc = max(prob.Gc, 1)
@@ -162,14 +303,24 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
     assert S % D == 0, (f"S={S} must divide over {D} devices "
                         f"(use pad_problem first)")
     M = proposals_per_step or max(8, min(256, (S // D) // 2))
-    real_s = S if n_real is None else n_real
+    if n_real is None:
+        real_s = prob.n_real if prob.n_real is not None else S
+    else:
+        real_s = n_real
     decay = (t1 / t0) ** (1.0 / max(steps - 1, 1))
+    lad = jnp.asarray(ladder, jnp.float32)
 
     def body(demand, conflict_ids, coloc_ids, eligible, preferred,
              capacity, node_valid, node_topology, assign, key):
         # shapes inside: demand (S/D, R), assign (S/D,), key replicated;
-        # axis_index distinguishes the shard
+        # axis_index distinguishes the shard (and the replica lane)
         me = jax.lax.axis_index(SVC_AXIS)
+        rep = (jax.lax.axis_index(REPLICA_AXIS) if has_rep
+               else jnp.int32(0))
+        # per-lane temperature multiplier: lane 0 is the cold lane on the
+        # base schedule, hotter lanes explore basins the cold lane cannot
+        lad_f = (lad ** rep.astype(jnp.float32) if has_rep
+                 else jnp.float32(1.0))
         S_loc = assign.shape[0]
         # pad_problem phantoms (global row >= real_s) carry no topology
         # weight: a parked phantom must not relax or tighten a spread
@@ -197,51 +348,20 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
         load0, used0, coloc0, topo0 = build_state(assign)
 
         def proposal_delta(load, used, coloc, topo, assign, s, b):
-            """anneal._proposal_delta term for term, on shard-local gathers
-            against the replicated node state."""
+            """The SHARED per-move cost delta (anneal._move_delta_core) on
+            shard-local gathers against the replicated node state — a
+            legal sweep here is a legal sweep in the single-device anneal
+            by construction, not by comment."""
             a = assign[s]
-            d = demand[s]
-            ids = conflict_ids[s]
-            valid = ids >= 0
-            safe = jnp.where(valid, ids, 0)
-            cids = coloc_ids[s]
-            lvalid = cids >= 0
-            lsafe = jnp.where(lvalid, cids, 0)
-
-            cap_a, cap_b = capacity[a], capacity[b]
-            load_a, load_b = load[a], load[b]
-
-            load_a2, load_b2 = load_a - d, load_b + d
-            d_cap = (_overflow_mass(prob, load_a2, cap_a)
-                     + _overflow_mass(prob, load_b2, cap_b)
-                     - _overflow_mass(prob, load_a, cap_a)
-                     - _overflow_mass(prob, load_b, cap_b)) * W_CAP
-
-            conf_a = ((used[a, safe] - 1) * valid).sum()
-            conf_b = (used[b, safe] * valid).sum()
-            d_conf = (conf_b - conf_a).astype(jnp.float32) * W_CONF
-
             elig_a = eligible[s, a] & node_valid[a]
             elig_b = eligible[s, b] & node_valid[b]
-            d_elig = (elig_a.astype(jnp.float32)
-                      - elig_b.astype(jnp.float32)) * W_ELIG
-
-            ta, tb = node_topology[a], node_topology[b]
-            r = real[s].astype(jnp.int32)
-            topo2 = topo.at[ta].add(-r).at[tb].add(r)
-            d_skew = _skew_pen(prob, topo2) - _skew_pen(prob, topo)
-
-            soft_before = _soft_rows(prob, jnp.stack([load_a, load_b]),
-                                     jnp.stack([cap_a, cap_b]))
-            soft_after = _soft_rows(prob, jnp.stack([load_a2, load_b2]),
-                                    jnp.stack([cap_a, cap_b]))
             d_pref = (preferred[s, a] - preferred[s, b]) / S
-            col_a = ((coloc[a, lsafe] - 1) * lvalid).sum()
-            col_b = (coloc[b, lsafe] * lvalid).sum()
-            d_coloc = (col_a - col_b).astype(jnp.float32) / max(S, 1)
-
-            return (d_cap + d_conf + d_elig + d_skew
-                    + (soft_after - soft_before) + d_pref + d_coloc)
+            return _move_delta_core(
+                prob, capacity=capacity, node_topology=node_topology,
+                load=load, used=used, coloc=coloc, topo=topo,
+                a=a, b=b, d=demand[s], ids=conflict_ids[s],
+                cids=coloc_ids[s], elig_a=elig_a, elig_b=elig_b,
+                d_pref=d_pref, r=real[s].astype(jnp.int32))
 
         def viol_total(assign, load, used, topo):
             """Exact hard-violation total: local math on the replicated
@@ -281,12 +401,30 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                 col = jnp.float32(0.0)
             return strat + pref + col
 
+        def energy(assign, load, used, coloc, topo):
+            """The annealing-cost energy the exchange criterion samples:
+            overflow mass, conflict pairs, ineligibility and skew at their
+            sweep weights, plus the soft score — the same landscape the
+            sweeps walk, so the swap criterion and the proposal criterion
+            agree on what "better" means."""
+            over = (jnp.maximum(load - capacity, 0.0)
+                    / jnp.maximum(capacity, 1e-6)).sum() * W_CAP
+            c = used.astype(jnp.float32)
+            conf = (c * (c - 1.0) / 2.0).sum() * W_CONF
+            inel = ((~eligible[jnp.arange(S_loc), assign]
+                     | ~node_valid[assign]) & real).sum()
+            inel = jax.lax.psum(inel, SVC_AXIS).astype(jnp.float32) * W_ELIG
+            return (over + conf + inel + _skew_pen(prob, topo)
+                    + soft_here(assign, load, coloc))
+
         def sweep(carry, i):
             (assign, load, used, coloc, topo, key,
              best_assign, best_viol, best_soft) = carry
-            temp = t0 * decay ** i.astype(jnp.float32)
+            temp = t0 * decay ** i.astype(jnp.float32) * lad_f
             key = jax.random.fold_in(key, i)
             kk = jax.random.fold_in(key, me)   # decorrelate shards
+            if has_rep:
+                kk = jax.random.fold_in(kk, rep)   # ...and replica lanes
             ks, kb, ka, kt = jax.random.split(kk, 4)
 
             # targeted half: this shard's services on violating/invalid nodes
@@ -376,49 +514,562 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             return (assign, load, used, coloc, topo, key,
                     best_assign, best_viol, best_soft), None
 
+        def exchange(assign, load, used, coloc, topo, key, b):
+            """One replica-exchange round at block boundary `b` (even/odd
+            pairing alternating with the round parity): neighboring lanes
+            trade their COMPLETE configurations via lax.ppermute under the
+            Metropolis swap criterion. Both partners of a pair fold the
+            SAME key (the pair's low lane index) so the decision is
+            symmetric without extra communication."""
+            E = energy(assign, load, used, coloc, topo)
+            # swap at the block's end temperature (clamped like the sweep
+            # schedule); betas are per-lane, computable locally
+            temp_b = t0 * decay ** jnp.minimum(
+                (b + 1) * block - 1, steps - 1).astype(jnp.float32)
+
+            def beta(rr):
+                return 1.0 / jnp.maximum(
+                    temp_b * lad ** rr.astype(jnp.float32), 1e-8)
+
+            fwd = [(i, (i + 1) % n_rep) for i in range(n_rep)]
+            bwd = [(i, (i - 1) % n_rep) for i in range(n_rep)]
+            st = (assign, load, used, coloc, topo, E)
+            below = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, REPLICA_AXIS, fwd), st)
+            above = jax.tree_util.tree_map(
+                lambda x: jax.lax.ppermute(x, REPLICA_AXIS, bwd), st)
+
+            # pairing parity advances per exchange ROUND, not per block:
+            # tied to raw b, exchange_every=2 would pin every active
+            # round to odd parity and a 2-lane ladder would never trade
+            parity = (b // exchange_every) % 2
+            kx = jax.random.fold_in(key, jnp.int32(0x7357))
+            u_lo = jax.random.uniform(jax.random.fold_in(kx, rep))
+            u_hi = jax.random.uniform(jax.random.fold_in(kx, rep - 1))
+            is_lo = ((rep % 2) == parity) & (rep + 1 < n_rep)
+            is_hi = (((rep + 1) % 2) == parity) & (rep >= 1)
+            take_above = is_lo & tempering_swap_accept(
+                E, above[5], beta(rep), beta(rep + 1), u_lo)
+            take_below = is_hi & tempering_swap_accept(
+                below[5], E, beta(rep - 1), beta(rep), u_hi)
+
+            def sel(cur, ab, bel):
+                return jnp.where(take_above, ab,
+                                 jnp.where(take_below, bel, cur))
+
+            out = tuple(sel(c, a2, b2)
+                        for c, a2, b2 in zip(st[:5], above[:5], below[:5]))
+            d_att = jax.lax.psum(is_lo.astype(jnp.int32), REPLICA_AXIS)
+            d_acc = jax.lax.psum(take_above.astype(jnp.int32), REPLICA_AXIS)
+            return out + (d_att, d_acc)
+
         viol0 = viol_total(assign, load0, used0, topo0)
         soft0 = soft_here(assign, load0, coloc0)
         carry0 = (assign, load0, used0, coloc0, topo0, key,
                   assign, viol0, soft0)
-
-        if not adaptive:
-            (_a, _l, _u, _c, _t, _k, best_assign, _bv, _bs), _ = \
-                jax.lax.scan(sweep, carry0,
-                             jnp.arange(steps, dtype=jnp.int32))
-            return best_assign, jnp.int32(steps)
-
+        zero_i = jnp.int32(0)
         n_blocks = -(-steps // block)
 
-        def cond(carry):
-            *_rest, b, done = carry
-            return (~done) & (b < n_blocks)
+        if not has_rep and not adaptive:
+            (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft), _ \
+                = jax.lax.scan(sweep, carry0,
+                               jnp.arange(steps, dtype=jnp.int32))
+            sweeps_run = jnp.int32(steps)
+            att = acc = zero_i
+        elif not has_rep:
+            def cond(carry):
+                *_rest, b, done = carry
+                return (~done) & (b < n_blocks)
 
-        def blk(carry):
-            (assign, load, used, coloc, topo, key,
-             best_assign, best_viol, best_soft, b, _done) = carry
-            offsets = b * block + jnp.arange(block, dtype=jnp.int32)
-            offsets = jnp.minimum(offsets, steps - 1)   # clamp temp schedule
-            (assign, load, used, coloc, topo, key,
-             best_assign, best_viol, best_soft), _ = jax.lax.scan(
-                sweep, (assign, load, used, coloc, topo, key,
-                        best_assign, best_viol, best_soft), offsets)
-            return (assign, load, used, coloc, topo, key,
-                    best_assign, best_viol, best_soft, b + 1,
-                    best_viol == 0)
+            def blk(carry):
+                (assign, load, used, coloc, topo, key,
+                 best_assign, best_viol, best_soft, b, _done) = carry
+                offsets = b * block + jnp.arange(block, dtype=jnp.int32)
+                offsets = jnp.minimum(offsets, steps - 1)  # clamp schedule
+                (assign, load, used, coloc, topo, key,
+                 best_assign, best_viol, best_soft), _ = jax.lax.scan(
+                    sweep, (assign, load, used, coloc, topo, key,
+                            best_assign, best_viol, best_soft), offsets)
+                return (assign, load, used, coloc, topo, key,
+                        best_assign, best_viol, best_soft, b + 1,
+                        best_viol == 0)
 
-        (_a, _l, _u, _c, _t, _k, best_assign, _bv, _bs, b_run,
-         _done) = jax.lax.while_loop(
-            cond, blk, carry0 + (jnp.int32(0), jnp.bool_(False)))
-        return best_assign, jnp.minimum(b_run * block, steps)
+            (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft,
+             b_run, _done) = jax.lax.while_loop(
+                cond, blk, carry0 + (zero_i, jnp.bool_(False)))
+            sweeps_run = jnp.minimum(b_run * block, steps)
+            att = acc = zero_i
+        else:
+            # tempered mesh: block loop + replica exchange at boundaries.
+            # adaptive=False runs every block (the quality-curve config);
+            # the exit predicate is pmin'd across lanes so every device
+            # takes the same branch (a lane-local exit would deadlock the
+            # collectives).
+            def cond(carry):
+                *_rest, b, done = carry
+                return (~done) & (b < n_blocks)
+
+            def blk(carry):
+                (assign, load, used, coloc, topo, key, best_assign,
+                 best_viol, best_soft, att, acc, b, _done) = carry
+                offsets = b * block + jnp.arange(block, dtype=jnp.int32)
+                offsets = jnp.minimum(offsets, steps - 1)  # clamp schedule
+                (assign, load, used, coloc, topo, key, best_assign,
+                 best_viol, best_soft), _ = jax.lax.scan(
+                    sweep, (assign, load, used, coloc, topo, key,
+                            best_assign, best_viol, best_soft), offsets)
+                if n_rep > 1:
+                    ops = (assign, load, used, coloc, topo)
+                    if exchange_every == 1:
+                        out = exchange(*ops, key, b)
+                    else:
+                        # skip the WHOLE round (energy psum + both
+                        # full-state ppermutes) on off blocks — the gate
+                        # is replica-uniform (computed from the carried
+                        # block index), so every lane takes the same
+                        # branch and the collectives stay collective
+                        out = jax.lax.cond(
+                            (b % exchange_every) == (exchange_every - 1),
+                            lambda o: exchange(*o, key, b),
+                            lambda o: o + (zero_i, zero_i), ops)
+                    (assign, load, used, coloc, topo, d_att, d_acc) = out
+                    att = att + d_att
+                    acc = acc + d_acc
+                g_viol = jax.lax.pmin(best_viol, REPLICA_AXIS)
+                done = (g_viol == 0) if adaptive else jnp.bool_(False)
+                return (assign, load, used, coloc, topo, key, best_assign,
+                        best_viol, best_soft, att, acc, b + 1, done)
+
+            (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft,
+             att, acc, b_run, _done) = jax.lax.while_loop(
+                cond, blk, carry0 + (zero_i, zero_i, zero_i,
+                                     jnp.bool_(False)))
+            sweeps_run = jnp.minimum(b_run * block, steps)
+            if n_rep > 1:
+                # global winner: the lexicographically best (violations,
+                # soft) state any lane ever visited, broadcast to every
+                # lane so the sharded output is replica-replicated
+                g_viol = jax.lax.pmin(best_viol, REPLICA_AXIS)
+                soft_m = jnp.where(best_viol == g_viol, best_soft, jnp.inf)
+                g_soft = jax.lax.pmin(soft_m, REPLICA_AXIS)
+                winner = (best_viol == g_viol) & (soft_m == g_soft)
+                rank = jnp.where(winner, rep, n_rep)
+                sel_rep = rep == jax.lax.pmin(rank, REPLICA_AXIS)
+                best_assign = jax.lax.psum(
+                    jnp.where(sel_rep, best_assign, 0), REPLICA_AXIS)
+                best_viol, best_soft = g_viol, g_soft
+
+        if return_stats:
+            # exact stats of the WINNER from a scratch rebuild: the
+            # carried float32 load drifts over thousands of scatter
+            # updates, and the caller's repair decision must not trust
+            # drifted state (the api._refine discipline)
+            loadF, usedF, colocF, topoF = build_state(best_assign)
+            capF = (loadF > capacity * (1 + 1e-6)).sum().astype(jnp.float32)
+            cF = usedF.astype(jnp.float32)
+            confF = (cF * (cF - 1.0) / 2.0).sum()
+            inelF = jax.lax.psum(
+                ((~eligible[jnp.arange(S_loc), best_assign]
+                  | ~node_valid[best_assign]) & real).sum(),
+                SVC_AXIS).astype(jnp.float32)
+            if prob.max_skew > 0:
+                skewF = jnp.maximum(
+                    (topoF.max() - topoF.min()) - prob.max_skew, 0
+                ).astype(jnp.float32)
+            else:
+                skewF = jnp.float32(0.0)
+            softF = soft_here(best_assign, loadF, colocF)
+        else:
+            capF = confF = inelF = skewF = softF = jnp.float32(0.0)
+        return (best_assign, sweeps_run, capF, confF, inelF, skewF,
+                softF, att, acc)
 
     sharded = shard_map(
         body, mesh=mesh,
         in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None), P(SVC_AXIS, None),
                   P(SVC_AXIS, None), P(SVC_AXIS, None),
                   P(), P(), P(), P(SVC_AXIS), P()),
-        out_specs=(P(SVC_AXIS), P()))
-    assign, sweeps = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
-                             prob.eligible, prob.preferred, prob.capacity,
-                             prob.node_valid, prob.node_topology,
-                             init_assignment.astype(jnp.int32), key)
-    return (assign, sweeps) if return_sweeps else assign
+        out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
+    out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
+                  prob.eligible, prob.preferred, prob.capacity,
+                  prob.node_valid, prob.node_topology,
+                  init_assignment.astype(jnp.int32), key)
+    stats = ShardedStats(*out)
+    if return_stats:
+        return stats
+    if return_sweeps:
+        return stats.assignment, stats.sweeps
+    return stats.assignment
+
+
+# -- mesh-resident sharded state: the pod-scale warm path --------------------
+
+@lru_cache(maxsize=8)
+def _merge_fn_sharded(mesh: Mesh):
+    """The donated delta-merge kernel for MESH-SHARDED resident state: the
+    same semantics as resident._merge_fn, with explicit sharding
+    constraints (SNIPPETS.md [1]-[3] pjit/donation/constraint patterns)
+    pinning every output to its input layout — the donated (S, ·) shards
+    are reused in place on their own devices and a warm re-solve never
+    reshards or round-trips the host."""
+    import dataclasses
+
+    svc2 = NamedSharding(mesh, P(SVC_AXIS, None))
+    svc1 = NamedSharding(mesh, P(SVC_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def merge(prob, assignment, node_valid, capacity, dem_idx, dem_val,
+              elig_idx, elig_rows, n_real, *, has_demand, has_eligible):
+        cst = jax.lax.with_sharding_constraint
+        demand = (cst(prob.demand.at[dem_idx].set(dem_val, mode="drop"),
+                      svc2)
+                  if has_demand else prob.demand)
+        eligible = (cst(prob.eligible.at[elig_idx].set(elig_rows,
+                                                       mode="drop"), svc2)
+                    if has_eligible else prob.eligible)
+        # re-park phantom rows on a valid node (see resident._merge_fn)
+        first_valid = jnp.argmax(node_valid).astype(jnp.int32)
+        ar = jnp.arange(prob.S)
+        assignment = cst(jnp.where(ar >= n_real, first_valid, assignment),
+                         svc1)
+        prob = dataclasses.replace(
+            prob, demand=demand, eligible=eligible,
+            node_valid=cst(node_valid, rep), capacity=cst(capacity, rep),
+            n_real=n_real)
+        return prob, assignment
+
+    return jax.jit(merge, donate_argnums=(0, 1),
+                   static_argnames=("has_demand", "has_eligible"))
+
+
+class ShardedResident(ResidentProblem):
+    """solver/resident.ResidentProblem generalized to a device mesh: the
+    padded, bucketed problem lives mesh-sharded
+    (`NamedSharding(mesh, P(SVC_AXIS, None))` for the (S, ·) planes,
+    replicated node state) and the last assignment lives `P(SVC_AXIS)`
+    across bursts. Churn merges through the donated sharded kernel above;
+    the small per-burst uploads (masks, capacity, scatter rows) are
+    committed replicated so the warm dispatch moves nothing implicitly —
+    the PR-7 transfer-guard contract, now at pod scale."""
+
+    def __init__(self, pt, *, mesh: Mesh, bucket: bool = True, cfg=None):
+        self.mesh = mesh
+        super().__init__(pt, bucket=bucket, cfg=cfg)
+
+    def _expected_padded_S(self, pt) -> int:
+        # the bucket tier, rounded up so it divides over the svc axis
+        s = super()._expected_padded_S(pt)
+        D = self.mesh.shape[SVC_AXIS]
+        return s + (-s) % D
+
+    def _staging_device(self):
+        # stage on the host CPU backend: the XL (S, N) planes must never
+        # materialize whole on accelerator 0 — a cold stage would OOM the
+        # chip before the mesh ever divides the bytes. shard_problem then
+        # commits each tensor straight to its NamedSharding, so every
+        # device receives only its own slice.
+        try:
+            return jax.local_devices(backend="cpu")[0]
+        except RuntimeError:                         # pragma: no cover
+            return None                              # cpu backend disabled
+
+    def cold_stage(self, pt) -> None:
+        import dataclasses
+        super().cold_stage(pt)
+        D = self.mesh.shape[SVC_AXIS]
+        prob, _ = pad_problem(self.prob, D)
+        # n_real must be COMMITTED to the mesh: an uncommitted scalar
+        # reshards at dispatch time, which the disallow guard (rightly)
+        # reads as a transfer on the warm path
+        prob = dataclasses.replace(prob, n_real=self._put_n_real())
+        self.prob = shard_problem(prob, self.mesh)
+
+    # -- staging hooks: everything lands committed on the mesh -------------
+
+    def _merge(self):
+        return _merge_fn_sharded(self.mesh)
+
+    def _put_small(self, tree):
+        return jax.device_put(tree, NamedSharding(self.mesh, P()))
+
+    def _put_n_real(self):
+        return jax.device_put(np.asarray(self.n_real, np.int32),
+                              NamedSharding(self.mesh, P()))
+
+    def _put_assignment(self, padded):
+        return jax.device_put(np.asarray(padded, np.int32),
+                              NamedSharding(self.mesh, P(SVC_AXIS)))
+
+    def _stage_scalars(self, key):
+        rep = NamedSharding(self.mesh, P())
+        return tuple(jax.device_put(np.float32(v), rep) for v in key)
+
+
+def _host_seed(pt, parts: int) -> np.ndarray:
+    """Cold host seed for the sharded path: native FFD when the library is
+    built (partitioned past the r5 crossover where whole-instance FFD
+    dominates), else one minimal pass through the single-chip pipeline."""
+    from ..native.lib import available_nobuild
+    if available_nobuild():
+        if pt.S * pt.N >= 1_000_000:
+            from .greedy import partitioned_seed
+            return partitioned_seed(pt, max(parts, 1))
+        from ..native.lib import native_place
+        seed, _ = native_place(pt.demand, pt.capacity, pt.eligible,
+                               pt.node_valid, pt.dep_depth, pt.port_ids,
+                               pt.volume_ids, pt.anti_ids,
+                               strategy=pt.strategy.value)
+        return np.asarray(seed, np.int32)
+    # no native .so: the pure-host greedy (sched/host.py). NOT the
+    # single-chip device pipeline — staging the whole un-sharded problem
+    # on one device to produce a seed is exactly the footprint the
+    # sharded path exists to avoid.
+    from ..sched.host import greedy_host_place
+    seed, _ = greedy_host_place(pt)
+    return np.asarray(seed, np.int32)
+
+
+def solve_sharded(pt, *, resident: ShardedResident,
+                  resident_warm: bool = False,
+                  init_assignment=None,
+                  steps: int = 64, seed: int = 0,
+                  t0: float = 1.0, t1: float = 1e-3,
+                  adaptive: bool = True, block: int = 8,
+                  proposals_per_step: Optional[int] = None,
+                  ladder: Optional[float] = None,
+                  exchange_every: Optional[int] = None,
+                  do_repair: bool = True,
+                  overlap_host_work=None):
+    """Pod-scale end-to-end solve through the mesh-resident sharded path:
+    the SPMD anneal (+ parallel tempering over the replica axis) with the
+    api.solve contract — exact stats, host repair backstop, SolveResult.
+
+    `resident_warm=True` seeds from the mesh-resident previous assignment
+    (churn already merged via `ShardedResident.apply_delta`): nothing
+    crosses the host boundary and the dispatch runs under
+    FLEET_TRANSFER_GUARD=disallow when set, exactly like the single-chip
+    resident path. Cold solves stage a host FFD seed. Tempering knobs:
+    `ladder` (temperature ratio between neighboring lanes,
+    FLEET_TEMPER_LADDER, default 1.3 — measured best of {1.3, 1.6, 2.0, 3.0} on the partitioned-seed curve) and `exchange_every` (sweep-blocks
+    between exchange rounds, FLEET_TEMPER_EXCHANGE, default 1)."""
+    import contextlib
+    import time
+
+    from .api import SolveResult
+    from .buckets import soft_score_host
+    from .repair import RepairResult, repair, verify
+
+    t = time.perf_counter
+    timings: dict = {}
+    t_start = t()
+    rp = resident
+    mesh = rp.mesh
+    prob = rp.prob
+    D = mesh.shape[SVC_AXIS]
+    n_rep = mesh.shape.get(REPLICA_AXIS, 1)
+    if ladder is None:
+        try:
+            ladder = float(os.environ.get("FLEET_TEMPER_LADDER") or "1.3")
+        except ValueError:
+            ladder = 1.3
+    if exchange_every is None:
+        try:
+            exchange_every = max(
+                1, int(os.environ.get("FLEET_TEMPER_EXCHANGE") or "1"))
+        except ValueError:
+            exchange_every = 1
+    warm = bool(resident_warm and rp.assignment is not None)
+    if warm:
+        timings["delta_stage_ms"] = rp.consume_delta_ms()
+    timings["stage_ms"] = (t() - t_start) * 1e3
+
+    t_seed = t()
+    if warm:
+        # seed already mesh-resident: the previous padded winner, phantoms
+        # re-parked at delta time; nothing crosses the host boundary
+        seed_assignment = rp.assignment
+        t0 = min(t0, 0.1)   # warm start: refine, don't re-scramble
+    else:
+        if init_assignment is not None:
+            seed_np = np.asarray(init_assignment, dtype=np.int32)
+            t0 = min(t0, 0.1)   # host warm seed: same refine contract
+        else:
+            seed_np = _host_seed(pt, D)
+        # adopt_host pads to the mesh tier and commits P(SVC_AXIS)
+        rp.adopt_host(seed_np, pt.node_valid, warm=False)
+        seed_assignment = rp.assignment
+    timings["seed_ms"] = (t() - t_seed) * 1e3
+    _M_SHARDED.inc(outcome="delta" if warm else "cold")
+
+    t_anneal = t()
+    t0_d, t1_d, lad_d = rp.warm_scalars(t0, t1, float(ladder))
+    # the PRNG key is minted and committed BEFORE the guard arms: it is
+    # not a problem tensor (same contract as api._solve)
+    key = jax.device_put(jax.random.PRNGKey(seed),
+                         NamedSharding(mesh, P()))
+    guard = transfer_guard_ctx() if warm else contextlib.nullcontext()
+    cache_before = anneal_sharded._cache_size()
+    with guard:
+        res = anneal_sharded(
+            prob, seed_assignment, key, steps=steps, t0=t0_d, t1=t1_d,
+            proposals_per_step=proposals_per_step, mesh=mesh,
+            adaptive=adaptive, block=block, ladder=lad_d,
+            exchange_every=exchange_every, return_stats=True)
+    compile_events = anneal_sharded._cache_size() - cache_before
+    # the padded winner stays mesh-resident as the next warm seed
+    rp.adopt(res.assignment)
+    if overlap_host_work is not None:
+        t_ov = t()
+        overlap_host_work()
+        timings["overlap_host_ms"] = (t() - t_ov) * 1e3
+    # ONE fetch for everything the host decision needs
+    (assignment, sweeps, capF, confF, inelF, skewF, _softF, att,
+     acc) = jax.device_get(tuple(res))
+    assignment = np.asarray(assignment)[: pt.S]
+    timings["anneal_ms"] = (t() - t_anneal) * 1e3
+
+    t_verify = t()
+    moves = 0
+    pre_repair = 0
+    if float(capF + confF + inelF + skewF) == 0:
+        stats = {"capacity": 0, "conflicts": 0, "eligibility": 0,
+                 "skew": 0, "total": 0}
+    else:
+        stats = {k: int(v) for k, v in verify(pt, assignment).items()}
+        pre_repair = int(stats["total"])
+        if do_repair and stats["total"] > 0:
+            rr: RepairResult = repair(pt, assignment)
+            assignment, moves = rr.assignment, rr.moves
+            stats = {k: int(v) for k, v in rr.stats.items()}
+            if moves:
+                # the resident seed must track what the fleet actually
+                # runs; on the warm path this is the host-transfer event
+                # the counter exists for
+                rp.adopt_host(assignment, pt.node_valid, warm=warm)
+    # the real rows' soft score (the device number counts phantoms in its
+    # /S mean denominators)
+    soft = soft_score_host(pt, assignment)
+    timings["verify_repair_ms"] = (t() - t_verify) * 1e3
+    timings["total_ms"] = (t() - t_start) * 1e3
+
+    # the CORE solver families too, not just the sharded ones: above the
+    # routing threshold these are the only solves a fleet runs, and the
+    # guide/10 catalog ("violations of the most recent solve", chaos
+    # monotonicity invariants) must keep reflecting them
+    from . import api as _api
+    _api._M_SOLVES.inc(backend=jax.default_backend(),
+                       warm="true" if warm else "false")
+    _api._M_SOLVE_S.observe(timings["total_ms"] / 1e3)
+    _api._M_SWEEPS.inc(int(sweeps))
+    if compile_events > 0:
+        _api._M_COMPILES.inc(compile_events)
+    _api._M_VIOL.set(int(stats["total"]))
+    _api._M_PRE_VIOL.set(pre_repair)
+    att, acc = int(att), int(acc)
+    if att > 0:
+        _M_SWAPS.inc(acc, accepted="true")
+        _M_SWAPS.inc(att - acc, accepted="false")
+    dev_bytes = per_device_bytes(prob, state=True)
+    _M_SH_BYTES.set(float(sum(dev_bytes.values())))
+    log.info("solve_sharded %s", kv(
+        S=pt.S, N=pt.N, padded=prob.S, mesh=f"{n_rep}x{D}",
+        sweeps=int(sweeps), swaps=f"{acc}/{att}" if att else None,
+        compiles=compile_events or None,
+        violations=int(stats["total"]), pre_repair=pre_repair,
+        repaired=moves or None, warm=warm or None,
+        **{k: f"{v:.1f}" for k, v in timings.items()}))
+    return SolveResult(
+        assignment=assignment, stats=stats, soft=float(soft),
+        feasible=stats["total"] == 0, moves_repaired=moves,
+        pre_repair_violations=pre_repair,
+        timings_ms=timings, chains=n_rep, steps=int(sweeps),
+        proposals_per_step=(proposals_per_step
+                            or max(8, min(256, (prob.S // D) // 2))),
+        accepted_moves=-1,
+        bucket={"orig_S": pt.S, "padded_S": prob.S,
+                "pad_waste": round(1.0 - pt.S / prob.S, 4),
+                "hit": compile_events == 0},
+        tempering={"replicas": n_rep, "ladder": float(ladder),
+                   "exchange_every": int(exchange_every),
+                   "swap_attempts": att, "swap_accepts": acc},
+    )
+
+
+# -- routing: when does a solve take the pod-scale path? ---------------------
+
+def sharded_route(pt) -> Optional[Mesh]:
+    """Decide whether `pt` takes the pod-scale sharded path, and on what
+    mesh. `FLEET_SHARDED=0` disables, `=1` forces; otherwise instances
+    with S*N >= FLEET_SHARDED_MIN_CELLS (default 5e7 — comfortably above
+    the proven single-chip 10k x 1k point) route when >= 2 devices are
+    visible. FLEET_SHARDED_REPLICAS picks the tempering lanes (default 2
+    when the device count allows an even split, else 1); the remaining
+    devices shard the service axis."""
+    mode = os.environ.get("FLEET_SHARDED", "").strip().lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    force = mode in ("1", "on", "true", "yes", "force")
+    try:
+        thresh = int(os.environ.get("FLEET_SHARDED_MIN_CELLS")
+                     or str(50_000_000))
+    except ValueError:
+        thresh = 50_000_000
+    if not force and pt.S * pt.N < thresh:
+        return None
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    try:
+        want = int(os.environ.get("FLEET_SHARDED_REPLICAS") or "0")
+    except ValueError:
+        want = 0
+    if want <= 0:
+        replicas = 2 if len(devs) >= 4 else 1
+    else:
+        # an explicit replica count is honored up to the device count
+        # (replicas=len(devs) means pure tempering, one-device lanes)
+        replicas = min(want, len(devs))
+        if replicas != want:
+            log.warning("FLEET_SHARDED_REPLICAS=%d clamped to %d "
+                        "(only %d devices visible)", want, replicas,
+                        len(devs))
+    return tempering_mesh(replicas, len(devs) // replicas, devices=devs)
+
+
+# solve() kwargs the sharded path speaks; anything else pins the call to
+# the single-chip pipeline (an explicit chains= or seed_impl, a custom
+# mesh, ...) — a knob this path would silently drop must not route
+_ROUTED_KW = {"steps", "seed", "init_assignment", "t0", "t1", "adaptive",
+              "do_repair", "overlap_host_work",
+              "prob", "resident", "mesh"}
+
+
+def maybe_solve_sharded(pt, **kw):
+    """api.solve's routing hook: above the pod-scale threshold (or under
+    FLEET_SHARDED=1) solve through a transient mesh-resident staging.
+    Returns None when the call stays on the single-chip path — explicit
+    staging kwargs (prob/resident/mesh) and solver knobs the sharded path
+    does not speak always stay put. The CP's TpuSolverScheduler routes
+    itself (persistent per-stage ShardedResident slots); this hook covers
+    direct library/bench calls."""
+    if any(kw.get(k) is not None for k in ("prob", "resident", "mesh")):
+        return None
+    if not set(kw) <= _ROUTED_KW:
+        return None
+    mesh = sharded_route(pt)
+    if mesh is None:
+        return None
+    rp = ShardedResident(pt, mesh=mesh)
+    try:
+        steps = kw.get("steps") or int(
+            os.environ.get("FLEET_SHARDED_STEPS") or "64")
+    except ValueError:
+        steps = 64
+    return solve_sharded(
+        pt, resident=rp, steps=steps,
+        seed=kw.get("seed", 0),
+        init_assignment=kw.get("init_assignment"),
+        t0=kw.get("t0", 1.0), t1=kw.get("t1", 1e-3),
+        adaptive=kw.get("adaptive", True),
+        do_repair=kw.get("do_repair", True),
+        overlap_host_work=kw.get("overlap_host_work"))

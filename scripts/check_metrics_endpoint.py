@@ -43,6 +43,7 @@ REQUIRED = (
     "fleet_reconverge_redeliveries_total",  # CP reconverger
     "fleet_agent_send_failures_total",  # agent session loops
     "fleet_solver_resident_reuse_total",    # device-resident warm path
+    "fleet_solver_sharded_solves_total",    # pod-scale sharded path
 )
 
 _SAMPLE = re.compile(
@@ -56,6 +57,7 @@ def scrape() -> str:
     import fleetflow_tpu.agent.agent      # noqa: F401
     import fleetflow_tpu.agent.monitor    # noqa: F401
     import fleetflow_tpu.solver.api       # noqa: F401
+    import fleetflow_tpu.solver.sharded   # noqa: F401  (pod-scale families)
     from fleetflow_tpu.cp.server import ServerConfig, start
     from fleetflow_tpu.daemon.web import WebServer
 

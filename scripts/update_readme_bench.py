@@ -98,6 +98,27 @@ def render(name: str, d: dict) -> str:
             + (f", {sharded['per_device_sharded_mib']:.0f} MiB sharded "
                f"tensors/device" if "per_device_sharded_mib" in sharded
                else "")))
+        sres = sharded.get("resident")
+        if sres:
+            rows.append((
+                f"Sharded warm re-solve, mesh-resident deltas "
+                f"({sres['mesh'][0]}×{sres['mesh'][1]} mesh, "
+                "transfer-guard pinned)",
+                f"p50 **{sres['p50_ms']:.0f} ms** / "
+                f"p99 {sres['p99_ms']:.0f} ms over {sres['bursts']} bursts "
+                f"({sres['compiles_total']} recompiles), "
+                f"{sres['violations_max']} violations"))
+        curve = sharded.get("quality_vs_devices")
+        if curve and curve.get("points"):
+            pts = curve["points"]
+            detail = ", ".join(
+                f"{p['replicas']}×lanes soft {p['soft_median']:.3f}"
+                for p in pts)
+            rows.append((
+                f"Quality vs devices (parallel tempering, "
+                f"{curve['steps']} sweeps, ladder {curve['ladder']})",
+                detail + (" — tempering wins"
+                          if curve.get("tempering_wins") else "")))
     pipe = d.get("pipeline")
     if pipe:
         rows.append((

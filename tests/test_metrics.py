@@ -28,6 +28,7 @@ import pytest
 # exposition surface, which includes the solver and agent-monitor families
 import fleetflow_tpu.agent.monitor    # noqa: F401
 import fleetflow_tpu.solver.api       # noqa: F401
+import fleetflow_tpu.solver.sharded   # noqa: F401  (pod-scale families)
 from fleetflow_tpu.agent import Agent, AgentConfig
 from fleetflow_tpu.core.loader import load_project_from_root_with_stage
 from fleetflow_tpu.cp import ServerConfig, start
