@@ -243,6 +243,17 @@ def main() -> None:
         if os.environ.get("BENCH_COLDWARM", "1").lower() not in ("0", "false"):
             pipeline["cold_warm"] = _coldwarm_scenario()
 
+    # ---- streaming admission (ROADMAP item 5): sustained placements/s ---
+    # An open-loop Poisson+diurnal arrival generator drives the admission
+    # pipeline (cp/admission.py) on the virtual clock for >= 60 simulated
+    # seconds; steady state must hold zero recompiles and zero host
+    # transfers under the disallow transfer guard. The sustained number
+    # sits NEXT TO the one-shot 10kx1k headline: serving millions of
+    # users is a stream, not a burst.
+    admission = None
+    if os.environ.get("BENCH_ADMISSION", "1").lower() not in ("0", "false"):
+        admission = _admission_scenario()
+
     pps = S / elapsed
     baseline_pps = 50.0  # sequential docker loop at 20 ms/call
     import jax
@@ -300,6 +311,7 @@ def main() -> None:
         "burst": burst,
         "sharded": sharded,
         "pipeline": pipeline,
+        "admission": admission,
         # the same registry GET /metrics serves, embedded so BENCH_*.json
         # artifacts carry the counters the endpoint would have shown for
         # this run (solve durations, sweeps, compiles, acceptance)
@@ -1271,10 +1283,272 @@ def _sharded_child() -> None:
     }))
 
 
+def _admission_scenario() -> dict:
+    """Run the streaming-admission child in a subprocess: the leg owns its
+    own device staging (a 10kx1k resident problem) and pins its own env
+    (transfer guard, compile watch), so it must not share the parent's
+    jax state."""
+    import subprocess
+    timeout = float(os.environ.get("BENCH_ADMISSION_TIMEOUT", "1500"))
+    env = dict(os.environ, BENCH_ADMISSION_CHILD="1")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"admission child exceeded {timeout:.0f}s"}
+    if out.returncode != 0:
+        return {"ok": False,
+                "error": (out.stderr or out.stdout).strip()[-800:]}
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"ok": False, "error": "child printed no JSON"}
+
+
+def _admission_child() -> None:
+    """Sustained placements/s under churn: the continuous-arrival leg next
+    to the one-shot 10kx1k number (ROADMAP item 5 + the first slice of
+    item 4's workload generator).
+
+    An OPEN-LOOP arrival generator — Poisson arrivals whose rate rides a
+    diurnal sine wave (a compressed day), each arrival carrying an
+    exponential lifetime that schedules its departure — drives the
+    streaming admission pipeline (cp/admission.py) on the chaos
+    VirtualClock: submit -> bounded tenant queues -> DRR micro-batches ->
+    bucketed micro-solves on the device-resident delta path ->
+    PlacementService commits. After a warm-up phase that compiles every
+    scatter tier, the MEASURED window (>= 60 virtual seconds) runs under
+    FLEET_TRANSFER_GUARD=disallow with compiles watched: steady state
+    must hold ZERO recompiles and ZERO host transfers
+    (BENCH_ADMIT_ASSERT=1 makes either fail the run — the CI smoke
+    contract). Reports sustained placements/s (wall), admission wait
+    p50/p99 (virtual queue latency), per-batch solve ms, shed/park
+    counts, and the max queue depth (the bounded-backpressure proof).
+
+    Prints one JSON line."""
+    from fleetflow_tpu.platform import ensure_platform
+    ensure_platform(min_devices=1, probe_timeout=240.0)
+    import math
+
+    import jax
+    import numpy as np
+
+    from fleetflow_tpu.chaos.runner import VirtualClock, make_flow, node_slug
+    from fleetflow_tpu.cp.admission import (AdmissionConfig,
+                                            AdmissionController,
+                                            AdmissionRejected)
+    from fleetflow_tpu.cp.models import ServerCapacity
+    from fleetflow_tpu.cp.placement import PlacementService
+    from fleetflow_tpu.cp.store import Store
+    from fleetflow_tpu.obs.metrics import REGISTRY
+
+    small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
+    # base rows + streamed steady state (rate x mean_life) land mid shape
+    # tier: ~9660 + ~800 ~= 10.5k rows inside the 11112 tier at full size
+    S, N = (900, 100) if small else (9200, 1000)   # +replica rows ~= S*1.05
+    rate = float(os.environ.get("BENCH_ADMIT_RATE",
+                                "6" if small else "40"))   # arrivals/s mean
+    mean_life = float(os.environ.get("BENCH_ADMIT_LIFE", "20"))
+    virtual_s = float(os.environ.get("BENCH_ADMIT_SECONDS", "60"))
+    # warm-up must outlive the mean service lifetime: the live-set only
+    # stops GROWING once the departure flow matches the arrival flow, and
+    # a still-growing fleet would cross its shape tier mid-measurement
+    warm_s = max(12.0, 2.5 * mean_life)
+    period = 30.0          # two diurnal waves inside the measured minute
+    batch_max = 128
+
+    clock = VirtualClock()
+    store = Store(None, clock=clock.now)
+    slugs = [node_slug(i) for i in range(N)]
+    flow = make_flow(S, 1, slugs, seed=0)
+    # capacity sized for ~2x headroom over base + streamed steady state
+    per_node_cpu = max(2.0 * (0.15 * S + 0.1 * rate * mean_life) / N, 1.0)
+    for slug in slugs:
+        store.register_server(slug, tenant="default", hostname=slug)
+        rec = store.server_by_slug(slug)
+        store.update("servers", rec.id, status="online",
+                     capacity=ServerCapacity(cpu=per_node_cpu,
+                                             memory=per_node_cpu * 2048.0,
+                                             disk=10240.0))
+    placement = PlacementService(store, use_tpu=True)
+    ctrl = AdmissionController(
+        placement, clock=clock.now,
+        config=AdmissionConfig(batch_max=batch_max, max_queue=4096,
+                               shed_age_s=0.0))
+
+    t_base = time.perf_counter()
+    ctrl.attach(flow, "app0")
+    baseline_s = time.perf_counter() - t_base
+    print(f"[bench] admission baseline solve {baseline_s:.1f}s "
+          f"({S}x{N}, backend={jax.default_backend()})",
+          file=sys.stderr, flush=True)
+
+    rng = np.random.default_rng(0)
+    seq = [0]
+    pending_departures: list[tuple[float, str]] = []   # (due, name)
+    live: list[str] = []
+
+    def submit_tick(now: float, lam: float) -> tuple[int, int]:
+        """One generator tick: Poisson arrivals at the diurnal rate +
+        departures that came due. Open loop: a shed submit drops its
+        ARRIVALS (counted; the client's problem, by design) but the due
+        departures stay scheduled — dropping them would leak the live
+        set past its lifetime steady state under sustained backpressure,
+        and the tier-crossing that follows would read as a solver
+        regression in the compiles==0 assert."""
+        k = int(rng.poisson(lam))
+        specs = []
+        for _ in range(k):
+            seq[0] += 1
+            name = f"gen-{seq[0]:06d}"
+            specs.append({"name": name, "cpu": 0.1, "memory": 64.0})
+        due = [n for (d, n) in pending_departures if d <= now and n in live]
+        shed = 0
+        try:
+            ctrl.submit("gen", arrivals=specs, departures=due)
+            done = set(due)
+            pending_departures[:] = [(d, n) for (d, n) in pending_departures
+                                     if n not in done]
+            for s in specs:
+                pending_departures.append(
+                    (now + float(rng.exponential(mean_life)), s["name"]))
+        except AdmissionRejected:
+            shed = len(specs)
+        return len(specs) - shed, shed
+
+    def drain(now: float) -> dict:
+        out = ctrl.step(now)
+        live.extend(out["placed"])
+        for n in out["departed"]:
+            if n in live:
+                live.remove(n)
+        return out
+
+    # ---- warm-up: compile the cold stage, the merge-kernel scatter tiers
+    # (8/32/128) and the warm solve variant, all OUTSIDE the guard -------
+    for k in (1, 20, batch_max):
+        specs = []
+        for _ in range(k):
+            seq[0] += 1
+            specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                          "memory": 64.0})
+        ctrl.submit("gen", arrivals=specs)
+        clock.advance(1.0)
+        drain(clock.now())
+    # one departure-heavy batch too (tombstones + row reuse)
+    ctrl.submit("gen", departures=list(live[:30]))
+    clock.advance(1.0)
+    drain(clock.now())
+    t = 0.0
+    while t < warm_s:
+        lam = rate * (1.0 + 0.6 * math.sin(2 * math.pi * t / period))
+        submit_tick(clock.now(), max(lam, 0.0))
+        clock.advance(1.0)
+        drain(clock.now())
+        t += 1.0
+
+    # ---- measured window: transfer guard disallow, compiles watched ----
+    reuse = REGISTRY.get("fleet_solver_resident_reuse_total")
+    xfer = REGISTRY.get("fleet_solver_host_transfers_total")
+    cold0 = reuse.value(outcome="cold")
+    xfer0 = xfer.value()
+    ctrl.wait_samples.clear()
+    placed = departed = sheds = 0
+    solve_ms: list[float] = []
+    batch_sizes: list[int] = []
+    max_depth = 0
+    violations_max = 0
+    guard_prev = os.environ.get("FLEET_TRANSFER_GUARD")
+    os.environ["FLEET_TRANSFER_GUARD"] = "disallow"
+    t_wall = time.perf_counter()
+    try:
+        with _watch_compiles() as compiles:
+            t = 0.0
+            while t < virtual_s:
+                lam = rate * (1.0 + 0.6 * math.sin(
+                    2 * math.pi * (warm_s + t) / period))
+                _ok, sh = submit_tick(clock.now(), max(lam, 0.0))
+                sheds += sh
+                max_depth = max(max_depth,
+                                ctrl.pressure()["queue_depth"])
+                clock.advance(1.0)
+                out = drain(clock.now())
+                placed += len(out["placed"])
+                departed += len(out["departed"])
+                if out["batch"]:
+                    solve_ms.append(out["solve_ms"])
+                    batch_sizes.append(out["batch"])
+                violations_max = max(violations_max, out["violations"])
+                t += 1.0
+    finally:
+        if guard_prev is None:
+            os.environ.pop("FLEET_TRANSFER_GUARD", None)
+        else:
+            os.environ["FLEET_TRANSFER_GUARD"] = guard_prev
+    wall_s = time.perf_counter() - t_wall
+    waits = [w for ws in ctrl.wait_samples.values() for w in ws]
+    cold_staged = int(reuse.value(outcome="cold") - cold0)
+    host_transfers = int(xfer.value() - xfer0)
+
+    result = {
+        "ok": True,
+        "shape": [S, N],
+        "rows": ctrl.status()["streams"][f"{flow.name}/app0"]["rows"],
+        "backend": jax.default_backend(),
+        "virtual_s": virtual_s,
+        "wall_s": round(wall_s, 2),
+        "arrival_rate": rate,
+        "mean_life_s": mean_life,
+        "diurnal_period_s": period,
+        "placements": placed,
+        "departures": departed,
+        "placements_per_s": round(placed / wall_s, 1) if wall_s else 0.0,
+        "wait_p50_s": round(float(np.percentile(waits, 50)), 3)
+        if waits else None,
+        "wait_p99_s": round(float(np.percentile(waits, 99)), 3)
+        if waits else None,
+        "solve_ms_p50": round(float(np.percentile(solve_ms, 50)), 1)
+        if solve_ms else None,
+        "solve_ms_p99": round(float(np.percentile(solve_ms, 99)), 1)
+        if solve_ms else None,
+        "batch_p50": round(float(np.percentile(batch_sizes, 50)), 1)
+        if batch_sizes else None,
+        "micro_solves": len(solve_ms),
+        "max_queue_depth": max_depth,
+        "sheds": sheds,
+        "parked": ctrl.stats["parked"],
+        "compactions": ctrl.stats["compactions"],
+        "compiles": len(compiles),
+        "cold_restages": cold_staged,
+        "host_transfers": host_transfers,
+        "violations_max": violations_max,
+        "transfer_guard": "disallow",
+        "baseline_solve_s": round(baseline_s, 2),
+    }
+    if os.environ.get("BENCH_ADMIT_ASSERT", "").lower() in \
+            ("1", "true", "on", "yes"):
+        # the CI smoke contract: a streaming steady state that recompiles
+        # or crosses the host boundary is not a steady state
+        assert result["compiles"] == 0, f"admission recompiled: {result}"
+        assert result["host_transfers"] == 0, \
+            f"admission crossed the host boundary: {result}"
+        assert result["cold_restages"] == 0, \
+            f"admission cold-restaged at steady state: {result}"
+        assert result["placements_per_s"] > 0, f"no throughput: {result}"
+        assert result["violations_max"] == 0, f"violations: {result}"
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_SHARDED_CHILD"):
         _sharded_child()
     elif os.environ.get("BENCH_PIPELINE_CHILD"):
         _pipeline_child()
+    elif os.environ.get("BENCH_ADMISSION_CHILD"):
+        _admission_child()
     else:
         main()

@@ -32,7 +32,8 @@ from typing import Optional
 __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
-    "SilentNodeCrash", "Tick", "PrimaryKill", "FaultSchedule",
+    "SilentNodeCrash", "Tick", "PrimaryKill", "AdmissionWave",
+    "FaultSchedule",
 ]
 
 # primitive ops the runner executes (the fault algebra's normal form)
@@ -50,6 +51,7 @@ CONTAINER_EXIT = "container_exit"
 WORKER_KILL = "worker_kill"
 REDEPLOY = "redeploy"
 CP_KILL = "cp_kill"
+ADMIT = "admit"
 
 
 @dataclass(frozen=True)
@@ -203,6 +205,27 @@ class PrimaryKill(Fault):
 
     def expand(self):
         return [(self.at, CP_KILL, {"phase": self.phase})]
+
+
+@dataclass(frozen=True)
+class AdmissionWave(Fault):
+    """One tenant's slice of the continuous arrival stream (the streaming
+    admission scenario, cp/admission.py): submit `arrivals` fresh services
+    and `departures` of the tenant's oldest live streamed services through
+    the admission queue. `burst=True` marks a wave that deliberately
+    exceeds the tenant's fair share — the admission-fair invariant exempts
+    bursting tenants from the latency bound (they PAY for the burst; the
+    point is that nobody else does)."""
+    tenant: str = ""
+    arrivals: int = 0
+    departures: int = 0
+    burst: bool = False
+
+    def expand(self):
+        return [(self.at, ADMIT, {"tenant": self.tenant,
+                                  "arrivals": self.arrivals,
+                                  "departures": self.departures,
+                                  "burst": self.burst})]
 
 
 @dataclass

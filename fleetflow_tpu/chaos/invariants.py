@@ -22,7 +22,8 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "no_dead_assignments", "pools_at_min", "solver_feasible",
            "containers_converged", "metrics_monotonic",
            "agents_gauge_consistent", "selfheal_converged",
-           "cp_failover_converged"]
+           "cp_failover_converged", "admission_fair",
+           "admission_converged"]
 
 _EPS = 1e-6
 
@@ -244,6 +245,79 @@ def cp_failover_converged(world, snapshot=None) -> list[str]:
     return out
 
 
+ADMISSION_FAIR_K = 4.0           # tenant p99 wait <= K x fleet median
+ADMISSION_FAIR_FLOOR_S = 30.0    # ... with a pacing-granularity floor
+
+
+def admission_fair(world) -> list[str]:
+    """Weighted tenant fairness under an arrival storm (cp/admission.py
+    deficit round robin): no tenant submitting WITHIN its weight may see
+    its p99 admission wait exceed K x the BEST-SERVED in-weight tenant's
+    median wait (plus a reconcile-granularity floor — waits quantize to
+    the replay's pacing). Tenants the scenario marked as deliberately
+    bursting are exempt: they pay for their own flood; the invariant is
+    that nobody else does.
+
+    The reference is the best-served tenant's median, not a pooled
+    percentile: a starved tenant's own samples dominate any pooled
+    statistic, so a pooled bound could never fire — exactly the
+    vacuous-invariant trap the canary tests exist to prevent."""
+    ctrl = getattr(world.state, "admission", None)
+    if ctrl is None:
+        return []
+    burst = getattr(world, "admission_burst_tenants", set())
+    p50s = {t: float(np.percentile(list(ws), 50))
+            for t, ws in ctrl.wait_samples.items()
+            if t not in burst and len(ws) >= 5}
+    if not p50s:
+        return []
+    best_p50 = min(p50s.values())
+    bound = ADMISSION_FAIR_K * max(best_p50, ADMISSION_FAIR_FLOOR_S / 2)
+    out: list[str] = []
+    for tenant in sorted(p50s):
+        p99 = float(np.percentile(list(ctrl.wait_samples[tenant]), 99))
+        if p99 > bound:
+            out.append(
+                f"tenant {tenant} starved: wait p99 {p99:.1f}s > "
+                f"{ADMISSION_FAIR_K:g} x best-served median "
+                f"{best_p50:.1f}s (bound {bound:.1f}s) while under its "
+                f"weight")
+    return out
+
+
+def admission_converged(world, snapshot=None) -> list[str]:
+    """Streaming-admission completeness: after settle, every submitted
+    request reached a TERMINAL state (placed | departed | parked | shed |
+    cancelled) — backpressure may refuse work, parking may defer it, but
+    nothing is ever silently lost — and every live streamed service is
+    actually IN its stage's settled placement."""
+    ctrl = getattr(world.state, "admission", None)
+    if ctrl is None or not ctrl.requests:
+        return []
+    out: list[str] = []
+    from ..cp.admission import AdmissionRequest
+    for rid in sorted(ctrl.requests):
+        r = ctrl.requests[rid]
+        if r.state not in AdmissionRequest.TERMINAL:
+            out.append(f"request {r.id} ({r.kind} {r.name} for "
+                       f"{r.tenant}) still {r.state!r} after settle")
+    if snapshot is None:
+        snapshot = world.state.placement.snapshot()
+    for key in sorted(getattr(ctrl, "_streams", {})):
+        view = snapshot.get(key)
+        assigned = set(view["assignment"]) if view else set()
+        for name in ctrl.live_names(key):
+            if name not in assigned:
+                out.append(f"admitted service {name} missing from the "
+                           f"settled placement of {key}")
+        stream = ctrl._streams[key]
+        for name in sorted(stream.tombstones):
+            if name in assigned:
+                out.append(f"departed service {name} still assigned in "
+                           f"{key}")
+    return out
+
+
 def metrics_monotonic(world) -> list[str]:
     """Counters never decrease across the run. The metrics registry is the
     operator's ground truth for rates and totals; a counter that went DOWN
@@ -292,6 +366,8 @@ FINAL_INVARIANTS = {
     "containers-converged": containers_converged,
     "selfheal-converged": selfheal_converged,
     "cp-failover-converged": cp_failover_converged,
+    "admission-fair": admission_fair,
+    "admission-converged": admission_converged,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,
 }
@@ -311,7 +387,8 @@ def check_final(world) -> list[str]:
     for name, fn in FINAL_INVARIANTS.items():
         found = (fn(world, snapshot=snap)
                  if fn in (no_dead_assignments, containers_converged,
-                           selfheal_converged, cp_failover_converged)
+                           selfheal_converged, cp_failover_converged,
+                           admission_converged)
                  else fn(world))
         out.extend(f"[{name}] {v}" for v in found)
     return out

@@ -35,6 +35,8 @@ from pathlib import Path
 from typing import Optional
 
 from ..core.model import (Flow, ResourceSpec, Service, Stage)
+from ..cp.admission import (AdmissionConfig, AdmissionController,
+                            AdmissionRejected)
 from ..cp.agent_registry import AgentRegistry
 from ..cp.auth import NoAuth
 from ..cp.autoscaler import Autoscaler
@@ -299,7 +301,7 @@ class ChaosWorld:
         self.backends: dict[str, MockBackend] = {}
         self.events: list[dict] = []
         self._seq = 0
-        self._levels_cache: dict[str, list[list[str]]] = {}
+        self._levels_cache: dict[tuple, list[list[str]]] = {}
         self._server_status: dict[str, str] = {}
         self._provider_instances: dict[str, str] = {}   # name -> id
         self.pool_min = pool_min
@@ -311,6 +313,12 @@ class ChaosWorld:
         self.fencing_rejections = 0
         self.prekill_work: set[tuple[str, bool]] = set()
         self.idem_executions: dict[str, list] = {}   # key -> [stage, runs]
+        # streaming-admission bookkeeping (arrival-storm scenario): the
+        # seeded generator state and which tenants deliberately burst
+        # (the admission-fair invariant exempts them from the bound)
+        self.admission_burst_tenants: set[str] = set()
+        self._admit_rng = random.Random(seed ^ 0xAD317)
+        self._admit_counts: dict[str, int] = {}
         self.standby: Optional[StandbyReplica] = None
         self.standby_store: Optional[Store] = None
         if replicated:
@@ -331,6 +339,15 @@ class ChaosWorld:
             deploy_sleep=self.clock.advance, chaos=self.injector)
         state.agent_registry.delivery_hook = self.injector.delivery_hook
         state.agent_registry.epoch_source = lambda: store.epoch
+        # streaming admission on the virtual clock (cp/admission.py):
+        # batch_max/quantum sized so an arrival storm actually QUEUES
+        # (fairness is only observable when drain capacity is contended)
+        state.admission = AdmissionController(
+            state.placement, clock=self.clock.now,
+            config=AdmissionConfig(batch_max=8, quantum=4.0,
+                                   max_queue=512, shed_age_s=240.0,
+                                   pressure_age_s=20.0,
+                                   pressure_sustain_s=40.0))
         return state
 
     # -- event log ---------------------------------------------------------
@@ -378,6 +395,41 @@ class ChaosWorld:
         self.detector.observe_disconnect(slug)
         if wipe:
             self.backends.pop(slug, None)
+
+    # -- streaming admission (arrival-storm scenario) ----------------------
+
+    def admit_wave(self, tenant: str, arrivals: int, departures: int,
+                   burst: bool = False) -> None:
+        """One tenant's wave: submit `arrivals` fresh streamed services
+        (tiny, eligibility-free — the delta-path shape) and depart the
+        tenant's oldest live ones. Deterministic: names come from a
+        per-tenant counter, demand from the world's seeded rng, and the
+        outcome (accepted vs shed) lands in the causal event log."""
+        ctrl = self.state.admission
+        stage_name = sorted(self.flow.stages)[0]
+        key = f"{self.flow.name}/{stage_name}"
+        if burst:
+            self.admission_burst_tenants.add(tenant)
+        ctrl.attach(self.flow, stage_name)
+        specs = []
+        for _ in range(arrivals):
+            n = self._admit_counts[tenant] = \
+                self._admit_counts.get(tenant, 0) + 1
+            specs.append({"name": f"{tenant}-a{n:05d}",
+                          "image": "chaos-app", "version": "1",
+                          "cpu": self._admit_rng.choice((0.02, 0.05)),
+                          "memory": float(self._admit_rng.choice((16, 32))),
+                          "disk": 0.0})
+        deps = ctrl.streamed_names(tenant, stage=key)[:departures]
+        try:
+            out = ctrl.submit(tenant, arrivals=specs, departures=deps,
+                              stage=key)
+            self.log("admit", tenant=tenant, arrivals=len(specs),
+                     departures=len(deps), queued=out["queued"],
+                     burst=burst)
+        except AdmissionRejected as e:
+            self.log("admit-shed", tenant=tenant, arrivals=len(specs),
+                     reason=e.reason)
 
     # -- replicated control plane (cp-failover scenario) -------------------
 
@@ -476,16 +528,24 @@ class ChaosWorld:
 
     def cp_placement(self, req: DeployRequest,
                      assignment: Optional[dict]) -> Optional[Placement]:
-        """Mirror of agent._placement_from with a per-stage level cache
-        (the flow is static, so the dependency schedule is too)."""
+        """Mirror of agent._placement_from with a per-stage level cache.
+        Keyed on the stage's service LIST, not just its name: streaming
+        admission grows and shrinks stages mid-run, and a stale level
+        schedule would silently skip every streamed service at deploy
+        time (found by the arrival-storm scenario: 0 streamed containers
+        despite 100 green deploys)."""
         if not assignment:
             return None
-        levels = self._levels_cache.get(req.stage_name)
+        sig = (req.stage_name,
+               tuple(req.flow.stage(req.stage_name).services))
+        levels = self._levels_cache.get(sig)
         if levels is None:
             pt = lower_stage(req.flow, req.stage_name,
                              nodes=[local_node(req.node or "sim")])
             levels = level_schedule(pt)
-            self._levels_cache[req.stage_name] = levels
+            if len(self._levels_cache) > 8:
+                self._levels_cache.clear()
+            self._levels_cache[sig] = levels
         return Placement(assignment=dict(assignment), levels=levels,
                          feasible=True, source="cp-solved")
 
@@ -554,7 +614,7 @@ class _Runner:
         self.dirty: set[str] = set()     # stage names needing redeploy
         self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
                       "resolves": 0, "restarts": 0, "scale_actions": 0,
-                      "heals": 0, "failovers": 0}
+                      "heals": 0, "failovers": 0, "admissions": 0}
 
     # -- world bootstrap ---------------------------------------------------
 
@@ -670,6 +730,9 @@ class _Runner:
                 w.log("fault", op=op, phase=p["phase"])
                 await w.cp_failover(p["phase"])
                 self.stats["failovers"] += 1
+            elif op == F.ADMIT:
+                w.admit_wave(p["tenant"], p["arrivals"], p["departures"],
+                             p.get("burst", False))
             elif op == F.REDEPLOY:
                 w.log("redeploy-requested", stage=p["stage"])
                 self.dirty.add(p["stage"])
@@ -757,8 +820,30 @@ class _Runner:
     def autoscaler_sweep(self):
         return self.world.autoscaler.run_sweep()
 
+    async def _admission_pass(self) -> None:
+        """Drain ONE admission micro-batch (the continuous-batching
+        cadence: one bucketed micro-solve per reconcile), then mark the
+        touched stages dirty so the placed services actually get their
+        containers through the real deploy path."""
+        w = self.world
+        ctrl = w.state.admission
+        if ctrl is None or not ctrl.has_work():
+            return
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, ctrl.step)
+        if not out["batch"]:
+            return
+        self.stats["admissions"] += len(out["placed"])
+        w.log("admit-batch", batch=out["batch"],
+              placed=len(out["placed"]), departed=len(out["departed"]),
+              parked=len(out["parked"]),
+              depth=ctrl.pressure()["queue_depth"])
+        for key in out["stages"]:
+            self.dirty.add(key.split("/", 1)[1])
+
     async def _reconcile(self) -> None:
         await self._heal_pass()
+        await self._admission_pass()
         await self._monitor_pass()
         if self.pool_min > 0:
             self._autoscale()
@@ -800,15 +885,21 @@ class _Runner:
         # by the schedule's horizon), then judge the final world
         w.clock.advance_to(max(self.schedule.horizon,
                                w.clock.offset()))
-        for _round in range(10):
+        # admission backlogs drain one micro-batch per round, so a storm
+        # needs more settle headroom than the fault scenarios do; rounds
+        # stay identical for schedules without admission work
+        for _round in range(40):
             await self._reconcile()
             exited = any(
                 info.state == "exited"
                 and info.labels.get("fleetflow.project") == w.flow.name
                 for slug in sorted(w.backends)
                 for info in w.backends[slug].containers.values())
+            admission_busy = (w.state.admission is not None
+                              and w.state.admission.has_work())
             if (not self.dirty and not exited
-                    and not w.reconverger.has_work()):
+                    and not w.reconverger.has_work()
+                    and not admission_busy):
                 break
             w.clock.advance(30.0)
         w.log("settled", rounds=_round + 1, dirty=sorted(self.dirty),

@@ -16,9 +16,10 @@ from __future__ import annotations
 import random
 from typing import Callable
 
-from .faults import (AgentPartition, ContainerExit, DeployFail,
-                     FaultSchedule, NodeCrash, NodeFlap, PrimaryKill,
-                     Redeploy, SilentNodeCrash, SlowAgent, Tick, WorkerKill)
+from .faults import (AdmissionWave, AgentPartition, ContainerExit,
+                     DeployFail, FaultSchedule, NodeCrash, NodeFlap,
+                     PrimaryKill, Redeploy, SilentNodeCrash, SlowAgent,
+                     Tick, WorkerKill)
 from .runner import node_slug
 
 __all__ = ["SCENARIOS", "build_schedule", "scenario_names"]
@@ -186,6 +187,38 @@ def _deploy_fail_burst(seed: int, services: int,
     return FaultSchedule("deploy-fail-burst", seed, faults, horizon=420.0)
 
 
+def _arrival_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
+    """Continuous service arrivals/departures through the streaming
+    admission pipeline (cp/admission.py), with one tenant bursting 10x
+    its weight mid-storm. Three steady tenants submit small waves every
+    10 s; `team-a` floods between t=80 and t=200. The admission queue
+    must stay fair (DRR: the flood queues behind team-a's own backlog,
+    never behind the others' — `admission-fair`) and complete (every
+    submitted request ends placed/parked/shed/departed, and every live
+    streamed service is in the committed placement — `admission-converged`).
+    Ticks keep draining after the last wave so the backlog is judged
+    drained, not abandoned."""
+    rng = random.Random(seed)
+    tenants = ["team-a", "team-b", "team-c"]
+    faults: list = []
+    t = 20.0
+    while t < 320.0:
+        for tenant in tenants:
+            burst = tenant == "team-a" and 80.0 <= t < 200.0
+            n = 10 if burst else rng.choice((1, 1, 2))
+            # departures only once the tenant has built up live services
+            dep = rng.choice((0, 1)) if t >= 60.0 else 0
+            faults.append(AdmissionWave(at=t, tenant=tenant, arrivals=n,
+                                        departures=dep, burst=burst))
+        t += 10.0
+    horizon = t + 300.0
+    tick = 15.0
+    while tick < horizon:
+        faults.append(Tick(at=tick))
+        tick += 15.0
+    return FaultSchedule("arrival-storm", seed, faults, horizon=horizon)
+
+
 SCENARIOS: dict[str, tuple[Callable, str]] = {
     "rolling-kill": (_rolling_kill,
                      "serial node kills with revival + a pool worker "
@@ -209,6 +242,10 @@ SCENARIOS: dict[str, tuple[Callable, str]] = {
     "deploy-fail-burst": (_deploy_fail_burst,
                           "injected mid-deploy service failures with a "
                           "crash stacked on top"),
+    "arrival-storm": (_arrival_storm,
+                      "continuous arrivals/departures through streaming "
+                      "admission with one tenant bursting 10x its weight "
+                      "— DRR fairness + completeness judged"),
 }
 
 

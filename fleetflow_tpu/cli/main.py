@@ -725,6 +725,49 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_admit(args) -> int:
+    """Streaming-admission status: the operator's answer to "why is my
+    arrival still queued?" — per-tenant depth/age/waits, DRR fairness
+    debt, parked + shed counts, and the autoscaler pressure signal
+    (docs/guide/14-streaming-admission.md)."""
+    with CpClient(args.cp) as cp:
+        out = cp.request("deploy", "admit_status")
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+            return 0
+        if not out.get("enabled", False):
+            print("streaming admission is disabled on this CP")
+            return 1
+        print(f"queued={out['queue_depth']} "
+              f"oldest={out['oldest_age_s']:.1f}s "
+              f"parked={out['parked']}")
+        pres = out.get("pressure", {})
+        since = pres.get("since_s")
+        print(f"pressure: {'SUSTAINED' if pres.get('sustained') else 'ok'}"
+              + (f" (hot for {since:.1f}s)" if since is not None else ""))
+        for tenant, t in sorted(out.get("tenants", {}).items()):
+            waits = ""
+            if t.get("wait_p50_s") is not None:
+                waits = (f" wait p50={t['wait_p50_s']:.3f}s "
+                         f"p99={t['wait_p99_s']:.3f}s")
+            print(f"  {tenant:<16} queued={t['queued']:<5} "
+                  f"oldest={t['oldest_age_s']:>7.1f}s "
+                  f"weight={t['weight']:g} debt={t['deficit']:.1f}{waits}")
+        for key, s in sorted(out.get("streams", {}).items()):
+            print(f"  stream {key}: rows={s['rows']} "
+                  f"live_streamed={s['live_streamed']} "
+                  f"tombstones={s['tombstones']} "
+                  f"free_rows={s['free_rows']}")
+        st = out.get("stats", {})
+        print(f"stats: admitted={st.get('admitted', 0)} "
+              f"departed={st.get('departed', 0)} "
+              f"sheds={st.get('sheds', 0)} parked={st.get('parked', 0)} "
+              f"unparked={st.get('unparked', 0)} "
+              f"solves={st.get('solves', 0)} "
+              f"compactions={st.get('compactions', 0)}")
+        return 0
+
+
 STARTER_KDL = '''// fleet.kdl — created by `fleet init`
 project "{name}"
 
@@ -1601,6 +1644,17 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--dry-run", action="store_true")
 
     p.set_defaults(fn=cmd_cp)
+
+    p = sub.add_parser("admit", help="streaming admission: queue depth, "
+                       "tenant fairness, backpressure and autoscaler "
+                       "pressure (docs/guide/14-streaming-admission.md)")
+    p.add_argument("--cp", dest="cp", help="CP endpoint host:port")
+    adms = p.add_subparsers(dest="admit_cmd", required=True)
+    q = adms.add_parser("status", help="per-tenant queues, waits, "
+                        "fairness debt, parked/shed counts, pressure")
+    q.add_argument("--json", action="store_true",
+                   help="raw deploy.admit_status payload")
+    p.set_defaults(fn=cmd_admit)
 
     p = sub.add_parser("chaos", help="seeded fault injection against a "
                        "simulated fleet (invariant-checked)")

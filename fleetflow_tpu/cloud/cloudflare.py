@@ -306,7 +306,14 @@ def worker_list(account_id: str, *, token: Optional[str] = None,
     token = token or os.environ.get(TOKEN_ENV, "")
     transport = transport or (_default_transport(token) if token else None)
     if transport is None:
-        raise CloudError(f"no cloudflare credentials ({TOKEN_ENV} unset)")
+        # the credential-less path answers [] so enumeration-shaped
+        # callers (cleanup sweeps, dashboards) keep working — but a
+        # misconfigured provider must be VISIBLE as degradation, never
+        # read as "no workers" (ISSUE 9 satellite; the reference stubbed
+        # this whole call as a silent TODO [])
+        from .provider import note_degraded
+        note_degraded("cloudflare", f"{TOKEN_ENV} unset")
+        return []
     doc = transport("GET", f"/accounts/{account_id}/workers/scripts", None)
     if not doc.get("success", False):
         errs = "; ".join(str(e.get("message", e))

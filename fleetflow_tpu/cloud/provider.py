@@ -10,16 +10,48 @@ the reference's ServerProviderKind.
 from __future__ import annotations
 
 import abc
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from ..core.errors import CloudError
 from ..core.model import CloudProviderDecl, ServerResource
+from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
 from .action import ApplyResult, Plan
 from .state import ProviderState
 
 __all__ = ["CloudProvider", "ServerProvider", "ServerInfo",
-           "register_provider", "get_provider", "provider_names"]
+           "register_provider", "get_provider", "provider_names",
+           "note_degraded"]
+
+log = get_logger("cloud.provider")
+
+# metric catalog: docs/guide/10-observability.md. A provider that answers
+# with an EMPTY result because it is misconfigured (no credentials, CLI
+# missing, unparseable output) must be visible as degradation, not read
+# as "no resources" — the silent-[] failure mode the satellite of ISSUE 9
+# closed (cloudflare worker_list, tailscale get_peers).
+_M_DEGRADED = REGISTRY.counter(
+    "fleet_cloud_provider_degraded_total",
+    "Cloud provider calls that degraded to an empty result because the "
+    "provider is misconfigured or unreachable, by provider",
+    labels=("provider",))
+_degraded_logged: set[tuple[str, str]] = set()
+_degraded_lock = threading.Lock()
+
+
+def note_degraded(provider: str, reason: str) -> None:
+    """Count a degraded-to-empty provider answer and log a structured
+    warning ONCE per (provider, reason) — visible without flooding the
+    log on every poll."""
+    _M_DEGRADED.inc(provider=provider)
+    with _degraded_lock:
+        if (provider, reason) in _degraded_logged:
+            return
+        _degraded_logged.add((provider, reason))
+    log.warning("cloud provider degraded to empty result %s",
+                kv(provider=provider, reason=reason))
 
 
 @dataclass

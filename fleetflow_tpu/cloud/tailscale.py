@@ -43,15 +43,22 @@ def _run(args: list[str], runner=None) -> tuple[int, str]:
 
 
 def get_peers(runner=None) -> list[Peer]:
-    """tailscale.rs get_peers:57."""
+    """tailscale.rs get_peers:57. The degraded paths (CLI missing, rc!=0,
+    unparseable JSON) still answer [] — but counted and warned-once via
+    fleet_cloud_provider_degraded_total so "no peers" from a broken
+    tailscaled is visible as degradation, not an empty fleet."""
+    from .provider import note_degraded
     if runner is None and not available():
+        note_degraded("tailscale", "tailscale CLI not found")
         return []
     rc, out = _run(["status", "--json"], runner)
     if rc != 0:
+        note_degraded("tailscale", f"status rc={rc}")
         return []
     try:
         doc = json.loads(out)
     except json.JSONDecodeError:
+        note_degraded("tailscale", "unparseable status JSON")
         return []
     peers = []
     for peer in (doc.get("Peer") or {}).values():

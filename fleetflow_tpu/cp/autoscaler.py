@@ -27,6 +27,7 @@ from typing import TYPE_CHECKING, Optional
 from .models import Server, ServerCapacity, WorkerPool
 from ..core.model import ResourceSpec, ServerResource
 from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
 
 if TYPE_CHECKING:
     from .server import AppState
@@ -34,6 +35,16 @@ if TYPE_CHECKING:
 __all__ = ["Autoscaler", "ScaleAction"]
 
 log = get_logger("cp.autoscaler")
+
+# metric catalog: docs/guide/10-observability.md. The streaming-admission
+# feedback signal (cp/admission.py pressure()): seconds the oldest queued
+# admission request has waited when the signal is hot, 0 when drained —
+# the input that makes the autoscaler provision on SOLVER pressure, not
+# just idle counts.
+_M_PRESSURE = REGISTRY.gauge(
+    "fleet_autoscaler_pressure",
+    "Admission queue pressure the autoscaler last planned against "
+    "(oldest queued age in seconds; 0 = drained)")
 
 IDLE_GRACE_S = 600.0     # idle-shutdown.sh waits ~10 min before poweroff
 PROVISION_TIMEOUT_S = 900.0   # a machine that never came up is a zombie
@@ -58,11 +69,16 @@ class ScaleAction:
 
 class Autoscaler:
     def __init__(self, state: "AppState", *, interval_s: float = 120.0,
-                 idle_grace_s: float = IDLE_GRACE_S, clock=time.time):
+                 idle_grace_s: float = IDLE_GRACE_S, clock=time.time,
+                 pressure_source=None):
         self.state = state
         self.interval_s = interval_s
         self.idle_grace_s = idle_grace_s
         self.clock = clock
+        # solver-pressure feedback (docs/guide/14-streaming-admission.md):
+        # a callable returning cp/admission.py pressure() — defaults to
+        # the AppState's admission controller when one is wired
+        self.pressure_source = pressure_source
         self._task = None
         self._counter = 0
         # slug -> last time the worker had any workload (allocations or
@@ -96,13 +112,37 @@ class Autoscaler:
         since = self._last_busy.get(s.slug, s.created_at)
         return self.clock() - since >= self.idle_grace_s
 
-    def plan(self, pool: WorkerPool) -> tuple[int, list[Server]]:
+    def _pressure(self) -> dict:
+        """The admission pressure signal this sweep plans against
+        (cp/admission.py pressure()): {} when no source is wired."""
+        src = self.pressure_source
+        if src is None:
+            adm = getattr(self.state, "admission", None)
+            src = adm.pressure if adm is not None else None
+        if src is None:
+            return {}
+        try:
+            return src() or {}
+        except Exception:
+            log.exception("pressure source failed; planning without it")
+            return {}
+
+    def plan(self, pool: WorkerPool,
+             pressure: Optional[dict] = None) -> tuple[int, list[Server]]:
         """(n_to_provision, servers_to_deprovision) for one pool.
 
         min_servers counts only ALIVE workers (online, or provisioning and
         younger than PROVISION_TIMEOUT_S): a pool whose machines died gets
         replacements, and a machine that never came up is reaped as a
-        zombie rather than blocking replenishment forever."""
+        zombie rather than blocking replenishment forever.
+
+        `pressure` is the streaming-admission feedback (cp/admission.py):
+        SUSTAINED queue age or infeasible-parked arrivals mean the solver
+        (or the fleet's capacity) is the bottleneck — provision one node
+        per sweep beyond the floor and hold idle scale-down; a drained
+        queue releases the hold so the normal idle-grace rules resume.
+        The max_servers cap applies AFTER the pressure bump: pressure can
+        never override the pool ceiling."""
         now = self.clock()
         servers = self._pool_servers(pool)
         zombies = [s for s in servers
@@ -123,15 +163,25 @@ class Autoscaler:
                  if s.status == "online"
                  or (s.status == "provisioning" and s not in zombies)]
         need = max(pool.min_servers - len(alive), 0)
+        pressurized = bool(pressure and pressure.get("sustained"))
         victims: list[Server] = list(dead)
-        if need == 0 and len(alive) > pool.min_servers:
+        if (not pressurized and need == 0
+                and len(alive) > pool.min_servers):
+            # idle scale-down only when the admission queue is NOT under
+            # sustained pressure: a hot queue means every node is about
+            # to be needed, even one that looks idle this instant
             idle = [s for s in alive if self._is_idle(s)]
             # newest first: long-lived workers keep caches warm
             idle.sort(key=lambda s: s.created_at, reverse=True)
             surplus = len(alive) - pool.min_servers
             victims += idle[:surplus]
+        if pressurized and need == 0:
+            # solver pressure provisions ahead of the floor — one node
+            # per sweep (a ratchet, not a thundering herd)
+            need = 1
         # max_servers is a hard cap on provisioning (0 = uncapped); dead
-        # records being reaped this sweep do not count against it
+        # records being reaped this sweep do not count against it —
+        # applied LAST so neither the floor nor pressure can pierce it
         if pool.max_servers > 0:
             room = max(pool.max_servers - (len(servers) - len(dead)), 0)
             need = min(need, room)
@@ -143,6 +193,9 @@ class Autoscaler:
 
     def run_sweep(self) -> list[ScaleAction]:
         actions: list[ScaleAction] = []
+        pressure = self._pressure()
+        _M_PRESSURE.set(float(pressure.get("oldest_age_s", 0.0))
+                        if pressure.get("sustained") else 0.0)
         for pool in self.state.store.list("worker_pools"):
             provider_name = pool.preferred_labels.get(
                 "provider", pool.required_labels.get("provider", ""))
@@ -154,7 +207,7 @@ class Autoscaler:
             for s in self._pool_servers(pool):
                 if self._is_busy(s):
                     self._last_busy[s.slug] = now
-            need, victims = self.plan(pool)
+            need, victims = self.plan(pool, pressure)
             inventory = None
             if victims:
                 # one provider listing per pool, not per victim; a failed

@@ -104,6 +104,7 @@ _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "logs.live", "show", "snapshots", "ps", "pool.list",
     "user.list", "ping", "reservations", "metrics", "heal.status",
+    "admit_status",
 })
 def _timed(channel: str, handler):
     """Wrap a channel handler with the request-latency histogram + error
@@ -669,6 +670,40 @@ def _deploy(state: "AppState"):
                 state, DeployRequest.from_dict(p["request"]),
                 tenant_name=p.get("tenant", "default"),
                 remove=bool(p.get("remove", False)))
+        if method == "submit":
+            # streaming admission (cp/admission.py, docs/guide/14): enqueue
+            # arrivals/departures for the continuous micro-solve pipeline
+            # instead of forcing a full deploy per change. Backpressure
+            # surfaces as AdmissionRejected — retryable; the message
+            # carries (reason, retry_after_s) and rides the error frame.
+            adm = getattr(state, "admission", None)
+            if adm is None:
+                raise ValueError(
+                    "streaming admission is disabled on this CP "
+                    "(`admission true` in the server config)")
+            stage = p.get("stage")
+            loop = asyncio.get_running_loop()
+            if p.get("flow") and stage:
+                # first submit for a stage may carry the flow to attach
+                # (runs the baseline solve off-loop)
+                flow = flow_from_dict(p["flow"])
+                key = f"{flow.name}/{stage}"
+                await loop.run_in_executor(
+                    None, lambda: adm.attach(
+                        flow, stage, tenant=p.get("tenant", "default")))
+                stage = key
+            return await loop.run_in_executor(
+                None, lambda: adm.submit(
+                    p.get("tenant", "default"),
+                    arrivals=p.get("arrivals") or (),
+                    departures=p.get("departures") or (),
+                    stage=stage))
+        if method == "admit_status":
+            adm = getattr(state, "admission", None)
+            if adm is None:
+                return {"enabled": False}
+            return await asyncio.get_running_loop().run_in_executor(
+                None, adm.status)
         raise ValueError(f"unknown method deploy.{method}")
     return handle
 

@@ -95,6 +95,12 @@ class PlacementService:
         self._committed: dict[str, Reservation] = {}      # stage_key -> last
         self._ids = itertools.count(1)
         self._last: dict[str, tuple[ProblemTensors, Placement]] = {}
+        # streaming-admission tombstones (cp/admission.py): rows kept in
+        # the problem at zero demand so the padded shape tier survives a
+        # departure, but masked OUT of every public assignment view —
+        # a departed service must never look placed to invariants,
+        # dashboards, or deploy fan-out
+        self._masked: dict[str, frozenset] = {}
         # the committed book explains servers.allocated: rebuild it from
         # the store's placements table so a restarted (or promoted
         # standby, docs/guide/13-cp-replication.md) CP's next commit
@@ -181,6 +187,17 @@ class PlacementService:
     # solve + 2-phase reservation
     # ------------------------------------------------------------------
 
+    def _apply_mask(self, key: str, placement: Placement) -> Placement:
+        """Filter a stage's tombstoned (departed-but-row-retained) service
+        names out of the public assignment. raw stays full-length — the
+        solver's exact checker verifies every row, tombstones included."""
+        mask = self._masked.get(key)
+        if not mask:
+            return placement
+        return _dc_replace(placement, assignment={
+            n: node for n, node in placement.assignment.items()
+            if n not in mask})
+
     def solve_stage(self, flow: Flow, stage_name: str, *,
                     tenant: str = "default",
                     reserve: bool = True) -> tuple[Placement, Optional[str]]:
@@ -189,6 +206,9 @@ class PlacementService:
         stage = flow.stage(stage_name)
         key = f"{flow.name}/{stage_name}"
         with self._lock:
+            # a full re-lower rebuilds the stage from the flow, which the
+            # admission controller keeps tombstone-free
+            self._masked.pop(key, None)
             # This stage's own churn hold is the placement this solve
             # supersedes, so it must not count against itself — but the
             # hold is only RELEASED when a real reservation replaces it
@@ -259,6 +279,7 @@ class PlacementService:
         with self._lock:
             if stage_key in self._last:
                 return True
+            self._masked.pop(stage_key, None)   # flow carries no tombstones
             committed = self._committed.get(stage_key)
             # the committed demand is the stage's OWN load: exclude it
             # from inventory like solve_stage excludes its churn hold,
@@ -291,13 +312,81 @@ class PlacementService:
                                                rows=pt.S))
         return True
 
+    def admit_batch(self, stage_key: str, pt: ProblemTensors, delta=None,
+                    *, tenant: str = "default", masked=None,
+                    ) -> tuple[Placement, Optional[str], ProblemTensors]:
+        """Streaming-admission micro-solve (cp/admission.py): solve a
+        pre-built candidate problem — the stage's streaming pt with this
+        batch's arrivals scattered in and departures tombstoned — warm
+        through the resident delta path, and open a reservation for the
+        whole batch. The candidate arrives in the delta shape
+        (dataclasses.replace sharing every untouched tensor), so steady
+        in-tier drift reuses ONE compiled executable and never crosses the
+        host boundary.
+
+        Unlike solve_stage, the stage's OWN standing demand (committed +
+        in-flight) is excluded from capacity — its services are the ones
+        being re-placed, and a stream that saw itself as load would choke
+        on its own success. Returns (placement, reservation_id, pt_used);
+        on an infeasible solve the retained (pt, placement) entry is left
+        standing (the stage IS still feasibly placed without the batch)
+        and reservation_id is None."""
+        with self._lock:
+            server_map = {s.slug: s for s in self.store.list("servers")}
+            valid = np.array(
+                [bool(server_map[slug].schedulable)
+                 if slug in server_map else bool(pt.node_valid[j])
+                 for j, slug in enumerate(pt.node_names)], dtype=bool)
+            if not np.array_equal(valid, pt.node_valid):
+                pt = _dc_replace(pt, node_valid=valid)
+            pt = self._refresh_capacity(pt, stage_key,
+                                        server_map=server_map)
+            if delta is not None:
+                # the delta always re-ships the small planes; keep them
+                # coherent with the refreshed candidate
+                delta.node_valid = pt.node_valid
+                delta.capacity = pt.capacity
+            degraded = False
+            try:
+                if self.use_tpu:
+                    new = self._sched_tpu.reschedule(pt, delta=delta,
+                                                     stage=stage_key)
+                else:
+                    new = self._sched_host.place(pt)
+            except Exception as e:
+                # same degradation contract as node_events: an admission
+                # micro-solve must cost quality, not liveness
+                _M_CHURN_FALLBACKS.inc()
+                degraded = True
+                log.error("admission solve failed; greedy host fallback %s",
+                          kv(stage=stage_key, error=e))
+                new = self._sched_host.place(pt)
+            if not new.feasible and pt.relax_order:
+                sched = (self._sched_host if degraded or not self.use_tpu
+                         else self._sched_tpu)
+                new, _ = place_with_fallback(
+                    sched, pt, initial=new,
+                    place_kwargs=({"stage": stage_key}
+                                  if sched is self._sched_tpu else None))
+            if not new.feasible:
+                return self._apply_mask(stage_key, new), None, pt
+            self._masked[stage_key] = frozenset(masked or ())
+            new = self._apply_mask(stage_key, new)
+            self._last[stage_key] = (pt, new)
+            rid = self._reserve(stage_key, pt, new)
+        return new, rid, pt
+
     @staticmethod
     def _demand_by_node(pt: ProblemTensors,
                         placement: Placement) -> dict[str, np.ndarray]:
         out: dict[str, np.ndarray] = {}
         for i, node in enumerate(placement.raw):
+            dem = pt.demand[i]
+            if not dem.any():
+                continue    # zero-demand rows (admission tombstones)
+                            # must not materialize per-node entries
             slug = pt.node_names[int(node)]
-            out[slug] = out.get(slug, 0) + pt.demand[i].astype(np.float64)
+            out[slug] = out.get(slug, 0) + dem.astype(np.float64)
         return out
 
     def _drop_churn(self, key: str) -> None:
@@ -333,6 +422,35 @@ class PlacementService:
                 reserved_disk=s.allocated.reserved_disk,
             ))
 
+    def _apply_allocation_delta(self, prev: Reservation,
+                                new: Reservation) -> None:
+        """Supersede `prev` by `new` touching only the nodes whose demand
+        actually CHANGED. Numerically identical to apply(prev, -1) +
+        apply(new, +1), but a streaming micro-solve commit (one per drain
+        tick, cp/admission.py) only moves a batch's worth of nodes —
+        rewriting every server record of a 10k-service stage per commit
+        was the admission bench's bottleneck, not the solve."""
+        slugs = set(prev.demand_by_node) | set(new.demand_by_node)
+        zero = np.zeros(3)
+        for slug in slugs:
+            d = (np.asarray(new.demand_by_node.get(slug, zero),
+                            dtype=np.float64)
+                 - np.asarray(prev.demand_by_node.get(slug, zero),
+                              dtype=np.float64))
+            if not d.any():
+                continue
+            s = self.store.server_by_slug(slug)
+            if s is None:
+                continue
+            self.store.update("servers", s.id, allocated=type(s.allocated)(
+                cpu=max(s.allocated.cpu + float(d[0]), 0.0),
+                memory=max(s.allocated.memory + float(d[1]), 0.0),
+                disk=max(s.allocated.disk + float(d[2]), 0.0),
+                reserved_cpu=s.allocated.reserved_cpu,
+                reserved_memory=s.allocated.reserved_memory,
+                reserved_disk=s.allocated.reserved_disk,
+            ))
+
     def commit(self, rid: str) -> bool:
         """Deploy succeeded: move reserved -> committed on the servers
         (2-phase step 2, model.rs:421-427). A redeploy of the same stage
@@ -344,8 +462,9 @@ class PlacementService:
                 return False
             prev = self._committed.pop(r.stage_key, None)
             if prev is not None:
-                self._apply_allocation(prev, -1.0)
-            self._apply_allocation(r, +1.0)
+                self._apply_allocation_delta(prev, r)
+            else:
+                self._apply_allocation(r, +1.0)
             r.committed = True
             self._committed[r.stage_key] = r
             self._drop_churn(r.stage_key)   # commitment reflects reality now
@@ -648,6 +767,9 @@ class PlacementService:
                         sched, pt, initial=new,
                         place_kwargs=({"stage": key}
                                       if sched is self._sched_tpu else None))
+                # a streaming stage's tombstoned rows stay masked through
+                # churn re-solves too
+                new = self._apply_mask(key, new)
                 self._last[key] = (pt, new)
                 if new.feasible:
                     new_dem = self._demand_by_node(pt, new)

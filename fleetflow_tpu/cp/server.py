@@ -14,6 +14,7 @@ from typing import Callable, Optional
 
 import asyncio
 
+from .admission import AdmissionConfig, AdmissionController
 from .agent_registry import AgentRegistry
 from .auth import Claims, NoAuth, make_provider
 from .failure_detector import FailureDetector, LeaseConfig
@@ -67,6 +68,14 @@ class ServerConfig:
     standby_ping_interval_s: float = 2.0
     standby_lease_s: float = 10.0
     standby_grace_s: float = 5.0
+    # streaming admission (cp/admission.py, docs/guide/14): continuous
+    # arrivals/departures batched into bucketed micro-solves with
+    # backpressure + tenant fairness; primaries only (a standby must not
+    # admit — there is one writer per epoch)
+    admission: bool = True
+    admission_queue: int = 4096
+    admission_batch: int = 128
+    admission_shed_age_s: float = 120.0
 
 
 @dataclass
@@ -107,6 +116,10 @@ class AppState:
     replication_role: str = "primary"
     replicator: Optional[Replicator] = None
     standby: Optional[StandbyRunner] = None
+    # streaming-admission controller (cp/admission.py); None on standbys
+    # and when ServerConfig.admission is off. Its pressure() output is
+    # the autoscaler's solver-pressure input.
+    admission: Optional[AdmissionController] = None
 
 
 class CpServerHandle:
@@ -127,6 +140,8 @@ class CpServerHandle:
             self.state.standby.stop()
         if self.state.reconverger is not None:
             self.state.reconverger.stop()
+        if self.state.admission is not None:
+            self.state.admission.stop()
         await self.server.stop()
         self.state.store.flush()
 
@@ -239,6 +254,8 @@ async def start(config: ServerConfig, *,
         state.agent_registry.epoch_source = lambda: store.epoch
         if config.self_heal:
             _build_self_heal(state, config)
+        if config.admission:
+            _build_admission(state, config)
 
     server = ProtocolServer(
         name=config.name, authenticate=authenticate, ssl_context=ssl_ctx,
@@ -287,6 +304,17 @@ def _build_self_heal(state: AppState, config: ServerConfig) -> None:
     state.reconverger.spawn()
 
 
+def _build_admission(state: AppState, config: ServerConfig) -> None:
+    """Streaming-admission controller + its background drain loop
+    (primaries only: exactly one admission writer per epoch)."""
+    state.admission = AdmissionController(
+        state.placement,
+        config=AdmissionConfig(max_queue=config.admission_queue,
+                               batch_max=config.admission_batch,
+                               shed_age_s=config.admission_shed_age_s))
+    state.admission.spawn()
+
+
 def _promote(state: AppState, config: ServerConfig,
              repl_config: ReplicationConfig) -> None:
     """Standby -> primary flip (StandbyRunner.on_promote): open the
@@ -298,5 +326,10 @@ def _promote(state: AppState, config: ServerConfig,
     state.agent_registry.epoch_source = lambda: state.store.epoch
     if config.self_heal:
         _build_self_heal(state, config)
+    if config.admission:
+        # streams do not survive the dead primary (they are in-memory
+        # batching state, not placement truth — that is journaled); a
+        # client's next deploy.submit re-attaches
+        _build_admission(state, config)
     log.warning("standby promoted: now serving as primary %s", kv(
         epoch=state.store.epoch, name=config.name))

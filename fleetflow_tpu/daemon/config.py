@@ -55,6 +55,13 @@ class DaemonConfig:
     standby_ping_interval_s: float = 2.0
     standby_lease_s: float = 10.0
     standby_grace_s: float = 5.0
+    # streaming admission (docs/guide/14-streaming-admission.md):
+    # continuous arrivals/departures as bucketed micro-solves with
+    # backpressure + tenant fairness
+    admission: bool = True
+    admission_queue: int = 4096
+    admission_batch: int = 128
+    admission_shed_age_s: float = 120.0
     source: Optional[str] = None
 
     def expand(self) -> "DaemonConfig":
@@ -167,3 +174,15 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             interval = node.prop("interval")
             if interval is not None:
                 cfg.heal_interval_s = float(interval)
+        elif n == "admission":
+            # `admission false` disables streaming admission; props tune
+            # the watermarks: `admission queue=4096 batch=128 shed-age=120`
+            if v is not None:
+                cfg.admission = _truthy(v, node)
+            for prop, attr, cast in (("queue", "admission_queue", int),
+                                     ("batch", "admission_batch", int),
+                                     ("shed-age", "admission_shed_age_s",
+                                      float)):
+                pv = node.prop(prop)
+                if pv is not None:
+                    setattr(cfg, attr, cast(pv))

@@ -53,7 +53,11 @@ class Daemon:
             standby_token=cfg.standby_token,
             standby_ping_interval_s=cfg.standby_ping_interval_s,
             standby_lease_s=cfg.standby_lease_s,
-            standby_grace_s=cfg.standby_grace_s))
+            standby_grace_s=cfg.standby_grace_s,
+            admission=cfg.admission,
+            admission_queue=cfg.admission_queue,
+            admission_batch=cfg.admission_batch,
+            admission_shed_age_s=cfg.admission_shed_age_s))
         if cfg.web_enabled:
             self.web = WebServer(self.cp.state)
             self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)

@@ -44,6 +44,9 @@ REQUIRED = (
     "fleet_agent_send_failures_total",  # agent session loops
     "fleet_solver_resident_reuse_total",    # device-resident warm path
     "fleet_solver_sharded_solves_total",    # pod-scale sharded path
+    "fleet_admission_queue_depth",          # streaming admission
+    "fleet_autoscaler_pressure",            # admission -> autoscaler loop
+    "fleet_cloud_provider_degraded_total",  # misconfigured-provider alarm
 )
 
 _SAMPLE = re.compile(
@@ -56,6 +59,8 @@ def scrape() -> str:
     # regardless of which subsystems the web server pulls in transitively
     import fleetflow_tpu.agent.agent      # noqa: F401
     import fleetflow_tpu.agent.monitor    # noqa: F401
+    import fleetflow_tpu.cloud.provider   # noqa: F401  (degraded alarm)
+    import fleetflow_tpu.cp.autoscaler    # noqa: F401  (pressure gauge)
     import fleetflow_tpu.solver.api       # noqa: F401
     import fleetflow_tpu.solver.sharded   # noqa: F401  (pod-scale families)
     from fleetflow_tpu.cp.server import ServerConfig, start

@@ -119,6 +119,19 @@ def render(name: str, d: dict) -> str:
                 f"{curve['steps']} sweeps, ladder {curve['ladder']})",
                 detail + (" — tempering wins"
                           if curve.get("tempering_wins") else "")))
+    adm = d.get("admission")
+    if adm and adm.get("ok"):
+        rows.append((
+            f"Streaming admission: {adm['virtual_s']:.0f} s of open-loop "
+            f"Poisson+diurnal churn at {adm['rows']:,} rows × "
+            f"{adm['shape'][1]:,} nodes (micro-solves on the resident "
+            "delta path, transfer-guard pinned)",
+            f"**{adm['placements_per_s']:.0f} placements/s** sustained, "
+            f"solve p50 {adm['solve_ms_p50']:.0f} ms / "
+            f"p99 {adm['solve_ms_p99']:.0f} ms, "
+            f"{adm['compiles']} recompiles, "
+            f"{adm['host_transfers']} host transfers, "
+            f"{adm['violations_max']} violations"))
     pipe = d.get("pipeline")
     if pipe:
         rows.append((

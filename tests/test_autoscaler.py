@@ -166,6 +166,113 @@ class TestAutoscaler:
         run(go())
 
 
+class TestAdmissionPressure:
+    """Autoscaler.plan's solver-pressure input (cp/admission.py
+    pressure(), docs/guide/14-streaming-admission.md): sustained queue
+    age provisions ahead of the floor, a drained queue releases the hold,
+    and pressure can never override max_servers."""
+
+    CASES = [
+        # (pressure, min, max, alive, expect_extra_provision)
+        ("sustained below max provisions",
+         {"sustained": True, "oldest_age_s": 30.0}, 1, 4, 1, True),
+        ("sustained at max is capped",
+         {"sustained": True, "oldest_age_s": 30.0}, 1, 1, 1, False),
+        ("hot but not yet sustained holds",
+         {"sustained": False, "oldest_age_s": 3.0}, 1, 4, 1, False),
+        ("drained changes nothing",
+         {"sustained": False, "drained": True}, 1, 4, 1, False),
+        ("no signal at all changes nothing", {}, 1, 4, 1, False),
+        ("uncapped pool provisions too",
+         {"sustained": True, "oldest_age_s": 30.0}, 1, 0, 1, True),
+    ]
+
+    def test_plan_pressure_table(self):
+        import pytest as _pytest  # noqa: F401
+
+        log = {"created": [], "deleted": []}
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            for (name, pressure, mn, mx, alive, expect) in self.CASES:
+                pool = db.create("worker_pools", WorkerPool(
+                    tenant="default", name=f"p-{len(db.list('worker_pools'))}",
+                    min_servers=mn, max_servers=mx,
+                    preferred_labels={"provider": "fake"}))
+                for i in range(alive):
+                    s = db.register_server(f"{pool.name}-w{i}")
+                    db.update("servers", s.id, pool=pool.name,
+                              status="online", provider="fake")
+                scaler = Autoscaler(handle.state)
+                need, victims = scaler.plan(pool, pressure)
+                assert need == (1 if expect else 0), (name, need)
+                assert victims == [], name
+            await handle.stop()
+        run(go())
+
+    def test_sustained_pressure_suppresses_idle_scale_down(self):
+        import time as _time
+        log = {"created": [], "deleted": []}
+        now = [_time.time()]
+        pressure = [{"sustained": True, "oldest_age_s": 60.0}]
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            pool = db.create("worker_pools", WorkerPool(
+                tenant="default", name="builders", min_servers=1,
+                max_servers=0, preferred_labels={"provider": "fake"}))
+            for i in range(2):
+                s = db.register_server(f"builders-w{i}")
+                db.update("servers", s.id, pool="builders",
+                          status="online", provider="fake")
+                log["created"].append(f"builders-w{i}")
+            now[0] += 10000            # both idle far past the grace
+            scaler = Autoscaler(handle.state, clock=lambda: now[0],
+                                pressure_source=lambda: pressure[0])
+            actions = scaler.run_sweep()
+            # under pressure: the idle surplus is HELD and one more node
+            # provisions ahead of the queue
+            kinds = [a.kind for a in actions]
+            assert kinds == ["provision"], actions
+            # queue drains -> the hold releases: surplus reaped down to
+            # the floor, nothing new provisioned
+            pressure[0] = {"sustained": False, "drained": True}
+            now[0] += 10000
+            actions = scaler.run_sweep()
+            downs = [a for a in actions if a.kind == "deprovision"]
+            ups = [a for a in actions if a.kind == "provision"]
+            assert ups == [] and len(downs) == 2, actions
+            alive = db.list("servers", lambda s: s.pool == pool.name
+                            and s.status == "online")
+            assert len(alive) == 1
+            await handle.stop()
+        run(go())
+
+    def test_pressure_never_exceeds_max_across_sweeps(self):
+        log = {"created": [], "deleted": []}
+
+        async def go():
+            handle = await _cp(log)
+            db = handle.state.store
+            db.create("worker_pools", WorkerPool(
+                tenant="default", name="capped", min_servers=1,
+                max_servers=2, preferred_labels={"provider": "fake"}))
+            scaler = Autoscaler(
+                handle.state,
+                pressure_source=lambda: {"sustained": True,
+                                         "oldest_age_s": 99.0})
+            # sweep 1: floor; sweep 2: pressure +1 (hits max); sweep 3+:
+            # pinned at the cap no matter how hot the queue stays
+            for expected_total in (1, 2, 2, 2):
+                scaler.run_sweep()
+                servers = db.list("servers", lambda s: s.pool == "capped")
+                assert len(servers) == expected_total
+            await handle.stop()
+        run(go())
+
+
 class TestDeadWorkerReplacement:
     def test_offline_corpse_reaped_and_replaced_under_cap(self):
         import time as _time

@@ -1751,3 +1751,99 @@ stage "live" { service "a"; servers "node-1" }
             await cli.close()
             await handle.stop()
         run(go())
+
+
+class TestStreamingAdmission:
+    """The deploy.submit streaming variant + admit_status over the real
+    wire (docs/guide/14-streaming-admission.md): attach-on-first-submit,
+    drain through the background pipeline, and structured backpressure."""
+
+    def test_submit_attach_drain_and_status(self):
+        from fleetflow_tpu.core.model import (Flow, ResourceSpec, Service,
+                                              Stage)
+        from fleetflow_tpu.core.serialize import flow_to_dict
+        from fleetflow_tpu.cp.models import ServerCapacity
+
+        flow = Flow(name="streamy")
+        flow.services["base"] = Service(
+            name="base", image="x", version="1",
+            resources=ResourceSpec(cpu=0.1, memory=32.0))
+        flow.stages["live"] = Stage(name="live", services=["base"],
+                                    servers=["node-1"])
+
+        async def go():
+            handle = await start_cp()
+            db = handle.state.store
+            s = db.register_server("node-1")
+            db.update("servers", s.id, status="online",
+                      capacity=ServerCapacity(cpu=4.0, memory=4096.0,
+                                              disk=1024.0))
+            cli, _ = await connect(handle)
+            out = await cli.request("deploy", "submit", {
+                "flow": flow_to_dict(flow), "stage": "live",
+                "arrivals": [{"name": "s1", "cpu": 0.1, "memory": 16.0}],
+            })
+            assert out["stage"] == "streamy/live"
+            assert len(out["accepted"]) == 1
+            # the background drain loop picks the batch up
+            ctrl = handle.state.admission
+            for _ in range(100):
+                if not ctrl.has_work():
+                    break
+                await asyncio.sleep(0.05)
+            assert "s1" in ctrl.live_names("streamy/live")
+            st = await cli.request("deploy", "admit_status")
+            assert st["enabled"]
+            assert st["streams"]["streamy/live"]["live_streamed"] == 1
+            assert st["stats"]["admitted"] == 1
+            # a departure through the same wire
+            out = await cli.request("deploy", "submit", {
+                "stage": "streamy/live", "departures": ["s1"]})
+            for _ in range(100):
+                if not ctrl.has_work():
+                    break
+                await asyncio.sleep(0.05)
+            assert ctrl.live_names("streamy/live") == []
+            # an unknown departure is a structured refusal over the wire
+            with pytest.raises(RpcError, match="no such live"):
+                await cli.request("deploy", "submit", {
+                    "stage": "streamy/live", "departures": ["ghost"]})
+            await cli.close()
+            await handle.stop()
+        run(go())
+
+    def test_backpressure_surfaces_retryable_error(self):
+        from fleetflow_tpu.core.model import (Flow, ResourceSpec, Service,
+                                              Stage)
+        from fleetflow_tpu.core.serialize import flow_to_dict
+        from fleetflow_tpu.cp.models import ServerCapacity
+
+        flow = Flow(name="bp")
+        flow.services["base"] = Service(
+            name="base", image="x", version="1",
+            resources=ResourceSpec(cpu=0.1, memory=32.0))
+        flow.stages["live"] = Stage(name="live", services=["base"],
+                                    servers=["node-1"])
+
+        async def go():
+            handle = await start_cp(admission_queue=1)
+            db = handle.state.store
+            s = db.register_server("node-1")
+            db.update("servers", s.id, status="online",
+                      capacity=ServerCapacity(cpu=4.0, memory=4096.0,
+                                              disk=1024.0))
+            # stall the drain loop so the queue actually fills
+            handle.state.admission.stop()
+            cli, _ = await connect(handle)
+            await cli.request("deploy", "submit", {
+                "flow": flow_to_dict(flow), "stage": "live",
+                "arrivals": [{"name": "a0"}]})
+            with pytest.raises(RpcError) as ei:
+                await cli.request("deploy", "submit", {
+                    "stage": "bp/live", "arrivals": [{"name": "a1"}]})
+            msg = str(ei.value)
+            assert "AdmissionRejected" in msg
+            assert "queue-depth" in msg and "retry_after_s" in msg
+            await cli.close()
+            await handle.stop()
+        run(go())

@@ -105,6 +105,22 @@ tpu-solver #true
         cfg = load_daemon_config(str(p))
         assert cfg.self_heal is True and cfg.lease_s == 90.0
 
+    def test_admission_knobs(self, tmp_path):
+        p = tmp_path / "fleetflowd.kdl"
+        p.write_text('admission #true queue=512 batch=32 shed-age=30\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.admission is True
+        assert cfg.admission_queue == 512
+        assert cfg.admission_batch == 32
+        assert cfg.admission_shed_age_s == 30.0
+        p.write_text('admission #false\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.admission is False
+        # on by default with the documented watermarks
+        p.write_text('listen "127.0.0.1" 4510\n')
+        cfg = load_daemon_config(str(p))
+        assert cfg.admission is True and cfg.admission_queue == 4096
+
 
 class TestConfigPositional:
     def test_listen_and_web_positional_args(self, tmp_path, monkeypatch):
