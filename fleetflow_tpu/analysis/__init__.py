@@ -1,0 +1,52 @@
+"""Static analysis over the solver's compiled artifacts and the codebase's
+JAX/async hygiene — the compile-time counterpart to the chaos harness.
+
+The perf story (warm re-solves reuse ONE executable, delta kernels donate
+their buffers, sharded outputs keep their PartitionSpecs, nothing
+round-trips the host under the disallow transfer guard) rests on contracts
+that runtime spies and bench assertions only catch when the right leg
+happens to run. This package pins them statically, on every change:
+
+  auditor    lowers each registered hot-path executable
+             (solver/contracts.py) at representative bucket tiers and
+             checks the lowered/compiled artifact — donation aliasing,
+             output shardings, host callbacks, recompile axes — against
+             the checked-in contract file
+             (tests/goldens/compile_contract.json)
+  jitspec    AST extraction of jit declarations (static_argnames,
+             donate_argnums -> parameter names) straight from source, so
+             the recompile-axis check is ground truth, not a hand-copied
+             tuple
+  hygiene    FJ001+ AST rules over solver/ and cp/ (host sync inside jit,
+             numpy/env reads in traced code, blocking calls in async
+             handlers, awaits under the store lock), riding the lint/
+             Diagnostic machinery
+
+Surfaces: `fleet audit kernels` / `fleet audit hygiene` (cli/main.py) and
+the pinned CI step. docs/guide/15-static-analysis.md is the operator's
+guide.
+"""
+
+from .hygiene import HYGIENE_RULES, hygiene_lint_paths, hygiene_lint_source
+from .jitspec import JitDecl, extract_jit_decl
+
+__all__ = [
+    "HYGIENE_RULES",
+    "hygiene_lint_paths",
+    "hygiene_lint_source",
+    "JitDecl",
+    "extract_jit_decl",
+    "audit_kernels",
+    "contract_diff",
+    "render_contract",
+]
+
+
+def __getattr__(name: str):
+    # auditor imports jax (lazily, via solver/contracts.py builders); keep
+    # `import fleetflow_tpu.analysis` jax-free so the hygiene half stays
+    # usable from dependency-free contexts (scripts/selflint.py)
+    if name in ("audit_kernels", "contract_diff", "render_contract"):
+        from . import auditor
+        return getattr(auditor, name)
+    raise AttributeError(name)

@@ -1,0 +1,341 @@
+"""The HLO/lowering auditor: pin the compile contracts statically.
+
+For every registered hot-path executable (solver/contracts.py), at every
+representative bucket tier, this module lowers the ACTUAL jitted function
+with the ACTUAL production staging and reads the contract off the
+artifact itself — not off runtime behavior:
+
+  donation      `lowered.args_info` names the donated leaves; the lowered
+                MLIR's ``tf.aliasing_output`` arg attributes name the
+                donations XLA accepted. Every leaf in the kernel's
+                ``must_alias`` set has to alias an output — a dropped
+                ``donate_argnums`` or a shape drift that breaks the alias
+                is a report violation, before any bench runs.
+  purity        host callbacks (``*callback*`` custom_calls), infeed,
+                outfeed, send/recv must not appear: the warm path is
+                transfer-guard-proven and a smuggled `debug.print` or
+                `pure_callback` would stall every dispatch.
+  shardings     for mesh kernels, ``compiled.output_shardings`` must
+                match the declared PartitionSpecs leaf for leaf — a lost
+                constraint silently decays to replication (device-0 OOM
+                at pod scale).
+  recompile     the jit declaration's static argnames (AST-extracted by
+  axes          analysis/jitspec, plus DeviceProblem's static dataclass
+                fields) are recorded verbatim.
+
+The whole report then diffs against the checked-in contract file
+(tests/goldens/compile_contract.json): adding a static axis, losing a
+donation, or changing an output layout is a reviewed golden diff, not a
+perf regression found weeks later. Regenerate intentionally with
+``fleet audit kernels --update`` (docs/guide/15-static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional
+
+from .jitspec import extract_jit_decl
+
+__all__ = ["audit_kernels", "audit_case", "contract_diff",
+           "render_contract", "default_contract_path", "AuditReport"]
+
+_MAIN_SIG = re.compile(r"func\.func public @main\((.*?)\)\s*->", re.S)
+_ALIAS_ATTR = re.compile(r"tf\.aliasing_output")
+# compiled-HLO header: input_output_alias={ {0}: (2, {}, may-alias), ... }
+# — the (N, ...) tuples name the INPUT parameter indices XLA will reuse
+_HLO_ALIAS_IN = re.compile(r"\{[0-9, ]*\}:\s*\((\d+),")
+# impurity: anything that escapes the device program mid-dispatch
+_IMPURE = re.compile(
+    r"custom_call\s+@([\w.]*callback[\w.]*)"
+    r"|stablehlo\.(infeed|outfeed|send|recv)\b")
+
+
+class AuditReport(dict):
+    """The audit result: a contract-file-shaped dict plus `violations`
+    (intrinsic failures independent of any golden) and `skipped`."""
+
+    @property
+    def violations(self) -> list:
+        return self["_violations"]
+
+    @property
+    def skipped(self) -> list:
+        return self["_skipped"]
+
+    def ok(self) -> bool:
+        return not self["_violations"]
+
+
+def default_contract_path() -> str:
+    """tests/goldens/compile_contract.json, resolved from the repo
+    checkout this package was imported from (the audit is a source-tree
+    tool, like scripts/selflint.py)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg), "tests", "goldens",
+                        "compile_contract.json")
+
+
+def _keystr(path, top_names: tuple) -> str:
+    """Render a tree_flatten_with_path key path as a dotted leaf name:
+    the top-level position maps through `top_names` (the kernel's
+    argument/output slot names), attributes keep their field names."""
+    import jax.tree_util as jtu
+
+    parts: list[str] = []
+    for i, k in enumerate(path):
+        if i == 0:
+            if isinstance(k, jtu.SequenceKey) and k.idx < len(top_names):
+                parts.append(str(top_names[k.idx]))
+                continue
+            if isinstance(k, jtu.GetAttrKey):
+                parts.append(k.name)
+                continue
+            parts.append(re.sub(r"[\[\]'\.]", "", jtu.keystr([k])))
+            continue
+        if isinstance(k, jtu.GetAttrKey):
+            parts.append(k.name)
+        elif isinstance(k, jtu.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jtu.DictKey):
+            parts.append(str(k.key))
+        else:
+            parts.append(re.sub(r"[\[\]'\.]", "", jtu.keystr([k])))
+    joined = ".".join(parts)
+    if joined:
+        return joined
+    return top_names[0] if top_names else "out"
+
+
+def _spec_str(sharding) -> str:
+    """Normalized PartitionSpec rendering: trailing Nones stripped, so
+    P('svc', None) and P('svc') compare equal — the layout is what
+    matters, not the padding of the spec tuple."""
+    spec = tuple(getattr(sharding, "spec", ()) or ())
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    inner = ", ".join(
+        "None" if s is None else
+        (repr(tuple(s)) if isinstance(s, tuple) else repr(str(s)))
+        for s in spec)
+    return f"P({inner})"
+
+
+def _flat_named(tree, top_names: tuple) -> list[tuple[str, Any]]:
+    import jax.tree_util as jtu
+    flat = jtu.tree_flatten_with_path(tree)[0]
+    return [(_keystr(p, top_names), v) for p, v in flat]
+
+
+def audit_case(contract, case) -> tuple[dict, list[str]]:
+    """Lower + (for mesh kernels) compile one case; returns the per-tier
+    record and any intrinsic violations."""
+    violations: list[str] = []
+    where = f"{contract.name}@{case.tier}"
+    lowered = case.fn.lower(*case.args, **case.kwargs)
+
+    # ---- donation: declared (args_info) vs landed (aliasing attrs) ----
+    # args_info mirrors (args, kwargs) minus statics; name leaves via the
+    # kernel's own argument slots: args.0.demand -> prob.demand
+    info_named = _flat_named(lowered.args_info, ("args", "kwargs"))
+
+    def leaf_name(raw: str) -> str:
+        parts = raw.split(".")
+        if parts[0] == "args" and len(parts) >= 2 and parts[1].isdigit():
+            i = int(parts[1])
+            head = (case.arg_names[i] if i < len(case.arg_names)
+                    else f"arg{i}")
+            return ".".join([head, *parts[2:]])
+        if parts[0] == "kwargs":
+            return ".".join(parts[1:])
+        return raw
+
+    donated = sorted(leaf_name(n) for n, a in info_named
+                     if getattr(a, "donated", False))
+
+    txt = lowered.as_text()
+    m = _MAIN_SIG.search(txt)
+    sig = m.group(1) if m else ""
+    # split the signature on top-level commas (tensor types carry no
+    # parens; attribute dicts do — track brace depth)
+    arg_chunks: list[str] = []
+    depth = 0
+    cur = ""
+    for ch in sig:
+        if ch == "," and depth == 0:
+            arg_chunks.append(cur)
+            cur = ""
+            continue
+        depth += ch in "{(<"
+        depth -= ch in "})>"
+        cur += ch
+    if cur.strip():
+        arg_chunks.append(cur)
+    try:
+        kept = sorted(lowered._lowering.compile_args["kept_var_idx"])
+    except Exception:
+        kept = list(range(len(arg_chunks)))   # assume nothing pruned
+    all_names = [leaf_name(n) for n, _ in info_named]
+
+    def kept_name(i: int) -> Optional[str]:
+        if i < len(kept) and kept[i] < len(all_names):
+            return all_names[kept[i]]
+        return None
+
+    # donation that LANDED: single-device lowerings resolve it to
+    # `tf.aliasing_output` arg attributes; sharded lowerings defer the
+    # pairing to XLA (`jax.buffer_donor`) and the compiled module's
+    # input_output_alias map is the truth — read both, union them
+    aliased_set = {kept_name(i) for i, chunk in enumerate(arg_chunks)
+                   if _ALIAS_ATTR.search(chunk)}
+    compiled = lowered.compile()
+    header = compiled.as_text().split("\n", 1)[0]
+    aliased_set |= {kept_name(int(m.group(1)))
+                    for m in _HLO_ALIAS_IN.finditer(header)}
+    aliased = sorted(n for n in aliased_set if n)
+
+    missing = sorted(set(contract.must_alias) - set(aliased))
+    if missing:
+        violations.append(
+            f"{where}: donated buffers not aliased in the lowered "
+            f"artifact: {', '.join(missing)} (donation dropped or "
+            f"shape/dtype no longer matches an output)")
+
+    # ---- purity: no host callbacks / infeed / outfeed ------------------
+    callbacks = sorted({mm.group(0).strip() for mm in _IMPURE.finditer(txt)})
+    if callbacks:
+        violations.append(
+            f"{where}: host-callback/infeed ops in the lowered artifact: "
+            f"{'; '.join(callbacks)} — the warm path must stay "
+            f"transfer-guard-pure")
+
+    # ---- output shardings (mesh kernels) -------------------------------
+    shard_rec: Optional[dict] = None
+    if case.out_shardings is not None:
+        out_names = tuple(case.out_shardings)
+        top = tuple(dict.fromkeys(n.split(".")[0] for n in out_names))
+        got = {name: _spec_str(s)
+               for name, s in _flat_named(compiled.output_shardings, top)}
+        shard_rec = dict(sorted(got.items()))
+        for name, want in sorted(case.out_shardings.items()):
+            have = got.get(name)
+            if have != want:
+                violations.append(
+                    f"{where}: output sharding of {name} is "
+                    f"{have or 'missing'}, declared {want} (a lost "
+                    f"with_sharding_constraint decays to replication)")
+
+    rec = {
+        "donated": donated,
+        "aliased": aliased,
+        "host_callbacks": callbacks,
+        "output_shardings": shard_rec,
+    }
+    return rec, violations
+
+
+def audit_kernels(kernels=None) -> AuditReport:
+    """Run the full audit; returns the report (contract-file shape plus
+    `_violations`/`_skipped`). Callers wanting a mesh audit on CPU must
+    arrange >= 8 devices BEFORE jax initializes (platform.force_cpu(8) —
+    the CLI does this)."""
+    import importlib
+
+    import jax
+
+    from ..solver.contracts import hot_path_kernels, problem_static_fields
+
+    if kernels is None:
+        kernels = hot_path_kernels()
+    ndev = len(jax.devices())
+    report = AuditReport({
+        "version": 1,
+        "problem_static_fields": problem_static_fields(),
+        "kernels": {},
+        "_violations": [],
+        "_skipped": [],
+    })
+    for contract in kernels:
+        if ndev < contract.needs_devices:
+            report["_skipped"].append(
+                f"{contract.name}: needs {contract.needs_devices} "
+                f"devices, have {ndev}")
+            continue
+        mod = importlib.import_module(contract.module)
+        src_path = mod.__file__
+        with open(src_path, encoding="utf-8") as f:
+            decl = extract_jit_decl(f.read(), contract.qualname,
+                                    os.path.basename(src_path))
+        entry: dict = {
+            "static_args": decl.static_args,
+            "donated_params": decl.donated_params,
+            "tiers": {},
+        }
+        for case in contract.cases():
+            rec, violations = audit_case(contract, case)
+            entry["tiers"][case.tier] = rec
+            report["_violations"].extend(violations)
+        report["kernels"][contract.name] = entry
+    return report
+
+
+def render_contract(report: AuditReport) -> str:
+    """The contract-file text for a report (stable ordering, trailing
+    newline — a reviewable golden)."""
+    doc = {k: v for k, v in report.items() if not k.startswith("_")}
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def contract_diff(report: AuditReport, pinned: dict) -> list[str]:
+    """Compare an audit report against the pinned contract document.
+    Returns human-readable mismatches (empty = contract holds). Kernels
+    the audit skipped are NOT compared — the caller decides whether a
+    skip is acceptable (CI forces enough devices that nothing skips)."""
+    out: list[str] = []
+    if report["problem_static_fields"] != pinned.get(
+            "problem_static_fields"):
+        out.append(
+            f"problem_static_fields drifted: audited "
+            f"{report['problem_static_fields']}, pinned "
+            f"{pinned.get('problem_static_fields')} — a new static "
+            f"DeviceProblem field is a recompile axis for every kernel")
+    pk = pinned.get("kernels", {})
+    audited = report["kernels"]
+    skipped_names = {s.split(":")[0] for s in report["_skipped"]}
+    for name in sorted(set(pk) | set(audited)):
+        if name in skipped_names:
+            continue
+        if name not in audited:
+            out.append(f"{name}: pinned in the contract but no longer "
+                       f"registered in solver/contracts.py")
+            continue
+        if name not in pk:
+            out.append(f"{name}: registered but absent from the contract "
+                       f"file (run `fleet audit kernels --update`)")
+            continue
+        a, p = audited[name], pk[name]
+        for key, label in (("static_args", "static args (recompile axes)"),
+                           ("donated_params", "donated parameters")):
+            if a[key] != p.get(key):
+                out.append(f"{name}: {label} drifted: declaration says "
+                           f"{a[key]}, contract pins {p.get(key)}")
+        at, ptiers = a["tiers"], p.get("tiers", {})
+        for tier in sorted(set(at) | set(ptiers)):
+            if tier not in at:
+                out.append(f"{name}@{tier}: pinned tier not audited "
+                           f"(AUDIT_TIERS changed?)")
+                continue
+            if tier not in ptiers:
+                out.append(f"{name}@{tier}: audited tier absent from the "
+                           f"contract file")
+                continue
+            for key in ("donated", "aliased", "host_callbacks",
+                        "output_shardings"):
+                if at[tier].get(key) != ptiers[tier].get(key):
+                    out.append(
+                        f"{name}@{tier}: {key} drifted: audited "
+                        f"{at[tier].get(key)}, contract pins "
+                        f"{ptiers[tier].get(key)}")
+    return out

@@ -1,0 +1,464 @@
+"""FJ001+ — JAX/async hygiene rules over Python source, AST only.
+
+The lint/ package proves fleet *configs* can't deploy doomed; this module
+holds the *codebase* to the equivalent bar for the two failure classes
+that repeatedly threaten the perf contracts:
+
+  host sync inside jit   a `.item()`, a `float()` on a tracer, an `np.`
+                         call, or an env read inside traced code either
+                         fails at trace time or — worse — silently
+                         constant-folds / forces a device round-trip,
+                         exactly what the transfer-guard benches exist to
+                         forbid (docs/guide/11-performance.md)
+  async CP hazards       a blocking call inside an `async def` handler
+                         stalls the whole CP event loop; an `await` while
+                         holding the (threading) store lock parks the
+                         lock across a scheduling point and deadlocks the
+                         sync writers sharing it
+
+Rules ride the lint Diagnostic machinery (stable codes, severity,
+file:line:col spans) but run on Python files, not KDL. Everything here is
+stdlib-only ON PURPOSE: scripts/selflint.py runs this pass in
+dependency-free environments, so importing this module must never pull
+jax or numpy.
+
+Codes (stable; retire by leaving a gap — same contract as FF0xx):
+
+  FJ001  error    `.item()` inside traced code (host sync per call)
+  FJ002  warning  `float()`/`int()`/`bool()` on a non-static value inside
+                  traced code (concretization error, or a silent sync)
+  FJ003  error    `np.*` compute call inside traced code (dtype/constant
+                  accessors exempt): numpy pulls the value to host
+  FJ004  error    `os.environ`/`os.getenv` read inside traced code: the
+                  env is read once at trace time and baked into the
+                  executable — config drift silently ignored
+  FJ005  warning  blocking call (`time.sleep`, `subprocess.*`,
+                  `requests.*`, `urllib.request.*`) inside `async def`
+  FJ006  error    `await` inside a `with <...lock...>:` block (threading
+                  lock held across a scheduling point)
+
+Suppression: a trailing ``# noqa: FJ00x`` on the offending line (comma
+lists and bare ``# noqa`` honored, same grammar ruff uses).
+
+Trace-context detection is deliberately lexical and conservative: a
+function is traced when it is (a) decorated with ``jax.jit`` /
+``partial(jax.jit, ...)``, (b) passed to a ``jax.jit(...)`` or
+``shard_map(...)`` call anywhere in the module, or (c) lexically nested
+inside one of those. Functions handed to ``jax.pure_callback`` /
+``io_callback`` / ``jax.debug.callback`` are exempt subtrees — they run
+on host by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterator, Optional, Union
+
+_Fn = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+from ..lint.diagnostics import Diagnostic, Severity
+
+__all__ = ["HygieneRule", "HYGIENE_RULES", "hygiene_lint_source",
+           "hygiene_lint_paths", "iter_python_files"]
+
+
+@dataclass(frozen=True)
+class HygieneRule:
+    code: str
+    slug: str
+    severity: Severity
+    doc: str
+
+
+HYGIENE_RULES: list[HygieneRule] = [
+    HygieneRule("FJ001", "host-sync-item", Severity.ERROR,
+                "`.item()` inside traced code forces a device->host sync"),
+    HygieneRule("FJ002", "host-cast-tracer", Severity.WARNING,
+                "float()/int()/bool() on a non-static value inside traced "
+                "code concretizes a tracer"),
+    HygieneRule("FJ003", "numpy-in-jit", Severity.ERROR,
+                "np.* compute call inside traced code runs on host"),
+    HygieneRule("FJ004", "env-read-in-jit", Severity.ERROR,
+                "environment read inside traced code is baked in at trace "
+                "time"),
+    HygieneRule("FJ005", "blocking-in-async", Severity.WARNING,
+                "blocking call inside an async def stalls the event loop"),
+    HygieneRule("FJ006", "await-under-lock", Severity.ERROR,
+                "await while holding a threading lock parks the lock "
+                "across a scheduling point"),
+]
+
+_RULE = {r.code: r for r in HYGIENE_RULES}
+
+# np attributes that are dtype constructors / constants, not compute — the
+# legitimate uses inside jitted code (jnp accepts them as dtype args)
+_NP_SAFE = {
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+    "complex128", "dtype", "pi", "e", "inf", "nan", "newaxis", "ndarray",
+    "generic", "integer", "floating", "number", "iinfo", "finfo",
+}
+
+# call roots considered blocking inside an async def (FJ005)
+_BLOCKING_ROOTS = {"subprocess", "requests", "urllib"}
+
+_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+def _noqa_codes(line: str) -> Optional[set[str]]:
+    """None = no noqa; empty set = bare noqa (suppresses everything)."""
+    m = _NOQA.search(line)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip().upper() for c in codes.split(",") if c.strip()}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target / attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = _dotted(call.func)
+    return name in ("jax.jit", "jit") or name.endswith(".jit")
+
+
+def _is_partial_jit_decorator(dec: ast.AST) -> bool:
+    """``@partial(jax.jit, ...)`` / ``@functools.partial(jit, ...)``."""
+    if not isinstance(dec, ast.Call):
+        return False
+    name = _dotted(dec.func)
+    if name not in ("partial", "functools.partial"):
+        return False
+    return bool(dec.args) and isinstance(dec.args[0], (ast.Name,
+                                                       ast.Attribute)) \
+        and _is_jit_call(ast.Call(func=dec.args[0], args=[], keywords=[]))
+
+
+_TRACING_WRAPPERS = ("shard_map",)
+_HOST_CALLBACK_WRAPPERS = ("pure_callback", "io_callback", "callback")
+
+
+def _first_arg_names(tree: ast.AST, wrapper_suffixes: tuple[str, ...],
+                     jit: bool) -> set[str]:
+    """Names of local functions passed (as first positional arg) to
+    jit/shard_map — or to host-callback wrappers when jit=False."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        hit = (_is_jit_call(node) or
+               any(name == w or name.endswith("." + w)
+                   for w in wrapper_suffixes)) if jit else \
+            any(name == w or name.endswith("." + w)
+                for w in wrapper_suffixes)
+        if hit and node.args and isinstance(node.args[0], ast.Name):
+            out.add(node.args[0].id)
+    return out
+
+
+class _Ctx:
+    """Shared per-file lint state."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = tree
+        # functions passed to jax.jit(f, ...) / shard_map(f, ...) by name
+        self.jit_wrapped = _first_arg_names(tree, _TRACING_WRAPPERS,
+                                            jit=True)
+        # functions passed to pure_callback / io_callback — host by design
+        self.host_cb = _first_arg_names(tree, _HOST_CALLBACK_WRAPPERS,
+                                        jit=False)
+        # bare names that are blocking calls because of how they were
+        # imported: `from time import sleep`, `from subprocess import
+        # run`, ... — a dotted call (`time.sleep`) is recognized by its
+        # root; the from-import form needs the alias table
+        self.blocking_aliases: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom) or node.module is None:
+                continue
+            mod_root = node.module.split(".")[0]
+            if mod_root in _BLOCKING_ROOTS:
+                self.blocking_aliases.update(
+                    a.asname or a.name for a in node.names
+                    if a.name != "*")
+            elif node.module == "time":
+                self.blocking_aliases.update(
+                    a.asname or a.name for a in node.names
+                    if a.name == "sleep")
+
+    def diag(self, code: str, node: ast.AST, message: str) -> \
+            Optional[Diagnostic]:
+        line = getattr(node, "lineno", 0)
+        if 0 < line <= len(self.lines):
+            codes = _noqa_codes(self.lines[line - 1])
+            if codes is not None and (not codes or code in codes):
+                return None
+        r = _RULE[code]
+        return Diagnostic(code=code, severity=r.severity, message=message,
+                          file=self.path, line=line,
+                          col=getattr(node, "col_offset", 0) + 1,
+                          rule=r.slug)
+
+
+def _is_jit_root(fn: ast.AST, ctx: _Ctx) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    if fn.name in ctx.jit_wrapped:
+        return True
+    for dec in fn.decorator_list:
+        if isinstance(dec, (ast.Name, ast.Attribute)) and \
+                _is_jit_call(ast.Call(func=dec, args=[], keywords=[])):
+            return True
+        if isinstance(dec, ast.Call) and _is_jit_call(dec):
+            return True
+        if _is_partial_jit_decorator(dec):
+            return True
+    return False
+
+
+def _static_argnames(fn: _Fn, ctx: _Ctx) -> set[str]:
+    """static_argnames declared on this jit root's decorator (FJ002 uses
+    them: casting a STATIC argument is ordinary Python, not a tracer
+    concretization)."""
+    def from_call(call: ast.Call) -> set[str]:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums") and \
+                    isinstance(kw.value, (ast.Tuple, ast.List)):
+                return {e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+        return set()
+
+    out: set[str] = set()
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            out |= from_call(dec)
+    # jax.jit(fn, static_argnames=...) call form anywhere in the module
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node) and \
+                node.args and isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == fn.name:
+            out |= from_call(node)
+    return out
+
+
+def _param_names(fn: ast.AST) -> set[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return set()
+    a = fn.args
+    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _check_traced_body(root: _Fn, ctx: _Ctx) -> \
+        Iterator[Diagnostic]:
+    """FJ001-FJ004 over a jit root and everything lexically inside it,
+    skipping host-callback subtrees."""
+    statics = _static_argnames(root, ctx)
+    # names that may hold tracers: every non-static parameter of the root
+    # or of any nested def (conservative; locals derived from them are
+    # only caught when the expression names a parameter directly)
+    traced_names: set[str] = set()
+
+    def walk(node: ast.AST, inside: bool) -> Iterator[Diagnostic]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child.name in ctx.host_cb:
+                    continue            # runs on host by design
+                traced_names.update(_param_names(child) - statics)
+                yield from walk(child, True)
+                continue
+            if isinstance(child, ast.Lambda):
+                traced_names.update(_param_names(child) - statics)
+            if inside and isinstance(child, ast.Call):
+                yield from check_call(child)
+            if inside and isinstance(child, ast.Attribute):
+                d = check_env_attr(child)
+                if d:
+                    yield d
+            yield from walk(child, inside)
+
+    def check_call(call: ast.Call) -> Iterator[Diagnostic]:
+        name = _dotted(call.func)
+        # FJ001 `.item()`
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "item" and not call.args:
+            d = ctx.diag("FJ001", call,
+                         f"`{name}()` inside traced code: every call is a "
+                         f"blocking device->host sync; keep the value on "
+                         f"device or move the read after the dispatch")
+            if d:
+                yield d
+        # FJ003 np.* compute
+        if name.startswith("np.") or name.startswith("numpy."):
+            attr = name.split(".", 1)[1]
+            if attr.split(".")[0] not in _NP_SAFE:
+                d = ctx.diag("FJ003", call,
+                             f"`{name}(...)` inside traced code runs on "
+                             f"host (silent transfer or trace-time "
+                             f"constant); use jnp/lax here")
+                if d:
+                    yield d
+        # FJ004 os.getenv(...)  (os.environ[...]/.get ride the attribute
+        # check below — listing the call here would double-report)
+        if name in ("os.getenv", "getenv"):
+            d = ctx.diag("FJ004", call,
+                         f"`{name}(...)` inside traced code is read once "
+                         f"at trace time and baked into the executable; "
+                         f"resolve env config before the jit boundary")
+            if d:
+                yield d
+        # FJ002 float()/int()/bool() on a likely tracer
+        if name in ("float", "int", "bool") and len(call.args) == 1:
+            arg = call.args[0]
+            loads = {n.id for n in ast.walk(arg)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            if loads & traced_names:
+                d = ctx.diag("FJ002", call,
+                             f"`{name}(...)` on a traced value "
+                             f"concretizes the tracer (ConcretizationError "
+                             f"at best, a silent host sync at worst); use "
+                             f"jnp dtypes/astype, or mark the argument "
+                             f"static")
+                if d:
+                    yield d
+
+    def check_env_attr(attr: ast.Attribute) -> Optional[Diagnostic]:
+        # FJ004 os.environ[...] / os.environ.get handled via Subscript
+        # parent is awkward in a child walk; flag the bare attribute read
+        if _dotted(attr) == "os.environ":
+            return ctx.diag("FJ004", attr,
+                            "`os.environ` read inside traced code is "
+                            "baked in at trace time; resolve env config "
+                            "before the jit boundary")
+        return None
+
+    traced_names.update(_param_names(root) - statics)
+    yield from walk(root, True)
+
+
+def _walk_own_body(fn: _Fn) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    defs (sync helpers are allowed to block; nested async defs get their
+    own visit from the module walk)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_async(fn: ast.AsyncFunctionDef, ctx: _Ctx) -> \
+        Iterator[Diagnostic]:
+    """FJ005/FJ006 over one async def's own body (nested defs pruned:
+    a sync helper is allowed to block — calling it from the coroutine
+    is a run_in_executor decision at the call site — and nested async
+    defs get their own visit from the module walk)."""
+    for node in _walk_own_body(fn):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            root_name = name.split(".")[0]
+            blocking = name == "time.sleep" \
+                or root_name in _BLOCKING_ROOTS \
+                or (root_name == name and name in ctx.blocking_aliases)
+            if blocking:
+                d = ctx.diag("FJ005", node,
+                             f"blocking call `{name}(...)` inside `async "
+                             f"def {fn.name}` stalls the event loop; use "
+                             f"asyncio primitives or run_in_executor")
+                if d:
+                    yield d
+        if isinstance(node, ast.With):
+            holds_lock = any(
+                "lock" in _dotted(item.context_expr.func).lower()
+                if isinstance(item.context_expr, ast.Call)
+                else "lock" in _dotted(item.context_expr).lower()
+                for item in node.items)
+            if holds_lock and any(isinstance(n, ast.Await)
+                                  for n in ast.walk(node)):
+                d = ctx.diag("FJ006", node,
+                             f"`await` while holding a threading lock in "
+                             f"`async def {fn.name}`: the lock is parked "
+                             f"across a scheduling point and sync writers "
+                             f"sharing it deadlock; release before "
+                             f"awaiting or use an asyncio.Lock")
+                if d:
+                    yield d
+
+
+def hygiene_lint_source(source: str, path: str = "<string>") -> \
+        list[Diagnostic]:
+    """Run every FJ rule over one Python source text."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []        # selflint's syntax check owns parse failures
+    ctx = _Ctx(path, source, tree)
+    out: list[Diagnostic] = []
+    # defs already covered by an enclosing jit root's traced-body walk:
+    # a jit root nested in a jit root must not be scanned twice
+    # (ast.walk is breadth-first, so outer roots are seen first)
+    covered: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            # every async def gets its own FJ005/FJ006 scan; _check_async
+            # prunes nested defs, so nesting never double-reports
+            out.extend(_check_async(node, ctx))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_jit_root(node, ctx) \
+                and id(node) not in covered:
+            covered.update(
+                id(n) for n in ast.walk(node)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+            out.extend(_check_traced_body(node, ctx))
+    out.sort(key=lambda d: (d.file or "", d.line, d.col, d.code))
+    return out
+
+
+def iter_python_files(roots: list[str]) -> list[str]:
+    out: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, f) for f in filenames
+                       if f.endswith(".py"))
+    return sorted(out)
+
+
+def hygiene_lint_paths(roots: list[str],
+                       rel_to: Optional[str] = None) -> list[Diagnostic]:
+    """Run the FJ rules over files/directories; paths in diagnostics are
+    relative to `rel_to` when given (CI-stable spans)."""
+    out: list[Diagnostic] = []
+    for path in iter_python_files(roots):
+        rel = os.path.relpath(path, rel_to) if rel_to else path
+        with open(path, encoding="utf-8") as f:
+            out.extend(hygiene_lint_source(f.read(), rel))
+    return out

@@ -595,12 +595,17 @@ def _run_lint(args, *, fmt: str = "text", strict: bool = False) -> int:
     try:
         root = find_project_root(getattr(args, "project_root", None))
     except ConfigNotFound:
+        # machine consumers always get a parseable document on stdout —
+        # a SARIF uploader fed an empty file fails on the parse, not the
+        # verdict
         if fmt == "json":
-            # machine consumers always get a JSON document on stdout
             print(json.dumps({"ok": False, "errors": 0, "warnings": 0,
                               "strict": strict, "diagnostics": [],
                               "reason": "no fleet config found "
                                         "(.fleetflow/fleet.kdl)"}))
+        elif fmt == "sarif":
+            from ..lint.sarif import to_sarif
+            print(json.dumps(to_sarif([]), indent=2))
         print("no fleet config found (.fleetflow/fleet.kdl). "
               "run `fleet init` to create one.", file=sys.stderr)
         return 2
@@ -609,6 +614,12 @@ def _run_lint(args, *, fmt: str = "text", strict: bool = False) -> int:
     # INFO diagnostics (e.g. FF014 bucket-waste advisories) never gate,
     # even under --strict: they report tuning opportunities, not defects
     failing = bool(errors or (strict and warnings))
+    if fmt == "sarif":
+        # SARIF 2.1.0 so CI (GitHub code scanning et al.) can annotate
+        # PRs with the exact spans; exit contract unchanged
+        from ..lint.sarif import to_sarif
+        print(json.dumps(to_sarif(res.diagnostics), indent=2))
+        return 1 if failing else 0
     if fmt == "json":
         print(json.dumps({
             "ok": not failing,
@@ -643,6 +654,106 @@ def cmd_validate(args) -> int:
     # plus everything the solver could never tell it (spans, codes, the
     # structural rule set)
     return _run_lint(args, fmt="text", strict=False)
+
+
+def cmd_audit(args) -> int:
+    """Static analysis over the CODEBASE (not the fleet config): the
+    compile-contract auditor and the JAX/async hygiene linter
+    (docs/guide/15-static-analysis.md)."""
+    if args.audit_cmd == "kernels":
+        return _audit_kernels(args)
+    return _audit_hygiene(args)
+
+
+def _audit_kernels(args) -> int:
+    """Lower every registered hot-path executable and hold the artifact
+    to the pinned compile contract: donation aliasing, output shardings,
+    host-callback purity, and the static-argument (recompile-axis) set.
+
+    Exit contract: 0 = contract holds, 1 = violations or contract drift,
+    2 = contract file missing/unreadable (run with --update to create)."""
+    # the mesh kernels need >= 8 devices; on a CPU-default platform (or
+    # under FLEET_FORCE_CPU) arrange the virtual mesh BEFORE jax inits —
+    # the same 8-device virtual CPU platform the tier-1 suite runs on
+    from .. import platform as plat
+    if os.environ.get("FLEET_FORCE_CPU") == "1" \
+            or os.environ.get("JAX_PLATFORMS", "").strip() in ("", "cpu"):
+        plat.force_cpu(8)
+    from ..analysis.auditor import (audit_kernels, contract_diff,
+                                    default_contract_path, render_contract)
+    contract_path = args.contract or default_contract_path()
+    report = audit_kernels()
+    for s in report.skipped:
+        print(f"audit: skipped {s}", file=sys.stderr)
+    if report.skipped and not args.allow_skips:
+        print("audit: kernels skipped (insufficient devices); rerun with "
+              "FLEET_FORCE_CPU=1 or --allow-skips", file=sys.stderr)
+        return 1
+    for v in report.violations:
+        print(f"audit: VIOLATION {v}", file=sys.stderr)
+    if args.update:
+        if report.violations:
+            print("audit: refusing to pin a contract with live "
+                  "violations", file=sys.stderr)
+            return 1
+        with open(contract_path, "w", encoding="utf-8") as f:
+            f.write(render_contract(report))
+        print(f"audit: contract written to {contract_path}")
+        return 0
+    try:
+        with open(contract_path, encoding="utf-8") as f:
+            pinned = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"audit: cannot read contract file {contract_path}: {e}\n"
+              f"       (generate it with `fleet audit kernels --update`)",
+              file=sys.stderr)
+        return 2
+    drift = contract_diff(report, pinned)
+    for d in drift:
+        print(f"audit: CONTRACT DRIFT {d}", file=sys.stderr)
+    if report.violations or drift:
+        print(f"audit: {len(report.violations)} violation(s), "
+              f"{len(drift)} contract drift(s). If the change is "
+              f"intentional, regenerate with `fleet audit kernels "
+              f"--update` and review the golden diff.", file=sys.stderr)
+        return 1
+    n = sum(len(k["tiers"]) for k in report["kernels"].values())
+    print(f"compile contract holds: {len(report['kernels'])} kernel(s) "
+          f"x {n} lowered case(s), 0 violations, 0 drift")
+    return 0
+
+
+def _audit_hygiene(args) -> int:
+    """Run the FJ001+ JAX/async hygiene rules over solver/ and cp/ (or
+    explicit paths). Exit 0 = clean (warnings allowed unless --strict),
+    1 = findings at the gating severity."""
+    from ..analysis import hygiene_lint_paths
+    from ..lint import Severity, severity_counts
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.paths or [os.path.join(pkg_root, "solver"),
+                           os.path.join(pkg_root, "cp")]
+    diags = hygiene_lint_paths(roots, rel_to=os.getcwd())
+    errors, warnings = severity_counts(diags)
+    failing = bool(errors or (args.strict and warnings))
+    if args.format == "json":
+        print(json.dumps({
+            "ok": not failing, "errors": errors, "warnings": warnings,
+            "diagnostics": [d.to_dict() for d in diags]}, indent=2))
+        return 1 if failing else 0
+    if args.format == "sarif":
+        from ..lint.sarif import to_sarif
+        print(json.dumps(to_sarif(diags, tool="fleet-audit-hygiene"),
+                         indent=2))
+        return 1 if failing else 0
+    for d in diags:
+        stream = sys.stderr if d.severity is Severity.ERROR else sys.stdout
+        print(d.format(), file=stream)
+    if failing:
+        print(f"hygiene: {errors} error(s), {warnings} warning(s)",
+              file=sys.stderr)
+        return 1
+    print(f"hygiene clean ({errors} error(s), {warnings} warning(s))")
+    return 0
 
 
 def cmd_solve(args) -> int:
@@ -1457,11 +1568,40 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("lint", help="static analysis of the fleet config "
                                     "(coded diagnostics with source spans)")
     stage_args(p, positional=False)
-    p.add_argument("--format", choices=["text", "json"], default="text",
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text",
                    help="diagnostic output format (default: text)")
     p.add_argument("--strict", action="store_true",
                    help="treat warnings as errors (exit 1)")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("audit", help="static analysis of the CODEBASE: "
+                       "compile contracts + JAX/async hygiene "
+                       "(docs/guide/15-static-analysis.md)")
+    auds = p.add_subparsers(dest="audit_cmd", required=True)
+    q = auds.add_parser("kernels", help="lower the hot-path executables "
+                        "and check donation/sharding/purity/recompile-"
+                        "axis contracts against the pinned contract file")
+    q.add_argument("--contract",
+                   help="contract file (default: tests/goldens/"
+                        "compile_contract.json in the source checkout)")
+    q.add_argument("--update", action="store_true",
+                   help="regenerate the contract file from this tree "
+                        "(review the diff: every change is a recompile "
+                        "axis, a donation, or a layout)")
+    q.add_argument("--allow-skips", action="store_true",
+                   help="tolerate kernels skipped for lack of devices")
+    q.set_defaults(fn=cmd_audit)
+    q = auds.add_parser("hygiene", help="FJ001+ AST rules over solver/ "
+                        "and cp/ (host sync inside jit, blocking calls "
+                        "in async handlers, awaits under the store lock)")
+    q.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: solver/ and cp/)")
+    q.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
+    q.add_argument("--strict", action="store_true",
+                   help="treat warnings as errors (exit 1)")
+    q.set_defaults(fn=cmd_audit)
 
     p = sub.add_parser("validate", help="load config + check placements "
                                         "(delegates to `fleet lint`)")

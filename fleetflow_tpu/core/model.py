@@ -373,6 +373,13 @@ class PlacementPolicy:
     fallback_policy: Optional[FallbackPolicy] = None
     spread_constraint: Optional[SpreadConstraint] = None
     strategy: PlacementStrategy = PlacementStrategy.SPREAD_ACROSS_POOL
+    # the stage is aimed at the streaming admission path (deploy.submit,
+    # cp/admission.py): services arrive/depart continuously as bucketed
+    # micro-solves. Declaring it here gives static tooling the intent —
+    # lint rule FF015 warns pre-deploy about services the delta path
+    # must reject at runtime (ports/volumes/anti-affinity/coloc/deps,
+    # replicas > 1; docs/guide/14-streaming-admission.md)
+    streaming: bool = False
 
 
 # --------------------------------------------------------------------------

@@ -444,6 +444,10 @@ def _parse_placement(node: KdlNode) -> PlacementPolicy:
         elif c.name in ("fallback", "fallback_policy", "fallback-policy"):
             p.fallback_policy = FallbackPolicy(relax_order=_str_args(c)
                                                or FallbackPolicy().relax_order)
+        elif c.name == "streaming":
+            # `streaming #true` — the stage feeds deploy.submit (the
+            # continuous-arrival path); lint FF015 keys on this
+            p.streaming = _as_bool(c.arg(0, True), c)
     return p
 
 

@@ -247,6 +247,7 @@ def _policy_to_dict(p: PlacementPolicy) -> dict:
         d["spread_constraint"] = {"topology_key": p.spread_constraint.topology_key,
                                   "max_skew": p.spread_constraint.max_skew}
     _put(d, "strategy", p.strategy.value, PlacementStrategy.SPREAD_ACROSS_POOL.value)
+    _put(d, "streaming", p.streaming, False)
     return d
 
 
@@ -273,6 +274,7 @@ def _policy_from_dict(d: dict) -> PlacementPolicy:
         resource_quota=quota, fallback_policy=fallback,
         spread_constraint=spread,
         strategy=PlacementStrategy(d.get("strategy", "spread_across_pool")),
+        streaming=d.get("streaming", False),
     )
 
 

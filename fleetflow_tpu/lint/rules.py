@@ -30,6 +30,10 @@ Codes are stable (never renumber; retire by leaving a gap):
   FF014  info     placement bucket waste: the stage's service-row count
                   sits just past a solver bucket boundary, so bucketed
                   solves (solver/buckets.py) pad heavily — advisory only
+  FF015  warning  non-streamable service in a `placement { streaming }`
+                  stage: ports/volumes/anti-affinity/coloc/deps or
+                  replicas>1 can't ride the streaming delta path;
+                  deploy.submit sheds it at runtime (cp/admission.py)
 
 Rules are pure functions over a :class:`LintContext`; `scope` says what
 they iterate ("flow" once, "stage" per stage) and `structural=True` marks
@@ -520,6 +524,36 @@ def check_placement_prelint(r: Rule, ctx: LintContext, stage: Stage):
     yield ctx.diag(r, msg, loc=stage.loc, stage=stage,
                    hint="`fleet cp placement explain` breaks down any "
                         "single service in full")
+
+
+@rule("FF015", "non-streamable-service", Severity.WARNING, "stage",
+      structural=True)
+def check_non_streamable(r: Rule, ctx: LintContext, stage: Stage):
+    """A stage declared `placement { streaming #true }` (aimed at the
+    deploy.submit continuous-arrival path) carries services the streaming
+    delta path must reject at runtime: ports, volumes, anti-affinity,
+    colocation, dependencies, or replicas > 1 all bring hard-constraint
+    ids or multi-row shapes the resident delta kernel cannot express
+    (solver/resident._arrivals_compatible), so cp/admission.py sheds them
+    with AdmissionRejected mid-stream — this is the pre-deploy signal."""
+    if stage.placement is None or not stage.placement.streaming:
+        return
+    # the SAME predicate the CP applies at submit time (cp/admission.py)
+    # — lint must never drift from what the runtime actually rejects
+    from ..cp.admission import _simple_reject
+
+    for svc in ctx.container_services(stage):
+        why = _simple_reject(svc)
+        if why is None:
+            continue
+        yield ctx.diag(
+            r, f"service {svc.name!r} cannot ride the streaming delta "
+               f"path ({why}); deploy.submit will reject it at runtime "
+               f"(AdmissionRejected)",
+            loc=svc.loc, stage=stage,
+            hint="route constrained services through deploy.execute, or "
+                 "drop the constraint "
+                 "(docs/guide/14-streaming-admission.md)")
 
 
 @rule("FF014", "placement-bucket-waste", Severity.INFO, "stage")

@@ -190,6 +190,7 @@ class ResidentProblem:
         self._cap_fp: Optional[np.ndarray] = None
         self._delta_ms: float = 0.0
         self._scalars: dict[tuple, tuple] = {}
+        self._staged_fp: tuple = (None, None)
         self.cold_stage(pt)
 
     # -- staging -----------------------------------------------------------
@@ -281,13 +282,15 @@ class ResidentProblem:
                 return False
         return True
 
-    def apply_delta(self, pt, delta: Optional[ProblemDelta] = None) -> float:
-        """Merge churn into the resident buffers on device; returns the
-        delta-staging wall ms (also accumulated for the next solve's
-        `delta_stage_ms` timing). The caller has already checked
-        `compatible`; node_valid/capacity always re-upload from `pt` (a few
-        KB — the (S, N) problem planes are what never move)."""
-        t0 = time.perf_counter()
+    def merge_inputs(self, pt, delta: Optional[ProblemDelta] = None):
+        """Stage the per-burst merge-kernel inputs for `delta`: returns
+        ``(uploads, n_real, has_demand, has_eligible)`` where `uploads`
+        is the device-staged small tuple the merge kernel consumes after
+        ``(prob, assignment)``. Split out of :meth:`apply_delta` so the
+        compile-contract auditor (solver/contracts.py) can lower the
+        EXACT argument shapes the production dispatch uses — not a
+        hand-built approximation that would drift. Mutates `self.n_real`
+        when the delta bumps it (the staging is the commit point)."""
         delta = delta or ProblemDelta()
         S = self.prob.S
         R = self.prob.demand.shape[1]
@@ -322,10 +325,27 @@ class ResidentProblem:
             self.n_real = int(delta.n_real)
         n_real = self._put_n_real()
 
-        # explicit small uploads, then ONE donated merge dispatch; the
-        # warm solve after this runs with everything already resident
+        # explicit small uploads; the warm solve after the merge runs
+        # with everything already resident
         uploads = self._put_small(
             (valid, cap, dem_idx, dem_val, elig_idx, elig_rows))
+        # host fingerprints adopted by apply_delta AFTER a successful
+        # merge (drifted() must keep matching the pre-merge staging when
+        # the merge fails and cold_stage recovers)
+        self._staged_fp = (valid, cap)
+        return uploads, n_real, has_demand, has_eligible
+
+    def apply_delta(self, pt, delta: Optional[ProblemDelta] = None) -> float:
+        """Merge churn into the resident buffers on device; returns the
+        delta-staging wall ms (also accumulated for the next solve's
+        `delta_stage_ms` timing). The caller has already checked
+        `compatible`; node_valid/capacity always re-upload from `pt` (a few
+        KB — the (S, N) problem planes are what never move)."""
+        t0 = time.perf_counter()
+        uploads, n_real, has_demand, has_eligible = self.merge_inputs(
+            pt, delta)
+        valid, cap = self._staged_fp
+        # ONE donated merge dispatch
         try:
             self.prob, self.assignment = self._merge()(
                 self.prob, self.assignment, *uploads, n_real,

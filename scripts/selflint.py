@@ -18,6 +18,12 @@ beats exhaustiveness for a gate):
   unused-import   a module-level import whose root name is never read
                   anywhere in the file (skipped in __init__.py re-export
                   surfaces; honors __all__ strings and `# noqa` lines)
+  FJ001+          the JAX/async hygiene rules (fleetflow_tpu/analysis/
+                  hygiene.py — stdlib-only by design, so this gate stays
+                  dependency-free) over solver/ and cp/: host sync inside
+                  jit, numpy/env reads in traced code, blocking calls in
+                  async handlers, awaits under the store lock. ERROR-
+                  severity findings gate; warnings print but don't.
 
 Exit 0 clean, 1 findings (one per line: path:line: code message).
 """
@@ -183,6 +189,29 @@ def check_unused_imports(path: str, tree: ast.Module,
     return out
 
 
+def check_hygiene() -> tuple[list[str], int]:
+    """The FJ001+ pass over solver/ and cp/. Returns (gating findings,
+    warning count) — warnings print to stderr but never gate, the same
+    contract `fleet audit hygiene` (without --strict) applies."""
+    sys.path.insert(0, REPO)
+    try:
+        from fleetflow_tpu.analysis.hygiene import hygiene_lint_paths
+        from fleetflow_tpu.lint.diagnostics import Severity
+    except Exception as e:         # pragma: no cover - package broken
+        return [f"fleetflow_tpu/analysis: hygiene pass unavailable "
+                f"({e})"], 0
+    diags = hygiene_lint_paths(
+        [os.path.join(REPO, "fleetflow_tpu", "solver"),
+         os.path.join(REPO, "fleetflow_tpu", "cp")], rel_to=REPO)
+    gating = [d.format() for d in diags if d.severity is Severity.ERROR]
+    warnings = 0
+    for d in diags:
+        if d.severity is not Severity.ERROR:
+            warnings += 1
+            print(d.format(), file=sys.stderr)
+    return gating, warnings
+
+
 def main() -> int:
     findings: list[str] = []
     for path in iter_py_files():
@@ -195,9 +224,12 @@ def main() -> int:
             continue
         findings.extend(check_undefined(rel, tree))
         findings.extend(check_unused_imports(rel, tree, source))
+    hygiene, hygiene_warnings = check_hygiene()
+    findings.extend(hygiene)
     for f in findings:
         print(f)
-    print(f"selflint: {len(findings)} finding(s) over "
+    print(f"selflint: {len(findings)} finding(s) "
+          f"({hygiene_warnings} hygiene warning(s)) over "
           f"{len(iter_py_files())} files", file=sys.stderr)
     return 1 if findings else 0
 

@@ -1,0 +1,381 @@
+"""Compile-contract auditor + JAX/async hygiene (fleetflow_tpu/analysis).
+
+Two proof obligations, mirroring the chaos-invariant canary discipline:
+
+  1. the UNMODIFIED tree passes: the full audit over the registered
+     hot-path kernels reports zero violations and zero drift against the
+     pinned contract file (tests/goldens/compile_contract.json), and the
+     hygiene rules find nothing in solver/ or cp/.
+
+  2. every contract class has a failing world: a deliberately-broken
+     kernel variant — donation dropped, host callback inserted, output
+     sharding lost, static argument added — MUST fail the auditor. An
+     auditor whose canaries pass is not checking anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fleetflow_tpu.analysis.auditor import (audit_case, audit_kernels,
+                                            contract_diff,
+                                            default_contract_path,
+                                            render_contract)
+from fleetflow_tpu.analysis.hygiene import (hygiene_lint_paths,
+                                            hygiene_lint_source)
+from fleetflow_tpu.analysis.jitspec import extract_jit_decl
+from fleetflow_tpu.lint import Severity
+from fleetflow_tpu.solver.contracts import (KernelCase, KernelContract,
+                                            hot_path_kernels)
+
+PKG = os.path.dirname(os.path.abspath(
+    __import__("fleetflow_tpu").__file__))
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+
+
+# --------------------------------------------------------------------------
+# the healthy tree: full audit == pinned contract
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def report():
+    _need_devices(8)
+    return audit_kernels()
+
+
+class TestContractHolds:
+    def test_no_intrinsic_violations(self, report):
+        assert report.violations == []
+        assert report.skipped == []
+
+    def test_matches_pinned_contract(self, report):
+        with open(default_contract_path(), encoding="utf-8") as f:
+            pinned = json.load(f)
+        assert contract_diff(report, pinned) == []
+
+    def test_render_roundtrip(self, report):
+        doc = json.loads(render_contract(report))
+        assert contract_diff(report, doc) == []
+
+    def test_every_registered_kernel_audited(self, report):
+        assert set(report["kernels"]) == {
+            c.name for c in hot_path_kernels()}
+        for entry in report["kernels"].values():
+            assert len(entry["tiers"]) >= 2   # representative tiers
+
+    def test_merge_kernels_alias_their_planes(self, report):
+        """The perf story itself: every (S, .) plane and the assignment
+        of both merge kernels must be reused in place."""
+        for name in ("resident.merge", "sharded.merge"):
+            for tier, rec in report["kernels"][name]["tiers"].items():
+                for leaf in ("prob.demand", "prob.eligible", "assignment"):
+                    assert leaf in rec["aliased"], (name, tier, leaf)
+
+
+# --------------------------------------------------------------------------
+# canaries: one broken world per contract class
+# --------------------------------------------------------------------------
+
+def _case(fn, args, kwargs=None, arg_names=("x", "y"),
+          out_shardings=None):
+    return KernelCase(tier="8x4", fn=fn, args=args, kwargs=kwargs or {},
+                      arg_names=arg_names, out_shardings=out_shardings)
+
+
+class TestCanaries:
+    def test_dropped_donation_fails(self):
+        """The same update-in-place shape as the merge kernel, jitted
+        WITHOUT donate_argnums: the must-alias check has to fire."""
+        def merge(x, rows):
+            return x.at[rows].set(0.0)
+
+        good = jax.jit(merge, donate_argnums=(0,))
+        bad = jax.jit(merge)
+        contract = KernelContract(
+            name="canary.merge", module="", qualname="",
+            cases=lambda: [], must_alias=("x",))
+        args = (jnp.ones((16, 3)), jnp.arange(4))
+        rec, violations = audit_case(contract, _case(good, args,
+                                                     arg_names=("x",
+                                                                "rows")))
+        assert violations == [] and rec["aliased"] == ["x"]
+        rec, violations = audit_case(contract, _case(bad, args,
+                                                     arg_names=("x",
+                                                                "rows")))
+        assert rec["donated"] == [] and rec["aliased"] == []
+        assert any("not aliased" in v and "x" in v for v in violations)
+
+    def test_host_callback_fails(self):
+        """A smuggled pure_callback must trip the purity check."""
+        def clean(x):
+            return x * 2
+
+        def dirty(x):
+            host = jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return host + 1
+
+        contract = KernelContract(name="canary.purity", module="",
+                                  qualname="", cases=lambda: [])
+        args = (jnp.ones((8,)),)
+        _rec, violations = audit_case(
+            contract, _case(jax.jit(clean), args, arg_names=("x",)))
+        assert violations == []
+        rec, violations = audit_case(
+            contract, _case(jax.jit(dirty), args, arg_names=("x",)))
+        assert rec["host_callbacks"]
+        assert any("host-callback" in v for v in violations)
+
+    def test_lost_output_sharding_fails(self):
+        """Declared P('svc') output that actually compiles replicated
+        (constraint dropped) must trip the sharding check."""
+        _need_devices(4)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("svc",))
+        svc = NamedSharding(mesh, P("svc"))
+        rep = NamedSharding(mesh, P())
+
+        def keeps(x):
+            return jax.lax.with_sharding_constraint(x * 2, svc)
+
+        def loses(x):
+            # an all-reduce style rewrite that silently de-shards
+            return jax.lax.with_sharding_constraint(x * 2, rep)
+
+        contract = KernelContract(name="canary.shard", module="",
+                                  qualname="", cases=lambda: [])
+        x = jax.device_put(jnp.arange(16.0), svc)
+        decl = {"out": "P('svc')"}
+        _rec, violations = audit_case(
+            contract, _case(jax.jit(keeps), (x,), arg_names=("x",),
+                            out_shardings=decl))
+        assert violations == []
+        rec, violations = audit_case(
+            contract, _case(jax.jit(loses), (x,), arg_names=("x",),
+                            out_shardings=decl))
+        assert rec["output_shardings"] == {"out": "P()"}
+        assert any("output sharding" in v for v in violations)
+
+    def test_extra_static_arg_is_contract_drift(self, report):
+        """Adding a recompile axis to a kernel's jit declaration must
+        surface as drift against the pinned contract — simulated by
+        pinning a contract missing the new axis."""
+        with open(default_contract_path(), encoding="utf-8") as f:
+            pinned = json.load(f)
+        entry = pinned["kernels"]["refine.warm"]
+        entry["static_args"] = [a for a in entry["static_args"]
+                                if a != "steps"]
+        drift = contract_diff(report, pinned)
+        assert any("refine.warm" in d and "static args" in d
+                   for d in drift)
+
+    def test_new_static_problem_field_is_contract_drift(self, report):
+        with open(default_contract_path(), encoding="utf-8") as f:
+            pinned = json.load(f)
+        pinned["problem_static_fields"].append("new_axis")
+        drift = contract_diff(report, pinned)
+        assert any("problem_static_fields" in d for d in drift)
+
+    def test_unregistered_kernel_is_contract_drift(self, report):
+        with open(default_contract_path(), encoding="utf-8") as f:
+            pinned = json.load(f)
+        pinned["kernels"]["ghost.kernel"] = {"static_args": [],
+                                             "donated_params": [],
+                                             "tiers": {}}
+        drift = contract_diff(report, pinned)
+        assert any("ghost.kernel" in d for d in drift)
+
+
+# --------------------------------------------------------------------------
+# jitspec: AST extraction is ground truth
+# --------------------------------------------------------------------------
+
+class TestJitSpec:
+    def test_extracts_decorator_form(self):
+        src = ('from functools import partial\nimport jax\n'
+               '@partial(jax.jit, static_argnames=("b", "a"),\n'
+               '         donate_argnums=(0,))\n'
+               'def f(x, y, *, a, b):\n    return x\n')
+        d = extract_jit_decl(src, "f")
+        assert d.static_args == ["a", "b"]
+        assert d.donated_params == ["x"]
+
+    def test_extracts_call_form(self):
+        src = ('import jax\n'
+               'def maker():\n'
+               '    def merge(prob, assignment, n):\n'
+               '        return prob, assignment\n'
+               '    return jax.jit(merge, donate_argnums=(0, 1),\n'
+               '                   static_argnames=("n",))\n')
+        d = extract_jit_decl(src, "maker.merge")
+        assert d.static_args == ["n"]
+        assert d.donated_params == ["assignment", "prob"]
+
+    def test_missing_anchor_raises(self):
+        with pytest.raises(LookupError):
+            extract_jit_decl("def f():\n    pass\n", "g")
+        with pytest.raises(LookupError):
+            # found but not jitted: must fail loudly, not pass vacuously
+            extract_jit_decl("def f():\n    pass\n", "f")
+
+    @pytest.mark.parametrize("module,qualname,expect_static", [
+        ("solver/resident.py", "_merge_fn.merge",
+         ["has_demand", "has_eligible"]),
+        ("solver/sharded.py", "anneal_sharded",
+         ["adaptive", "block", "exchange_every", "mesh",
+          "proposals_per_step", "return_stats", "return_sweeps",
+          "steps"]),
+    ])
+    def test_real_anchors_resolve(self, module, qualname, expect_static):
+        path = os.path.join(PKG, module)
+        with open(path, encoding="utf-8") as f:
+            d = extract_jit_decl(f.read(), qualname, path)
+        assert d.static_args == expect_static
+
+
+# --------------------------------------------------------------------------
+# hygiene: FJ rules fire on broken worlds, stay silent on the tree
+# --------------------------------------------------------------------------
+
+_JIT_HEADER = ("import jax, os, time\nimport numpy as np\n"
+               "from functools import partial\n"
+               '@partial(jax.jit, static_argnames=("flag",))\n')
+
+
+def _codes(src):
+    return [d.code for d in hygiene_lint_source(src, "t.py")]
+
+
+class TestHygieneRules:
+    def test_fj001_item_in_jit(self):
+        src = _JIT_HEADER + "def f(x, *, flag):\n    return x.item()\n"
+        assert _codes(src) == ["FJ001"]
+
+    def test_fj002_cast_on_tracer_but_not_static(self):
+        src = _JIT_HEADER + ("def f(x, *, flag):\n"
+                             "    a = float(x)\n"
+                             "    b = float(flag)\n"   # static: allowed
+                             "    return a + b\n")
+        assert _codes(src) == ["FJ002"]
+
+    def test_fj003_numpy_compute_but_not_dtypes(self):
+        src = _JIT_HEADER + ("def f(x, *, flag):\n"
+                             "    a = np.sum(x)\n"
+                             "    dt = np.float32\n"   # dtype: allowed
+                             "    return a\n")
+        assert _codes(src) == ["FJ003"]
+
+    def test_fj004_env_read(self):
+        src = _JIT_HEADER + ("def f(x, *, flag):\n"
+                             "    if os.environ.get('FLEET_X'):\n"
+                             "        return x\n"
+                             "    return x + int(os.getenv('Y') or 0)\n")
+        assert _codes(src) == ["FJ004", "FJ004"]
+
+    def test_fj005_blocking_in_async(self):
+        src = ("import time\nasync def h(req):\n"
+               "    time.sleep(1)\n    return req\n")
+        assert _codes(src) == ["FJ005"]
+
+    def test_fj005_from_import_sleep(self):
+        """`from time import sleep` must be caught too — the dotted-name
+        match alone can't see it."""
+        src = ("from time import sleep\nasync def h(req):\n"
+               "    sleep(1)\n    return req\n")
+        assert _codes(src) == ["FJ005"]
+        src = ("from subprocess import run\nasync def h(req):\n"
+               "    run(['ls'])\n    return req\n")
+        assert _codes(src) == ["FJ005"]
+
+    def test_fj005_sync_helper_exempt(self):
+        """A sync helper nested in the coroutine may block — whether to
+        executor it is the CALL site's problem, and only a direct
+        blocking call in the coroutine body is the hazard."""
+        src = ("import time\nasync def h(req):\n"
+               "    def helper():\n"
+               "        time.sleep(1)\n"
+               "    helper()\n    return req\n")
+        assert _codes(src) == []
+
+    def test_nested_roots_not_double_reported(self):
+        """A jit root nested in a jit root (and an async def nested in
+        an async def) must be scanned exactly once."""
+        src = ("import jax\n"
+               "@jax.jit\n"
+               "def outer(x):\n"
+               "    @jax.jit\n"
+               "    def inner(y):\n"
+               "        return y.item()\n"
+               "    return inner(x)\n")
+        assert _codes(src) == ["FJ001"]
+        src = ("import requests\n"
+               "async def outer(req):\n"
+               "    async def inner():\n"
+               "        requests.get('http://x')\n"
+               "    await inner()\n")
+        assert _codes(src) == ["FJ005"]
+
+    def test_fj006_await_under_lock(self):
+        src = ("async def h(self):\n"
+               "    with self._lock:\n"
+               "        await self.flush()\n")
+        assert _codes(src) == ["FJ006"]
+
+    def test_nested_defs_inside_jit_are_traced(self):
+        src = ("import jax\nimport numpy as np\n"
+               "def outer():\n"
+               "    def body(x):\n"
+               "        return np.square(x)\n"
+               "    return jax.jit(body)\n")
+        assert _codes(src) == ["FJ003"]
+
+    def test_host_callback_subtree_exempt(self):
+        src = ("import jax\nimport numpy as np\n"
+               "@jax.jit\n"
+               "def f(x):\n"
+               "    def cb(v):\n"
+               "        return np.asarray(v) * 2\n"
+               "    return jax.pure_callback(\n"
+               "        cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x)\n")
+        assert _codes(src) == []
+
+    def test_noqa_suppresses(self):
+        src = _JIT_HEADER + ("def f(x, *, flag):\n"
+                             "    return x.item()  # noqa: FJ001\n")
+        assert _codes(src) == []
+
+    def test_plain_functions_not_traced(self):
+        src = ("import numpy as np\nimport os\n"
+               "def f(x):\n"
+               "    return np.sum(x) + int(os.getenv('Y') or 0)\n")
+        assert _codes(src) == []
+
+    def test_syntax_error_returns_nothing(self):
+        assert hygiene_lint_source("def f(:\n", "t.py") == []
+
+    def test_severities_ride_lint_machinery(self):
+        src = _JIT_HEADER + "def f(x, *, flag):\n    return x.item()\n"
+        d = hygiene_lint_source(src, "t.py")[0]
+        assert d.severity is Severity.ERROR
+        assert d.file == "t.py" and d.line == 6
+        assert "t.py:6:" in d.format()
+
+
+class TestHygieneTreeClean:
+    def test_solver_and_cp_are_clean(self):
+        """The production tree holds its own bar (anything here is a real
+        finding: fix it or `# noqa: FJ00x` it with a reason)."""
+        diags = hygiene_lint_paths(
+            [os.path.join(PKG, "solver"), os.path.join(PKG, "cp")])
+        assert diags == [], "\n".join(d.format() for d in diags)

@@ -397,6 +397,67 @@ class TestCliLint:
 
 
 # --------------------------------------------------------------------------
+# SARIF output (--format sarif): CI annotation surfaces speak it
+# --------------------------------------------------------------------------
+
+class TestSarif:
+    def test_roundtrip_on_existing_fixture(self):
+        """Lint a fixture, render SARIF, parse it back: every diagnostic
+        the `// expect:` header pins must survive with its exact code,
+        level, and span — the annotation a CI surface would post."""
+        from fleetflow_tpu.lint.sarif import to_sarif
+        path = os.path.join(FIXTURES, "ff002_unknown_depends_on.kdl")
+        expected = _expectations(path)
+        res = lint_text(open(path, encoding="utf-8").read(),
+                        os.path.basename(path))
+        doc = json.loads(json.dumps(to_sarif(res.diagnostics)))
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "fleet-lint"
+        got = []
+        level_to_sev = {"error": "error", "warning": "warning",
+                        "note": "info"}
+        for r in run["results"]:
+            region = r["locations"][0]["physicalLocation"]["region"]
+            got.append((r["ruleId"], level_to_sev[r["level"]],
+                        region["startLine"], region["startColumn"]))
+        assert sorted(got) == sorted(expected)
+        # rules cataloged once with stable ids
+        ids = [ru["id"] for ru in run["tool"]["driver"]["rules"]]
+        assert ids == sorted(set(ids)) or len(set(ids)) == len(ids)
+
+    def test_cli_sarif_format(self, project, capsys):
+        from fleetflow_tpu.cli.main import main
+        root, write = project
+        write("services/bad.kdl",
+              'service "x" { image "i"; depends_on "nope" }\n'
+              'stage "local" { service "x" }\n')
+        rc = main(["--project-root", str(root), "lint",
+                   "--format", "sarif"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        results = doc["runs"][0]["results"]
+        assert any(r["ruleId"] == "FF002" and r["level"] == "error"
+                   for r in results)
+        uri = results[0]["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"]
+        assert uri.endswith("services/bad.kdl")
+
+    def test_cli_sarif_no_config_still_emits_document(self, tmp_path,
+                                                      capsys):
+        """Same contract as --format json: every exit path produces a
+        parseable document, or the CI uploader chokes on an empty file
+        instead of seeing the verdict."""
+        from fleetflow_tpu.cli.main import main
+        rc = main(["--project-root", str(tmp_path), "lint",
+                   "--format", "sarif"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert doc["version"] == "2.1.0"
+        assert doc["runs"][0]["results"] == []
+
+
+# --------------------------------------------------------------------------
 # diagnostics plumbing
 # --------------------------------------------------------------------------
 

@@ -216,6 +216,26 @@ stage "live" {
         assert p.spread_constraint.topology_key == "region"
         assert p.spread_constraint.max_skew == 2
         assert p.fallback_policy.relax_order == ["preferred_labels", "spread"]
+        assert p.streaming is False
+
+    def test_placement_streaming_flag(self):
+        """`streaming #true` marks a stage for deploy.submit; it must
+        round-trip the serializer (the CP ships stages as dicts)."""
+        from fleetflow_tpu.core.serialize import (stage_from_dict,
+                                                  stage_to_dict)
+        flow = parse_kdl_string('''
+stage "live" {
+    placement { streaming #true }
+}
+''')
+        st = flow.stages["live"]
+        assert st.placement.streaming is True
+        rt = stage_from_dict(stage_to_dict(st))
+        assert rt.placement.streaming is True
+        # absent by default, and absent from the serialized dict
+        flow2 = parse_kdl_string('stage "s" { placement { tier "t" } }')
+        d = stage_to_dict(flow2.stages["s"])
+        assert "streaming" not in d["placement"]
 
 
 class TestTopLevel:
