@@ -114,9 +114,35 @@ def _timed_runs(solve_once, reps: int):
     return runs, results, order, order[(reps - 1) // 2]
 
 
+def _default_caches() -> None:
+    """Thread the persistent caches into the DEFAULT bench run: r06 showed
+    the headline pipeline leg with compile_cache/enabled: false, so the
+    published numbers never benefited from the warm-path work. The bench
+    now runs the production recipe — FLEET_COMPILE_CACHE (XLA binaries)
+    and FLEET_PARSE_CACHE (parsed Flow fragments) under ~/.cache — unless
+    the operator set the knobs explicitly or BENCH_NO_CACHES=1 asks for a
+    bare run. BENCH_CACHES_DEFAULTED marks the values as bench-supplied so
+    the cold/warm child leg knows to use fresh throwaway dirs instead
+    (its POINT is the cold->warm contrast)."""
+    if os.environ.get("BENCH_NO_CACHES", "").lower() in ("1", "true", "on"):
+        return
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+    defaulted = []
+    for var, sub in (("FLEET_COMPILE_CACHE", "xla"),
+                     ("FLEET_PARSE_CACHE", "parse")):
+        if not os.environ.get(var, "").strip():
+            os.environ[var] = os.path.join(root, "fleetflow", sub)
+            defaulted.append(var)
+    if defaulted:
+        # names the vars the bench supplied, so the cold/warm leg swaps
+        # ONLY those for throwaway dirs and honors operator-set ones
+        os.environ["BENCH_CACHES_DEFAULTED"] = ",".join(defaulted)
+
+
 def main() -> None:
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (1000, 100) if small else (10000, 1000)
+    _default_caches()
 
     # Decide the platform BEFORE any jax device use; never hang, never die
     # on a broken tunnel (round-1 failure mode: rc=1 inside device_put).
@@ -655,10 +681,16 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
     from fleetflow_tpu.registry.aggregate import FlowCache, aggregate_fleets
     from fleetflow_tpu.solver import prepare_problem, solve
 
+    import hashlib
+
     F = 8                                   # tenant fleets in the registry
     texts, reg, loader, parse_box, kdl_bytes = _gen_registry(S, N, F)
     cache = FlowCache()
-    versions = {n: "v1" for n in texts}
+    # CONTENT hashes, not version labels: the lowered-instance cache
+    # persists to the (bench-defaulted, shared) FLEET_PARSE_CACHE dir, and
+    # a content-independent key would serve a previous run's tensors
+    versions = {n: hashlib.sha256(t.encode()).hexdigest()
+                for n, t in texts.items()}
 
     parse_before = parse_box[0]      # servers parse happened in _gen_registry
     t1 = time.perf_counter()
@@ -713,7 +745,7 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
     # re-lowers exactly that fleet
     for name, text in texts2.items():
         if texts[name] != text:
-            versions[name] = "v2"
+            versions[name] = hashlib.sha256(text.encode()).hexdigest()
     parse2_before = parse2_box[0]
     t7 = time.perf_counter()
     pt2, _ = aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
@@ -737,11 +769,11 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
     # solve is dispatched, the changed fleets re-lower on the host WHILE
     # the device anneals, then the result is fetched. wall_ms vs
     # solve-only + relower-only shows how much host work the anneal hid.
-    texts3, _reg3, loader3, _parse3, _ = _gen_registry(
+    texts3, _reg3, loader3, parse3_box, _ = _gen_registry(
         S, N, F, trim_fleet="t1", trim_by=13)
     for name, text in texts3.items():
         if texts2[name] != text:
-            versions[name] = "v3"
+            versions[name] = hashlib.sha256(text.encode()).hexdigest()
     box: dict = {}
 
     def _relower():
@@ -758,6 +790,53 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
                      proposals_per_step=proposals, bucket=True,
                      overlap_host_work=_relower)
         overlap_wall_ms = (time.perf_counter() - t9) * 1e3
+
+    # ---- warm front end (ISSUE 12 acceptance): every cache hot ----------
+    # Re-run parse -> aggregate -> stage for the UNCHANGED registry in the
+    # same process. Leg A (reparse) bypasses the FlowCache so the
+    # content-addressed parse cache itself is exercised (hit counters must
+    # move); leg B (cached) is the production warm path — FlowCache rows +
+    # whole-instance lowering reuse + arena restage of the same tier —
+    # whose parse+lower+stage total is the <= 250 ms acceptance number.
+    from fleetflow_tpu.core.parsecache import parse_cache_stats
+    from fleetflow_tpu.solver import stage_problem_tiers, staging_arena_stats
+
+    parse_w_before = parse3_box[0]
+    t_wa = time.perf_counter()
+    aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
+                     loader=loader3, cache=None)
+    reparse_wall_ms = (time.perf_counter() - t_wa) * 1e3
+    reparse_parse_ms = parse3_box[0] - parse_w_before
+
+    parse_wb_before = parse3_box[0]
+    t_wb = time.perf_counter()
+    pt_w, _ = aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
+                               loader=loader3, cache=cache,
+                               content_hash=lambda p: versions[p])
+    warm_parse_ms = parse3_box[0] - parse_wb_before
+    warm_lower_ms = ((time.perf_counter() - t_wb) * 1e3 - warm_parse_ms)
+    cfg_b = bucket_config()
+    t_ws = time.perf_counter()
+    prob_w1, _ = stage_problem_tiers(pt_w, cfg_b)   # arena (re)alloc
+    jax.block_until_ready(prob_w1)
+    stage_first_ms = (time.perf_counter() - t_ws) * 1e3
+    t_ws2 = time.perf_counter()
+    prob_w2, _ = stage_problem_tiers(pt_w, cfg_b)   # arena restage
+    jax.block_until_ready(prob_w2)
+    warm_stage_ms = (time.perf_counter() - t_ws2) * 1e3
+    frontend = {
+        "reparse": {"parse_ms": round(reparse_parse_ms, 1),
+                    "lower_ms": round(reparse_wall_ms - reparse_parse_ms,
+                                      1)},
+        "warm": {"parse_ms": round(warm_parse_ms, 1),
+                 "lower_ms": round(warm_lower_ms, 1),
+                 "stage_first_ms": round(stage_first_ms, 1),
+                 "stage_ms": round(warm_stage_ms, 1),
+                 "total_ms": round(warm_parse_ms + warm_lower_ms
+                                   + warm_stage_ms, 1)},
+        "parse_cache": parse_cache_stats(),
+        "arena": staging_arena_stats(),
+    }
 
     parse_ms = parse_box[0]
     return {
@@ -785,6 +864,7 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
                        soft_score=round(res_b.soft, 4)),
         "compile_cache": compile_cache_info(),
         "flow_cache": cache.stats(),
+        "frontend": frontend,
         "second_size": {
             "services": pt2.S,
             "relower_ms": round(relower_ms, 1),
@@ -818,22 +898,36 @@ def _pipeline_child() -> None:
     ensure_platform(min_devices=1, probe_timeout=240.0)
     import jax
 
+    from fleetflow_tpu.core.parsecache import parse_cache_stats
     from fleetflow_tpu.registry.aggregate import aggregate_fleets
-    from fleetflow_tpu.solver import (bucket_config, pad_problem_tiers,
-                                      prepare_problem, solve)
+    from fleetflow_tpu.solver import (bucket_config, solve,
+                                      stage_problem_tiers)
 
     small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
     S, N = (1000, 100) if small else (10000, 1000)
     t_all = time.perf_counter()
     texts, reg, loader, parse_box, _ = _gen_registry(S, N)
     parse_before = parse_box[0]      # servers parse happened in _gen_registry
+    # the production warm recipe: a FlowCache with a CONTENT hash over the
+    # fleet texts — under FLEET_PARSE_CACHE the lowered instance persists
+    # to disk, so the warm child skips the parse AND the lower
+    import hashlib
+
+    from fleetflow_tpu.registry.aggregate import FlowCache
+    digests = {name: hashlib.sha256(t.encode()).hexdigest()
+               for name, t in texts.items()}
+    flow_cache = FlowCache()
     t1 = time.perf_counter()
     pt, _ = aggregate_fleets(reg, stages={n: ["prod"] for n in texts},
-                             loader=loader)
+                             loader=loader, cache=flow_cache,
+                             content_hash=lambda p: digests[p])
     lower_ms = ((time.perf_counter() - t1) * 1e3
                 - (parse_box[0] - parse_before))
     t2 = time.perf_counter()
-    prob, _ = pad_problem_tiers(prepare_problem(pt), bucket_config())
+    # compile-free arena staging straight to the padded tier
+    # (solver/buckets.stage_problem_tiers): the r06 child paid ~667 ms
+    # here, mostly one-time jnp.pad/fill compiles a memcpy never needs
+    prob, _ = stage_problem_tiers(pt, bucket_config())
     jax.block_until_ready(prob)
     stage_ms = (time.perf_counter() - t2) * 1e3
     with _watch_compiles() as compiles:
@@ -852,24 +946,35 @@ def _pipeline_child() -> None:
         "violations": res.violations,
         "end_to_end_s": round(time.perf_counter() - t_all, 2),
         "compile_cache": compile_cache_info(),
+        # the warm child must show disk hits here (the parse cache is the
+        # reason its parse_ms collapses across processes; the flow-cache
+        # instance_hits line shows the lowered-instance disk tier landing)
+        "parse_cache": parse_cache_stats(),
+        "flow_cache": flow_cache.stats(),
     }))
 
 
 def _coldwarm_scenario() -> dict:
     """Run _pipeline_child twice in fresh processes sharing one
-    FLEET_COMPILE_CACHE directory: the cold run populates the persistent
-    XLA cache, the warm run must show first_solve_s collapsing (the
-    4-5 s compile cliff disappearing across process restarts)."""
+    FLEET_COMPILE_CACHE directory AND one FLEET_PARSE_CACHE directory: the
+    cold run populates the persistent XLA + parse caches, the warm run
+    must show first_solve_s collapsing (the 4-5 s compile cliff) and
+    parse_ms collapsing >= 3x (the front-end cliff). Bench-defaulted
+    cache dirs (BENCH_CACHES_DEFAULTED) are replaced with throwaway
+    tmpdirs — a previous run's populated cache would fake the cold leg."""
     import subprocess
     import tempfile
 
-    tmp = None
+    defaulted = os.environ.get("BENCH_CACHES_DEFAULTED", "").split(",")
     cache_dir = os.environ.get("FLEET_COMPILE_CACHE", "").strip()
-    if not cache_dir:
-        tmp = tempfile.mkdtemp(prefix="fleet-compile-cache-")
-        cache_dir = tmp
+    if not cache_dir or "FLEET_COMPILE_CACHE" in defaulted:
+        cache_dir = tempfile.mkdtemp(prefix="fleet-compile-cache-")
+    parse_dir = os.environ.get("FLEET_PARSE_CACHE", "").strip()
+    if not parse_dir or "FLEET_PARSE_CACHE" in defaulted:
+        parse_dir = tempfile.mkdtemp(prefix="fleet-parse-cache-")
     env = dict(os.environ, BENCH_PIPELINE_CHILD="1",
-               FLEET_COMPILE_CACHE=cache_dir)
+               FLEET_COMPILE_CACHE=cache_dir,
+               FLEET_PARSE_CACHE=parse_dir)
     if jax_backend_is_cpu():
         env["FLEET_FORCE_CPU"] = "1"
     timeout = float(os.environ.get("BENCH_COLDWARM_TIMEOUT", "1200"))
@@ -892,10 +997,33 @@ def _coldwarm_scenario() -> dict:
 
     cold = run("cold")
     warm = run("warm")
-    result = {"cache_dir": cache_dir, "cold": cold, "warm": warm}
+    result = {"cache_dir": cache_dir, "parse_cache_dir": parse_dir,
+              "cold": cold, "warm": warm}
     if cold.get("ok") and warm.get("ok"):
         result["compile_cliff_s"] = round(
             cold["first_solve_s"] - warm["first_solve_s"], 2)
+        # the front-end acceptance pair (ISSUE 12): the warm PROCESS's
+        # parse must collapse against the cold one (disk parse cache),
+        # and its whole front end is parse+lower+stage
+        warm_fe = warm["parse_ms"] + warm["lower_ms"] + warm["stage_ms"]
+        result["frontend"] = {
+            "cold_parse_ms": cold["parse_ms"],
+            "warm_parse_ms": warm["parse_ms"],
+            "parse_ratio": round(cold["parse_ms"]
+                                 / max(warm["parse_ms"], 0.1), 2),
+            "warm_front_end_ms": round(warm_fe, 1),
+            "warm_parse_cache": warm.get("parse_cache"),
+        }
+        if os.environ.get("BENCH_FRONTEND_ASSERT", "").lower() in \
+                ("1", "true", "on", "yes"):
+            # CI smoke contract: a warm process that re-pays the parser
+            # is a front-end cache regression
+            fe = result["frontend"]
+            assert fe["parse_ratio"] >= 3.0, \
+                f"warm-process parse did not collapse: {fe}"
+            pc = fe["warm_parse_cache"] or {}
+            assert (pc.get("disk_hits", 0) + pc.get("hits", 0)) > 0, \
+                f"parse cache never hit in the warm process: {fe}"
     return result
 
 

@@ -22,6 +22,7 @@ unit-tested (tests/test_kdl.py), mirroring the reference's parser test corpus
 from __future__ import annotations
 
 import os
+import re
 from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
@@ -34,8 +35,15 @@ class KdlError(ValueError):
 
     def __init__(self, message: str, line: int, col: int):
         super().__init__(f"KDL parse error at {line}:{col}: {message}")
+        self.message = message
         self.line = line
         self.col = col
+
+    def __reduce__(self):
+        # default exception pickling replays __init__ with the FORMATTED
+        # args tuple (wrong arity); parse errors cross process boundaries
+        # on the parallel-ingest path, so rebuild from the raw triple
+        return (type(self), (self.message, self.line, self.col))
 
 
 _BOOL_TRUE = frozenset(("true", "1", "yes", "on"))
@@ -136,9 +144,78 @@ def _value_to_str(v: Any) -> str:
 
 # Characters that terminate a bare identifier.
 _NON_IDENTIFIER = set('\\/(){}<>;[]=,"')
-_WS = set(" \t\ufeff\u00a0\u1680\u2000\u2001\u2002\u2003\u2004\u2005\u2006"
-          "\u2007\u2008\u2009\u200a\u202f\u205f\u3000")
-_NEWLINES = set("\r\n\x0c\u0085\u2028\u2029")
+_WS_CHARS = (" \t\ufeff\u00a0\u1680\u2000\u2001\u2002\u2003\u2004\u2005\u2006"
+             "\u2007\u2008\u2009\u200a\u202f\u205f\u3000")
+_NL_CHARS = "\r\n\x0c\u0085\u2028\u2029"
+_WS = set(_WS_CHARS)
+_NEWLINES = set(_NL_CHARS)
+
+# -- precompiled token regexes (the hot-loop rewrite) -----------------------
+# The scanner used to walk characters one peek() at a time (~400k calls on a
+# fleet-scale document). Each regex below consumes exactly the run the old
+# per-char loop consumed, so token boundaries \u2014 and therefore every parse
+# and every error position \u2014 are unchanged. The rare/ambiguous corners
+# (escaped strings, exotic digits) fall back to the original per-char code.
+_RX_WS = re.compile("[%s]+" % re.escape(_WS_CHARS))
+# ws / newlines / line comments, interleaved in any order: the entire
+# inter-node gap in one match (parse_nodes' dominant skip)
+_RX_GAP = re.compile("(?:[%s%s]+|//[^%s]*)+"
+                     % (re.escape(_WS_CHARS), re.escape(_NL_CHARS),
+                        re.escape(_NL_CHARS)))
+_RX_LINE_COMMENT = re.compile("//[^%s]*" % re.escape(_NL_CHARS))
+_RX_BLOCK_DELIM = re.compile(r"/\*|\*/")
+_RX_IDENT = re.compile("[^%s]+" % re.escape(
+    "".join(sorted(_NON_IDENTIFIER)) + _WS_CHARS + _NL_CHARS))
+# a complete terminated string, escapes included ([^"\\] spans newlines)
+_RX_STRING = re.compile(r'"[^"\\]*(?:\\.[^"\\]*)*"', re.DOTALL)
+# exactly the runs the per-char number scanner consumes (incl. its quirks:
+# multiple '.' accepted when digit-followed, one exponent, digits optional
+# after a radix prefix \u2014 conversion errors reproduce "bad number ...").
+# The dot lookahead is \d, not [0-9]: the scanner's peek(1).isdigit() is
+# unicode-wide, so `1.\u0663` must consume "1." (then error on the lone \u0663)
+# exactly as the per-char code did.
+_RX_NUMBER = re.compile(
+    r"[+-]?(?=[0-9])(?:"
+    r"0[xX][0-9a-fA-F_]*"
+    r"|0[oO][0-7_]*"
+    r"|0[bB][01_]*"
+    r"|(?:[0-9_]|\.(?=\d))+(?:[eE][+-]?(?:[0-9_]|\.(?=\d))*)?"
+    r")")
+_NUM_SRC = _RX_NUMBER.pattern
+_IDENT_SRC = _RX_IDENT.pattern
+# one master regex per node ENTRY: horizontal ws, then the next token, in
+# one match. Covers the overwhelmingly common entry forms — escape-free
+# string / number / ident=prop / bare ident / terminator / brace. Anything
+# else (comments, (type) annotations, /- entries, raw strings, #keywords,
+# escaped strings, continuations, malformed input) fails the alternation
+# and replays through _entry_fallback, the original general path.
+# `special` catches raw-string starts and '#' so `r"..."`/`#true` never
+# half-match as identifiers.
+_RX_ENTRY = re.compile(
+    "[%s]*(?:" % re.escape(_WS_CHARS) +
+    '(?P<estr>"[^"\\\\]*")' +
+    "|(?P<num>%s)" % _NUM_SRC +
+    '|(?P<special>r["#]|#)' +
+    "|(?P<prop>%s)=" % _IDENT_SRC +
+    "|(?P<ident>%s)" % _IDENT_SRC +
+    "|(?P<term>;|\r\n|[%s])" % re.escape(_NL_CHARS) +
+    "|(?P<brace>[{}])" +
+    ")", re.DOTALL)
+_BARE_WORDS = {"true": True, "false": False, "null": None}
+# node-level master: the inter-node gap (ws / newlines / semicolons / line
+# comments, interleaved) plus a bare-identifier node name, one match per
+# node. Quoted/annotated/slash-dashed names, block comments, EOF and '}'
+# miss and take the general path. The gap is made ATOMIC via the
+# lookahead-capture trick ((?=(?P<gap>...))(?P=gap)): a plain
+# `(?:[class]+|...)*` followed by a required name backtracks
+# exponentially when the name can't match (~30 gap chars before EOF or a
+# quoted name would hang the parser); lookarounds don't backtrack, so
+# the maximal gap is committed in one pass and a name failure fails the
+# whole match immediately.
+_RX_NODE_START = re.compile(
+    "(?=(?P<gap>(?:[%s%s;]+|//[^%s]*(?=[%s]|$))*))(?P=gap)(?P<name>%s)"
+    % (re.escape(_WS_CHARS), re.escape(_NL_CHARS), re.escape(_NL_CHARS),
+       re.escape(_NL_CHARS), _IDENT_SRC))
 
 
 MAX_DEPTH = 128    # a document nested deeper is hostile or broken — fail
@@ -146,7 +223,8 @@ MAX_DEPTH = 128    # a document nested deeper is hostile or broken — fail
 
 
 class _Parser:
-    def __init__(self, text: str, record_spans: bool = False):
+    def __init__(self, text: str, record_spans: bool = False,
+                 line_offset: int = 0):
         self.text = text
         self.pos = 0
         self.n = len(text)
@@ -155,6 +233,11 @@ class _Parser:
         # every path: a parse WITHOUT want_spans yields span-less nodes
         # whether it ran natively or fell back to this parser
         self.record_spans = record_spans
+        # line_offset shifts every reported line (spans AND errors): the
+        # loader parses each rendered file as its own fragment but keeps
+        # positions in the multi-file concatenation's coordinates, which
+        # the lint SourceMap resolves back to files
+        self.line_offset = line_offset
         self._nl: Optional[list[int]] = None  # newline index, built lazily
 
     # -- position helpers ---------------------------------------------------
@@ -172,7 +255,7 @@ class _Parser:
             self._nl = nl
         line = bisect_left(self._nl, pos) + 1
         col = pos - (self._nl[line - 2] + 1 if line > 1 else 0) + 1
-        return line, col
+        return line + self.line_offset, col
 
     def _line_col(self) -> tuple[int, int]:
         return self._line_col_at(self.pos)
@@ -196,22 +279,19 @@ class _Parser:
     # -- whitespace / comments ---------------------------------------------
 
     def _skip_block_comment(self) -> None:
-        assert self.startswith("/*")
+        # nestable /* */: regex-scan for the next delimiter instead of
+        # stepping one char at a time
         start = self.pos
-        self.pos += 2
+        pos = start + 2
         depth = 1
-        while depth and self.pos < self.n:
-            if self.startswith("/*"):
-                depth += 1
-                self.pos += 2
-            elif self.startswith("*/"):
-                depth -= 1
-                self.pos += 2
-            else:
-                self.pos += 1
-        if depth:
-            self.pos = start
-            raise self.error("unterminated block comment")
+        while depth:
+            m = _RX_BLOCK_DELIM.search(self.text, pos)
+            if m is None:
+                self.pos = start
+                raise self.error("unterminated block comment")
+            depth += 1 if m.group() == "/*" else -1
+            pos = m.end()
+        self.pos = pos
 
     def skip_ws(self, newlines: bool = False) -> None:
         """Skip horizontal whitespace, comments, and line continuations.
@@ -219,33 +299,38 @@ class _Parser:
         With ``newlines=True`` also skips newlines and line (``//``) comments;
         otherwise stops at a newline (which terminates a node).
         """
-        while self.pos < self.n:
-            c = self.peek()
-            if c in _WS:
-                self.pos += 1
-            elif self.startswith("/*"):
+        text, n = self.text, self.n
+        rx = _RX_GAP if newlines else _RX_WS
+        pos = self.pos
+        while pos < n:
+            m = rx.match(text, pos)
+            if m is not None:
+                pos = m.end()
+                if pos >= n:
+                    break
+            c = text[pos]
+            if c == "/" and text.startswith("/*", pos):
+                self.pos = pos
                 self._skip_block_comment()
+                pos = self.pos
             elif c == "\\" and not newlines:
                 # line continuation: \ ws* (// comment)? newline
-                save = self.pos
-                self.pos += 1
-                while self.peek() in _WS:
-                    self.pos += 1
-                if self.startswith("//"):
-                    while self.pos < self.n and self.peek() not in _NEWLINES:
-                        self.pos += 1
-                if self.peek() in _NEWLINES:
-                    self._consume_newline()
+                save = pos
+                pos += 1
+                m = _RX_WS.match(text, pos)
+                if m is not None:
+                    pos = m.end()
+                m = _RX_LINE_COMMENT.match(text, pos)
+                if m is not None:
+                    pos = m.end()
+                if pos < n and text[pos] in _NEWLINES:
+                    pos += 2 if text.startswith("\r\n", pos) else 1
                 else:
                     self.pos = save
                     return
-            elif newlines and c in _NEWLINES:
-                self.pos += 1
-            elif newlines and self.startswith("//"):
-                while self.pos < self.n and self.peek() not in _NEWLINES:
-                    self.pos += 1
             else:
-                return
+                break
+        self.pos = pos
 
     def _consume_newline(self) -> None:
         if self.startswith("\r\n"):
@@ -256,6 +341,19 @@ class _Parser:
     # -- tokens -------------------------------------------------------------
 
     def parse_string(self) -> str:
+        # fast path: one regex match spans the whole terminated string; the
+        # escape-free common case returns a single slice. Strings with
+        # escapes (or unterminated ones) replay through the per-char
+        # decoder, which owns the exact error positions.
+        m = _RX_STRING.match(self.text, self.pos)
+        if m is not None:
+            tok = m.group()
+            if "\\" not in tok:
+                self.pos = m.end()
+                return tok[1:-1]
+        return self._parse_string_slow()
+
+    def _parse_string_slow(self) -> str:
         assert self.peek() == '"'
         self.pos += 1
         out: list[str] = []
@@ -320,6 +418,41 @@ class _Parser:
         return s
 
     def parse_number(self) -> Any:
+        # fast path: the regex consumes exactly the run the per-char scanner
+        # consumed; conversion failures raise the same "bad number" at the
+        # same position — except a bare exponent at EOF ("1e"), where the
+        # old scanner's `peek() in "+-"` was True for "" and stepped one
+        # past the end; the regex reports the correct column. Leading
+        # unicode-digit oddities (isdigit() is wider than [0-9]) miss the
+        # regex and replay through the original scanner.
+        m = _RX_NUMBER.match(self.text, self.pos)
+        if m is None:
+            return self._parse_number_slow()
+        self.pos = m.end()
+        return self._number_value(m.group())
+
+    def _number_value(self, tok: str) -> Any:
+        """Convert a _RX_NUMBER token; self.pos must already sit at the
+        token end so "bad number" errors point where the scanner's did."""
+        body = tok[1:] if tok[0] in "+-" else tok
+        prefix = body[:2].lower()
+        if prefix in ("0x", "0o", "0b"):
+            digits = body[2:].replace("_", "")
+            sign = -1 if tok[0] == "-" else 1
+            try:
+                return sign * int(digits,
+                                  {"0x": 16, "0o": 8, "0b": 2}[prefix])
+            except ValueError:
+                raise self.error(f"bad number {digits!r}") from None
+        dec = tok.replace("_", "")
+        try:
+            if "." in dec or "e" in dec or "E" in dec:
+                return float(dec)
+            return int(dec)
+        except ValueError:
+            raise self.error(f"bad number {dec!r}") from None
+
+    def _parse_number_slow(self) -> Any:
         start = self.pos
         if self.peek() in "+-":
             self.pos += 1
@@ -372,15 +505,11 @@ class _Parser:
                 raise self.error(f"bad number {tok!r}") from None
 
     def parse_identifier(self) -> str:
-        start = self.pos
-        while not self.at_end():
-            c = self.peek()
-            if c in _WS or c in _NEWLINES or c in _NON_IDENTIFIER:
-                break
-            self.pos += 1
-        if self.pos == start:
+        m = _RX_IDENT.match(self.text, self.pos)
+        if m is None:
             raise self.error("expected identifier")
-        return self.text[start : self.pos]
+        self.pos = m.end()
+        return m.group()
 
     def _at_value_start(self) -> bool:
         c = self.peek()
@@ -441,127 +570,205 @@ class _Parser:
 
     def parse_node(self) -> Optional[KdlNode]:
         """Parse one node. Returns None for a slash-dash'd node."""
+        text = self.text
         slashdash = False
-        if self.startswith("/-"):
+        if text.startswith("/-", self.pos):
             slashdash = True
             self.pos += 2
             self.skip_ws(newlines=True)
         name_pos = self.pos
         ty = self.parse_type_annotation()
-        if self.peek() == '"':
+        if text[self.pos : self.pos + 1] == '"':
             name = self.parse_string()
         else:
             name = self.parse_identifier()
+        node = self._node_tail(name, ty, name_pos)
+        return None if slashdash else node
+
+    def _node_tail(self, name: str, ty: Optional[str],
+                   name_pos: int) -> KdlNode:
+        """Entries + children of a node whose name token is consumed."""
+        text = self.text
         node = KdlNode(name=name, type_annotation=ty)
         if self.record_spans:
             node.line, node.col = self._line_col_at(name_pos)
 
+        # entry loop: one master-regex match per argument/property in the
+        # common case; everything it can't express takes _entry_fallback
+        # (the original general path, bit-for-bit)
+        args_append = node.args.append
+        props = node.props
+        entry_match = _RX_ENTRY.match
         while True:
-            self.skip_ws(newlines=False)
-            if self.at_end():
-                break
-            c = self.peek()
-            if c in _NEWLINES or c == ";":
-                if c == ";":
-                    self.pos += 1
-                else:
-                    self._consume_newline()
-                break
-            if self.startswith("//"):
-                while self.pos < self.n and self.peek() not in _NEWLINES:
-                    self.pos += 1
+            m = entry_match(text, self.pos)
+            if m is None:
+                if self._entry_fallback(node):
+                    break
                 continue
-            if c == "{":
-                # children terminate the node (KDL spec: nothing may follow a
-                # children block). Anything after `}` on the same line parses
-                # as a sibling node, so `capacity { cpu 4 } labels { ... }`
-                # reads naturally.
+            g = m.lastgroup
+            if g == "estr":
+                self.pos = m.end()
+                args_append(m.group("estr")[1:-1])
+            elif g == "num":
+                self.pos = m.end()
+                args_append(self._number_value(m.group("num")))
+            elif g == "prop":
+                tok = m.group("prop")
+                if tok[0].isdigit() or (tok[0] in "+-"
+                                        and tok[1:2].isdigit()):
+                    # non-ASCII digit (isdigit() is wider than [0-9]): the
+                    # scanner treats it as a value start — general path
+                    if self._entry_fallback(node):
+                        break
+                    continue
+                self.pos = m.end()
+                props[tok] = self.parse_value()
+            elif g == "ident":
+                tok = m.group("ident")
+                if tok[0].isdigit() or (tok[0] in "+-"
+                                        and tok[1:2].isdigit()):
+                    if self._entry_fallback(node):
+                        break
+                    continue
+                self.pos = m.end()
+                args_append(_BARE_WORDS.get(tok, tok))
+            elif g == "term":
+                self.pos = m.end()
+                break
+            elif g == "brace":
+                if m.group("brace") == "{":
+                    # children terminate the node (KDL spec: nothing may
+                    # follow a children block). Anything after `}` on the
+                    # same line parses as a sibling node, so
+                    # `capacity { cpu 4 } labels { ... }` reads naturally.
+                    self.pos = m.end()
+                    self.depth += 1
+                    if self.depth > MAX_DEPTH:
+                        raise self.error(f"children nested deeper than "
+                                         f"{MAX_DEPTH} levels")
+                    node.children = self.parse_nodes(until_brace=True)
+                    self.depth -= 1
+                else:
+                    # let caller consume the closing brace
+                    self.pos = m.start("brace")
+                break
+            else:
+                # special (raw-string start / '#'): general path owns it
+                if self._entry_fallback(node):
+                    break
+        return node
+
+    def _entry_fallback(self, node: KdlNode) -> bool:
+        """One node entry via the general path: comments, ``(type)``
+        annotations, ``/-`` entries, raw strings, ``#`` keywords, escaped
+        strings, line continuations, EOF — and the error corners. Returns
+        True when the node ends (terminator/children/EOF/closing brace)."""
+        text, n = self.text, self.n
+        self.skip_ws(newlines=False)
+        pos = self.pos
+        if pos >= n:
+            return True
+        c = text[pos]
+        if c in _NEWLINES or c == ";":
+            if c == ";":
+                self.pos = pos + 1
+            else:
+                self._consume_newline()
+            return True
+        if c == "/" and text.startswith("//", pos):
+            m = _RX_LINE_COMMENT.match(text, pos)
+            self.pos = m.end()
+            return False
+        if c == "{":
+            self.pos += 1
+            self.depth += 1
+            if self.depth > MAX_DEPTH:
+                raise self.error(f"children nested deeper than "
+                                 f"{MAX_DEPTH} levels")
+            node.children = self.parse_nodes(until_brace=True)
+            self.depth -= 1
+            return True
+        if c == "}":
+            return True  # let caller consume the closing brace
+
+        entry_slashdash = False
+        if c == "/" and text.startswith("/-", pos):
+            entry_slashdash = True
+            self.pos = pos + 2
+            self.skip_ws(newlines=False)
+            if self.peek() == "{":
                 self.pos += 1
                 self.depth += 1
                 if self.depth > MAX_DEPTH:
                     raise self.error(f"children nested deeper than "
                                      f"{MAX_DEPTH} levels")
-                node.children = self.parse_nodes(until_brace=True)
+                self.parse_nodes(until_brace=True)  # discard
                 self.depth -= 1
-                break
-            if c == "}":
-                break  # let caller consume the closing brace
+                return False
+            # refresh: c was peeked before the `/-` was consumed, so a
+            # slash-dashed annotated entry (`a /- (t)5`) must re-peek to
+            # see the '(' (parity with native/kdl.cpp, which accepts it)
+            c = self.peek()
 
-            entry_slashdash = False
-            if self.startswith("/-"):
-                entry_slashdash = True
-                self.pos += 2
-                self.skip_ws(newlines=False)
-                if self.peek() == "{":
-                    self.pos += 1
-                    self.depth += 1
-                    if self.depth > MAX_DEPTH:
-                        raise self.error(f"children nested deeper than "
-                                         f"{MAX_DEPTH} levels")
-                    self.parse_nodes(until_brace=True)  # discard
-                    self.depth -= 1
-                    continue
-                # refresh: c was peeked before the `/-` was consumed, so a
-                # slash-dashed annotated entry (`a /- (t)5`) must re-peek to
-                # see the '(' (parity with native/kdl.cpp, which accepts it)
-                c = self.peek()
+        if c == "(":
+            # (type)value annotation on an argument: parse and discard
+            # the annotation, keep the value
+            self.parse_type_annotation()
+            val = self.parse_value()
+            if not entry_slashdash:
+                node.args.append(val)
+            return False
 
-            if c == "(":
-                # (type)value annotation on an argument: parse and discard
-                # the annotation, keep the value
-                self.parse_type_annotation()
-                val = self.parse_value()
-                if not entry_slashdash:
-                    node.args.append(val)
-                continue
+        if self._at_value_start():
+            val = self.parse_value()
+            if not entry_slashdash:
+                node.args.append(val)
+            return False
 
-            if self._at_value_start():
-                val = self.parse_value()
-                if not entry_slashdash:
-                    node.args.append(val)
-                continue
-
-            # identifier: either prop key or bare-word arg
-            ident = self.parse_identifier()
-            if self.peek() == "=":
-                self.pos += 1
-                val = self.parse_value()
-                if not entry_slashdash:
-                    node.props[ident] = val
-            else:
-                if not entry_slashdash:
-                    if ident == "true":
-                        node.args.append(True)
-                    elif ident == "false":
-                        node.args.append(False)
-                    elif ident == "null":
-                        node.args.append(None)
-                    else:
-                        node.args.append(ident)
-        return None if slashdash else node
+        # identifier: either prop key or bare-word arg
+        ident = self.parse_identifier()
+        if text[self.pos : self.pos + 1] == "=":
+            self.pos += 1
+            val = self.parse_value()
+            if not entry_slashdash:
+                node.props[ident] = val
+        elif not entry_slashdash:
+            node.args.append(_BARE_WORDS.get(ident, ident))
+        return False
 
     def parse_nodes(self, until_brace: bool = False) -> list[KdlNode]:
+        text, n_len = self.text, self.n
         nodes: list[KdlNode] = []
+        append = nodes.append
+        start_match = _RX_NODE_START.match
         while True:
+            # fast path: gap + bare node name in one match
+            m = start_match(text, self.pos)
+            if m is not None:
+                self.pos = m.end()
+                append(self._node_tail(m.group("name"), None,
+                                       m.start("name")))
+                continue
             self.skip_ws(newlines=True)
-            while self.peek() == ";":
+            while text.startswith(";", self.pos):
                 self.pos += 1
                 self.skip_ws(newlines=True)
-            if self.at_end():
+            if self.pos >= n_len:
                 if until_brace:
                     raise self.error("unexpected EOF, expected '}'")
                 return nodes
-            if self.peek() == "}":
+            if text[self.pos] == "}":
                 if until_brace:
                     self.pos += 1
                     return nodes
                 raise self.error("unexpected '}'")
             n = self.parse_node()
             if n is not None:
-                nodes.append(n)
+                append(n)
 
 
-def parse_document(text: str, *, want_spans: bool = False) -> list[KdlNode]:
+def parse_document(text: str, *, want_spans: bool = False,
+                   line_offset: int = 0) -> list[KdlNode]:
     """Parse a KDL document into a list of top-level nodes.
 
     Uses the native parser (native/kdl.cpp via ctypes) as the fast path when
@@ -576,6 +783,9 @@ def parse_document(text: str, *, want_spans: bool = False) -> list[KdlNode]:
     ``want_spans=True`` forces the pure-Python parser so every node carries
     its 1-based line/col (the native export has no position channel) —
     the `fleet lint` path, where diagnostics must point at source.
+    ``line_offset`` shifts every reported line (spans and error positions)
+    by a constant — per-fragment parses of a multi-file concatenation keep
+    concatenation coordinates.
     """
     if not want_spans and \
             os.environ.get("FLEET_KDL_NATIVE", "1").lower() not in ("0", "false"):
@@ -590,7 +800,8 @@ def parse_document(text: str, *, want_spans: bool = False) -> list[KdlNode]:
             nodes = _native_parse(text)
             if nodes is not None:
                 return nodes
-    return _Parser(text, record_spans=want_spans).parse_nodes()
+    return _Parser(text, record_spans=want_spans,
+                   line_offset=line_offset).parse_nodes()
 
 
 # resolved native fast path: None = not yet tried, False = unavailable

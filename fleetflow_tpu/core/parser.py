@@ -611,27 +611,98 @@ def parse_tenant(node: KdlNode) -> TenantSpec:
 # Top-level dispatch (mod.rs)
 # --------------------------------------------------------------------------
 
-def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
-                     want_spans: bool = False,
-                     source: Optional[str] = None) -> Flow:
-    """Parse KDL text into (or onto) a Flow.
+def _merge_stage_into(old: Stage, st: Stage) -> None:
+    """Stage redefinition: merge `st` onto `old` (reads `st`, mutates
+    `old` — the dispatch's historical in-place semantics)."""
+    have = set(old.services)   # O(n^2) scan at fleet scale
+    for sname in st.services:
+        if sname not in have:
+            have.add(sname)
+            old.services.append(sname)
+    for sname, ov in st.service_overrides.items():
+        if sname in old.service_overrides:
+            old.service_overrides[sname] = \
+                old.service_overrides[sname].merge(ov)
+        else:
+            old.service_overrides[sname] = ov
+    old.servers = st.servers or old.servers
+    old.service_locs.update(st.service_locs)
+    old.server_locs.update(st.server_locs)
+    old.variables.update(st.variables)
+    old.registry = st.registry or old.registry
+    if st.backend != Backend.DOCKER:
+        old.backend = st.backend
+    old.placement = st.placement or old.placement
 
-    Reference: parser/mod.rs:160,184-299. Top-level nodes: project / stage /
-    service / provider / server / variables / registry / tenant / include
-    (include must be resolved beforehand via read_kdl_with_includes; a
-    leftover include node raises). Service redefinition merges; stage
-    redefinition merges service lists/overrides. Stage selection happens at
-    load time (template pre-pass) and resolve time (Stage.resolved_services),
-    not at parse time.
 
-    ``want_spans=True`` forces the span-carrying pure-Python KDL parser so
-    model objects get SourceLoc positions (the `fleet lint` path); ``source``
-    labels those locations with a file name (single-file parses — multi-file
-    concatenations resolve lines through the lint SourceMap instead).
+def _stage_copy(st: Stage) -> Stage:
+    """Stage with fresh top-level containers (shared Service/loc leaves) —
+    later redefinition merges mutate the copy, never a cached fragment."""
+    return Stage(name=st.name, services=list(st.services),
+                 service_overrides=dict(st.service_overrides),
+                 servers=list(st.servers), variables=dict(st.variables),
+                 registry=st.registry, backend=st.backend,
+                 placement=st.placement, loc=st.loc,
+                 service_locs=dict(st.service_locs),
+                 server_locs=dict(st.server_locs))
+
+
+def merge_flow_fragment(flow: Flow, frag: Flow) -> Flow:
+    """Merge a parsed fragment onto `flow` with the semantics of running
+    the top-level dispatch over the fragment's source text. Reads the
+    fragment only — cached fragments stay immutable; mutable containers
+    that later merges write into (stages, service entries) are copied in.
     """
-    flow = flow if flow is not None else Flow()
+    if frag.name != "unnamed":
+        flow.name = frag.name
+    for svc in frag.services.values():
+        flow.merge_service(svc.shallow_copy())
+    flow.redefinitions.extend(frag.redefinitions)
+    for st in frag.stages.values():
+        old = flow.stages.get(st.name)
+        if old is not None:
+            _merge_stage_into(old, st)
+        else:
+            flow.stages[st.name] = _stage_copy(st)
+    flow.providers.update(frag.providers)
+    flow.servers.update(frag.servers)
+    flow.variables.update(frag.variables)
+    for k, v in frag.variable_locs.items():
+        flow.variable_locs.setdefault(k, v)
+    if frag.registry is not None:
+        flow.registry = frag.registry
+    if frag.tenant is not None:
+        flow.tenant = frag.tenant
+    return flow
+
+
+def _thaw_fragment(frag: Flow) -> Flow:
+    """A caller-owned view of a cached fragment: fresh top-level
+    containers, shallow-copied services, copied stages. Nested leaf
+    containers (ports, env dicts, ...) stay shared under the established
+    read-only contract (model.Stage.resolved_services docstring)."""
+    return Flow(
+        name=frag.name,
+        services={k: v.shallow_copy() for k, v in frag.services.items()},
+        stages={k: _stage_copy(v) for k, v in frag.stages.items()},
+        providers=dict(frag.providers),
+        servers=dict(frag.servers),
+        registry=frag.registry,
+        variables=dict(frag.variables),
+        tenant=frag.tenant,
+        variable_locs=dict(frag.variable_locs),
+        redefinitions=list(frag.redefinitions),
+    )
+
+
+def _parse_kdl_fragment(text: str, *, want_spans: bool = False,
+                        source: Optional[str] = None,
+                        line_offset: int = 0) -> Flow:
+    """The uncached parse: KDL text -> a fresh Flow fragment."""
+    flow = Flow()
     try:
-        nodes = parse_document(text, want_spans=want_spans)
+        nodes = parse_document(text, want_spans=want_spans,
+                               line_offset=line_offset)
     except Exception as e:
         raise FlowError(f"KDL parse failed: {e}") from e
 
@@ -644,26 +715,7 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
         elif n == "stage":
             st = parse_stage(node, source)
             if st.name in flow.stages:
-                old = flow.stages[st.name]
-                have = set(old.services)   # O(n^2) scan at fleet scale
-                for sname in st.services:
-                    if sname not in have:
-                        have.add(sname)
-                        old.services.append(sname)
-                for sname, ov in st.service_overrides.items():
-                    if sname in old.service_overrides:
-                        old.service_overrides[sname] = \
-                            old.service_overrides[sname].merge(ov)
-                    else:
-                        old.service_overrides[sname] = ov
-                old.servers = st.servers or old.servers
-                old.service_locs.update(st.service_locs)
-                old.server_locs.update(st.server_locs)
-                old.variables.update(st.variables)
-                old.registry = st.registry or old.registry
-                if st.backend != Backend.DOCKER:
-                    old.backend = st.backend
-                old.placement = st.placement or old.placement
+                _merge_stage_into(flow.stages[st.name], st)
             else:
                 flow.stages[st.name] = st
         elif n == "provider":
@@ -690,6 +742,62 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
         # unknown top-level nodes are ignored (forward compat), matching the
         # reference's lenient dispatch
     return flow
+
+
+def _cache_min_bytes() -> int:
+    from .parsecache import _env_int
+    return _env_int("FLEET_PARSE_CACHE_MIN", 2048)
+
+
+def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
+                     want_spans: bool = False,
+                     source: Optional[str] = None,
+                     line_offset: int = 0,
+                     cache: Optional[bool] = None) -> Flow:
+    """Parse KDL text into (or onto) a Flow.
+
+    Reference: parser/mod.rs:160,184-299. Top-level nodes: project / stage /
+    service / provider / server / variables / registry / tenant / include
+    (include must be resolved beforehand via read_kdl_with_includes; a
+    leftover include node raises). Service redefinition merges; stage
+    redefinition merges service lists/overrides. Stage selection happens at
+    load time (template pre-pass) and resolve time (Stage.resolved_services),
+    not at parse time.
+
+    ``want_spans=True`` forces the span-carrying pure-Python KDL parser so
+    model objects get SourceLoc positions (the `fleet lint` path); ``source``
+    labels those locations with a file name (single-file parses — multi-file
+    concatenations resolve lines through the lint SourceMap instead).
+    ``line_offset`` shifts every span/error line by a constant so per-file
+    fragment parses keep concatenation coordinates.
+
+    Parses are served from the content-addressed parse cache
+    (core/parsecache.py) keyed on sha256 of the text: ``cache=None`` (auto)
+    caches texts >= FLEET_PARSE_CACHE_MIN bytes, ``cache=True``/``False``
+    force. Cached fragments are immutable; callers get a thawed copy (or a
+    fragment merge when ``flow`` is passed), sharing leaf objects under the
+    read-only contract.
+    """
+    if cache is None:
+        cache = len(text) >= _cache_min_bytes()
+    if not cache:
+        frag = _parse_kdl_fragment(text, want_spans=want_spans,
+                                   source=source, line_offset=line_offset)
+        if flow is None:
+            return frag
+        return merge_flow_fragment(flow, frag)
+
+    from .parsecache import default_parse_cache
+    pc = default_parse_cache()
+    key = pc.key(text, want_spans, source, line_offset)
+    frag = pc.get(key)
+    if frag is None:
+        frag = _parse_kdl_fragment(text, want_spans=want_spans,
+                                   source=source, line_offset=line_offset)
+        pc.put(key, frag)
+    if flow is None:
+        return _thaw_fragment(frag)
+    return merge_flow_fragment(flow, frag)
 
 
 def _read_expanded(path: str, seen: set[str]

@@ -131,6 +131,15 @@ _M_DEBT = REGISTRY.gauge(
     "Deficit-round-robin credit per tenant (requests the tenant may pop "
     "before yielding the drain to the next tenant)",
     labels=("tenant",))
+_M_PHASE = REGISTRY.histogram(
+    "fleet_admission_solve_phase_ms",
+    "Wall milliseconds per admission drain phase: drain = parked "
+    "retry + age shed + DRR batch pop, fold = candidate delta-problem "
+    "build (+compaction), solve = resident micro-solve(s), commit = "
+    "reservation commit + row bookkeeping — the p99-vs-p50 breakdown "
+    "the solve-tail hunt needs",
+    labels=("phase",),
+    buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500))
 
 
 class AdmissionRejected(ControlPlaneError):
@@ -260,6 +269,10 @@ class AdmissionController:
         self.stats = {"admitted": 0, "departed": 0, "sheds": 0,
                       "parked": 0, "unparked": 0, "solves": 0,
                       "compactions": 0, "batches": 0}
+        # wall-ms of the most recent drain pass, by phase (drain / fold /
+        # solve / commit) — surfaced through deploy.admit_status so a
+        # p99 solve tail can be attributed to a phase without a profiler
+        self.last_phase_ms: dict[str, float] = {}
         self._task = None
 
     # ------------------------------------------------------------------
@@ -553,12 +566,16 @@ class AdmissionController:
         Returns a summary for callers that narrate (chaos runner, tests)."""
         with self._lock:
             now = self.clock() if now is None else now
+            t_drain = time.perf_counter()
             self._retry_parked()
             self._shed_aged(now)
             batch = self._next_batch()
+            drain_ms = (time.perf_counter() - t_drain) * 1e3
             summary = {"batch": len(batch), "placed": [], "departed": [],
                        "parked": [], "stages": [], "violations": 0,
-                       "solve_ms": 0.0, "shed": 0}
+                       "solve_ms": 0.0, "shed": 0,
+                       "phase_ms": {"drain": drain_ms, "fold": 0.0,
+                                    "solve": 0.0, "commit": 0.0}}
             if not batch:
                 self._update_pressure(now)
                 self._set_queue_gauges(now)
@@ -579,8 +596,13 @@ class AdmissionController:
                 summary["violations"] = max(summary["violations"],
                                             out["violations"])
                 summary["solve_ms"] += out["solve_ms"]
+                for ph, ms in out.get("phase_ms", {}).items():
+                    summary["phase_ms"][ph] += ms
                 if out["placed"] or out["departed"]:
                     summary["stages"].append(key)
+            for ph, ms in summary["phase_ms"].items():
+                _M_PHASE.observe(ms, phase=ph)
+                self.last_phase_ms[ph] = round(ms, 3)
             self._update_pressure(now)
             self._set_queue_gauges(now)
             return summary
@@ -814,7 +836,8 @@ class AdmissionController:
         departures re-apply alone (they strictly free capacity) and the
         arrivals PARK for retry when capacity moves."""
         out = {"placed": [], "departed": [], "parked": [], "violations": 0,
-               "solve_ms": 0.0}
+               "solve_ms": 0.0,
+               "phase_ms": {"fold": 0.0, "solve": 0.0, "commit": 0.0}}
         # a departure whose arrival has not landed yet: cancel a PARKED
         # arrival in place, defer one still queued (its arrival sits ahead
         # of it in FIFO order, so the retry resolves next pass)
@@ -847,11 +870,13 @@ class AdmissionController:
         events = kept
         if not events:
             return out
+        t_fold = time.perf_counter()
         n_app = sum(1 for r in events if r.kind == "arrival")
         if self._should_compact(stream, max(n_app - len(stream.free_rows),
                                             0)):
             self._compact(stream)
         folded = self._fold(stream, events)
+        out["phase_ms"]["fold"] += (time.perf_counter() - t_fold) * 1e3
         pt2, delta, plan = folded
         if plan is None:
             return out
@@ -868,13 +893,17 @@ class AdmissionController:
             stream.key, pt2, delta, tenant=stream.tenant, masked=masked)
         wall_ms = (time.perf_counter() - t0) * 1e3
         out["solve_ms"] = wall_ms
+        out["phase_ms"]["solve"] += wall_ms
         out["violations"] = placement.violations
         self.stats["solves"] += 1
 
         if placement.feasible and rid:
+            t_commit = time.perf_counter()
             self.placement.commit(rid)
             _M_SOLVES.inc(outcome="committed")
             self._commit_plan(stream, pt_used, plan, now, out)
+            out["phase_ms"]["commit"] += \
+                (time.perf_counter() - t_commit) * 1e3
             if wall_ms > 0:
                 _M_RATE.set(len(out["placed"]) / (wall_ms / 1e3))
             return out
@@ -896,17 +925,25 @@ class AdmissionController:
         out["parked"] = [r.name for r in arrivals]
         if departures:
             # strictly capacity-freeing — re-fold without the arrivals
+            t_fold = time.perf_counter()
             pt3, delta3, plan3 = self._fold(stream, departures)
+            out["phase_ms"]["fold"] += (time.perf_counter() - t_fold) * 1e3
             if plan3 is not None and plan3["events"]:
                 masked3 = (stream.tombstones
                            | {n for _row, n in plan3["tomb_rows"]})
+                t_solve = time.perf_counter()
                 placement3, rid3, pt_used3 = self.placement.admit_batch(
                     stream.key, pt3, delta3, tenant=stream.tenant,
                     masked=masked3)
+                out["phase_ms"]["solve"] += \
+                    (time.perf_counter() - t_solve) * 1e3
                 if placement3.feasible and rid3:
+                    t_commit = time.perf_counter()
                     self.placement.commit(rid3)
                     _M_SOLVES.inc(outcome="committed")
                     self._commit_plan(stream, pt_used3, plan3, now, out)
+                    out["phase_ms"]["commit"] += \
+                        (time.perf_counter() - t_commit) * 1e3
                     return out
                 if rid3:
                     self.placement.release(rid3)
@@ -1073,6 +1110,9 @@ class AdmissionController:
                         "since_s": round(now - self._pressure_since, 3)
                         if self._pressure_since is not None else None},
                     "stats": dict(self.stats),
+                    # last non-empty drain pass, by phase — attribute a
+                    # p99 solve tail without attaching a profiler
+                    "solve_phases_ms": dict(self.last_phase_ms),
                     "config": {"max_queue": self.cfg.max_queue,
                                "shed_age_s": self.cfg.shed_age_s,
                                "on_full": self.cfg.on_full,

@@ -7,7 +7,8 @@ mesh-sharded simulated annealing over dense constraint tensors.
 
 from .anneal import anneal, chain_states_from_assignment, prerepair_state
 from .buckets import (BucketConfig, BucketInfo, bucket_config, bucket_size,
-                      pad_problem_tiers, soft_score_host)
+                      pad_problem_tiers, soft_score_host,
+                      stage_problem_tiers, staging_arena_stats)
 from .resident import ProblemDelta, ResidentProblem, transfer_guard_ctx
 from .sharded import SVC_AXIS, anneal_sharded, pad_problem, shard_problem
 from .api import CHAIN_AXIS, SolveResult, make_chain_inits, solve

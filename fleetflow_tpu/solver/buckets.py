@@ -53,6 +53,7 @@ import dataclasses
 import math
 import os
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional
 
@@ -60,7 +61,8 @@ import numpy as np
 
 __all__ = ["BucketConfig", "BucketInfo", "bucket_config", "bucket_size",
            "width_bucket", "pad_problem", "pad_problem_tiers",
-           "pad_assignment", "record_bucket", "soft_score_host"]
+           "pad_assignment", "record_bucket", "soft_score_host",
+           "stage_problem_tiers", "staging_arena_stats"]
 
 
 @dataclass(frozen=True)
@@ -258,6 +260,205 @@ def pad_assignment(assignment: np.ndarray, padded_S: int,
     fill = int(valid[0]) if valid.size else 0
     return np.concatenate(
         [assignment, np.full(pad, fill, dtype=np.int32)])
+
+
+# -- compile-free padded staging -------------------------------------------
+# pad_problem_tiers pads ON DEVICE: every plane pays a jnp.pad dispatch and
+# — in a fresh process — a shape-specific XLA compile, which is why the
+# cold_warm bench leg's stage_ms sat at ~667 ms while the actual bytes are
+# a ~100 ms memcpy. stage_problem_tiers instead builds the PADDED planes on
+# the host, in per-tier arena buffers reused across restages (the phantom
+# region is written once per arena, not once per restage), and uploads
+# them: staging becomes pure memcpy + device_put, no XLA ops at all.
+# Constant (S, N) planes — eligible all-True, preferred absent — can
+# additionally be served from a small immutable device-side cache, so a
+# restage of the same tier re-uploads nothing for them.
+
+_STAGE_LOCK = threading.Lock()          # arenas hand out shared buffers
+_ARENAS: OrderedDict[tuple, list] = OrderedDict()   # key -> [array, rows]
+_DEV_CONSTS: OrderedDict[tuple, object] = OrderedDict()
+_DEV_CONST_CAP = 6                      # (S, N) planes; LRU beyond this
+
+
+def _arena_cap_bytes() -> int:
+    try:
+        return int(float(os.environ.get("FLEET_STAGE_ARENA_MB", "")
+                         or 512) * 1e6)
+    except ValueError:
+        return 512_000_000
+
+
+def _arena_take_locked(name: str, shape: tuple, dtype, fill,
+                       rows_written: int) -> np.ndarray:
+    """A host buffer of `shape` whose rows >= rows_written hold `fill`;
+    the caller overwrites rows [0:rows_written] (and owns the buffer until
+    it releases _STAGE_LOCK). Reuse resets only the rows the previous
+    staging dirtied beyond the new watermark."""
+    key = (name, shape, np.dtype(dtype).str, repr(fill))
+    ent = _ARENAS.get(key)
+    if ent is None:
+        arr = np.full(shape, fill, dtype=dtype)
+        ent = _ARENAS[key] = [arr, 0]
+        cap = _arena_cap_bytes()
+        while len(_ARENAS) > 1 and \
+                sum(e[0].nbytes for e in _ARENAS.values()) > cap:
+            _ARENAS.popitem(last=False)
+    else:
+        _ARENAS.move_to_end(key)
+        arr, dirty = ent
+        if dirty > rows_written:
+            arr[rows_written:dirty] = fill
+    ent[1] = rows_written
+    return ent[0]
+
+
+def _device_const_locked(kind: str, shape: tuple, dtype, value,
+                         device) -> object:
+    """An immutable on-device constant plane, cached per shape/device.
+    Rebuilt if a consumer deleted it (donation); callers that DONATE
+    problem planes must not use this cache at all (a shared array donated
+    by one staging would invalidate every other holder)."""
+    import jax
+
+    key = (kind, shape, None if device is None else repr(device))
+    arr = _DEV_CONSTS.get(key)
+    if arr is not None and not arr.is_deleted():
+        _DEV_CONSTS.move_to_end(key)
+        return arr
+    host = _arena_take_locked(f"const:{kind}", shape, dtype, value, 0)
+    arr = jax.device_put(host, device=device)
+    _DEV_CONSTS[key] = arr
+    while len(_DEV_CONSTS) > _DEV_CONST_CAP:
+        _DEV_CONSTS.popitem(last=False)
+    return arr
+
+
+def staging_arena_stats() -> dict:
+    with _STAGE_LOCK:
+        return {
+            "arenas": len(_ARENAS),
+            "arena_bytes": int(sum(e[0].nbytes for e in _ARENAS.values())),
+            "device_consts": len(_DEV_CONSTS),
+        }
+
+
+def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
+                        device=None, reuse_device_constants: bool = True):
+    """Stage a ProblemTensors DIRECTLY at its padded bucket shape.
+
+    Equivalent to ``pad_problem_tiers(prepare_problem(pt), cfg)`` —
+    bit-identical tensors, same statics — but compile-free: padded host
+    planes are assembled in reusable per-tier arenas and uploaded with
+    plain device_put (no jnp.pad / on-device fill ops, so a cold process
+    pays zero staging compiles), and the two dense (S, N) planes reuse an
+    immutable device-side constant cache in the common degenerate cases
+    (eligible all-True, preferred absent).
+
+    Returns (DeviceProblem, BucketInfo). ``reuse_device_constants=False``
+    opts out of the shared device cache — REQUIRED for stagings whose
+    planes are later DONATED (the resident merge kernels), where a shared
+    array would be invalidated under every other holder.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .problem import STRATEGY_CODES, DeviceProblem, _unify_conflict_ids
+
+    cfg = cfg or bucket_config()
+    conflict = _unify_conflict_ids(pt)
+    S, N = pt.S, pt.N
+    K = conflict.shape[1]
+    C = pt.coloc_ids.shape[1]
+    G = max(int(conflict.max(initial=-1)) + 1, 1)
+    Gc = int(pt.coloc_ids.max(initial=-1)) + 1
+    T = int(pt.node_topology.max(initial=0)) + 1
+    if cfg.enabled:
+        S_pad = bucket_size(S, growth=cfg.growth, minimum=cfg.minimum,
+                            align=cfg.align)
+        K_pad = width_bucket(K, cfg.width_multiple)
+        C_pad = width_bucket(C, cfg.width_multiple)
+        G_pad = bucket_size(G, growth=2.0, minimum=16, align=4)
+        Gc_pad = bucket_size(Gc, growth=2.0, minimum=4,
+                             align=2) if Gc > 0 else 0
+    else:
+        S_pad, K_pad, C_pad, G_pad, Gc_pad = S, K, C, G, Gc
+    info = BucketInfo(orig_S=S, padded_S=S_pad, G=G_pad, Gc=Gc_pad)
+
+    def put(x):
+        return jax.device_put(x, device=device)
+
+    def put_arena(arr):
+        # jax's CPU backend ZERO-COPIES device_put for large aligned
+        # arrays (verified on jax 0.4.37): handing the shared arena
+        # buffer straight to device_put would alias it into the returned
+        # DeviceProblem, and the next restage of this tier would rewrite
+        # a live staging's tensors in place. Upload a private copy — the
+        # fresh buffer is then solely owned by (and may be aliased by)
+        # the device array. One memcpy per plane; still no XLA ops. The
+        # device-CONSTANT arenas below stay zero-copy: they are written
+        # once at creation and never again.
+        return jax.device_put(arr.copy(), device=device)
+
+    R = np.asarray(pt.demand).shape[1]
+    with _STAGE_LOCK:
+        demand = _arena_take_locked("demand", (S_pad, R), np.float32, 0.0, S)
+        demand[:S] = pt.demand
+        conf = _arena_take_locked("conflict", (S_pad, K_pad), np.int32,
+                                  -1, S)
+        conf[:S, :K] = conflict
+        if K_pad > K:
+            conf[:S, K:] = -1
+        coloc = _arena_take_locked("coloc", (S_pad, C_pad), np.int32, -1, S)
+        coloc[:S, :C] = pt.coloc_ids
+        if C_pad > C:
+            coloc[:S, C:] = -1
+
+        eligible_np = np.asarray(pt.eligible)
+        all_eligible = bool(eligible_np.all())
+        if all_eligible and reuse_device_constants:
+            eligible_arr = _device_const_locked("eligible_true",
+                                                (S_pad, N), bool, True,
+                                                device)
+        else:
+            elig = _arena_take_locked("eligible", (S_pad, N), bool, True,
+                                      0 if all_eligible else S)
+            if not all_eligible:
+                elig[:S] = eligible_np
+            eligible_arr = put_arena(elig)
+
+        if pt.preferred is None:
+            if reuse_device_constants:
+                preferred_arr = _device_const_locked(
+                    "preferred_zero", (S_pad, N), np.float32, 0.0, device)
+            else:
+                preferred_arr = put_arena(_arena_take_locked(
+                    "preferred", (S_pad, N), np.float32, 0.0, 0))
+        else:
+            pref = _arena_take_locked("preferred", (S_pad, N), np.float32,
+                                      0.0, S)
+            pref[:S] = pt.preferred
+            preferred_arr = put_arena(pref)
+
+        prob = DeviceProblem(
+            demand=put_arena(demand),
+            capacity=put(np.asarray(pt.capacity, dtype=np.float32).copy()),
+            conflict_ids=put_arena(conf),
+            coloc_ids=put_arena(coloc),
+            eligible=eligible_arr,
+            node_valid=put(np.asarray(pt.node_valid, dtype=bool).copy()),
+            node_topology=put(np.asarray(pt.node_topology,
+                                         dtype=np.int32).copy()),
+            preferred=preferred_arr,
+            S=S_pad, N=N, G=G_pad, Gc=Gc_pad, T=T,
+            strategy=STRATEGY_CODES[pt.strategy],
+            max_skew=int(pt.max_skew),
+            # same treedef as pad_problem_tiers(prepare_problem(pt)):
+            # n_real traced whenever ANY extent padded, None on-tier
+            n_real=(jnp.asarray(S, jnp.int32)
+                    if (S_pad, K_pad, C_pad, G_pad, Gc_pad)
+                    != (S, K, C, G, Gc) else None),
+        )
+    return prob, info
 
 
 # -- bucket hit/miss telemetry ---------------------------------------------

@@ -200,12 +200,19 @@ class ResidentProblem:
         when a delta's compatibility gate fails."""
         import jax.numpy as jnp
 
-        from .buckets import pad_problem_tiers
+        from .buckets import stage_problem_tiers
         from .problem import prepare_problem
 
-        prob = prepare_problem(pt, device=self._staging_device())
         if self.bucket:
-            prob, _ = pad_problem_tiers(prob, self.cfg)
+            # arena staging (compile-free), but with PRIVATE device
+            # buffers: the resident merge kernels donate these planes, so
+            # the shared device-constant cache must not hand the same
+            # array to two stagings
+            prob, _ = stage_problem_tiers(
+                pt, self.cfg, device=self._staging_device(),
+                reuse_device_constants=False)
+        else:
+            prob = prepare_problem(pt, device=self._staging_device())
         if prob.n_real is None:
             # always traced, even unpadded/on-tier: keeps one treedef for
             # every resident solve and lets the merge kernel re-park

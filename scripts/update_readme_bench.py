@@ -143,6 +143,34 @@ def render(name: str, d: dict) -> str:
             f"(parse {pipe['parse_ms']:.0f} / lower {pipe['lower_ms']:.0f} "
             f"/ stage {pipe['stage_ms']:.0f} / solve "
             f"{pipe['solve_ms']:.0f}), {pipe['violations']} violations"))
+        fe = pipe.get("frontend")
+        if fe and fe.get("warm"):
+            w = fe["warm"]
+            pc = fe.get("parse_cache", {})
+            rows.append((
+                "Warm front end, caches hot (content-addressed parse "
+                "cache + per-stage FlowCache + whole-instance lowering "
+                "reuse + staging-arena restage)",
+                f"**{w['total_ms']:.0f} ms** "
+                f"(parse {w['parse_ms']:.1f} / lower {w['lower_ms']:.1f} "
+                f"/ stage {w['stage_ms']:.1f}), parse cache "
+                f"{pc.get('hits', 0)} hits / {pc.get('misses', 0)} misses"))
+        cc = pipe.get("compile_cache")
+        if cc:
+            rows.append((
+                "Persistent caches threaded into the default leg "
+                "(`FLEET_COMPILE_CACHE` + `FLEET_PARSE_CACHE`)",
+                f"compile cache {'on' if cc.get('enabled') else 'OFF'}, "
+                f"{cc.get('entries', 0)} entries"))
+        cwf = (pipe.get("cold_warm") or {}).get("frontend")
+        if cwf:
+            rows.append((
+                "Cold → warm process restart (fresh shared XLA + parse "
+                "cache dirs)",
+                f"parse {cwf['cold_parse_ms']:.0f} → "
+                f"{cwf['warm_parse_ms']:.0f} ms "
+                f"({cwf['parse_ratio']}×), warm-process front end "
+                f"{cwf['warm_front_end_ms']:.0f} ms"))
     rows.append((
         "Reference's own path (sequential per-service Docker round-trips, "
         "engine.rs:157-167)",

@@ -1,0 +1,599 @@
+"""Front end at solver speed (ISSUE 12): the content-addressed parse
+cache, per-file fragment merging, per-stage FlowCache grain, whole-
+instance lowering reuse, compile-free arena staging, and the parallel
+ingest pool — held to a hard equivalence bar: cached/parallel paths must
+produce bit-identical lowered tensors and identical lint diagnostics
+(spans included) vs a fresh cold load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.core.kdl import _Parser, parse_document
+from fleetflow_tpu.core.loader import load_project_from_root_with_stage
+from fleetflow_tpu.core.parsecache import (ParseCache, default_parse_cache,
+                                           parse_cache_clear)
+from fleetflow_tpu.core.parser import (merge_flow_fragment, parse_kdl_string,
+                                       _parse_kdl_fragment)
+from fleetflow_tpu.registry.aggregate import (FlowCache, aggregate_fleets,
+                                              fleet_stage_hashes)
+from fleetflow_tpu.registry.model import FleetEntry, Registry
+
+
+# ---------------------------------------------------------------------------
+# project scaffolding
+# ---------------------------------------------------------------------------
+
+def _svc(name: str, cpu: float, mem: float, dep: str = None) -> str:
+    dep_line = f'\n    depends_on "{dep}"' if dep else ""
+    return (f'service "{name}" {{\n'
+            f'    image "registry.example/app:1.0"\n'
+            f'    resources {{ cpu {cpu}; memory {mem}; disk 10 }}'
+            f'{dep_line}\n}}\n')
+
+
+def _write_project(root, seed: int, n_per_file: int = 6) -> None:
+    """A multi-file project: fleet.kdl + services/{a,b}.kdl + per-stage
+    overlays, deterministic in `seed`."""
+    rng = np.random.default_rng(seed)
+    cfg = root / ".fleetflow"
+    (cfg / "services").mkdir(parents=True, exist_ok=True)
+
+    def block(prefix, n):
+        return "".join(
+            _svc(f"{prefix}-{i}", round(float(rng.uniform(0.1, 0.5)), 3),
+                 round(float(rng.uniform(64, 256)), 1))
+            for i in range(n))
+
+    names = [f"a-{i}" for i in range(n_per_file)] + \
+            [f"b-{i}" for i in range(n_per_file)]
+    stage = ('stage "prod" {\n'
+             + "".join(f'    service "{n}"\n' for n in names)
+             + "}\n"
+             'stage "dev" {\n    service "a-0"\n}\n')
+    (cfg / "fleet.kdl").write_text(
+        f'project "p{seed}"\n' + stage)
+    (cfg / "services" / "a.kdl").write_text(block("a", n_per_file))
+    (cfg / "services" / "b.kdl").write_text(block("b", n_per_file))
+    (cfg / "flow.prod.kdl").write_text(
+        'service "a-0" { labels { tier "hot" } }\n')
+
+
+def _servers_flow():
+    txt = "".join(
+        f'server "n{j}" {{ capacity {{ cpu 8; memory 4096; disk 500 }} }}\n'
+        for j in range(4))
+    return parse_kdl_string(txt, cache=False)
+
+
+def _registry(root) -> Registry:
+    return Registry(fleets={"f": FleetEntry(name="f", path=str(root))},
+                    servers=_servers_flow().servers)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches(monkeypatch):
+    # tiny test files must still flow through the cache
+    monkeypatch.setenv("FLEET_PARSE_CACHE_MIN", "1")
+    monkeypatch.delenv("FLEET_PARSE_CACHE", raising=False)
+    monkeypatch.delenv("FLEET_PARSE_WORKERS", raising=False)
+    parse_cache_clear()
+    yield
+    parse_cache_clear()
+
+
+def _assert_pt_equal(a, b, ctx=""):
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            assert np.array_equal(np.asarray(va), np.asarray(vb)), \
+                f"{ctx}: ProblemTensors.{f.name} differs"
+        elif isinstance(va, (list, tuple)) or va is None or \
+                isinstance(va, (int, float, str)) or True:
+            assert (va == vb) or (va is vb) or _eq_loose(va, vb), \
+                f"{ctx}: ProblemTensors.{f.name} differs"
+
+
+def _eq_loose(a, b):
+    try:
+        return bool(a == b)
+    except ValueError:
+        return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+
+
+# ---------------------------------------------------------------------------
+# the 6-seed mutate-one-file property (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+class TestMutateOneFileEquivalence:
+    """A mutate-one-file -> reload cycle through the parse cache and the
+    per-stage FlowCache yields bit-identical lowered tensors and identical
+    `fleet lint` JSON (codes + exact spans) vs a cold fresh load."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_bit_identical_tensors(self, tmp_path, seed):
+        _write_project(tmp_path, seed)
+        reg = _registry(tmp_path)
+        cache = FlowCache()
+        stages = {"f": ["prod"]}
+
+        pt_cold, _ = aggregate_fleets(reg, stages=stages, cache=cache)
+        # warm re-aggregation of the UNCHANGED project: whole-instance hit
+        pt_warm, _ = aggregate_fleets(reg, stages=stages, cache=cache)
+        assert pt_warm is pt_cold
+        assert cache.instance_hits == 1
+
+        # mutate ONE file, reload through the same caches
+        b = tmp_path / ".fleetflow" / "services" / "b.kdl"
+        b.write_text(b.read_text().replace("cpu 0.", "cpu 0.9", 1))
+        pt_mut, _ = aggregate_fleets(reg, stages=stages, cache=cache)
+        assert pt_mut is not pt_cold
+
+        # fresh cold load: new caches, parse cache cleared
+        parse_cache_clear()
+        pt_fresh, _ = aggregate_fleets(reg, stages=stages,
+                                       cache=FlowCache())
+        _assert_pt_equal(pt_mut, pt_fresh, ctx=f"seed {seed}")
+
+    def test_parse_cache_hits_on_reload(self, tmp_path):
+        _write_project(tmp_path, 0)
+        load_project_from_root_with_stage(str(tmp_path), "prod")
+        pc = default_parse_cache()
+        before = pc.hits
+        load_project_from_root_with_stage(str(tmp_path), "prod")
+        assert pc.hits > before
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_identical_lint_json(self, tmp_path, seed):
+        from fleetflow_tpu.lint import lint_project
+
+        _write_project(tmp_path, seed)
+        # span-carrying diagnostics: a same-file duplicate definition
+        # (FF005-shaped) and a dangling dependency on an in-stage service
+        b = tmp_path / ".fleetflow" / "services" / "b.kdl"
+        b.write_text(b.read_text()
+                     + _svc("b-0", 0.1, 64)
+                     + _svc("b-1", 0.1, 64, dep="nope-does-not-exist"))
+
+        parse_cache_clear()
+        cold = [d.to_dict() for d in
+                lint_project(str(tmp_path), "prod").diagnostics]
+        # second run: every file parse comes from the cache
+        pc = default_parse_cache()
+        before = pc.hits
+        warm = [d.to_dict() for d in
+                lint_project(str(tmp_path), "prod").diagnostics]
+        assert pc.hits > before
+        assert json.dumps(cold, sort_keys=True) == \
+            json.dumps(warm, sort_keys=True)
+        assert any(d["code"] for d in cold)  # the project does lint dirty
+
+
+# ---------------------------------------------------------------------------
+# fragment merge parity
+# ---------------------------------------------------------------------------
+
+class TestFragmentMergeParity:
+    CASES = [
+        # (file A, file B): concatenated parse == per-fragment merge
+        ('project "x"\nservice "a" { image "i:1" }\n',
+         'service "a" { replicas 3 }\nstage "s" { service "a" }\n'),
+        ('stage "s" { service "a"; server "n1" }\nservice "a" { image "i" }\n',
+         'stage "s" { service "b" { image "j" } server "n2" }\n'
+         'service "b" { image "k" }\n'),
+        ('variables { A "1"; B "2" }\nregistry "r.example/one"\n',
+         'variables { B "3" }\ntenant "acme" { display_name "Acme" }\n'
+         'provider "sakura" { zone "tk1a" }\n'),
+        ('server "n1" { capacity { cpu 4 } }\n',
+         'server "n1" { capacity { cpu 8 } }\nproject "late-name"\n'),
+    ]
+
+    @pytest.mark.parametrize("a,b", CASES, ids=range(len(CASES)))
+    def test_concat_equals_fragment_merge(self, a, b):
+        whole = parse_kdl_string(a + "\n" + b, cache=False)
+        merged = parse_kdl_string(a, cache=False)
+        merged = parse_kdl_string(b, merged, cache=False)
+        assert whole.name == merged.name
+        assert whole.services == merged.services
+        assert set(whole.stages) == set(merged.stages)
+        for k in whole.stages:
+            sa, sb = whole.stages[k], merged.stages[k]
+            assert sa.services == sb.services
+            assert sa.servers == sb.servers
+            assert sa.service_overrides == sb.service_overrides
+        assert whole.variables == merged.variables
+        assert whole.providers == merged.providers
+        assert whole.servers == merged.servers
+        assert (whole.registry is None) == (merged.registry is None)
+        if whole.registry:
+            assert whole.registry.url == merged.registry.url
+        assert (whole.tenant is None) == (merged.tenant is None)
+
+    def test_cached_fragment_not_mutated_by_merges(self):
+        text = 'service "a" { image "i:1" }\nstage "s" { service "a" }\n'
+        frag1 = parse_kdl_string(text)          # populates the cache
+        target = parse_kdl_string('service "a" { replicas 2 }', cache=False)
+        parse_kdl_string(text, target)          # merge from cache
+        # mutate the TARGET's stage; the cached fragment must be untouched
+        target.stages["s"].services.append("injected")
+        frag2 = parse_kdl_string(text)
+        assert frag2.stages["s"].services == ["a"]
+        assert frag1.stages["s"].services == ["a"]
+        # and thawed copies are caller-owned
+        frag2.services["a"].image = "mutated"
+        assert parse_kdl_string(text).services["a"].image == "i:1"
+
+
+# ---------------------------------------------------------------------------
+# parse cache mechanics
+# ---------------------------------------------------------------------------
+
+class TestParseCache:
+    def test_disk_tier_round_trip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_PARSE_CACHE", str(tmp_path / "pc"))
+        text = 'service "a" { image "i:1" }\n' * 40
+        cold = parse_kdl_string(text)
+        pc = default_parse_cache()
+        assert pc.misses == 1
+        # a "fresh process": new cache object, same disk dir
+        import fleetflow_tpu.core.parsecache as P
+        monkeypatch.setattr(P, "_default", None)
+        warm = parse_kdl_string(text)
+        pc2 = default_parse_cache()
+        assert pc2.disk_hits == 1
+        assert warm.services == cold.services
+
+    def test_corrupt_disk_entry_is_a_miss(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_PARSE_CACHE", str(tmp_path / "pc"))
+        text = 'service "z" { image "i" }\n' * 20
+        parse_kdl_string(text)
+        pc = default_parse_cache()
+        files = list((tmp_path / "pc").iterdir())
+        assert files
+        files[0].write_bytes(b"not a pickle")
+        import fleetflow_tpu.core.parsecache as P
+        monkeypatch.setattr(P, "_default", None)
+        again = parse_kdl_string(text)   # must parse fresh, not crash
+        assert again.services
+        assert default_parse_cache().misses == 1
+
+    def test_lru_bound(self, monkeypatch):
+        monkeypatch.setenv("FLEET_PARSE_CACHE_MEM", "2")
+        import fleetflow_tpu.core.parsecache as P
+        monkeypatch.setattr(P, "_default", None)
+        for i in range(5):
+            parse_kdl_string(f'service "s{i}" {{ image "i" }}\n')
+        assert len(default_parse_cache()._mem) <= 2
+
+    def test_span_parses_key_on_offset(self):
+        text = 'service "a" { image "i" }\n'
+        f0 = parse_kdl_string(text, want_spans=True, line_offset=0)
+        f9 = parse_kdl_string(text, want_spans=True, line_offset=9)
+        assert f0.services["a"].loc.line == 1
+        assert f9.services["a"].loc.line == 10
+
+    def test_spanless_hot_path_ignores_offset(self):
+        text = 'service "a" { image "i" }\n'
+        k1 = ParseCache.key(text, False, None, 0)
+        k2 = ParseCache.key(text, False, "x", 7)
+        assert k1 == k2
+
+
+# ---------------------------------------------------------------------------
+# per-stage hash grain + instance cache
+# ---------------------------------------------------------------------------
+
+class TestStageHashGrain:
+    def test_stage_overlay_edit_invalidates_one_stage(self, tmp_path):
+        _write_project(tmp_path, 1)
+        h1 = fleet_stage_hashes(str(tmp_path), ["prod", "dev"])
+        (tmp_path / ".fleetflow" / "flow.prod.kdl").write_text(
+            'service "a-0" { labels { tier "cold" } }\n')
+        h2 = fleet_stage_hashes(str(tmp_path), ["prod", "dev"])
+        assert h1["prod"] != h2["prod"]
+        assert h1["dev"] == h2["dev"]
+
+    def test_common_edit_invalidates_every_stage(self, tmp_path):
+        _write_project(tmp_path, 1)
+        h1 = fleet_stage_hashes(str(tmp_path), ["prod", "dev"])
+        p = tmp_path / ".fleetflow" / "services" / "a.kdl"
+        p.write_text(p.read_text() + "// touched\n")
+        h2 = fleet_stage_hashes(str(tmp_path), ["prod", "dev"])
+        assert h1["prod"] != h2["prod"]
+        assert h1["dev"] != h2["dev"]
+
+    def test_flowcache_reloads_only_changed_stage(self, tmp_path):
+        _write_project(tmp_path, 2)
+        reg = _registry(tmp_path)
+        cache = FlowCache()
+        stages = {"f": ["dev", "prod"]}
+        aggregate_fleets(reg, stages=stages, cache=cache)
+        assert cache.misses == 2
+        (tmp_path / ".fleetflow" / "flow.prod.kdl").write_text(
+            'service "a-0" { labels { tier "cold" } }\n')
+        aggregate_fleets(reg, stages=stages, cache=cache)
+        # dev rows reused, prod re-loaded
+        assert cache.hits == 1 and cache.misses == 3
+
+    def test_legacy_single_param_hash_still_works(self, tmp_path):
+        _write_project(tmp_path, 3)
+        reg = _registry(tmp_path)
+        cache = FlowCache()
+        versions = {str(tmp_path): "v1"}
+        stages = {"f": ["prod"]}
+        aggregate_fleets(reg, stages=stages, cache=cache,
+                         content_hash=lambda p: versions[p])
+        pt2, _ = aggregate_fleets(reg, stages=stages, cache=cache,
+                                  content_hash=lambda p: versions[p])
+        assert cache.hits >= 1 or cache.instance_hits >= 1
+        versions[str(tmp_path)] = "v2"
+        aggregate_fleets(reg, stages=stages, cache=cache,
+                         content_hash=lambda p: versions[p])
+        assert cache.misses >= 2
+
+
+# ---------------------------------------------------------------------------
+# parallel ingest
+# ---------------------------------------------------------------------------
+
+class TestParallelIngest:
+    def test_pooled_load_equals_serial(self, tmp_path, monkeypatch):
+        _write_project(tmp_path, 4, n_per_file=10)
+        # pin the env-derived variable context: the workers knob itself is
+        # an allowlisted FLEET_* variable and must not skew the comparison
+        serial = load_project_from_root_with_stage(str(tmp_path), "prod",
+                                                   environ={})
+        parse_cache_clear()
+        monkeypatch.setenv("FLEET_PARSE_WORKERS", "2")
+        pooled = load_project_from_root_with_stage(str(tmp_path), "prod",
+                                                   environ={})
+        assert serial.services == pooled.services
+        assert sorted(serial.stages) == sorted(pooled.stages)
+        assert serial.variables == pooled.variables
+
+    def test_parse_error_propagates_from_pool(self, tmp_path, monkeypatch):
+        from fleetflow_tpu.core.errors import FlowError
+
+        _write_project(tmp_path, 5)
+        bad = tmp_path / ".fleetflow" / "services" / "a.kdl"
+        bad.write_text('service "broken" {\n')   # unterminated children
+        monkeypatch.setenv("FLEET_PARSE_WORKERS", "2")
+        with pytest.raises(FlowError):
+            load_project_from_root_with_stage(str(tmp_path), "prod")
+
+    def test_kdl_error_pickles_round_trip(self):
+        import pickle
+
+        from fleetflow_tpu.core.kdl import KdlError
+
+        e = KdlError("boom", 3, 7)
+        e2 = pickle.loads(pickle.dumps(e))
+        assert (e2.line, e2.col) == (3, 7)
+        assert str(e2) == str(e)
+
+
+# ---------------------------------------------------------------------------
+# tokenizer regression corners (the master-regex fast paths)
+# ---------------------------------------------------------------------------
+
+class TestTokenizerCorners:
+    def test_comment_then_semicolon_only(self):
+        # the node-start gap must not backtrack INTO a line comment
+        assert parse_document("//c\n;") == []
+
+    def test_unicode_digit_rejected_like_scanner(self):
+        from fleetflow_tpu.core.kdl import KdlError
+        with pytest.raises(KdlError):
+            _Parser("a ٣").parse_nodes()
+
+    def test_raw_string_after_ident_prefix(self):
+        nodes = _Parser('a r"raw" r#"h#sh"#').parse_nodes()
+        assert nodes[0].args == ["raw", "h#sh"]
+
+    def test_prop_and_keyword_mix(self):
+        nodes = _Parser('n k=#true v=0x1f w="s" true').parse_nodes()
+        assert nodes[0].props == {"k": True, "v": 31, "w": "s"}
+        assert nodes[0].args == [True]
+
+    def test_fast_slow_string_parity(self):
+        doc = 'n "plain" "es\\tc\\u{41}" r"raw\\no-escape"'
+        nodes = _Parser(doc).parse_nodes()
+        assert nodes[0].args == ["plain", "es\tcA", "raw\\no-escape"]
+
+    @pytest.mark.parametrize("bad", ["n 0x", "n 1e", "n 1.2.3", "n +"])
+    def test_bad_numbers_still_raise(self, bad):
+        from fleetflow_tpu.core.kdl import KdlError
+        if bad == "n +":
+            # lone '+' is a bare-word arg, not a number — parity pin
+            assert _Parser(bad).parse_nodes()[0].args == ["+"]
+            return
+        with pytest.raises(KdlError):
+            _Parser(bad).parse_nodes()
+
+
+# ---------------------------------------------------------------------------
+# fragment internals
+# ---------------------------------------------------------------------------
+
+class TestFragmentInternals:
+    def test_fragment_offset_shifts_errors_too(self):
+        from fleetflow_tpu.core.errors import FlowError
+        with pytest.raises(FlowError) as ei:
+            _parse_kdl_fragment("ok\n}", line_offset=10)
+        assert "12:1" in str(ei.value)
+
+    def test_merge_redefinition_records(self):
+        a = parse_kdl_string('service "a" { image "one" }', cache=False)
+        frag = _parse_kdl_fragment('service "a" { image "two" }')
+        merge_flow_fragment(a, frag)
+        assert a.services["a"].image == "two"
+        assert len(a.redefinitions) == 1
+
+
+class TestInstanceDiskTier:
+    def test_fresh_flowcache_hits_disk_instance(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FLEET_PARSE_CACHE", str(tmp_path / "pc"))
+        proj = tmp_path / "proj"
+        _write_project(proj, 7)
+        reg = _registry(proj)
+        stages = {"f": ["prod"]}
+        pt1, ix1 = aggregate_fleets(reg, stages=stages, cache=FlowCache())
+        # a "fresh process": brand-new FlowCache, same disk dir
+        cache2 = FlowCache()
+        pt2, ix2 = aggregate_fleets(reg, stages=stages, cache=cache2)
+        assert cache2.instance_hits == 1 and cache2.misses == 0
+        _assert_pt_equal(pt1, pt2, ctx="disk instance")
+        assert ix1.rows == ix2.rows
+        # content change invalidates: the disk entry must not resurrect
+        b = proj / ".fleetflow" / "services" / "b.kdl"
+        b.write_text(b.read_text() + "// changed\n")
+        cache3 = FlowCache()
+        aggregate_fleets(reg, stages=stages, cache=cache3)
+        assert cache3.instance_hits == 0
+
+
+class TestArenaStaging:
+    """stage_problem_tiers (the production cold-staging path): bit-parity
+    with pad_problem_tiers(prepare_problem(pt)), watermark-correct arena
+    reuse across restages (incl. shrink-in-tier), and the donation rule
+    for the shared device-constant cache."""
+
+    def _pt(self, n_svc: int, seed: int = 11):
+        from fleetflow_tpu.lower import synthetic_problem
+        return synthetic_problem(n_svc, 8, seed=seed, port_fraction=0.3,
+                                 volume_fraction=0.2)
+
+    def _assert_prob_equal(self, a, b, ctx=""):
+        for f in dataclasses.fields(a):
+            va, vb = getattr(a, f.name), getattr(b, f.name)
+            if hasattr(va, "shape") or hasattr(vb, "shape"):
+                assert va is not None and vb is not None, (ctx, f.name)
+                assert np.asarray(va).dtype == np.asarray(vb).dtype, \
+                    (ctx, f.name)
+                assert np.array_equal(np.asarray(va), np.asarray(vb)), \
+                    (ctx, f.name)
+            else:
+                assert va == vb, (ctx, f.name, va, vb)
+
+    def test_bit_parity_with_pad_path(self):
+        from fleetflow_tpu.solver import (bucket_config, pad_problem_tiers,
+                                          prepare_problem,
+                                          stage_problem_tiers)
+        cfg = bucket_config()
+        pt = self._pt(73)
+        ref, rinfo = pad_problem_tiers(prepare_problem(pt), cfg)
+        new, ninfo = stage_problem_tiers(pt, cfg)
+        assert (rinfo.orig_S, rinfo.padded_S, rinfo.G, rinfo.Gc) == \
+            (ninfo.orig_S, ninfo.padded_S, ninfo.G, ninfo.Gc)
+        self._assert_prob_equal(ref, new, "cold")
+
+    def test_shrink_in_tier_restage_has_no_stale_rows(self):
+        from fleetflow_tpu.solver import (bucket_config, pad_problem_tiers,
+                                          prepare_problem,
+                                          stage_problem_tiers)
+        cfg = bucket_config()
+        big = self._pt(78, seed=11)
+        stage_problem_tiers(big, cfg)          # dirties the tier's arenas
+        small = self._pt(66, seed=12)          # same tier, fewer real rows
+        ref, _ = pad_problem_tiers(prepare_problem(small), cfg)
+        new, _ = stage_problem_tiers(small, cfg)
+        assert ref.S == new.S                  # same tier, property is real
+        self._assert_prob_equal(ref, new, "shrink-in-tier")
+
+    def test_device_constant_sharing_and_donation_optout(self):
+        from fleetflow_tpu.solver import bucket_config, stage_problem_tiers
+        cfg = bucket_config()
+        pt = self._pt(70, seed=13)
+        assert np.asarray(pt.eligible).all()   # the constant-plane case
+        a, _ = stage_problem_tiers(pt, cfg)
+        b, _ = stage_problem_tiers(pt, cfg)
+        # shared immutable constant on the default path
+        assert a.eligible is b.eligible
+        # donation-safe staging gets PRIVATE buffers
+        c, _ = stage_problem_tiers(pt, cfg, reuse_device_constants=False)
+        assert c.eligible is not a.eligible
+        assert np.array_equal(np.asarray(c.eligible),
+                              np.asarray(a.eligible))
+
+    def test_deleted_device_constant_is_rebuilt(self):
+        from fleetflow_tpu.solver import bucket_config, stage_problem_tiers
+        cfg = bucket_config()
+        pt = self._pt(70, seed=14)
+        a, _ = stage_problem_tiers(pt, cfg)
+        a.eligible.delete()                    # what a donation would do
+        b, _ = stage_problem_tiers(pt, cfg)
+        assert not b.eligible.is_deleted()
+        assert np.asarray(b.eligible).all()
+
+
+class TestReviewRegressions:
+    """Pins for the code-review findings on this PR."""
+
+    def test_restage_never_aliases_arena_buffers(self):
+        # jax's CPU backend zero-copies device_put for LARGE aligned
+        # arrays: a returned DeviceProblem plane sharing memory with a
+        # reusable arena would be rewritten in place by the next restage
+        from fleetflow_tpu.lower import synthetic_problem
+        from fleetflow_tpu.solver import bucket_config, stage_problem_tiers
+        from fleetflow_tpu.solver import buckets as B
+
+        pt = synthetic_problem(6000, 2000, seed=3)   # (S_pad, N) ~12 MB
+        prob, _ = stage_problem_tiers(pt, bucket_config())
+        with B._STAGE_LOCK:
+            arenas = [e[0] for e in B._ARENAS.values()]
+        for name in ("demand", "conflict_ids", "coloc_ids", "eligible",
+                     "preferred"):
+            plane = np.asarray(getattr(prob, name))
+            for arena in arenas:
+                if arena.dtype == plane.dtype:
+                    assert not np.shares_memory(plane, arena), \
+                        f"{name} aliases a shared staging arena"
+
+    def test_node_start_gap_is_atomic_no_blowup(self):
+        import time
+        # a long gap before EOF / a quoted name used to backtrack
+        # exponentially (~3x per extra char past ~25)
+        docs = ["node 1\n" + "\n" * 200,
+                " " * 120 + '"quoted" 1\n',
+                "a\n" + ";" * 150,
+                "b\n" + "\n \n " * 60 + "/* end */"]
+        t0 = time.perf_counter()
+        for doc in docs:
+            parse_document(doc, want_spans=True)
+        assert time.perf_counter() - t0 < 2.0, "node-start gap backtracked"
+
+    def test_unicode_digit_after_dot_matches_scanner(self):
+        from fleetflow_tpu.core.kdl import KdlError
+        # scanner: '1.' consumed (float 1.0), then the lone unicode digit
+        # is a value start that parses as "bad number ''"
+        with pytest.raises(KdlError, match="bad number"):
+            _Parser("n 1.٣").parse_nodes()
+
+
+class TestCrossFileConstructCompat:
+    def test_brace_opened_in_one_file_closed_in_next(self, tmp_path):
+        # historical whole-concatenation semantics: a children block may
+        # span discovered files; the fragment path falls back to one
+        # whole-text parse rather than rejecting the project
+        cfg = tmp_path / ".fleetflow"
+        (cfg / "services").mkdir(parents=True)
+        (cfg / "fleet.kdl").write_text(
+            'project "x"\nstage "prod" {\n    service "a"\n')  # unclosed!
+        (cfg / "services" / "a.kdl").write_text(
+            '}\nservice "a" { image "i:1" }\n')
+        flow = load_project_from_root_with_stage(str(tmp_path), "prod")
+        assert flow.stages["prod"].services == ["a"]
+        assert flow.services["a"].image == "i:1"
+
+    def test_genuine_error_still_raises_with_position(self, tmp_path):
+        from fleetflow_tpu.core.errors import FlowError
+        cfg = tmp_path / ".fleetflow"
+        cfg.mkdir(parents=True)
+        (cfg / "fleet.kdl").write_text('project "x"\nstage "p" {\n')
+        with pytest.raises(FlowError, match="expected '}'"):
+            load_project_from_root_with_stage(str(tmp_path), None)

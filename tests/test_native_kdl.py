@@ -223,9 +223,12 @@ def test_fleet_scale_document_parity_and_speed():
 
     assert tree(native) == tree(py)
     assert len(native) == 10_000
-    # Generous bound (measured ~3x); guards against the fast path rotting
-    # into a slow path without anyone noticing.
-    assert t_native < t_py / 2, \
+    # Guards against the native fast path rotting into a slow path without
+    # anyone noticing. The regex rewrite of the PYTHON parser (ISSUE 12)
+    # closed the gap from ~3x to ~2x, so the old t_py/2 bound sat exactly
+    # on the measured ratio and flapped under suite load; "still
+    # meaningfully faster" is the contract, not a specific multiple.
+    assert t_native < t_py * 0.75, \
         f"native {t_native:.2f}s not faster than Python {t_py:.2f}s"
 
 

@@ -396,3 +396,44 @@ class TestFusedPrerepair:
         assert res2.feasible
         assert not res2.fused_prerepair
         assert "prerepair_ms" in res2.timings_ms
+
+
+class TestZeroSweepTrustedStats:
+    """ROADMAP item 2 shave: a resident warm dispatch that exits at
+    sweeps==0 with a feasible pre-repair trusts the carried stats instead
+    of re-running the from-scratch kernels — parity pinned here against
+    the recomputed path (device violation_stats + host verify +
+    soft_score_host)."""
+
+    def test_trusted_zero_sweep_stats_match_recompute(self):
+        from fleetflow_tpu.solver.buckets import (pad_assignment,
+                                                  soft_score_host)
+        from fleetflow_tpu.solver.kernels import violation_stats
+
+        pt = synthetic_problem(73, 12, seed=3, port_fraction=0.3,
+                               volume_fraction=0.2)
+        rp = ResidentProblem(pt)
+        solve(pt, prob=rp.prob, resident=rp, seed=3, steps=16, bucket=True)
+        # capacity-only churn: the standing assignment stays feasible, so
+        # the fused prologue lands feasible and the dispatch exits at 0
+        # sweeps — the trusted-stats path under test
+        cap = pt.capacity.copy()
+        cap *= 1.25
+        pt2 = dataclasses.replace(pt, capacity=cap)
+        rp.apply_delta(pt2, ProblemDelta(capacity=cap))
+        res = solve(pt2, prob=rp.prob, resident=rp, resident_warm=True,
+                    seed=11, steps=16, bucket=True)
+        assert res.steps == 0, \
+            "expected the feasible-prologue 0-sweep exit (trusted stats)"
+        assert res.violations == 0 and res.pre_repair_violations == 0
+        # recomputed paths agree with the trusted zeros:
+        # 1. host numpy ground truth on the real rows
+        assert verify(pt2, res.assignment)["total"] == 0
+        # 2. the device from-scratch kernel on the padded winner (exactly
+        #    what the skipped recompute would have produced)
+        padded = pad_assignment(res.assignment, rp.prob.S, pt2.node_valid)
+        dstats = violation_stats(rp.prob, np.asarray(padded))
+        assert float(dstats["total"]) == 0.0
+        # 3. the reported soft is the exact host objective of the winner
+        assert res.soft == pytest.approx(
+            soft_score_host(pt2, res.assignment), abs=1e-6)
