@@ -280,6 +280,14 @@ def main() -> None:
     if os.environ.get("BENCH_ADMISSION", "1").lower() not in ("0", "false"):
         admission = _admission_scenario()
 
+    # packed problem planes (ISSUE 13): the staged layout vs the
+    # analytic model; BENCH_PACKED_ASSERT=1 fails the run on divergence
+    # or on any recompile inside the warm churn loop
+    packed = _packed_report(prob)
+    if os.environ.get("BENCH_PACKED_ASSERT", "").lower() \
+            in ("1", "true", "on", "yes"):
+        _assert_packed(packed, resched)
+
     pps = S / elapsed
     baseline_pps = 50.0  # sequential docker loop at 20 ms/call
     import jax
@@ -334,6 +342,7 @@ def main() -> None:
         "reschedule_soft_parity": resched["soft_parity"],
         "churn_affected": resched["affected_last"],
         "churn_moved": resched["moved_last"],
+        "packed": packed,
         "burst": burst,
         "sharded": sharded,
         "pipeline": pipeline,
@@ -348,6 +357,59 @@ def main() -> None:
 def _metrics_snapshot() -> dict:
     from fleetflow_tpu.obs.metrics import REGISTRY
     return REGISTRY.snapshot()
+
+
+def _packed_report(prob) -> dict:
+    """The packed-plane reality check (ISSUE 13): what the staging
+    actually holds vs the analytic packed model — S x ceil(N/32) uint32
+    words for `eligible`, no `preferred` plane at all when nothing scores
+    nodes. BENCH_PACKED_ASSERT=1 turns any divergence (or a dense plane
+    reappearing) into a failed run."""
+    from fleetflow_tpu.solver.problem import packed_width
+
+    elig = prob.eligible
+    elig_bytes = int(elig.size) * elig.dtype.itemsize
+    model_bytes = prob.S * packed_width(prob.N) * 4
+    dense_bytes = prob.S * prob.N            # the old bool plane
+    return {
+        "eligible_dtype": str(elig.dtype),
+        "eligible_bytes": elig_bytes,
+        "eligible_bytes_model": model_bytes,
+        "eligible_model_error": round(
+            abs(elig_bytes - model_bytes) / max(model_bytes, 1), 4),
+        "eligible_reduction_vs_dense_x": round(
+            dense_bytes / max(elig_bytes, 1), 1),
+        "preferred_absent": prob.preferred is None,
+        # the headline number: total (S, N) plane bytes the sweeps
+        # stream, old layout (f32 preferred + bool eligible = 5*S*N) vs
+        # what is actually staged now — ~40x when nothing scores nodes
+        "plane_reduction_vs_dense_x": round(
+            5 * dense_bytes / max(
+                elig_bytes + (0 if prob.preferred is None
+                              else int(prob.preferred.size) * 4), 1), 1),
+    }
+
+
+def _assert_packed(packed: dict, resched: dict) -> None:
+    """BENCH_PACKED_ASSERT=1: fail the run on any packed-layout breach."""
+    breaches = []
+    if packed["eligible_dtype"] != "uint32":
+        breaches.append(f"eligible plane is {packed['eligible_dtype']}, "
+                        f"not bit-packed uint32")
+    if not packed["preferred_absent"]:
+        breaches.append("a materialized preferred plane is staged")
+    if packed["eligible_model_error"] > 0.10:
+        breaches.append(
+            f"eligible bytes {packed['eligible_bytes']} diverge from the "
+            f"analytic packed model {packed['eligible_bytes_model']} by "
+            f"{packed['eligible_model_error']:.0%} (> 10%)")
+    if resched["compiles_total"] != 0:
+        breaches.append(f"warm churn loop recompiled "
+                        f"{resched['compiles_total']} time(s)")
+    if breaches:
+        print(json.dumps({"packed_assert": "FAIL", "breaches": breaches}),
+              file=sys.stderr, flush=True)
+        sys.exit(1)
 
 
 def _resident_churn_loop(pt, *, chains, steps, block, warm_block,
@@ -1176,6 +1238,18 @@ def _sharded_resident_leg(pt, D: int) -> tuple:
             sum(v for k, v in dev.items() if k.startswith("state_"))
             / 2**20, 2),
         "per_device_total_mib": round(sum(dev.values()) / 2**20, 1),
+        # packed-plane reality on the mesh (ISSUE 13): the per-device
+        # eligible shard in MiB, its dense-bool counterpart, and the
+        # reduction factor — the memory report that makes the ~32x cut a
+        # tracked number at the XL shape
+        "per_device_eligible_mib": round(
+            dev.get("eligible", 0) / 2**20, 3),
+        "per_device_eligible_dense_mib": round(
+            (rp.prob.S // svc) * rp.prob.N / 2**20, 3),
+        "eligible_reduction_x": round(
+            (rp.prob.S // svc) * rp.prob.N
+            / max(dev.get("eligible", 1), 1), 1),
+        "preferred_absent": rp.prob.preferred is None,
         "runs": runs,
     }
 

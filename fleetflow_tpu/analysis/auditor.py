@@ -211,6 +211,32 @@ def audit_case(contract, case) -> tuple[dict, list[str]]:
             f"{'; '.join(callbacks)} — the warm path must stay "
             f"transfer-guard-pure")
 
+    # ---- packed problem planes (solver/problem.py layout contract) -----
+    # pin the staged DeviceProblem's plane dtypes in the golden, and hold
+    # the packed invariants intrinsically: the eligibility plane must be
+    # bit-packed uint32 and no preference plane may exist — a dense bool
+    # or f32 (S, N) plane silently reappearing in a hot-path executable
+    # is exactly the bandwidth regression the packed layout removed
+    dtype_rec: Optional[dict] = None
+    if "prob" in case.arg_names:
+        prob = case.args[case.arg_names.index("prob")]
+        dtype_rec = {f"prob.{name}": str(v.dtype)
+                     for name, v in _flat_named(prob, ("prob",))
+                     if hasattr(v, "dtype")}
+        if getattr(contract, "packed_planes", False):
+            elig_dt = dtype_rec.get("prob.eligible")
+            if elig_dt != "uint32":
+                violations.append(
+                    f"{where}: eligibility plane is {elig_dt}, not the "
+                    f"bit-packed uint32 layout — a dense (S, N) plane is "
+                    f"back in a hot-path executable")
+            if "prob.preferred" in dtype_rec:
+                violations.append(
+                    f"{where}: a materialized preference plane "
+                    f"({dtype_rec['prob.preferred']}) is staged into a "
+                    f"hot-path executable — the packed layout keeps "
+                    f"`preferred` absent when no service scores nodes")
+
     # ---- output shardings (mesh kernels) -------------------------------
     shard_rec: Optional[dict] = None
     if case.out_shardings is not None:
@@ -232,6 +258,8 @@ def audit_case(contract, case) -> tuple[dict, list[str]]:
         "aliased": aliased,
         "host_callbacks": callbacks,
         "output_shardings": shard_rec,
+        "problem_dtypes": (dict(sorted(dtype_rec.items()))
+                           if dtype_rec is not None else None),
     }
     return rec, violations
 
@@ -332,7 +360,7 @@ def contract_diff(report: AuditReport, pinned: dict) -> list[str]:
                            f"contract file")
                 continue
             for key in ("donated", "aliased", "host_callbacks",
-                        "output_shardings"):
+                        "output_shardings", "problem_dtypes"):
                 if at[tier].get(key) != ptiers[tier].get(key):
                     out.append(
                         f"{name}@{tier}: {key} drifted: audited "

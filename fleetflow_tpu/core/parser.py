@@ -37,6 +37,7 @@ from .model import (
 
 __all__ = [
     "parse_kdl_string", "parse_kdl_file", "read_kdl_with_includes",
+    "include_patterns_of_line", "resolve_include_pattern",
     "parse_service", "parse_stage", "parse_provider", "parse_server",
     "parse_port", "parse_volume", "parse_tenant",
 ]
@@ -800,6 +801,31 @@ def parse_kdl_string(text: str, flow: Optional[Flow] = None, *,
     return merge_flow_fragment(flow, frag)
 
 
+def include_patterns_of_line(stripped: str) -> Optional[list[str]]:
+    """The include-glob patterns when `stripped` is an `include` node
+    line, else None. THE one definition of the include line discipline —
+    shared by the loader's expansion (`_read_expanded`) and the cache
+    hashes' include scanner (registry/aggregate.py), so what invalidates
+    a cache can never drift from what a load actually reads."""
+    if not (stripped.startswith("include ") or stripped == "include"):
+        return None
+    try:
+        nodes = parse_document(stripped)
+    except Exception:
+        return None
+    if not nodes or nodes[0].name != "include":
+        return None
+    return [str(a) for a in nodes[0].args]
+
+
+def resolve_include_pattern(pat: str, base: str) -> tuple[list[str], str]:
+    """(sorted on-disk matches, resolved pattern) for one include glob
+    against `base` — the shared resolution rule (absolute patterns stand,
+    relative ones join the including file's real directory)."""
+    full = pat if os.path.isabs(pat) else os.path.join(base, pat)
+    return sorted(globmod.glob(full)), full
+
+
 def _read_expanded(path: str, seen: set[str]
                    ) -> tuple[list[str], list[tuple[int, int, str, int]]]:
     """Recursive include expansion with segment tracking.
@@ -833,29 +859,21 @@ def _read_expanded(path: str, seen: set[str]
         run_out, run_src = len(out), next_src_line
 
     for i, line in enumerate(text.splitlines()):
-        stripped = line.strip()
-        if stripped.startswith("include ") or stripped == "include":
-            try:
-                nodes = parse_document(stripped)
-            except Exception:
-                out.append(line)
-                continue
-            if nodes and nodes[0].name == "include":
-                flush(i + 2)    # the include line itself emits nothing
-                patterns = [str(a) for a in nodes[0].args]
-                for pat in patterns:
-                    full = pat if os.path.isabs(pat) else os.path.join(base, pat)
-                    matches = sorted(globmod.glob(full))
-                    if not matches and not globmod.has_magic(full):
-                        raise FlowError(f"include target not found: {pat}")
-                    for m in matches:
-                        sub_lines, sub_segs = _read_expanded(m, seen)
-                        offset = len(out)
-                        segs.extend((offset + s, n, p, ls)
-                                    for s, n, p, ls in sub_segs)
-                        out.extend(sub_lines)
-                run_out = len(out)
-                continue
+        patterns = include_patterns_of_line(line.strip())
+        if patterns is not None:
+            flush(i + 2)    # the include line itself emits nothing
+            for pat in patterns:
+                matches, full = resolve_include_pattern(pat, base)
+                if not matches and not globmod.has_magic(full):
+                    raise FlowError(f"include target not found: {pat}")
+                for m in matches:
+                    sub_lines, sub_segs = _read_expanded(m, seen)
+                    offset = len(out)
+                    segs.extend((offset + s, n, p, ls)
+                                for s, n, p, ls in sub_segs)
+                    out.extend(sub_lines)
+            run_out = len(out)
+            continue
         out.append(line)
     flush(0)
     return out, segs

@@ -34,6 +34,11 @@ Codes are stable (never renumber; retire by leaving a gap):
                   stage: ports/volumes/anti-affinity/coloc/deps or
                   replicas>1 can't ride the streaming delta path;
                   deploy.submit sheds it at runtime (cp/admission.py)
+  FF016  info     placement plane memory: the stage's estimated
+                  per-device solver bytes (packed (S, N) plane math,
+                  solver/problem.py) exceed the configured device budget
+                  (FLEET_LINT_DEVICE_BUDGET_MB) — surfaced at lint time,
+                  before a staging OOM does it the hard way
 
 Rules are pure functions over a :class:`LintContext`; `scope` says what
 they iterate ("flow" once, "stage" per stage) and `structural=True` marks
@@ -587,3 +592,71 @@ def check_bucket_waste(r: Rule, ctx: LintContext, stage: Stage):
         hint=f"dropping {rows - lower} row(s) would fit the {lower} "
              f"bucket; or tune FLEET_BUCKET_GROWTH/FLEET_BUCKET_MIN "
              f"(docs/guide/11-performance.md)")
+
+
+def _plane_budget_bytes() -> int:
+    """FLEET_LINT_DEVICE_BUDGET_MB (default 16384 — one v5e chip's HBM):
+    the per-device byte budget FF016 estimates stages against."""
+    import os
+    try:
+        mb = float(os.environ.get("FLEET_LINT_DEVICE_BUDGET_MB", "")
+                   or 16384)
+    except ValueError:
+        mb = 16384.0
+    return int(mb * 1e6)
+
+
+@rule("FF016", "placement-plane-memory", Severity.INFO, "stage")
+def check_plane_memory(r: Rule, ctx: LintContext, stage: Stage):
+    """The stage's estimated per-device solver bytes exceed the device
+    budget: the same packed-plane math the staged problem actually uses
+    (solver/problem.py — bit-packed (S, ceil(N/32)) uint32 eligibility,
+    a preference plane only when the stage scores nodes), evaluated at
+    the bucket tier the rows pad to, plus the node capacity/load planes.
+    The anneal's (N, G)/(N, Gc) occupancy tables are NOT estimated —
+    G/Gc depend on lowered content (port/volume/anti/coloc groups), so
+    the estimate is a floor, not a ceiling. Advisory (INFO, never
+    gates): an operator sees the memory shape of a stage at lint time
+    instead of at a staging OOM."""
+    if ctx.local:
+        return          # local execution never stages on a device
+    nodes, is_local = ctx.stage_nodes(stage)
+    if is_local:
+        return
+    services = ctx.container_services(stage)
+    rows = sum(_replicas(s) for s in services)
+    if rows == 0:
+        return
+    from ..core.model import ResourceSpec
+    from ..solver.buckets import bucket_config, bucket_size
+    from ..solver.problem import packed_width
+
+    cfg = bucket_config()
+    S_pad = (bucket_size(rows, growth=cfg.growth, minimum=cfg.minimum,
+                         align=cfg.align) if cfg.enabled else rows)
+    N = len(nodes)
+    R = len(ResourceSpec.axes())
+    # the packed (S, N) planes + per-row tables the staging materializes
+    elig = S_pad * packed_width(N) * 4          # bit-packed uint32 words
+    has_pref = bool(stage.placement and stage.placement.preferred_labels)
+    pref = S_pad * N * 4 if has_pref else 0     # absent plane costs zero
+    demand = S_pad * R * 4
+    node_planes = N * R * 4 * 2                 # capacity + carried load
+    est = elig + pref + demand + node_planes
+    budget = _plane_budget_bytes()
+    if est <= budget:
+        return
+    parts = [f"eligible {elig / 1e6:.1f} MB (packed)"]
+    if has_pref:
+        parts.append(f"preferred {pref / 1e6:.1f} MB")
+    parts.append(f"demand {demand / 1e6:.1f} MB")
+    yield ctx.diag(
+        r, f"stage {stage.name!r} stages ~{est / 1e6:.1f} MB of solver "
+           f"planes per device ({rows} row(s) padded to {S_pad} x {N} "
+           f"node(s): {', '.join(parts)}), over the "
+           f"{budget / 1e6:.0f} MB device budget",
+        loc=stage.loc, stage=stage,
+        hint="shard the stage over a device mesh (FLEET_SHARDED=1 — the "
+             "packed (S, ·) planes divide by mesh width), or raise "
+             "FLEET_LINT_DEVICE_BUDGET_MB if the device is larger "
+             "(docs/guide/11-performance.md)")

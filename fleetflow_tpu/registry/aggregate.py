@@ -107,16 +107,96 @@ class FlowCache:
                 "instance_hits": self.instance_hits}
 
 
+def _scan_include_targets(path: str, data: bytes) -> list[str]:
+    """The on-disk paths a KDL file's `include "glob"` nodes match right
+    now — a lightweight static scan (no expansion, no not-found errors:
+    the loader reports those; the hash just has to cover what a load
+    WOULD read). Line discipline and glob resolution are the parser's
+    own helpers, so the scan cannot drift from what `_read_expanded`
+    actually loads."""
+    try:
+        text = data.decode("utf-8")
+    except UnicodeDecodeError:
+        return []
+    if "include" not in text:
+        return []
+    from ..core.parser import (include_patterns_of_line,
+                               resolve_include_pattern)
+
+    base = os.path.dirname(os.path.realpath(path))
+    out: list[str] = []
+    for line in text.splitlines():
+        patterns = include_patterns_of_line(line.strip())
+        if not patterns:
+            continue
+        for pat in patterns:
+            out.extend(resolve_include_pattern(pat, base)[0])
+    return out
+
+
+def _out_of_root_includes(file_data: list[tuple[str, bytes]]
+                          ) -> list[tuple[str, list[str], bytes]]:
+    """Follow `include` globs out of the walked file set: returns
+    ``(realpath, sorted walked origins, bytes)`` for every file any
+    walked (or transitively included) KDL file references that the walk
+    itself did not hash — truly out-of-root files AND under-root files
+    with names the walk skips (an `include "fragments/foo.conf"`, say).
+    Closes the PR-11 cache blind spot: an edit to an included file
+    OUTSIDE the root must invalidate the parse/lowered-instance caches
+    exactly like an in-root edit.
+
+    `file_data` carries the walked files' already-read bytes (the hash
+    loop read them anyway — no second disk pass, no window for the
+    scanned bytes to differ from the hashed bytes). Origins are exact
+    under SHARING: each walked file's include closure is traversed
+    separately (per-file scan/read results memoized), so a fragment two
+    overlays both reach — directly or through a shared intermediate —
+    lists both as origins and sinks into both scopes."""
+    walked = {os.path.realpath(f) for f, _ in file_data}
+    datas: dict[str, bytes] = {}         # out-of-walk realpath -> bytes
+    targets: dict[str, list[str]] = {}   # memoized per-file scan
+    origins: dict[str, set] = {}         # -> walked files reaching it
+
+    def read(rt: str) -> bytes:
+        if rt not in datas:
+            try:
+                with open(rt, "rb") as fh:
+                    datas[rt] = fh.read()
+            except OSError:
+                datas[rt] = b"<unreadable>"
+        return datas[rt]
+
+    def targets_of(rt: str) -> list[str]:
+        if rt not in targets:
+            targets[rt] = (_scan_include_targets(rt, read(rt))
+                           if rt.endswith(".kdl") else [])
+        return targets[rt]
+
+    for f, data in file_data:
+        if not f.endswith(".kdl"):
+            continue
+        stack = [os.path.realpath(t)
+                 for t in _scan_include_targets(f, data)]
+        visited: set[str] = set()
+        while stack:
+            rt = stack.pop()
+            if rt in walked or rt in visited:
+                continue
+            visited.add(rt)
+            read(rt)
+            origins.setdefault(rt, set()).add(f)
+            stack.extend(os.path.realpath(t) for t in targets_of(rt))
+    return [(rt, sorted(origins[rt]), datas[rt]) for rt in sorted(origins)]
+
+
 def fleet_content_hash(path: str) -> str:
     """Hash of the load inputs for a fleet root: every *.kdl and .env*
-    file under it (names + bytes, sorted walk) plus the allowlisted
-    process env (FLEET_*/CI_*/APP_* — the loader injects those into the
-    template context, so an export must invalidate just like an edit).
-
-    Known blind spot: `include` globs can reference files OUTSIDE the
-    fleet root; edits to those are invisible to this hash. A fleet using
-    out-of-root includes should pass a custom `content_hash` to
-    aggregate_fleets (or skip the cache for that registry)."""
+    file under it (names + bytes, sorted walk), every file its `include`
+    globs reach OUTSIDE the root (followed transitively — the PR-11
+    blind spot: an edit to an out-of-root included file must invalidate
+    like an in-root edit), plus the allowlisted process env
+    (FLEET_*/CI_*/APP_* — the loader injects those into the template
+    context, so an export must invalidate just like an edit)."""
     from ..core.template import ENV_ALLOWLIST_PREFIXES
 
     h = hashlib.sha256()
@@ -129,13 +209,19 @@ def fleet_content_hash(path: str) -> str:
             for n in sorted(names):
                 if n.endswith(".kdl") or n.startswith(".env"):
                     files.append(os.path.join(root, n))
+    file_data: list[tuple[str, bytes]] = []
     for f in files:
-        h.update(f.encode())
         try:
             with open(f, "rb") as fh:
-                h.update(fh.read())
+                data = fh.read()
         except OSError:
-            h.update(b"<unreadable>")
+            data = b"<unreadable>"
+        file_data.append((f, data))
+        h.update(f.encode())
+        h.update(data)
+    for rt, _srcs, data in _out_of_root_includes(file_data):
+        h.update(rt.encode())
+        h.update(data)
     for k in sorted(os.environ):
         if k.startswith(ENV_ALLOWLIST_PREFIXES):
             h.update(f"{k}={os.environ[k]}".encode())
@@ -226,8 +312,10 @@ def fleet_stage_hashes(path: str, stages: list[str]) -> dict[str, str]:
     the fleet-common load inputs plus only that stage's scoped files
     (flow.{stage}.kdl, .env.{stage}) and the allowlisted env. An edit to
     flow.prod.kdl then invalidates the prod rows only — single-stage
-    churn re-lowers one stage instead of one fleet. Same out-of-root
-    include blind spot as :func:`fleet_content_hash`."""
+    churn re-lowers one stage instead of one fleet. `include` globs are
+    followed out of the fleet root (transitively), sinking into the
+    including file's scope: an edit to a shared out-of-root fragment
+    invalidates exactly the stages that load it."""
     from ..core.template import ENV_ALLOWLIST_PREFIXES
 
     scoped = {s: hashlib.sha256() for s in stages}
@@ -240,6 +328,7 @@ def fleet_stage_hashes(path: str, stages: list[str]) -> dict[str, str]:
             for n in sorted(names):
                 if n.endswith(".kdl") or n.startswith(".env"):
                     files.append(os.path.join(root, n))
+    relevant: list[tuple[str, bytes]] = []    # files that sink somewhere
     for f in files:
         stage = _stage_scoped(f, path)
         if stage is not None and stage not in scoped:
@@ -249,10 +338,23 @@ def fleet_stage_hashes(path: str, stages: list[str]) -> dict[str, str]:
                 data = fh.read()
         except OSError:
             data = b"<unreadable>"
+        relevant.append((f, data))
         sinks = [scoped[stage]] if stage is not None else \
             list(scoped.values())
         for sink in sinks:
             sink.update(f.encode())
+            sink.update(data)
+    for rt, srcs, data in _out_of_root_includes(relevant):
+        # included content enters through the file(s) that include it,
+        # so it sinks into the union of their scopes (a stage overlay's
+        # include -> that stage only; any common includer -> every stage)
+        src_stages = {_stage_scoped(src, path) for src in srcs}
+        if None in src_stages:
+            sinks = list(scoped.values())
+        else:
+            sinks = [scoped[s] for s in sorted(src_stages) if s in scoped]
+        for sink in sinks:
+            sink.update(rt.encode())
             sink.update(data)
     env_blob = b"".join(
         f"{k}={os.environ[k]}".encode() for k in sorted(os.environ)

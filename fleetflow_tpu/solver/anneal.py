@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .kernels import real_row_weights
-from .problem import DeviceProblem
+from .problem import DeviceProblem, eligible_lookup, eligible_row
 
 __all__ = ["anneal", "anneal_adaptive", "anneal_states",
            "anneal_adaptive_states", "chain_states_from_assignment",
@@ -94,7 +94,7 @@ def prerepair_state(prob: DeviceProblem, st: ChainState,
     ar = jnp.arange(prob.S)
 
     def stranded_of(st):
-        return (~prob.eligible[ar, st.assignment]
+        return (~eligible_lookup(prob.eligible, ar, st.assignment)
                 | ~prob.node_valid[st.assignment])
 
     def cond(carry):
@@ -117,7 +117,7 @@ def prerepair_state(prob: DeviceProblem, st: ChainState,
         fits = ((st.load + d[None, :])
                 <= prob.capacity * (1 + 1e-6)).all(-1)          # (N,)
         conf_free = ((st.used[:, safe] * valid).sum(-1) == 0)    # (N,)
-        elig = prob.eligible[s] & prob.node_valid                # (N,)
+        elig = eligible_row(prob.eligible, s, prob.N) & prob.node_valid  # (N,)
         ok = fits & conf_free & elig
         util = (st.load / jnp.maximum(prob.capacity, 1e-6)).max(-1)
         # clean candidates rank first; any eligible node beats staying
@@ -160,7 +160,8 @@ def state_violation_stats(prob: DeviceProblem, st: ChainState) -> dict:
     cap_cells = (st.load > prob.capacity * (1 + 1e-6)).sum().astype(jnp.float32)
     c = st.used.astype(jnp.float32)
     conflict_pairs = (c * (c - 1.0) / 2.0).sum()
-    inelig = (~prob.eligible[jnp.arange(prob.S), st.assignment]).sum()
+    inelig = (~eligible_lookup(prob.eligible, jnp.arange(prob.S),
+                               st.assignment)).sum()
     invalid = (~prob.node_valid[st.assignment]).sum()
     elig = (inelig + invalid).astype(jnp.float32)
     if prob.max_skew > 0:
@@ -213,10 +214,13 @@ def state_soft_score(prob: DeviceProblem, st: ChainState) -> jax.Array:
         strat = -usq / denom
     else:
         strat = (st.assignment.astype(jnp.float32) / denom).mean()
-    pref = -prob.preferred[jnp.arange(prob.S), st.assignment].mean()
+    if prob.preferred is None:
+        pref = jnp.float32(0.0)   # absent plane: no zeros to stream
+    else:
+        pref = -prob.preferred[jnp.arange(prob.S), st.assignment].mean()
     if prob.sticky_prev is not None:
         prev = prob.sticky_prev
-        anchored = (prob.eligible[jnp.arange(prob.S), prev]
+        anchored = (eligible_lookup(prob.eligible, jnp.arange(prob.S), prev)
                     & prob.node_valid[prev])
         at_prev = ((st.assignment == prev) & anchored)
         # the materialized plane added sticky_w * S at [s, prev[s]], whose
@@ -323,17 +327,21 @@ def _proposal_delta(prob: DeviceProblem, state: ChainState,
                     s: jax.Array, b: jax.Array) -> jax.Array:
     """Annealing-cost delta of moving service s to node b (no apply)."""
     a = state.assignment[s]
-    elig_a = prob.eligible[s, a] & prob.node_valid[a]
-    elig_b = prob.eligible[s, b] & prob.node_valid[b]
+    elig_a = eligible_lookup(prob.eligible, s, a) & prob.node_valid[a]
+    elig_b = eligible_lookup(prob.eligible, s, b) & prob.node_valid[b]
     r = (jnp.int32(1) if prob.n_real is None
          else (s < prob.n_real).astype(jnp.int32))
-    d_pref = (prob.preferred[s, a] - prob.preferred[s, b]) / prob.S
+    if prob.preferred is None:
+        d_pref = jnp.float32(0.0)
+    else:
+        d_pref = (prob.preferred[s, a] - prob.preferred[s, b]) / prob.S
     if prob.sticky_prev is not None:
         # on-the-fly migration stickiness: the materialized plane's
         # bonus[s, prev[s]] = sticky_w * S contributed exactly
         # sticky_w * (at_prev(a) - at_prev(b)) through d_pref's /S
         prev = prob.sticky_prev[s]
-        anchored = prob.eligible[s, prev] & prob.node_valid[prev]
+        anchored = (eligible_lookup(prob.eligible, s, prev)
+                    & prob.node_valid[prev])
         d_pref = d_pref + prob.sticky_w * (
             ((a == prev) & anchored).astype(jnp.float32)
             - ((b == prev) & anchored).astype(jnp.float32))
@@ -371,7 +379,8 @@ def _batched_step(prob: DeviceProblem, state: ChainState,
     u = state.used
     conf_node = ((u * (u - 1)).sum(-1) > 0)                          # (N,)
     hot_node = over_node | conf_node
-    svc_bad = (~prob.eligible[jnp.arange(prob.S), state.assignment]
+    svc_bad = (~eligible_lookup(prob.eligible, jnp.arange(prob.S),
+                                state.assignment)
                | ~prob.node_valid[state.assignment])
     hot = hot_node[state.assignment] | svc_bad                       # (S,)
     logits = jnp.where(hot, 0.0, -30.0)

@@ -592,7 +592,11 @@ def _solve(pt: ProblemTensors, *,
              min(warm_block, anneal_block) if warm else anneal_block,
              proposals_per_step, fused, prerepair_moves,
              bool(resident_warm and adaptive and fused),
-             prob.n_real is not None))
+             prob.n_real is not None,
+             # plane layout is part of the executable identity: a packed
+             # and a dense staging (or absent vs present preference) are
+             # different treedefs/dtypes, hence different XLA programs
+             str(prob.eligible.dtype), prob.preferred is not None))
         _M_BUCKET.inc(hit="true" if binfo.hit else "false")
         _M_PAD_WASTE.set(binfo.pad_waste)
     # the PRNG key is minted BEFORE the transfer guard arms: it is not a

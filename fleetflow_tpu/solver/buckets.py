@@ -22,7 +22,9 @@ arange(N)) and follows it.
 
 Padded service rows are PHANTOMS — the same construction the sharded
 mega-solve uses (`pad_problem`, generalized here from solver/sharded.py):
-zero demand, no conflict/coloc ids, zero preference, eligible everywhere.
+zero demand, no conflict/coloc ids, no preference (the packed layout
+keeps the plane absent; a present plane pads with zeros), eligible
+everywhere (all-ones packed words).
 A phantom parked on any *valid* node is provably inert:
 
   capacity     zero demand adds nothing to any load cell
@@ -169,9 +171,18 @@ def _pad_cols(a, pad: int, fill):
     return jnp.pad(a, [(0, 0), (0, pad)], constant_values=fill)
 
 
+def _elig_fill(eligible):
+    """Phantom-row fill for the eligibility plane: all-ones words when
+    bit-packed (solver/problem.py packed layout), True when dense bool.
+    Pad bits of a packed row are never read (gathers index columns < N)."""
+    import jax.numpy as jnp
+    return (np.uint32(0xFFFFFFFF) if eligible.dtype == jnp.uint32
+            else True)
+
+
 def pad_problem(prob, multiple: int):
     """Pad the service axis up to a multiple of ``multiple`` with phantom
-    services (zero demand, no conflict/coloc ids, eligible everywhere, zero
+    services (zero demand, no conflict/coloc ids, eligible everywhere, no
     preference): they sit wherever the annealer leaves them without
     touching any constraint or score. Returns (padded problem, original S)
     — slice the returned assignment back to [:orig_S].
@@ -183,14 +194,16 @@ def pad_problem(prob, multiple: int):
     pad = (-S) % multiple
     if pad == 0:
         return prob, S
+    kw = {}
+    if prob.preferred is not None:   # absent plane stays absent
+        kw["preferred"] = _pad_rows(prob.preferred, pad, 0.0)
     return dataclasses.replace(
         prob,
         demand=_pad_rows(prob.demand, pad, 0.0),
         conflict_ids=_pad_rows(prob.conflict_ids, pad, -1),
         coloc_ids=_pad_rows(prob.coloc_ids, pad, -1),
-        eligible=_pad_rows(prob.eligible, pad, True),
-        preferred=_pad_rows(prob.preferred, pad, 0.0),
-        S=S + pad,
+        eligible=_pad_rows(prob.eligible, pad, _elig_fill(prob.eligible)),
+        S=S + pad, **kw,
     ), S
 
 
@@ -235,14 +248,16 @@ def pad_problem_tiers(prob, cfg: Optional[BucketConfig] = None):
     # already-resident problem) is preserved.
     n_real = (prob.n_real if prob.n_real is not None
               else jnp.asarray(prob.S, jnp.int32))
+    kw = {}
+    if prob.preferred is not None:   # absent plane stays absent
+        kw["preferred"] = _pad_rows(prob.preferred, pad, 0.0)
     return dataclasses.replace(
         prob,
         demand=_pad_rows(prob.demand, pad, 0.0),
         conflict_ids=_pad_rows(conflict_ids, pad, -1),
         coloc_ids=_pad_rows(coloc_ids, pad, -1),
-        eligible=_pad_rows(prob.eligible, pad, True),
-        preferred=_pad_rows(prob.preferred, pad, 0.0),
-        S=S_pad, G=G_pad, Gc=Gc_pad, n_real=n_real,
+        eligible=_pad_rows(prob.eligible, pad, _elig_fill(prob.eligible)),
+        S=S_pad, G=G_pad, Gc=Gc_pad, n_real=n_real, **kw,
     ), info
 
 
@@ -350,9 +365,11 @@ def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
     bit-identical tensors, same statics — but compile-free: padded host
     planes are assembled in reusable per-tier arenas and uploaded with
     plain device_put (no jnp.pad / on-device fill ops, so a cold process
-    pays zero staging compiles), and the two dense (S, N) planes reuse an
-    immutable device-side constant cache in the common degenerate cases
-    (eligible all-True, preferred absent).
+    pays zero staging compiles). The eligibility plane stages BIT-PACKED
+    (solver/problem.py, 8x fewer arena/upload/sweep bytes; FLEET_PACKED=0
+    restores dense bool), an absent preference stays absent (no zero
+    plane at all), and the all-True eligible constant reuses an immutable
+    device-side cache.
 
     Returns (DeviceProblem, BucketInfo). ``reuse_device_constants=False``
     opts out of the shared device cache — REQUIRED for stagings whose
@@ -362,9 +379,12 @@ def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
     import jax
     import jax.numpy as jnp
 
-    from .problem import STRATEGY_CODES, DeviceProblem, _unify_conflict_ids
+    from .problem import (STRATEGY_CODES, DeviceProblem, _unify_conflict_ids,
+                          pack_bool_rows, packed_enabled, packed_width,
+                          record_plane_bytes)
 
     cfg = cfg or bucket_config()
+    packed = packed_enabled()
     conflict = _unify_conflict_ids(pt)
     S, N = pt.S, pt.N
     K = conflict.shape[1]
@@ -415,7 +435,25 @@ def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
 
         eligible_np = np.asarray(pt.eligible)
         all_eligible = bool(eligible_np.all())
-        if all_eligible and reuse_device_constants:
+        if packed:
+            # bit-packed plane: 8x fewer bytes through the arena, the
+            # upload, AND every anneal sweep (solver/problem.py). Phantom
+            # rows (and the all-eligible constant) are all-ones words —
+            # pad bits past N are never read.
+            W = packed_width(N)
+            ones = np.uint32(0xFFFFFFFF)
+            if all_eligible and reuse_device_constants:
+                eligible_arr = _device_const_locked(
+                    "eligible_true_packed", (S_pad, W), np.uint32, ones,
+                    device)
+            else:
+                elig = _arena_take_locked("eligible_packed", (S_pad, W),
+                                          np.uint32, ones,
+                                          0 if all_eligible else S)
+                if not all_eligible:
+                    elig[:S] = pack_bool_rows(eligible_np)
+                eligible_arr = put_arena(elig)
+        elif all_eligible and reuse_device_constants:
             eligible_arr = _device_const_locked("eligible_true",
                                                 (S_pad, N), bool, True,
                                                 device)
@@ -427,7 +465,11 @@ def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
             eligible_arr = put_arena(elig)
 
         if pt.preferred is None:
-            if reuse_device_constants:
+            if packed:
+                # absent by design: no zero plane is ever materialized —
+                # the executables for this treedef carry no pref term
+                preferred_arr = None
+            elif reuse_device_constants:
                 preferred_arr = _device_const_locked(
                     "preferred_zero", (S_pad, N), np.float32, 0.0, device)
             else:
@@ -458,6 +500,7 @@ def stage_problem_tiers(pt, cfg: Optional[BucketConfig] = None,
                     if (S_pad, K_pad, C_pad, G_pad, Gc_pad)
                     != (S, K, C, G, Gc) else None),
         )
+    record_plane_bytes(prob)
     return prob, info
 
 

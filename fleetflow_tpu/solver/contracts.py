@@ -71,6 +71,11 @@ class KernelContract:
     # story; a dropped alias here is a silent memory/latency regression
     must_alias: tuple = ()
     needs_devices: int = 1
+    # packed-plane policing (solver/problem.py): the staged problem must
+    # carry a bit-packed uint32 eligibility plane and NO preference plane
+    # — an f32/bool (S, N) plane reappearing in a hot-path executable is
+    # an intrinsic audit violation, not just a golden diff
+    packed_planes: bool = True
 
 
 def problem_static_fields() -> list[str]:
@@ -108,9 +113,13 @@ _MERGE_ARG_NAMES = ("prob", "assignment", "node_valid", "capacity",
 
 # the donated (S, .) buffers whose in-place reuse the merge kernels exist
 # for; small node-state leaves may or may not alias (XLA's choice) and
-# prob.n_real is replaced by the n_real argument, so none of those gate
+# prob.n_real is replaced by the n_real argument, so none of those gate.
+# prob.preferred is ABSENT from the packed layout (solver/problem.py): the
+# hot-path stagings carry no preference plane, so there is nothing to
+# alias — and the packed-plane policing below guarantees one can never
+# silently reappear.
 _MERGE_MUST_ALIAS = ("prob.demand", "prob.eligible", "prob.conflict_ids",
-                     "prob.coloc_ids", "prob.preferred", "assignment")
+                     "prob.coloc_ids", "assignment")
 
 
 def _merge_case(rp, pt, tier: str,
@@ -150,7 +159,6 @@ def _sharded_merge_decl_shardings() -> dict:
     return {
         "prob.demand": svc, "prob.eligible": svc,
         "prob.conflict_ids": svc, "prob.coloc_ids": svc,
-        "prob.preferred": svc,
         "prob.capacity": rep, "prob.node_valid": rep,
         "prob.node_topology": rep, "prob.n_real": rep,
         "assignment": svc,

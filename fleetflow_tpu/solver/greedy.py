@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .problem import DeviceProblem
+from .problem import DeviceProblem, eligible_row, eligible_rows
 
 __all__ = ["greedy_place", "greedy_place_batched", "placement_order",
            "partitioned_seed"]
@@ -72,7 +72,8 @@ def greedy_place(prob: DeviceProblem, order: jax.Array,
         conflict = (used[:, safe] * valid_ids[None, :]).sum(-1) > 0   # (N,)
         new_load = load + d[None, :]                                   # (N, R)
         fits = (new_load <= prob.capacity + eps).all(-1)
-        ok = fits & prob.eligible[s] & prob.node_valid & ~conflict
+        elig_s = eligible_row(prob.eligible, s, prob.N)
+        ok = fits & elig_s & prob.node_valid & ~conflict
 
         u_after = new_load / jnp.maximum(prob.capacity, 1e-6)
         usq = (u_after * u_after).sum(-1)                              # (N,)
@@ -82,14 +83,15 @@ def greedy_place(prob: DeviceProblem, order: jax.Array,
             score = usq
         else:                       # fill_lowest: low node index first
             score = -jnp.arange(prob.N, dtype=jnp.float32)
-        score = score + prob.preferred[s] * 0.5
+        if prob.preferred is not None:
+            score = score + prob.preferred[s] * 0.5
 
         best_ok = jnp.argmax(jnp.where(ok, score, _NEG))
         if best_effort:
             overflow = jnp.maximum(new_load - prob.capacity, 0.0).sum(-1)
             n_conf = (used[:, safe] * valid_ids[None, :]).sum(-1)
             fb_score = -(overflow * 1e3 + n_conf.astype(jnp.float32) * 1e3) + score
-            fb_ok = prob.eligible[s] & prob.node_valid
+            fb_ok = elig_s & prob.node_valid
             best_fb = jnp.argmax(jnp.where(fb_ok, fb_score, fb_score - 1e15))
             node = jnp.where(ok.any(), best_ok, best_fb)
         else:
@@ -130,7 +132,8 @@ def _node_scores(prob: DeviceProblem, load: jax.Array, svc: jax.Array):
     else:                        # fill_lowest: low node index first
         score = jnp.broadcast_to(-jnp.arange(prob.N, dtype=jnp.float32),
                                  usq.shape)
-    score = score + prob.preferred[svc] * 0.5
+    if prob.preferred is not None:
+        score = score + prob.preferred[svc] * 0.5
     overflow = jnp.maximum(new_load - prob.capacity[None], 0.0).sum(-1)
     return score, fits, overflow
 
@@ -224,7 +227,8 @@ def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
         def choose(load, used, live):
             score, fits, overflow = _node_scores(prob, load, svc)
             conflict = _conflict_rows(prob, used, svc)
-            hard_ok = (fits & prob.eligible[svc] & prob.node_valid[None]
+            elig_b = eligible_rows(prob.eligible, svc, prob.N)   # (M, N)
+            hard_ok = (fits & elig_b & prob.node_valid[None]
                        & ~conflict)
             masked = jnp.where(hard_ok, score, _NEG)
             # Anti-herding ranks: a plain argmax sends every batch-mate to
@@ -256,7 +260,7 @@ def greedy_place_batched(prob: DeviceProblem, order: jax.Array,
             best_ok = jnp.take_along_axis(topk, r_eff[:, None], 1)[:, 0]
             # fallback: least overflow / fewest conflicts among eligible
             fb_score = score - overflow * 1e3 - conflict * 1e3
-            fb_ok = prob.eligible[svc] & prob.node_valid[None]
+            fb_ok = elig_b & prob.node_valid[None]
             best_fb = jnp.argmax(jnp.where(fb_ok, fb_score, fb_score - 1e15),
                                  axis=-1)
             has_ok = hard_ok.any(-1)

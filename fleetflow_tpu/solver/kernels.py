@@ -28,7 +28,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .problem import DeviceProblem
+from .problem import DeviceProblem, eligible_lookup
 
 __all__ = ["node_loads", "group_counts", "violation_stats", "total_violations",
            "soft_score", "total_cost", "real_row_weights", "W_HARD"]
@@ -90,7 +90,8 @@ def violation_stats(prob: DeviceProblem, assignment: jax.Array) -> dict:
     counts = group_counts(prob, assignment, prob.conflict_ids, prob.G)
     conflict_pairs = _conflict_pairs(counts)
 
-    inelig = (~prob.eligible[jnp.arange(prob.S), assignment]).sum()
+    inelig = (~eligible_lookup(prob.eligible, jnp.arange(prob.S),
+                               assignment)).sum()
     invalid = (~prob.node_valid[assignment]).sum()
     elig = (inelig + invalid).astype(jnp.float32)
 
@@ -126,7 +127,10 @@ def soft_score(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
     else:                         # fill_lowest: prefer low node indices
         strat = (assignment.astype(jnp.float32) / denom).mean()
 
-    pref = -prob.preferred[jnp.arange(prob.S), assignment].mean()
+    if prob.preferred is None:
+        pref = jnp.float32(0.0)   # absent plane: no zeros to stream
+    else:
+        pref = -prob.preferred[jnp.arange(prob.S), assignment].mean()
 
     # colocation reward: pairs sharing a coloc id on the same node
     if prob.Gc > 0:

@@ -17,6 +17,7 @@ in HBM (SURVEY.md hard part (b)).
 from __future__ import annotations
 
 import contextlib
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional
@@ -27,14 +28,129 @@ import numpy as np
 
 from ..core.model import PlacementStrategy
 from ..lower.tensors import ProblemTensors
+from ..obs.metrics import REGISTRY
 
-__all__ = ["DeviceProblem", "STRATEGY_CODES", "prepare_problem"]
+__all__ = ["DeviceProblem", "STRATEGY_CODES", "prepare_problem",
+           "PLANE_PACK", "packed_width", "packed_enabled", "pack_bool_rows",
+           "eligible_lookup", "eligible_row", "eligible_rows",
+           "record_plane_bytes"]
 
 STRATEGY_CODES = {
     PlacementStrategy.SPREAD_ACROSS_POOL: 0,
     PlacementStrategy.PACK_INTO_DEDICATED: 1,
     PlacementStrategy.FILL_LOWEST: 2,
 }
+
+# -- packed problem planes ---------------------------------------------------
+# The two dense (S, N) planes dominate problem memory AND the anneal's
+# sweep bandwidth (~4.7 GiB at 100k x 10k; anneal_ms ~13 of 14.6 ms at
+# 10k x 1k was plane reads, BENCH_r07_dev). The packed layout attacks both:
+#
+#   eligible   bit-packed (S, ceil(N/32)) uint32 — one bit per node, 8x
+#              fewer bytes than the dense bool plane; the kernels unpack
+#              with a shift/mask at each gather site (cheap ALU vs.
+#              streamed bytes on both TPU and CPU)
+#   preferred  ABSENT from the pytree (None) when no service scores nodes,
+#              instead of a materialized 4*S*N zero plane every sweep then
+#              streams; `prob.preferred is None` is a static treedef fact,
+#              so each layout compiles its own executable variant
+#
+# Every eligibility read goes through eligible_lookup/eligible_row(s) below,
+# which dispatch on dtype — the dense bool layout stays supported (the
+# FLEET_PACKED=0 A/B and the packed-vs-unpacked parity property tests), but
+# production staging is packed and `fleet audit kernels` pins the dtype so
+# a dense (S, N) plane cannot silently reappear in a hot-path executable.
+
+PLANE_PACK = 32  # bits per packed eligibility word
+
+
+def packed_width(n: int) -> int:
+    """Words per packed eligibility row: ceil(n / 32)."""
+    return -(-max(int(n), 1) // PLANE_PACK)
+
+
+def packed_enabled(default: bool = True) -> bool:
+    """FLEET_PACKED gate (default on): bit-packed eligible plane + absent
+    preferred plane at staging time."""
+    v = os.environ.get("FLEET_PACKED", "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off", "no")
+
+
+def pack_bool_rows(mask: np.ndarray) -> np.ndarray:
+    """Host pack: (..., N) bool -> (..., ceil(N/32)) uint32, little-endian
+    bit order (bit j of word w is column 32*w + j). Trailing pad bits of
+    the last word are SET — never read (gathers index columns < N), and
+    the all-ones convention makes the representation canonical: an
+    all-True row packs to the same words as the staging arenas' constant
+    0xFFFFFFFF fill, so bit-identical-tensor checks across staging paths
+    stay meaningful."""
+    mask = np.ascontiguousarray(np.asarray(mask, dtype=bool))
+    N = mask.shape[-1]
+    W = packed_width(N)
+    b = np.packbits(mask, axis=-1, bitorder="little")
+    pad = W * 4 - b.shape[-1]
+    if pad:
+        b = np.concatenate(
+            [b, np.full(b.shape[:-1] + (pad,), 0xFF, np.uint8)], axis=-1)
+    out = np.ascontiguousarray(b).view(np.uint32)
+    rem = N % PLANE_PACK
+    if rem:
+        out[..., -1] |= np.uint32((0xFFFFFFFF << rem) & 0xFFFFFFFF)
+    return out
+
+
+def eligible_lookup(eligible: jax.Array, s, node) -> jax.Array:
+    """eligible[s, node] as bool, for either plane layout: dense (S, N)
+    bool, or bit-packed (S, ceil(N/32)) uint32 unpacked with shift/mask at
+    the gather site. `s`/`node` broadcast like fancy indices."""
+    if eligible.dtype != jnp.uint32:
+        return eligible[s, node]
+    node = jnp.asarray(node)
+    word = eligible[s, node >> 5]
+    return ((word >> (node & 31).astype(jnp.uint32))
+            & jnp.uint32(1)).astype(bool)
+
+
+def eligible_row(eligible: jax.Array, s, N: int) -> jax.Array:
+    """One service's full (N,) eligibility row (dense or unpacked)."""
+    if eligible.dtype != jnp.uint32:
+        return eligible[s]
+    cols = jnp.arange(N, dtype=jnp.int32)
+    return eligible_lookup(eligible, s, cols)
+
+
+def eligible_rows(eligible: jax.Array, svc: jax.Array, N: int) -> jax.Array:
+    """(M, N) eligibility rows for a batch of services (dense or unpacked)."""
+    if eligible.dtype != jnp.uint32:
+        return eligible[svc]
+    cols = jnp.arange(N, dtype=jnp.int32)
+    return eligible_lookup(eligible, svc[:, None], cols[None, :])
+
+
+# metric catalog: docs/guide/10-observability.md
+_M_PLANE_BYTES = REGISTRY.gauge(
+    "fleet_solver_plane_bytes",
+    "Device bytes of the most recent staging's dense (S, N) problem "
+    "planes, by plane and layout (packed=\"true\" = bit-packed eligibility "
+    "/ absent preferred plane)",
+    labels=("plane", "packed"))
+
+
+def record_plane_bytes(prob: "DeviceProblem") -> None:
+    """Report the staged plane footprint (solver/problem.py packed layout):
+    what the memory math of docs/guide/11-performance.md claims, read off
+    the actual staging."""
+    e = prob.eligible
+    _M_PLANE_BYTES.set(float(e.size) * e.dtype.itemsize, plane="eligible",
+                       packed="true" if e.dtype == jnp.uint32 else "false")
+    if prob.preferred is None:
+        _M_PLANE_BYTES.set(0.0, plane="preferred", packed="true")
+    else:
+        p = prob.preferred
+        _M_PLANE_BYTES.set(float(p.size) * p.dtype.itemsize,
+                           plane="preferred", packed="false")
 
 
 @jax.tree_util.register_dataclass
@@ -45,10 +161,11 @@ class DeviceProblem:
     capacity: jax.Array        # (N, R) f32
     conflict_ids: jax.Array    # (S, K) i32, -1 pad (ports ∪ volumes ∪ anti)
     coloc_ids: jax.Array       # (S, C) i32, -1 pad
-    eligible: jax.Array        # (S, N) bool
+    # bit-packed (S, ceil(N/32)) uint32 (production layout; read through
+    # eligible_lookup/eligible_row) or dense (S, N) bool (FLEET_PACKED=0)
+    eligible: jax.Array
     node_valid: jax.Array      # (N,) bool
     node_topology: jax.Array   # (N,) i32 in [0, T)
-    preferred: jax.Array       # (S, N) f32 (zeros when unused)
     # static (not traced)
     S: int = field(metadata=dict(static=True))
     N: int = field(metadata=dict(static=True))
@@ -57,6 +174,12 @@ class DeviceProblem:
     T: int = field(metadata=dict(static=True))   # number of topology domains
     strategy: int = field(metadata=dict(static=True))
     max_skew: int = field(metadata=dict(static=True))
+    # (S, N) f32 soft preference plane, or None when NO service scores
+    # nodes — absent by design, not an all-zero plane every sweep then
+    # streams (4*S*N bytes). Absence is a static treedef fact (`preferred
+    # is None` == the has_preferred flag), so each layout is its own
+    # compiled executable variant.
+    preferred: Optional[jax.Array] = None
     # TRACED count of real (non-phantom) service rows, or None when every
     # row is real. Rows >= n_real are bucket-padding phantoms; the kernels
     # exclude them from topology/skew accounting (the sharded path threads
@@ -72,6 +195,18 @@ class DeviceProblem:
     # churn-forced moves stay free, same semantics as the old plane.
     sticky_prev: Optional[jax.Array] = None
     sticky_w: Optional[jax.Array] = None
+
+    @property
+    def has_preferred(self) -> bool:
+        """Static: does a preference plane exist at all? (The absent-plane
+        half of the packed layout — mirrors the merge kernel's
+        has_demand/has_eligible static delta flags.)"""
+        return self.preferred is not None
+
+    @property
+    def eligible_packed(self) -> bool:
+        """Static: is the eligibility plane bit-packed uint32?"""
+        return self.eligible.dtype == jnp.uint32
 
 
 def _unify_conflict_ids(pt: ProblemTensors) -> np.ndarray:
@@ -98,41 +233,59 @@ def _unify_conflict_ids(pt: ProblemTensors) -> np.ndarray:
 
 
 def prepare_problem(pt: ProblemTensors,
-                    device: Optional[Any] = None) -> DeviceProblem:
-    """Stage a ProblemTensors onto the device (or default backend)."""
+                    device: Optional[Any] = None,
+                    packed: Optional[bool] = None) -> DeviceProblem:
+    """Stage a ProblemTensors onto the device (or default backend).
+
+    `packed=None` defers to FLEET_PACKED (default on): the eligibility
+    plane stages bit-packed uint32 and an absent preference stays absent
+    (no zero plane); `packed=False` is the legacy dense layout, kept for
+    the packed-vs-unpacked parity property tests and A/B debugging."""
+    if packed is None:
+        packed = packed_enabled()
     conflict = _unify_conflict_ids(pt)
     G = int(conflict.max(initial=-1)) + 1
     T = int(pt.node_topology.max(initial=0)) + 1
 
     put = partial(jax.device_put, device=device)
-    # The two dense (S, N) planes dominate staging bytes (50 MB at 10k x 1k)
-    # and the degenerate cases are common: no placement preferences -> an
-    # all-zero `preferred`, no eligibility restrictions -> an all-True
-    # `eligible`.  On accelerators, materialize those as on-device XLA
-    # fills instead of host->device uploads — over the axon tunnel
-    # (~12 MB/s measured r5) uploading constant planes is seconds of pure
-    # waste per staging.  On CPU the "upload" is a memcpy (~10 ms) while
-    # the fill pays a shape-specific compile (~70 ms measured in the
-    # pipeline leg), so the fills are accelerator-only.
-    # keyed on the platform the arrays actually land on — an explicit
-    # `device` can differ from the default backend in either direction
+    # Degenerate (S, N) planes are common: no placement preferences -> no
+    # `preferred` plane at all (packed) or an all-zero one (dense), no
+    # eligibility restrictions -> an all-True `eligible`. On accelerators,
+    # materialize constant planes as on-device XLA fills instead of
+    # host->device uploads — over the axon tunnel (~12 MB/s measured r5)
+    # uploading constant planes is seconds of pure waste per staging. On
+    # CPU the "upload" is a memcpy while the fill pays a shape-specific
+    # compile (~70 ms measured in the pipeline leg), so fills are
+    # accelerator-only. Keyed on the platform the arrays actually land on —
+    # an explicit `device` can differ from the default backend.
     use_fills = (device.platform if device is not None
                  else jax.default_backend()) != "cpu"
     fill_ctx = (jax.default_device(device) if device is not None
                 else contextlib.nullcontext())
     with fill_ctx:
         if pt.preferred is None:
-            preferred_arr = (jnp.zeros((pt.S, pt.N), dtype=jnp.float32)
-                             if use_fills else
-                             put(np.zeros((pt.S, pt.N), dtype=np.float32)))
+            preferred_arr = (None if packed else
+                             (jnp.zeros((pt.S, pt.N), dtype=jnp.float32)
+                              if use_fills else
+                              put(np.zeros((pt.S, pt.N), dtype=np.float32))))
         else:
             preferred_arr = put(jnp.asarray(pt.preferred, dtype=jnp.float32))
         eligible_np = np.asarray(pt.eligible)
-        if use_fills and eligible_np.all():
+        all_eligible = bool(eligible_np.all())
+        if packed:
+            W = packed_width(pt.N)
+            if use_fills and all_eligible:
+                # all-ones fill: pad bits of the last word are set but
+                # never read (gathers index columns < N only)
+                eligible_arr = jnp.full((pt.S, W), np.uint32(0xFFFFFFFF),
+                                        dtype=jnp.uint32)
+            else:
+                eligible_arr = put(pack_bool_rows(eligible_np))
+        elif use_fills and all_eligible:
             eligible_arr = jnp.ones((pt.S, pt.N), dtype=bool)
         else:
             eligible_arr = put(jnp.asarray(pt.eligible))
-    return DeviceProblem(
+    prob = DeviceProblem(
         demand=put(jnp.asarray(pt.demand, dtype=jnp.float32)),
         capacity=put(jnp.asarray(pt.capacity, dtype=jnp.float32)),
         conflict_ids=put(jnp.asarray(conflict)),
@@ -147,3 +300,5 @@ def prepare_problem(pt: ProblemTensors,
         strategy=STRATEGY_CODES[pt.strategy],
         max_skew=int(pt.max_skew),
     )
+    record_plane_bytes(prob)
+    return prob

@@ -326,8 +326,22 @@ class ResidentProblem:
         has_eligible = delta.eligible_rows is not None
         dem_idx, dem_val = (pad_rows(delta.demand_rows, R, np.float32)
                             if has_demand else (None, None))
-        elig_idx, elig_rows = (pad_rows(delta.eligible_rows, N, bool)
-                               if has_eligible else (None, None))
+        if has_eligible:
+            # the delta contract stays host-friendly ((k, N) bool masks);
+            # the rows are packed HERE to match the resident plane's
+            # layout, so the donated merge scatters packed words — an
+            # arrival costs k*ceil(N/32)*4 bytes on the wire, not k*N
+            idx, masks = delta.eligible_rows
+            if self.prob.eligible.dtype == np.uint32:
+                from .problem import pack_bool_rows, packed_width
+                masks = pack_bool_rows(
+                    np.asarray(masks, dtype=bool).reshape(-1, N))
+                elig_idx, elig_rows = pad_rows((idx, masks),
+                                               packed_width(N), np.uint32)
+            else:
+                elig_idx, elig_rows = pad_rows((idx, masks), N, bool)
+        else:
+            elig_idx, elig_rows = None, None
         if delta.n_real is not None:
             self.n_real = int(delta.n_real)
         n_real = self._put_n_real()

@@ -8,11 +8,12 @@ and preference matrices — while the per-node state (load, conflict-group
 occupancy, colocation occupancy, topology counts) is replicated and kept
 identical on every device by all-reducing each sweep's applied deltas.
 
-Why it matters: the (S, N) eligibility/preference matrices dominate memory
-— at 100k services x 10k nodes they are ~1 GB each in bool/f32, past a
-single chip's budget once chain state is added. Sharding S divides them by
-the mesh size; the sweep's hot path then needs two collective patterns,
-both riding ICI:
+Why it matters: the (S, ·) matrices dominate memory. The packed problem
+layout (solver/problem.py) already cut the worst of it — eligibility is
+bit-packed uint32 (~125 MB at 100k x 10k vs ~1 GB dense bool) and an
+unused preference plane is absent instead of a 4 GB f32 zero fill — and
+sharding S divides what remains by the mesh size; the sweep's hot path
+then needs two collective patterns, both riding ICI:
 
   1. a `pmin` over the svc axis electing ONE winning move per target node
      globally (the feasibility-preserving winner-per-target rule must hold
@@ -54,7 +55,7 @@ except ImportError:                                  # pragma: no cover
 from .anneal import (W_CAP, W_CONF, W_ELIG, _move_delta_core, _skew_pen,
                      violation_total_from_parts)
 from .buckets import pad_problem
-from .problem import DeviceProblem
+from .problem import DeviceProblem, eligible_lookup
 from .resident import ResidentProblem, transfer_guard_ctx
 from ..obs import get_logger, kv
 from ..obs.metrics import REGISTRY
@@ -174,16 +175,19 @@ def shard_problem(prob: DeviceProblem, mesh: Mesh) -> DeviceProblem:
 
     svc2 = NamedSharding(mesh, P(SVC_AXIS, None))
     rep = NamedSharding(mesh, P())
+    kw = {}
+    if prob.preferred is not None:   # absent plane: nothing to shard
+        kw["preferred"] = jax.device_put(prob.preferred, svc2)
     return dataclasses.replace(
         prob,
         demand=jax.device_put(prob.demand, svc2),
         conflict_ids=jax.device_put(prob.conflict_ids, svc2),
         coloc_ids=jax.device_put(prob.coloc_ids, svc2),
         eligible=jax.device_put(prob.eligible, svc2),
-        preferred=jax.device_put(prob.preferred, svc2),
         capacity=jax.device_put(prob.capacity, rep),
         node_valid=jax.device_put(prob.node_valid, rep),
         node_topology=jax.device_put(prob.node_topology, rep),
+        **kw,
     )
 
 
@@ -353,9 +357,10 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             legal sweep here is a legal sweep in the single-device anneal
             by construction, not by comment."""
             a = assign[s]
-            elig_a = eligible[s, a] & node_valid[a]
-            elig_b = eligible[s, b] & node_valid[b]
-            d_pref = (preferred[s, a] - preferred[s, b]) / S
+            elig_a = eligible_lookup(eligible, s, a) & node_valid[a]
+            elig_b = eligible_lookup(eligible, s, b) & node_valid[b]
+            d_pref = (jnp.float32(0.0) if preferred is None
+                      else (preferred[s, a] - preferred[s, b]) / S)
             return _move_delta_core(
                 prob, capacity=capacity, node_topology=node_topology,
                 load=load, used=used, coloc=coloc, topo=topo,
@@ -368,7 +373,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             node state + ONE scalar psum for the shard-local eligibility
             count (phantoms are eligible everywhere so the `real` mask is
             belt-and-braces)."""
-            inel = ((~eligible[jnp.arange(S_loc), assign]
+            inel = ((~eligible_lookup(eligible, jnp.arange(S_loc), assign)
                      | ~node_valid[assign]) & real).sum()
             inel = jax.lax.psum(inel, SVC_AXIS)
             return violation_total_from_parts(prob, load, used, topo, inel)
@@ -391,9 +396,12 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                 strat = jax.lax.psum(
                     (assign.astype(jnp.float32) / denom).sum(),
                     SVC_AXIS) / s_denom
-            pref = -jax.lax.psum(
-                preferred[jnp.arange(S_loc), assign].sum(),
-                SVC_AXIS) / s_denom
+            if preferred is None:   # absent plane: no zeros to stream
+                pref = jnp.float32(0.0)
+            else:
+                pref = -jax.lax.psum(
+                    preferred[jnp.arange(S_loc), assign].sum(),
+                    SVC_AXIS) / s_denom
             if prob.Gc > 0:
                 cc = coloc.astype(jnp.float32)
                 col = -(cc * (cc - 1.0) / 2.0).sum() / s_denom
@@ -411,7 +419,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                     / jnp.maximum(capacity, 1e-6)).sum() * W_CAP
             c = used.astype(jnp.float32)
             conf = (c * (c - 1.0) / 2.0).sum() * W_CONF
-            inel = ((~eligible[jnp.arange(S_loc), assign]
+            inel = ((~eligible_lookup(eligible, jnp.arange(S_loc), assign)
                      | ~node_valid[assign]) & real).sum()
             inel = jax.lax.psum(inel, SVC_AXIS).astype(jnp.float32) * W_ELIG
             return (over + conf + inel + _skew_pen(prob, topo)
@@ -431,7 +439,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             over_node = (load > capacity * (1 + 1e-6)).any(-1)
             conf_node = ((used * (used - 1)).sum(-1) > 0)
             hot_node = over_node | conf_node
-            svc_bad = (~eligible[jnp.arange(S_loc), assign]
+            svc_bad = (~eligible_lookup(eligible, jnp.arange(S_loc), assign)
                        | ~node_valid[assign])
             hot = hot_node[assign] | svc_bad
             logits = jnp.where(hot, 0.0, -30.0)
@@ -669,7 +677,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             cF = usedF.astype(jnp.float32)
             confF = (cF * (cF - 1.0) / 2.0).sum()
             inelF = jax.lax.psum(
-                ((~eligible[jnp.arange(S_loc), best_assign]
+                ((~eligible_lookup(eligible, jnp.arange(S_loc), best_assign)
                   | ~node_valid[best_assign]) & real).sum(),
                 SVC_AXIS).astype(jnp.float32)
             if prob.max_skew > 0:
@@ -684,16 +692,37 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
         return (best_assign, sweeps_run, capF, confF, inelF, skewF,
                 softF, att, acc)
 
-    sharded = shard_map(
-        body, mesh=mesh,
-        in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None), P(SVC_AXIS, None),
-                  P(SVC_AXIS, None), P(SVC_AXIS, None),
-                  P(), P(), P(), P(SVC_AXIS), P()),
-        out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
-    out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
-                  prob.eligible, prob.preferred, prob.capacity,
-                  prob.node_valid, prob.node_topology,
-                  init_assignment.astype(jnp.int32), key)
+    # the preference plane may be ABSENT (packed layout): the shard_map
+    # operand list — and the executable — then simply has no pref plane,
+    # instead of streaming an all-zero (S/D, N) shard every sweep
+    if prob.preferred is not None:
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None),
+                      P(SVC_AXIS, None), P(SVC_AXIS, None),
+                      P(SVC_AXIS, None),
+                      P(), P(), P(), P(SVC_AXIS), P()),
+            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
+        out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
+                      prob.eligible, prob.preferred, prob.capacity,
+                      prob.node_valid, prob.node_topology,
+                      init_assignment.astype(jnp.int32), key)
+    else:
+        def body_nopref(demand, conflict_ids, coloc_ids, eligible,
+                        capacity, node_valid, node_topology, assign, key):
+            return body(demand, conflict_ids, coloc_ids, eligible, None,
+                        capacity, node_valid, node_topology, assign, key)
+
+        sharded = shard_map(
+            body_nopref, mesh=mesh,
+            in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None),
+                      P(SVC_AXIS, None), P(SVC_AXIS, None),
+                      P(), P(), P(), P(SVC_AXIS), P()),
+            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
+        out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
+                      prob.eligible, prob.capacity,
+                      prob.node_valid, prob.node_topology,
+                      init_assignment.astype(jnp.int32), key)
     stats = ShardedStats(*out)
     if return_stats:
         return stats

@@ -95,9 +95,9 @@ def render(name: str, d: dict) -> str:
             f"(`{sharded['backend']}`)",
             f"{sharded['sharded_solve_ms']:.0f} ms, "
             f"{sharded['violations']} violations"
-            + (f", {sharded['per_device_sharded_mib']:.0f} MiB sharded "
-               f"tensors/device" if "per_device_sharded_mib" in sharded
-               else "")))
+            + (f", {sharded['per_device_sharded_mib']:.1f} MiB sharded "
+               f"tensors/device (bit-packed eligibility)"
+               if "per_device_sharded_mib" in sharded else "")))
         sres = sharded.get("resident")
         if sres:
             rows.append((

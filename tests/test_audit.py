@@ -116,6 +116,38 @@ class TestCanaries:
         assert rec["donated"] == [] and rec["aliased"] == []
         assert any("not aliased" in v and "x" in v for v in violations)
 
+    def test_dense_plane_fails_packed_contract(self, monkeypatch):
+        """Deliberate breakage of the packed-plane layout: a resident
+        staging carrying a dense bool eligibility plane (FLEET_PACKED=0)
+        and a materialized zero preference plane must trip the intrinsic
+        packed-plane checks — an f32/bool (S, N) plane can never silently
+        reappear in a hot-path executable."""
+        monkeypatch.setenv("FLEET_PACKED", "0")
+        from fleetflow_tpu.lower import synthetic_problem
+        from fleetflow_tpu.solver.contracts import (_MERGE_ARG_NAMES,
+                                                    _rich_delta)
+        from fleetflow_tpu.solver.resident import ResidentProblem
+
+        pt = synthetic_problem(60, 12, seed=0, port_fraction=0.3,
+                               volume_fraction=0.2)
+        rp = ResidentProblem(pt)
+        rp.adopt_host(np.zeros(pt.S, np.int32), pt.node_valid, warm=False)
+        uploads, n_real, has_demand, has_eligible = rp.merge_inputs(
+            pt, _rich_delta(pt))
+        contract = KernelContract(
+            name="canary.packed", module="", qualname="", cases=lambda: [])
+        case = KernelCase(
+            tier="dense", fn=rp._merge(),
+            args=(rp.prob, rp.assignment, *uploads, n_real),
+            kwargs=dict(has_demand=has_demand, has_eligible=has_eligible),
+            arg_names=_MERGE_ARG_NAMES)
+        rec, violations = audit_case(contract, case)
+        assert rec["problem_dtypes"]["prob.eligible"] == "bool"
+        assert any("bit-packed uint32" in v for v in violations)
+        # dense staging also materializes the zero preference plane
+        assert "prob.preferred" in rec["problem_dtypes"]
+        assert any("preference plane" in v for v in violations)
+
     def test_host_callback_fails(self):
         """A smuggled pure_callback must trip the purity check."""
         def clean(x):

@@ -24,6 +24,7 @@ from fleetflow_tpu.solver.buckets import (BucketConfig, bucket_bounds,
                                           bucket_size, pad_assignment,
                                           pad_problem, pad_problem_tiers,
                                           width_bucket)
+from fleetflow_tpu.solver.problem import pack_bool_rows
 from fleetflow_tpu.solver.repair import verify
 
 
@@ -73,13 +74,16 @@ class TestPadding:
         demand = np.asarray(padded.demand)
         ids = np.asarray(padded.conflict_ids)
         elig = np.asarray(padded.eligible)
-        pref = np.asarray(padded.preferred)
         assert (demand[pt.S:] == 0).all()
         assert (ids[pt.S:] == -1).all()
-        assert elig[pt.S:].all()
-        assert (pref[pt.S:] == 0).all()
+        # packed layout: phantom rows are all-ones words (eligible
+        # everywhere) and the preference plane is absent by design
+        assert elig.dtype == np.uint32
+        assert (elig[pt.S:] == 0xFFFFFFFF).all()
+        assert padded.preferred is None
         # real rows byte-identical
         assert np.array_equal(demand[: pt.S], pt.demand)
+        assert np.array_equal(elig[: pt.S], pack_bool_rows(pt.eligible))
 
     def test_pad_problem_tiers_idempotent(self):
         pt = synthetic_problem(37, 8, seed=1)

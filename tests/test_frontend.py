@@ -320,6 +320,88 @@ class TestStageHashGrain:
         # dev rows reused, prod re-loaded
         assert cache.hits == 1 and cache.misses == 3
 
+    def test_out_of_root_include_edit_invalidates(self, tmp_path):
+        """The PR-11 known corner, closed: a file OUTSIDE the fleet root
+        pulled in by an `include` glob is part of the content hash — an
+        edit to it must invalidate the parse/lowered-instance caches
+        exactly like an in-root edit (transitively, through nested
+        includes too)."""
+        from fleetflow_tpu.registry.aggregate import fleet_content_hash
+
+        root = tmp_path / "fleet"
+        shared = tmp_path / "shared"
+        shared.mkdir()
+        _write_project(root, 4)
+        (shared / "common.kdl").write_text(_svc("shared-0", 0.1, 64.0))
+        (shared / "nested.kdl").write_text(_svc("shared-1", 0.1, 64.0)
+                                           + 'include "deep.kdl"\n')
+        (shared / "deep.kdl").write_text(_svc("shared-2", 0.1, 64.0))
+        cfg = root / ".fleetflow"
+        (cfg / "services" / "inc.kdl").write_text(
+            'include "../../../shared/common.kdl" "../../../shared/nested.kdl"\n')
+
+        h1 = fleet_content_hash(str(root))
+        s1 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        # edit the directly-included out-of-root file
+        (shared / "common.kdl").write_text(_svc("shared-0", 0.4, 64.0))
+        h2 = fleet_content_hash(str(root))
+        s2 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        assert h1 != h2, "out-of-root include edit must change the hash"
+        assert s1["prod"] != s2["prod"] and s1["dev"] != s2["dev"]
+        # edit a TRANSITIVELY included out-of-root file
+        (shared / "deep.kdl").write_text(_svc("shared-2", 0.4, 64.0))
+        h3 = fleet_content_hash(str(root))
+        assert h2 != h3, "nested out-of-root include edit must invalidate"
+        # stability: no edit, no drift
+        assert fleet_content_hash(str(root)) == h3
+
+    def test_stage_scoped_include_invalidates_one_stage(self, tmp_path):
+        """An out-of-root include reached only from a stage overlay sinks
+        into that stage's hash alone — single-stage churn discipline
+        holds across the root boundary."""
+        root = tmp_path / "fleet"
+        shared = tmp_path / "shared"
+        shared.mkdir()
+        _write_project(root, 5)
+        (shared / "prod-extra.kdl").write_text(
+            'service "a-0" { labels { tier "hot" } }\n')
+        (root / ".fleetflow" / "flow.prod.kdl").write_text(
+            'include "../../shared/prod-extra.kdl"\n')
+        h1 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        (shared / "prod-extra.kdl").write_text(
+            'service "a-0" { labels { tier "cold" } }\n')
+        h2 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        assert h1["prod"] != h2["prod"]
+        assert h1["dev"] == h2["dev"]
+
+    def test_shared_transitive_include_sinks_into_every_reacher(
+            self, tmp_path):
+        """Two stage overlays both include a shared out-of-root fragment
+        which itself includes a deeper file: an edit to the DEEP file
+        must invalidate BOTH stages. (Origins propagate through shared
+        intermediates — not just to whichever walked file happened to
+        reach the fragment first.)"""
+        root = tmp_path / "fleet"
+        shared = tmp_path / "shared"
+        shared.mkdir()
+        _write_project(root, 6)
+        (shared / "frag.kdl").write_text('include "deep.kdl"\n')
+        (shared / "deep.kdl").write_text(
+            'service "a-0" { labels { tier "hot" } }\n')
+        cfg = root / ".fleetflow"
+        (cfg / "flow.prod.kdl").write_text(
+            'include "../../shared/frag.kdl"\n')
+        (cfg / "flow.dev.kdl").write_text(
+            'include "../../shared/frag.kdl"\n')
+        h1 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        (shared / "deep.kdl").write_text(
+            'service "a-0" { labels { tier "cold" } }\n')
+        h2 = fleet_stage_hashes(str(root), ["prod", "dev"])
+        assert h1["prod"] != h2["prod"], \
+            "transitive include edit must invalidate prod"
+        assert h1["dev"] != h2["dev"], \
+            "transitive include edit must invalidate dev too"
+
     def test_legacy_single_param_hash_still_works(self, tmp_path):
         _write_project(tmp_path, 3)
         reg = _registry(tmp_path)
@@ -537,7 +619,13 @@ class TestReviewRegressions:
     def test_restage_never_aliases_arena_buffers(self):
         # jax's CPU backend zero-copies device_put for LARGE aligned
         # arrays: a returned DeviceProblem plane sharing memory with a
-        # reusable arena would be rewritten in place by the next restage
+        # REUSABLE arena would be rewritten in place by the next restage.
+        # Device-CONSTANT arenas ("const:" keys) are exempt by design:
+        # they are written once at creation and never again (the
+        # buckets.py put_arena comment), so their zero-copy aliasing is
+        # the intended fast path — the packed all-True eligible constant
+        # (uint32, which jax's CPU zero-copy DOES cover, unlike bool)
+        # rides it.
         from fleetflow_tpu.lower import synthetic_problem
         from fleetflow_tpu.solver import bucket_config, stage_problem_tiers
         from fleetflow_tpu.solver import buckets as B
@@ -545,14 +633,30 @@ class TestReviewRegressions:
         pt = synthetic_problem(6000, 2000, seed=3)   # (S_pad, N) ~12 MB
         prob, _ = stage_problem_tiers(pt, bucket_config())
         with B._STAGE_LOCK:
-            arenas = [e[0] for e in B._ARENAS.values()]
+            arenas = [e[0] for k, e in B._ARENAS.items()
+                      if not k[0].startswith("const:")]
         for name in ("demand", "conflict_ids", "coloc_ids", "eligible",
                      "preferred"):
-            plane = np.asarray(getattr(prob, name))
+            v = getattr(prob, name)
+            if v is None:          # absent preference plane (packed)
+                continue
+            plane = np.asarray(v)
             for arena in arenas:
                 if arena.dtype == plane.dtype:
                     assert not np.shares_memory(plane, arena), \
                         f"{name} aliases a shared staging arena"
+        # the donated-staging path must NOT ride the shared const cache
+        # at all (a donation would invalidate every other holder) — its
+        # packed eligible plane is a private buffer
+        prob2, _ = stage_problem_tiers(pt, bucket_config(),
+                                       reuse_device_constants=False)
+        with B._STAGE_LOCK:
+            all_arenas = [e[0] for e in B._ARENAS.values()]
+        plane2 = np.asarray(prob2.eligible)
+        for arena in all_arenas:
+            if arena.dtype == plane2.dtype:
+                assert not np.shares_memory(plane2, arena), \
+                    "donated-path eligible aliases a staging arena"
 
     def test_node_start_gap_is_atomic_no_blowup(self):
         import time

@@ -47,9 +47,14 @@ class TestGoldenFixtures:
     @pytest.mark.parametrize(
         "path", sorted(glob.glob(os.path.join(FIXTURES, "*.kdl"))),
         ids=lambda p: os.path.basename(p)[:-4])
-    def test_fixture_fires_exactly_as_stamped(self, path):
+    def test_fixture_fires_exactly_as_stamped(self, path, monkeypatch):
         if "ff009" in path and shutil.which("op"):
             pytest.skip("op CLI installed; FF009 cannot fire here")
+        if "ff016" in path:
+            # FF016's packed-plane estimate is exact arithmetic; a tiny
+            # budget stands in for a pod-scale stage (the fixture header
+            # documents this)
+            monkeypatch.setenv("FLEET_LINT_DEVICE_BUDGET_MB", "0.001")
         expected = _expectations(path)
         assert expected, f"{path} has no // expect: header"
         name = os.path.basename(path)

@@ -210,16 +210,32 @@ def test_fleet_scale_document_parity_and_speed():
             f'}}\n')
     text = "".join(parts)
 
-    t_native = float("inf")
-    for _ in range(3):     # min-of-3: immune to CI noisy-neighbor spikes
-        t0 = time.perf_counter()
-        native = native_parse_document(text)
-        t_native = min(t_native, time.perf_counter() - t0)
-    assert native is not None
+    # Both sides allocate millions of small Python objects (the native
+    # wrapper converts to KdlNode trees too), so in-suite timings are
+    # hostage to whatever garbage-collection pressure the preceding ~600
+    # tests left behind — measured swings of 2-3x in EITHER direction on
+    # identical parser code. Collect once and time with the collector
+    # off: the test measures parsing, not the suite's GC state.
+    import gc
+    gc.collect()
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    try:
+        t_native = float("inf")
+        for _ in range(3):   # min-of-3: immune to CI noisy-neighbor spikes
+            t0 = time.perf_counter()
+            native = native_parse_document(text)
+            t_native = min(t_native, time.perf_counter() - t0)
+        assert native is not None
 
-    t0 = time.perf_counter()
-    py = python_parse(text)
-    t_py = time.perf_counter() - t0
+        t_py = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            py = python_parse(text)
+            t_py = min(t_py, time.perf_counter() - t0)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
 
     assert tree(native) == tree(py)
     assert len(native) == 10_000

@@ -150,6 +150,52 @@ class TestDeltaEquivalence:
                 assert np.array_equal(np.asarray(va), np.asarray(vb)), \
                     f"resident tensor {f.name} drifted after arrival delta"
 
+    def test_arrival_packed_row_scatter_restricted_mask(self):
+        """The packed-row-scatter arrival path: an arrival whose
+        eligibility mask is RESTRICTED (not all-True) lands on the
+        resident plane as bit-packed words, and the warm solve honors the
+        scattered restriction."""
+        from fleetflow_tpu.solver.problem import pack_bool_rows
+
+        pt = synthetic_problem(70, 12, seed=5)
+        rp = ResidentProblem(pt)
+        solve(pt, prob=rp.prob, resident=rp, seed=5, steps=16, bucket=True)
+        k = 2
+        S2 = pt.S + k
+        names = [f"arrival{i}" for i in range(k)]
+        grow = lambda a: np.concatenate(
+            [a, np.full((k, a.shape[1]), -1, dtype=a.dtype)])
+        dem_new = np.full((k, pt.demand.shape[1]), 0.01,
+                          dtype=pt.demand.dtype)
+        elig_new = np.zeros((k, pt.N), dtype=bool)
+        elig_new[:, :5] = True          # arrivals pinned to the first 5
+        pt2 = dataclasses.replace(
+            pt,
+            service_names=pt.service_names + names,
+            demand=np.concatenate([pt.demand, dem_new]),
+            eligible=np.concatenate([pt.eligible, elig_new]),
+            dep_adj=np.pad(pt.dep_adj, ((0, k), (0, k))),
+            dep_depth=np.concatenate(
+                [pt.dep_depth, np.zeros(k, pt.dep_depth.dtype)]),
+            port_ids=grow(pt.port_ids), volume_ids=grow(pt.volume_ids),
+            anti_ids=grow(pt.anti_ids), coloc_ids=grow(pt.coloc_ids),
+            replica_of=pt.replica_of + names if pt.replica_of else
+            pt.replica_of)
+        rows = np.arange(pt.S, S2, dtype=np.int32)
+        delta = ProblemDelta(demand_rows=(rows, dem_new),
+                             eligible_rows=(rows, elig_new), n_real=S2)
+        assert rp.compatible(pt2, delta)
+        rp.apply_delta(pt2, delta)
+        # the scattered rows are the PACKED image of the bool masks
+        got = np.asarray(rp.prob.eligible)[pt.S:S2]
+        assert got.dtype == np.uint32
+        assert np.array_equal(got, pack_bool_rows(elig_new))
+        r = solve(pt2, prob=rp.prob, resident=rp, resident_warm=True,
+                  seed=105, steps=64, bucket=True)
+        assert r.feasible
+        assert (r.assignment[pt.S:] < 5).all(), \
+            "arrivals must obey the packed-row-scattered eligibility"
+
     def test_bounded_compiles_across_sequence(self):
         """The whole delta sequence reuses ONE fused-pipeline executable:
         every burst stays inside the shape tier."""
@@ -171,6 +217,48 @@ class TestDeltaEquivalence:
             assert r.fused_prerepair
         assert _refine._cache_size() == cache_before, \
             "warm delta re-solves recompiled the fused pipeline"
+
+
+class TestPackedParity:
+    """ISSUE 13 property: the packed problem layout (bit-packed uint32
+    eligibility + absent preference plane) is numerically IDENTICAL to
+    the dense layout — bit-identical final assignments and identical
+    violation/soft stats — across the cold path and the resident-delta
+    warm path, over N seeds. The packed plane is a pure re-encoding: the
+    kernels unpack with shift/mask at each gather site, so the proposal
+    stream, the Metropolis decisions, and every carried float are
+    unchanged."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_cold_and_delta_paths_match_dense(self, seed, monkeypatch):
+        pt0 = synthetic_problem(73, 12, seed=seed, port_fraction=0.3,
+                                volume_fraction=0.2, n_tenants=2)
+        runs = {}
+        for packed in (True, False):
+            monkeypatch.setenv("FLEET_PACKED", "1" if packed else "0")
+            rng = np.random.default_rng(seed)   # identical churn stream
+            pt = pt0
+            rp = ResidentProblem(pt)
+            assert (np.asarray(rp.prob.eligible).dtype
+                    == (np.uint32 if packed else np.bool_))
+            assert (rp.prob.preferred is None) == packed
+            cold = solve(pt, prob=rp.prob, resident=rp, seed=seed,
+                         steps=16, bucket=True)
+            seq = [(cold.assignment.copy(), cold.violations, cold.soft)]
+            for step in range(3):
+                pt, delta = _churn_step(pt, rng)
+                rp.apply_delta(pt, delta)
+                r = solve(pt, prob=rp.prob, resident=rp,
+                          resident_warm=True, seed=100 + step, steps=16,
+                          bucket=True)
+                seq.append((r.assignment.copy(), r.violations, r.soft))
+            runs[packed] = seq
+        for i, ((a, va, sa), (b, vb, sb)) in enumerate(
+                zip(runs[True], runs[False])):
+            assert np.array_equal(a, b), \
+                f"packed/dense assignments diverged at step {i}"
+            assert va == vb, f"violations diverged at step {i}"
+            assert sa == sb, f"soft stats diverged at step {i}"
 
 
 class TestTransferGuard:
