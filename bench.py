@@ -76,44 +76,6 @@ def _watch_compiles():
         jax.config.update("jax_log_compiles", old_cfg)
 
 
-def _resched_reps() -> int:
-    try:
-        return max(1, int(os.environ.get("BENCH_RESCHED_REPS") or "3"))
-    except ValueError:
-        return 3
-
-
-def _timed_runs(solve_once, reps: int):
-    """Run `solve_once(i)` `reps` times; each run is wall-timed AND
-    compile-watched, with the solver's phase breakdown recorded — the one
-    shape every warm-re-solve leg reports (VERDICT r4 weak #1: a single
-    noisy or recompiling run must never become an unexplainable record).
-
-    Returns (runs, results, order, mid): per-run dicts, the SolveResults,
-    run indices sorted by wall time, and the LOWER-MIDDLE median index —
-    with an even rep count the faster middle run is the headline (an
-    outlier must never be)."""
-    runs, results = [], []
-    for i in range(reps):
-        with _watch_compiles() as compiles:
-            t = time.perf_counter()
-            r = solve_once(i)
-            ms = (time.perf_counter() - t) * 1e3
-        results.append(r)
-        runs.append({"ms": round(ms, 1),
-                     "timings_ms": {k: round(v, 1)
-                                    for k, v in r.timings_ms.items()},
-                     "sweeps": int(r.steps),
-                     "violations": r.violations,
-                     "soft": round(r.soft, 4),
-                     "pre_repair_violations": r.pre_repair_violations,
-                     "moves_repaired": r.moves_repaired,
-                     "compiles": len(compiles),
-                     "compile_events": compiles[:3]})
-    order = sorted(range(reps), key=lambda i: runs[i]["ms"])
-    return runs, results, order, order[(reps - 1) // 2]
-
-
 def _default_caches() -> None:
     """Thread the persistent caches into the DEFAULT bench run: r06 showed
     the headline pipeline leg with compile_cache/enabled: false, so the
@@ -126,12 +88,29 @@ def _default_caches() -> None:
     (its POINT is the cold->warm contrast)."""
     if os.environ.get("BENCH_NO_CACHES", "").lower() in ("1", "true", "on"):
         return
+    import tempfile
     root = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
     defaulted = []
     for var, sub in (("FLEET_COMPILE_CACHE", "xla"),
                      ("FLEET_PARSE_CACHE", "parse")):
         if not os.environ.get(var, "").strip():
-            os.environ[var] = os.path.join(root, "fleetflow", sub)
+            if var == "FLEET_COMPILE_CACHE":
+                # per-RUN throwaway, not the persistent dir: XLA
+                # executables DESERIALIZED from a warm persistent cache
+                # misbehave on this jax/CPU build — warm re-solves lose
+                # their carried-state exits (12.9 ms -> 3 s p50 on the
+                # unmodified r08 code, garbage assignments in repeat
+                # runs; r09 bring-up). The cold/warm child leg already
+                # isolates its own pair of dirs, so the cold->warm
+                # contrast is unaffected; operators who set the var
+                # explicitly keep their choice (and the risk).
+                import atexit
+                import shutil
+                tmp = tempfile.mkdtemp(prefix="fleet-bench-xla-")
+                atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+                os.environ[var] = tmp
+            else:
+                os.environ[var] = os.path.join(root, "fleetflow", sub)
             defaulted.append(var)
     if defaulted:
         # names the vars the bench supplied, so the cold/warm leg swaps
@@ -460,14 +439,32 @@ def _resident_churn_loop(pt, *, chains, steps, block, warm_block,
         dead.append(victim)
         return valid, victim
 
-    # warm-up burst compiles the warm fused variant (untimed)
+    # warm-up bursts (untimed): the first compiles the FULL warm fused
+    # variant with the active-set path disabled — it is the fallback
+    # executable a gate-rejected sub-solve re-runs, and a timed burst
+    # must never pay its compile; the second compiles the localized
+    # mini-tier variant the steady-state bursts ride
     mask_seq = []
-    valid, _ = next_mask(pt.node_valid.copy(), base.assignment)
+    sub_prev = os.environ.get("FLEET_SUBSOLVE")
+    os.environ["FLEET_SUBSOLVE"] = "0"
+    try:
+        valid, _ = next_mask(pt.node_valid.copy(), base.assignment)
+        mask_seq.append(valid)
+        cur = dataclasses.replace(pt, node_valid=valid)
+        rp.apply_delta(cur, ProblemDelta(node_valid=valid))
+        prev = solve(cur, prob=rp.prob, resident=rp, resident_warm=True,
+                     seed=51, bucket=True, **kw)
+    finally:
+        if sub_prev is None:
+            os.environ.pop("FLEET_SUBSOLVE", None)
+        else:
+            os.environ["FLEET_SUBSOLVE"] = sub_prev
+    valid, _ = next_mask(valid, prev.assignment)
     mask_seq.append(valid)
     cur = dataclasses.replace(pt, node_valid=valid)
     rp.apply_delta(cur, ProblemDelta(node_valid=valid))
     prev = solve(cur, prob=rp.prob, resident=rp, resident_warm=True,
-                 seed=51, bucket=True, **kw)
+                 seed=52, bucket=True, **kw)
 
     runs = []
     prev_assignment = prev.assignment
@@ -518,9 +515,16 @@ def _resident_churn_loop(pt, *, chains, steps, block, warm_block,
                                 node_valid=jnp.asarray(mask_seq[0]))
     prev_l = solve(cur0, prob=prob0, init_assignment=base.assignment,
                    prerepair=cpu, seed=51, **kw)   # warm-up (compile)
+    cur1 = dataclasses.replace(pt, node_valid=mask_seq[1])
+    prob1 = dataclasses.replace(prob_l,
+                                node_valid=jnp.asarray(mask_seq[1]))
+    prev_l = solve(cur1, prob=prob1, init_assignment=prev_l.assignment,
+                   prerepair=cpu, seed=52, **kw)   # mirrors warm-up 2
     legacy_runs = []
     prev_l_assignment = prev_l.assignment
-    for i, valid in enumerate(mask_seq[1:]):
+    # mask_seq[0:2] are the resident leg's warm-up bursts; the timed
+    # legacy replay walks the same masks as the timed resident loop
+    for i, valid in enumerate(mask_seq[2:]):
         cur = dataclasses.replace(pt, node_valid=valid)
         prob_i = dataclasses.replace(prob_l,
                                      node_valid=jnp.asarray(valid))
@@ -596,88 +600,279 @@ def _deactivate_rows(pt, start: int):
 
 def _burst_scenario(S: int, N: int, *, chains: int, steps: int, block: int,
                     warm_block: int, proposals) -> dict:
+    """Multi-event churn through the DEVICE-RESIDENT + ACTIVE-SET path
+    (ISSUE 14): a rolling burst loop — a single-kill micro-burst, then
+    3-kill/revive bursts with the tenant stage (S//50 services) arriving
+    and departing as row scatters — each burst ONE ProblemDelta + ONE
+    warm re-solve whose anneal runs over the churn closure's mini tier
+    (solver/subsolve.py), gated by exact full-problem stats.
+
+    The deterministic sequence runs TWICE: pass 1 (untimed) compiles
+    every mini-tier/ladder variant the churn will touch; pass 2 replays
+    it under jax.transfer_guard("disallow") with compiles watched — the
+    timed numbers hold zero recompiles and zero host transfers by
+    construction. A LEGACY leg replays the same worlds the pre-resident
+    way (staged problem + host seed, full-problem sweeps — the r08 path
+    that cost 133 ms/burst) for the speedup comparison.
+    BENCH_SUBSOLVE_ASSERT=1 is the CI smoke contract: zero recompiles,
+    zero host transfers, zero violations, and >= 2 mini tiers exercised."""
     import dataclasses
+    from collections import deque
+
     import numpy as np
 
     from fleetflow_tpu.lower import synthetic_problem
+    from fleetflow_tpu.obs.metrics import REGISTRY
     from fleetflow_tpu.solver import prepare_problem, solve
+    from fleetflow_tpu.solver.resident import ProblemDelta, ResidentProblem
 
-    S_new = max(S // 50, 8)            # the arriving tenant stage
+    S_new = max(S // 50, 8)            # the arriving/departing tenant stage
     full = synthetic_problem(S + S_new, N, seed=11, n_tenants=8,
                              port_fraction=0.2, volume_fraction=0.1)
+    arr_rows = np.arange(S, S + S_new, dtype=np.int32)
+    arr_demand = np.asarray(full.demand[S:], dtype=np.float32).copy()
+    arr_elig = np.asarray(full.eligible[S:], dtype=bool).copy()
+    # tenant rows start INERT (zero demand, no ids, eligible everywhere):
+    # the streamed-arrival shape — an arrival/departure is then exactly a
+    # demand+eligibility row scatter, the delta the resident merge and
+    # the active-set closure both understand
     pt0 = _deactivate_rows(full, S)
-    prob0 = prepare_problem(pt0)
-    # cold solve doubles as the compile warm-up for this shape
-    res0 = solve(pt0, prob=prob0, chains=chains, steps=steps, seed=20,
-                 anneal_block=block, proposals_per_step=proposals)
+    kw = dict(chains=chains, steps=steps, anneal_block=block,
+              warm_block=warm_block, proposals_per_step=proposals)
+    # kill1 -> first mini tier; the multi-event bursts (3 kills + revives
+    # +- the 200-row tenant scatter) -> a bigger tier: the loop exercises
+    # the tier ladder, not one compiled shape
+    pattern = ["kill1", "arrive", "kill3", "depart", "arrive", "kill3"]
 
-    # phase A (untimed): one node dies -> the steady pre-burst world
-    # (loads count REAL rows only: where the solver parks the S_new
-    # inactive phantoms must not pick the victim)
-    victim = int(np.bincount(res0.assignment[:S], minlength=N).argmax())
-    validA = pt0.node_valid.copy()
-    validA[victim] = False
-    ptA = dataclasses.replace(pt0, node_valid=validA)
-    probA = prepare_problem(ptA)
-    resA = solve(ptA, prob=probA, chains=chains, steps=steps, seed=21,
-                 init_assignment=res0.assignment, anneal_block=block,
-                 warm_block=warm_block, proposals_per_step=proposals)
+    def run_world(record):
+        """One deterministic pass over the burst sequence. `record` is
+        None for the untimed compile pass, else the runs list."""
+        rp = ResidentProblem(pt0)
+        base = solve(pt0, prob=rp.prob, resident=rp, seed=20, bucket=True,
+                     **kw)
+        valid = pt0.node_valid.copy()
+        dead: deque = deque()
+        pt = pt0
+        prev = base.assignment
+        last = {"affected": 0, "moved": 0}
+        for i, kind in enumerate(pattern):
+            loads = np.bincount(prev[:S], minlength=N).astype(np.float64)
+            loads[~valid] = -1.0
+            nkill = 1 if kind == "kill1" else 3
+            victims = np.argsort(loads)[-nkill:]
+            valid = valid.copy()
+            valid[victims] = False
+            revived = 0
+            if len(dead) >= 2:
+                old = dead.popleft()
+                valid[old] = True
+                revived = len(old)
+            dead.append(victims)
+            fields = dict(node_valid=valid)
+            delta_kw = dict(node_valid=valid)
+            if kind in ("arrive", "depart"):
+                tdem = (arr_demand if kind == "arrive"
+                        else np.zeros_like(arr_demand))
+                teli = (arr_elig if kind == "arrive"
+                        else np.ones_like(arr_elig))
+                demand = pt.demand.copy()
+                demand[S:] = tdem
+                eligible = pt.eligible.copy()
+                eligible[S:] = teli
+                fields.update(demand=demand, eligible=eligible)
+                delta_kw.update(demand_rows=(arr_rows, tdem),
+                                eligible_rows=(arr_rows, teli))
+            cur = dataclasses.replace(pt, **fields)
+            with _watch_compiles() as compiles:
+                t = time.perf_counter()
+                delta_ms = rp.apply_delta(cur, ProblemDelta(**delta_kw))
+                r = solve(cur, prob=rp.prob, resident=rp,
+                          resident_warm=True, seed=40 + i, bucket=True,
+                          **kw)
+                ms = (time.perf_counter() - t) * 1e3
+            last = {"affected": int(np.isin(prev[:S], victims).sum())
+                    + (S_new if kind in ("arrive", "depart") else 0),
+                    "moved": int((r.assignment[:S] != prev[:S]).sum())}
+            if record is not None:
+                record.append({
+                    "kind": kind,
+                    "events": {"killed": nkill, "revived": revived,
+                               "scattered_rows":
+                               S_new if kind in ("arrive", "depart")
+                               else 0},
+                    "ms": round(ms, 1),
+                    "delta_stage_ms": round(delta_ms, 2),
+                    "timings_ms": {k: round(v, 1)
+                                   for k, v in r.timings_ms.items()},
+                    "sweeps": int(r.steps),
+                    "violations": r.violations,
+                    "pre_repair_violations": r.pre_repair_violations,
+                    "soft": round(r.soft, 4),
+                    "subsolve": r.subsolve,
+                    "compiles": len(compiles),
+                    **last,
+                })
+            prev = r.assignment
+            pt = cur
+        return pt, prev
 
-    # the burst: 3 busiest nodes die, the old victim revives, the new
-    # tenant's stage arrives — ONE warm re-solve against the final world
-    loads = np.bincount(resA.assignment[:S], minlength=N)
-    loads[victim] = -1
-    dead = np.argsort(loads)[-3:]
-    validB = validA.copy()
-    validB[dead] = False
-    validB[victim] = True
-    ptB = dataclasses.replace(full, node_valid=validB)
-    probB = prepare_problem(ptB)
-    # arrivals seed on the least-loaded eligible valid node (host-side
-    # admission placement — counted into the burst cost below)
-    t0 = time.perf_counter()
-    init = resA.assignment.copy()
-    node_load = np.bincount(init[:S], minlength=N).astype(np.float64)
-    node_load[~validB] = np.inf
-    for s in range(S, S + S_new):
-        cand = np.where(full.eligible[s] & validB)[0]
-        j = cand[np.argmin(node_load[cand])] if len(cand) else victim
-        init[s] = j
-        node_load[j] += 1
-    seed_ms = (time.perf_counter() - t0) * 1e3
-    solve(ptB, prob=probB, chains=chains, steps=steps, seed=22,  # warm compile
-          init_assignment=init, anneal_block=block, warm_block=warm_block,
-          proposals_per_step=proposals)
-    # same timed-median machinery as the single-kill reschedule: per-run
-    # phase timings + compile counts, lower-middle median as the headline.
-    # Each run's "ms" INCLUDES the (constant, separately-reported)
-    # admission seed, so the runs list sums to the headline at sight.
-    reps = _resched_reps()
-    runs, results, order, mid = _timed_runs(
-        lambda i: solve(ptB, prob=probB, chains=chains, steps=steps,
-                        seed=23 + i, init_assignment=init,
-                        anneal_block=block, warm_block=warm_block,
-                        proposals_per_step=proposals), reps)
-    # constant shift: ordering and the median index are unaffected
-    for r in runs:
-        r["ms"] = round(r["ms"] + seed_ms, 1)
-    median_run, resB = runs[mid], results[mid]
-    affected = int(np.isin(resA.assignment[:S], dead).sum()) + S_new
-    moved = int((resB.assignment[:S] != resA.assignment[:S]).sum())
-    return {
-        "events": {"killed": 3, "revived": 1, "arrived_services": S_new},
-        "reschedule_ms": median_run["ms"],
-        "reschedule_ms_min": runs[order[0]]["ms"],
-        "reschedule_compiles": median_run["compiles"],
+    # throwaway warm-up (untimed): compile the FULL warm fused variant
+    # with the active-set path disabled — it is the executable a
+    # gate-rejected sub-solve falls back to, and XLA:CPU's threaded
+    # float reductions mean pass 2 can take a fallback pass 1 did not
+    sub_prev = os.environ.get("FLEET_SUBSOLVE")
+    os.environ["FLEET_SUBSOLVE"] = "0"
+    try:
+        rp_w = ResidentProblem(pt0)
+        base_w = solve(pt0, prob=rp_w.prob, resident=rp_w, seed=20,
+                       bucket=True, **kw)
+        valid_w = pt0.node_valid.copy()
+        valid_w[int(np.bincount(base_w.assignment[:S],
+                                minlength=N).argmax())] = False
+        cur_w = dataclasses.replace(pt0, node_valid=valid_w)
+        rp_w.apply_delta(cur_w, ProblemDelta(node_valid=valid_w))
+        solve(cur_w, prob=rp_w.prob, resident=rp_w, resident_warm=True,
+              seed=21, bucket=True, **kw)
+        del rp_w
+    finally:
+        if sub_prev is None:
+            os.environ.pop("FLEET_SUBSOLVE", None)
+        else:
+            os.environ["FLEET_SUBSOLVE"] = sub_prev
+
+    # pass 1 (untimed): compile every mini-tier variant the sequence
+    # touches; pass 2 replays it timed under the disallow guard
+    run_world(None)
+    xfer = REGISTRY.get("fleet_solver_host_transfers_total")
+    xfer0 = xfer.value()
+    runs: list = []
+    guard_prev = os.environ.get("FLEET_TRANSFER_GUARD")
+    os.environ["FLEET_TRANSFER_GUARD"] = "disallow"
+    try:
+        run_world(runs)
+    finally:
+        if guard_prev is None:
+            os.environ.pop("FLEET_TRANSFER_GUARD", None)
+        else:
+            os.environ["FLEET_TRANSFER_GUARD"] = guard_prev
+    host_transfers = int(xfer.value() - xfer0)
+
+    # ---- legacy replay: identical worlds, the pre-resident warm path ----
+    # (staged problem + host seed + full-problem sweeps — the r08 burst
+    # leg). Plane swaps happen OUTSIDE the timer, matching r08's
+    # pre-staged-probB accounting: the comparison is solve cost.
+    import jax
+    import jax.numpy as jnp
+
+    from fleetflow_tpu.solver.problem import pack_bool_rows
+    cpu = jax.default_backend() == "cpu"
+    prob_l = prepare_problem(pt0)
+
+    def legacy_planes(pt):
+        out = {"node_valid": jnp.asarray(pt.node_valid)}
+        if pt.demand is not pt0.demand:
+            out["demand"] = jnp.asarray(pt.demand, dtype=jnp.float32)
+            e = np.asarray(pt.eligible)
+            out["eligible"] = jnp.asarray(
+                pack_bool_rows(e) if prob_l.eligible.dtype == jnp.uint32
+                else e)
+        return out
+
+    legacy_runs = []
+    valid = pt0.node_valid.copy()
+    pt = pt0
+    # same pattern replayed against the legacy leg's own assignments
+    base_l = solve(pt0, prob=prob_l, seed=20, **kw)
+    prev_l = base_l.assignment
+    dead = deque()
+    warmed = False
+    for i, kind in enumerate(pattern):
+        loads = np.bincount(prev_l[:S], minlength=N).astype(np.float64)
+        loads[~valid] = -1.0
+        nkill = 1 if kind == "kill1" else 3
+        victims = np.argsort(loads)[-nkill:]
+        valid = valid.copy()
+        valid[victims] = False
+        if len(dead) >= 2:
+            valid[dead.popleft()] = True
+        dead.append(victims)
+        fields = dict(node_valid=valid)
+        if kind in ("arrive", "depart"):
+            tdem = (arr_demand if kind == "arrive"
+                    else np.zeros_like(arr_demand))
+            teli = (arr_elig if kind == "arrive"
+                    else np.ones_like(arr_elig))
+            demand = pt.demand.copy()
+            demand[S:] = tdem
+            eligible = pt.eligible.copy()
+            eligible[S:] = teli
+            fields.update(demand=demand, eligible=eligible)
+        cur = dataclasses.replace(pt, **fields)
+        prob_i = dataclasses.replace(prob_l, **legacy_planes(cur))
+        if not warmed:
+            # one untimed warm-up compiles the legacy warm variant
+            warmed = True
+            solve(cur, prob=prob_i, init_assignment=prev_l, prerepair=cpu,
+                  seed=40 + i, **kw)
+        t = time.perf_counter()
+        r = solve(cur, prob=prob_i, init_assignment=prev_l, prerepair=cpu,
+                  seed=40 + i, **kw)
+        ms = (time.perf_counter() - t) * 1e3
+        legacy_runs.append({"kind": kind, "ms": round(ms, 1),
+                            "violations": r.violations,
+                            "soft": round(r.soft, 4)})
+        prev_l = r.assignment
+        pt = cur
+
+    ms_r = [r["ms"] for r in runs]
+    ms_l = [r["ms"] for r in legacy_runs]
+    # the r08-comparable headline: the multi-event bursts (3 kills +
+    # revives + tenant scatter), not the kill1 micro-burst
+    multi = [r["ms"] for r in runs if r["kind"] != "kill1"]
+    multi_l = [r["ms"] for r in legacy_runs if r["kind"] != "kill1"]
+    tiers = sorted({r["subsolve"]["tier"] for r in runs
+                    if r.get("subsolve")})
+    localized = sum(1 for r in runs
+                    if (r.get("subsolve") or {}).get("outcome")
+                    == "localized")
+    p50 = float(np.percentile(multi, 50))
+    p50_l = float(np.percentile(multi_l, 50))
+    out = {
+        "events": {"killed": 3, "revived": 3, "arrived_services": S_new},
+        "pattern": pattern,
+        "bursts": len(pattern),
+        "reschedule_ms": round(p50, 1),
+        "reschedule_ms_min": round(min(multi), 1),
+        "reschedule_p99_ms": round(float(np.percentile(ms_r, 99)), 1),
+        "reschedule_compiles": sum(r["compiles"] for r in runs),
         "reschedule_runs": runs,
-        "violations": median_run["violations"],
-        "pre_repair_violations": median_run["pre_repair_violations"],
-        "soft": median_run["soft"],
-        "sweeps": median_run["sweeps"],
-        "affected": affected,
-        "moved": moved,
-        "admission_seed_ms": round(seed_ms, 1),
+        "violations": max(r["violations"] for r in runs),
+        "pre_repair_violations": max(r["pre_repair_violations"]
+                                     for r in runs),
+        "soft": round(float(np.median([r["soft"] for r in runs])), 4),
+        "sweeps": int(np.median([r["sweeps"] for r in runs])),
+        "affected": runs[-1]["affected"],
+        "moved": runs[-1]["moved"],
+        "host_transfers": host_transfers,
+        "transfer_guard": "disallow",
+        "subsolve_tiers": tiers,
+        "localized_bursts": localized,
+        "legacy": {"p50_ms": round(p50_l, 1), "runs": legacy_runs},
+        "speedup_vs_legacy": round(p50_l / p50, 2) if p50 else None,
     }
+    if os.environ.get("BENCH_SUBSOLVE_ASSERT", "").lower() in \
+            ("1", "true", "on", "yes"):
+        # the CI smoke contract for the active-set path: a churn loop
+        # exercising >= 2 mini tiers with zero recompiles, zero host
+        # transfers and zero violations under the disallow guard
+        assert out["reschedule_compiles"] == 0, \
+            f"burst loop recompiled: {out}"
+        assert out["host_transfers"] == 0, \
+            f"burst loop crossed the host boundary: {out}"
+        assert out["violations"] == 0, f"burst loop violated: {out}"
+        assert len(tiers) >= 2, \
+            f"burst loop exercised {tiers}; expected >= 2 mini tiers"
+    return out
 
 
 def _gen_registry(S: int, N: int, F: int = 8, trim_fleet: str = None,
@@ -816,7 +1011,13 @@ def _pipeline_scenario(S: int, N: int, *, chains: int, steps: int,
     relower_ms = ((time.perf_counter() - t7) * 1e3
                   - (parse2_box[0] - parse2_before))
     t7b = time.perf_counter()
-    prob2_b, _ = pad_problem_tiers(prepare_problem(pt2), bucket_config())
+    # the ARENA fast path (stage_problem_tiers): padded host planes in
+    # reusable per-tier buffers + plain device_put — the production
+    # restage. r08 regressed this leg 6.4 -> 62.1 ms by routing through
+    # prepare_problem + on-device pad_problem_tiers (eager jnp.pad
+    # dispatches per plane); tests/test_buckets.py pins the fast path
+    from fleetflow_tpu.solver import stage_problem_tiers as _stage_tiers
+    prob2_b, _ = _stage_tiers(pt2, bucket_config())
     jax.block_until_ready(prob2_b)
     stage2_ms = (time.perf_counter() - t7b) * 1e3
     with _watch_compiles() as compiles2:
@@ -1269,7 +1470,17 @@ def _quality_vs_devices_curve(pt, replicas: int, svc: int,
     seed path, whose slice-local fragmentation leaves real annealing
     headroom — so the curve measures annealing power per device, not seed
     quality. Reports a 3-seed median per point: a single PRNG draw would
-    make the monotone-quality claim a coin flip."""
+    make the monotone-quality claim a coin flip.
+
+    The curve runs on a HARDENED copy of the instance: at the headline
+    fleet's ~2x capacity headroom the seed lands near-optimal and the
+    r08 curve saturated (soft bit-identical at 1 vs 2 replicas,
+    tempering_wins silently false). Tightening capacity
+    (BENCH_CURVE_TIGHTEN, default 0.85) leaves the anneal real packing
+    work, and saturation — every point's soft identical — is now an
+    EXPLICIT artifact field, not a silent boolean."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1280,6 +1491,13 @@ def _quality_vs_devices_curve(pt, replicas: int, svc: int,
     from fleetflow_tpu.solver.sharded import (anneal_sharded, pad_problem,
                                               tempering_mesh)
 
+    try:
+        tighten = float(os.environ.get("BENCH_CURVE_TIGHTEN", "0.85"))
+    except ValueError:
+        tighten = 0.85
+    pt = dataclasses.replace(
+        pt, capacity=(np.asarray(pt.capacity, dtype=np.float32)
+                      * tighten))
     curve_steps = int(os.environ.get("BENCH_SHARDED_CURVE_STEPS", "48"))
     try:
         lad = float(os.environ.get("FLEET_TEMPER_LADDER") or "1.3")
@@ -1331,10 +1549,22 @@ def _quality_vs_devices_curve(pt, replicas: int, svc: int,
         })
     base = points[0]["soft_median"]
     multi = [p["soft_median"] for p in points if p["replicas"] > 1]
+    wins = bool(multi and min(multi) < base - 1e-9)
+    # saturation is an explicit verdict, not a silent false: every
+    # point's soft within float noise of the single-lane baseline means
+    # the instance/budget leaves the anneal nothing to buy with devices
+    saturated = bool(multi) and not wins and all(
+        abs(m - base) <= 1e-7 for m in multi)
     return {"steps": curve_steps, "ladder": lad,
             "seed": "partitioned" if available_nobuild() else "greedy",
+            "capacity_tighten": tighten,
             "points": points,
-            "tempering_wins": bool(multi and min(multi) < base)}
+            "tempering_wins": wins,
+            "saturated": saturated,
+            "note": ("soft identical across replica counts: no annealing "
+                     "headroom at this budget — tighten "
+                     "BENCH_CURVE_TIGHTEN or raise "
+                     "BENCH_SHARDED_CURVE_STEPS") if saturated else None}
 
 
 def _sharded_child() -> None:
@@ -1645,6 +1875,25 @@ def _admission_child() -> None:
     ctrl.submit("gen", departures=list(live[:30]))
     clock.advance(1.0)
     drain(clock.now())
+    # one drain with the active-set path disabled: compiles the FULL warm
+    # fused variant — the fallback executable a gate-rejected sub-solve
+    # re-runs, which must never compile inside the measured window
+    sub_prev = os.environ.get("FLEET_SUBSOLVE")
+    os.environ["FLEET_SUBSOLVE"] = "0"
+    try:
+        specs = []
+        for _ in range(8):
+            seq[0] += 1
+            specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                          "memory": 64.0})
+        ctrl.submit("gen", arrivals=specs)
+        clock.advance(1.0)
+        drain(clock.now())
+    finally:
+        if sub_prev is None:
+            os.environ.pop("FLEET_SUBSOLVE", None)
+        else:
+            os.environ["FLEET_SUBSOLVE"] = sub_prev
     t = 0.0
     while t < warm_s:
         lam = rate * (1.0 + 0.6 * math.sin(2 * math.pi * t / period))
@@ -1730,6 +1979,16 @@ def _admission_child() -> None:
         "violations_max": violations_max,
         "transfer_guard": "disallow",
         "baseline_solve_s": round(baseline_s, 2),
+        # the solve TAIL ratio the active-set path (solver/subsolve.py)
+        # keeps flat: p99/p50 of the micro-solve wall times. r08 sat at
+        # 4.2 because tail batches paid full-problem sweeps.
+        "solve_tail_ratio": round(
+            float(np.percentile(solve_ms, 99))
+            / max(float(np.percentile(solve_ms, 50)), 1e-9), 2)
+        if solve_ms else None,
+        # localized-vs-fallback census over the measured window
+        "subsolve": {k: int(_subsolve_outcomes().get(k, 0))
+                     for k in sorted(_subsolve_outcomes())} or None,
     }
     if os.environ.get("BENCH_ADMIT_ASSERT", "").lower() in \
             ("1", "true", "on", "yes"):
@@ -1742,7 +2001,28 @@ def _admission_child() -> None:
             f"admission cold-restaged at steady state: {result}"
         assert result["placements_per_s"] > 0, f"no throughput: {result}"
         assert result["violations_max"] == 0, f"violations: {result}"
+        # tail-ratio bound: CI catches a re-grown solve tail (r08: 4.2).
+        # The BENCH_SMALL profile gets a looser default — at a few
+        # hundred rows a single compaction restage dominates the p99.
+        dflt = "4.0" if small else "2.5"
+        try:
+            bound = float(os.environ.get("BENCH_ADMIT_TAIL", dflt))
+        except ValueError:
+            bound = float(dflt)
+        if result["solve_tail_ratio"] is not None:
+            assert result["solve_tail_ratio"] < bound, (
+                f"admission solve tail re-grew: p99/p50 "
+                f"{result['solve_tail_ratio']} >= {bound}: {result}")
     print(json.dumps(result))
+
+
+def _subsolve_outcomes() -> dict:
+    """fleet_solver_subsolve_total{outcome} counter values, as a dict."""
+    from fleetflow_tpu.obs.metrics import REGISTRY
+    ctr = REGISTRY.get("fleet_solver_subsolve_total")
+    if ctr is None:
+        return {}
+    return {k[0]: c[0] for k, c in sorted(ctr._children.items())}
 
 
 if __name__ == "__main__":

@@ -876,6 +876,10 @@ def cmd_admit(args) -> int:
               f"unparked={st.get('unparked', 0)} "
               f"solves={st.get('solves', 0)} "
               f"compactions={st.get('compactions', 0)}")
+        if out.get("solve_ms_p50") is not None:
+            p50, p99 = out["solve_ms_p50"], out["solve_ms_p99"]
+            ratio = f" (p99/p50={p99 / p50:.1f}x)" if p50 else ""
+            print(f"solve: p50={p50:.1f}ms p99={p99:.1f}ms{ratio}")
         return 0
 
 

@@ -8,6 +8,17 @@ donated on-device merge — warm reschedules never round-trip the host
 per-reschedule path). Content drift the delta cannot express (a relowered
 fleet, new conflict ids, a different shape tier) falls back to cold
 staging, counted in fleet_solver_resident_reuse_total{outcome}.
+
+Warm deltas additionally feed the ACTIVE-SET path (solver/subsolve.py):
+the resident staging tracks which rows each delta touched, and when the
+churn's constraint closure is small the warm anneal runs over a gathered
+mini tier instead of the full problem — the O(affected) sweep cost the
+burst-reschedule and admission micro-solve legs ride. The scheduler needs
+no extra bookkeeping for this: `ResidentProblem.apply_delta` accumulates
+the affected rows and `solver.api._solve` plans/gates the localized
+dispatch, so every `reschedule()` caller gets it for free (the outcome is
+visible on `fleet_solver_subsolve_total{outcome}` and the debug log
+line below).
 """
 
 from __future__ import annotations
@@ -21,6 +32,9 @@ import numpy as np
 
 from .base import Placement, level_schedule, record_placement
 from ..lower.tensors import ProblemTensors
+from ..obs import get_logger, kv
+
+log = get_logger("sched.tpu")
 
 __all__ = ["TpuSolverScheduler"]
 
@@ -216,6 +230,13 @@ class TpuSolverScheduler:
                         overlap_host_work=overlap_host_work)
         slot.last_assignment = res.assignment
         ms = (time.perf_counter() - t0) * 1e3
+        sub = getattr(res, "subsolve", None)
+        if sub is not None:
+            # the churn rode the mini-tier path (or tried to): the line
+            # an operator correlates with a reschedule latency change
+            log.debug("active-set %s", kv(
+                stage=stage, rows=sub["rows"], tier=sub["tier"],
+                outcome=sub["outcome"], ms=sub["ms"]))
 
         placement = Placement(
             assignment={pt.service_names[i]: pt.node_names[int(res.assignment[i])]

@@ -48,28 +48,38 @@ class ChainState(NamedTuple):
 
 
 def chain_states_from_assignment(prob: DeviceProblem,
-                                 assignment: jax.Array) -> ChainState:
-    """Build the incremental state for one chain from a dense assignment."""
+                                 assignment: jax.Array,
+                                 base: tuple | None = None) -> ChainState:
+    """Build the incremental state for one chain from a dense assignment.
+
+    `base` is an optional frozen remainder ``(load0, used0, coloc0,
+    topo0)`` the scatters accumulate ONTO instead of zeros — the active-set
+    sub-solve (solver/subsolve.py) seeds the mini problem's carried state
+    with the frozen rows' contribution so capacity/conflict/skew gradients
+    against the untouched fleet stay exact without streaming its planes."""
     R = prob.demand.shape[1]
-    load = jnp.zeros((prob.N, R), jnp.float32).at[assignment].add(prob.demand)
+    load0, used0, coloc0, topo0 = (
+        base if base is not None else
+        (jnp.zeros((prob.N, R), jnp.float32),
+         jnp.zeros((prob.N, prob.G), jnp.int32),
+         jnp.zeros((prob.N, max(prob.Gc, 1)), jnp.int32),
+         jnp.zeros(prob.T, jnp.int32)))
+    load = load0.at[assignment].add(prob.demand)
 
     valid = prob.conflict_ids >= 0
     safe = jnp.where(valid, prob.conflict_ids, 0)
     nodes = jnp.broadcast_to(assignment[:, None], safe.shape)
-    used = jnp.zeros((prob.N, prob.G), jnp.int32).at[nodes, safe].add(
-        valid.astype(jnp.int32))
+    used = used0.at[nodes, safe].add(valid.astype(jnp.int32))
 
-    Gc = max(prob.Gc, 1)
     cvalid = prob.coloc_ids >= 0
     csafe = jnp.where(cvalid, prob.coloc_ids, 0)
     cnodes = jnp.broadcast_to(assignment[:, None], csafe.shape)
-    coloc = jnp.zeros((prob.N, Gc), jnp.int32).at[cnodes, csafe].add(
-        cvalid.astype(jnp.int32))
+    coloc = coloc0.at[cnodes, csafe].add(cvalid.astype(jnp.int32))
 
     # phantom rows (bucket padding, rows >= n_real) carry no topology
     # weight: a parked phantom must not shift a spread constraint
     tw = real_row_weights(prob)
-    topo = jnp.zeros(prob.T, jnp.int32).at[prob.node_topology[assignment]].add(tw)
+    topo = topo0.at[prob.node_topology[assignment]].add(tw)
     return ChainState(assignment, load, used, coloc, topo)
 
 
@@ -457,6 +467,18 @@ def default_proposals_per_step(S: int) -> int:
     round 3, docs/guide/03-placement-and-the-tpu-solver.md tuning notes +
     docs/profiles/)."""
     return max(1, min(256, S // 2))
+
+
+def backend_proposals_per_step(S: int) -> int:
+    """The backend-aware width both the full pipeline (api._solve) and
+    the active-set sub-solve derive from: the CPU knee is 64 (sweep cost
+    ~linear in width there — no free MXU width), accelerators take the
+    256 knee above. ONE helper so a re-tuned knee cannot update one call
+    site and silently leave the other stale."""
+    import jax
+    if jax.default_backend() == "cpu":
+        return max(1, min(64, S // 2))
+    return default_proposals_per_step(S)
 
 
 @partial(jax.jit, static_argnames=("steps", "proposals_per_step", "unroll"))

@@ -112,6 +112,11 @@ class SolveResult:
     # parallel-tempering config + replica-exchange outcome here:
     # {replicas, ladder, exchange_every, swap_attempts, swap_accepts}
     tempering: Optional[dict] = None
+    # churn-localized sub-solve (solver/subsolve.py): {rows, tier,
+    # affected, outcome, ms} when a localized dispatch ran (outcome
+    # "localized" = committed by the exact gate, "fallback_infeasible" =
+    # the full fused path re-ran), None when the solve was full-problem
+    subsolve: Optional[dict] = None
 
     @property
     def acceptance_rate(self) -> float:
@@ -442,8 +447,11 @@ def _solve(pt: ProblemTensors, *,
     # True -> the legacy host repair.py pre-pass (kept for A/B and
     # debugging); False -> none (the anneal's targeted proposals alone).
     fused = warm and prerepair is None
-    guard = (transfer_guard_ctx() if resident_warm
-             else contextlib.nullcontext())
+    # a FACTORY, not a context instance: jax.transfer_guard is a one-shot
+    # generator CM, and a sub-solve the gate rejects dispatches twice
+    # (mini attempt, then the full fused path) — each under its own guard
+    guard_ctx = (transfer_guard_ctx if resident_warm
+                 else contextlib.nullcontext)
     def _legacy_host_prepass(seed_np: np.ndarray) -> np.ndarray:
         # the legacy host pre-repair (kept for A/B against the fused
         # prologue): relocate services stranded on dead/ineligible nodes.
@@ -560,16 +568,14 @@ def _solve(pt: ProblemTensors, *,
         # derived from the PADDED row count: proposals_per_step is a static
         # jit argument, so deriving it from the exact S would recompile on
         # every fleet-size drift and defeat the bucketing (the clamps make
-        # this a no-op at fleet scale)
-        if jax.default_backend() == "cpu":
-            # CPU sweep cost is ~linear in proposals (no free width the way
-            # the MXU gives it): a 64-wide sweep costs ~25 ms at 10k x 1k vs
-            # ~100 ms at the 256 TPU knee, and with a feasible seed the
-            # sweeps only buy soft polish. Measured in VERDICT r2 item 5.
-            proposals_per_step = max(1, min(64, prob.S // 2))
-        else:
-            from .anneal import default_proposals_per_step
-            proposals_per_step = default_proposals_per_step(prob.S)
+        # this a no-op at fleet scale). CPU sweep cost is ~linear in
+        # proposals (no free width the way the MXU gives it): a 64-wide
+        # sweep costs ~25 ms at 10k x 1k vs ~100 ms at the 256 TPU knee,
+        # and with a feasible seed the sweeps only buy soft polish
+        # (measured in VERDICT r2 item 5) — backend_proposals_per_step
+        # holds the knee for this path AND the sub-solve's.
+        from .anneal import backend_proposals_per_step
+        proposals_per_step = backend_proposals_per_step(prob.S)
 
     t_anneal = t()
     sharding = (NamedSharding(mesh, P(CHAIN_AXIS, None))
@@ -581,6 +587,14 @@ def _solve(pt: ProblemTensors, *,
     # fused pre-repair budget: a static bound the while_loop exits early
     # from; derived from the PADDED rows so it cannot break bucket reuse
     prerepair_moves = max(16, min(prob.S, 256)) if fused else 0
+    # ---- churn-localized sub-solve plan (solver/subsolve.py) ------------
+    # when the resident delta path knows the affected set and its
+    # constraint closure is small, the anneal runs over a mini tier of
+    # gathered rows instead of the full problem; the exact full-problem
+    # gate below decides whether the localized result commits
+    sub_plan = None
+    if resident_warm and fused and adaptive and mesh is None:
+        sub_plan = resident.take_active_plan()
     if binfo is not None:
         # hit = this process already ran the fused pipeline at these
         # jit-relevant extents, so the dispatch below will not recompile
@@ -596,7 +610,11 @@ def _solve(pt: ProblemTensors, *,
              # plane layout is part of the executable identity: a packed
              # and a dense staging (or absent vs present preference) are
              # different treedefs/dtypes, hence different XLA programs
-             str(prob.eligible.dtype), prob.preferred is not None))
+             str(prob.eligible.dtype), prob.preferred is not None,
+             # a localized dispatch is its own executable, keyed by the
+             # mini tier and compact id ladders (solver/subsolve.py)
+             (sub_plan.tier, sub_plan.G_sub, sub_plan.Gc_sub)
+             if sub_plan is not None else None))
         _M_BUCKET.inc(hit="true" if binfo.hit else "false")
         _M_PAD_WASTE.set(binfo.pad_waste)
     # the PRNG key is minted BEFORE the transfer guard arms: it is not a
@@ -620,17 +638,69 @@ def _solve(pt: ProblemTensors, *,
         # 1-block polish (same results as r05).
         skip_feasible_polish=bool(resident_warm and adaptive and fused))
     cache_before = _refine._cache_size()
-    # the proof: under FLEET_TRANSFER_GUARD=disallow any host->device
-    # transfer inside the warm dispatch raises (every input above is
-    # already resident; statics hash, they don't transfer); off the
-    # resident path the guard is a nullcontext
-    with guard:
-        best_assignment, dstats, dsoft, sweeps_run, accepted = _refine(
-            prob, seed_assignment, key, t0_d, t1_d, mw_d, **refine_kw)
+    sub_info = None
+    sub_cache_before = 0
+    if sub_plan is not None:
+        from .anneal import backend_proposals_per_step
+        from .subsolve import (record_outcome, record_subsolve_ms,
+                               stage_subsolve, subsolve_cache_size,
+                               subsolve_dispatch)
+        sub_cache_before = subsolve_cache_size()
+        t_sub = t()
+        # small per-burst uploads (closure rows, compact ids, frozen
+        # base) stage BEFORE the guard arms — the merge-upload discipline
+        staged = stage_subsolve(resident, sub_plan)
+        sub_props = backend_proposals_per_step(sub_plan.tier)
+        with guard_ctx():
+            best_assignment, dstats, dsoft, sweeps_run, accepted = \
+                subsolve_dispatch(
+                    prob, resident.assignment, staged, sub_plan, key,
+                    t0_d, t1_d, mw_d, chains=chains, steps=steps,
+                    block=min(warm_block, anneal_block),
+                    proposals_per_step=sub_props)
+        if overlap_host_work is not None:
+            # the gate decision below synchronizes with the in-flight
+            # sub dispatch, so the overlapped host work must run NOW —
+            # after it, the async window is gone
+            t_ov = t()
+            overlap_host_work()
+            timings["overlap_host_ms"] = (t() - t_ov) * 1e3
+            overlap_host_work = None
+        # the exact full-problem gate rules: feasible commits the
+        # scattered result; infeasible discards it and the full fused
+        # path re-runs from the ORIGINAL seed (the kernel does not
+        # donate, so the previous assignment — stranded rows intact, the
+        # battle-tested prerepair shape — is still alive)
+        sub_feasible = float(jax.device_get(dstats["total"])) == 0
+        # disjoint phases: overlapped host work is reported under
+        # overlap_host_ms, not double-counted into the sub-solve timing
+        timings["subsolve_ms"] = ((t() - t_sub) * 1e3
+                                  - timings.get("overlap_host_ms", 0.0))
+        record_subsolve_ms(timings["subsolve_ms"])
+        outcome = "localized" if sub_feasible else "fallback_infeasible"
+        record_outcome(outcome)
+        sub_info = {"rows": sub_plan.n_sub, "tier": sub_plan.tier,
+                    "affected": sub_plan.affected, "outcome": outcome,
+                    "ms": round(timings["subsolve_ms"], 2)}
+        if sub_feasible:
+            resident.adopt(best_assignment)
+        else:
+            sub_plan = None     # seed_assignment still holds the original
+    if sub_plan is None:
+        # the proof: under FLEET_TRANSFER_GUARD=disallow any host->device
+        # transfer inside the warm dispatch raises (every input above is
+        # already resident; statics hash, they don't transfer); off the
+        # resident path the guard is a nullcontext
+        with guard_ctx():
+            best_assignment, dstats, dsoft, sweeps_run, accepted = _refine(
+                prob, seed_assignment, key, t0_d, t1_d, mw_d, **refine_kw)
+        if resident is not None:
+            # the padded winner stays on device as the next warm seed
+            resident.adopt(best_assignment)
     compile_events = _refine._cache_size() - cache_before
-    if resident is not None:
-        # the padded winner stays on device as the next warm seed
-        resident.adopt(best_assignment)
+    if sub_info is not None:
+        from .subsolve import subsolve_cache_size
+        compile_events += subsolve_cache_size() - sub_cache_before
     if overlap_host_work is not None:
         # async dispatch: the solve is in flight on device; do host work
         # (e.g. lower/ re-lowering of changed fleets) before blocking
@@ -640,7 +710,17 @@ def _solve(pt: ProblemTensors, *,
     # ONE transfer for everything the host decision needs
     assignment, dstats, soft, sweeps_run, accepted = jax.device_get(
         (best_assignment, dstats, dsoft, sweeps_run, accepted))
-    assignment = np.asarray(assignment)
+    # FORCE a host copy: on the CPU backend device_get returns a VIEW of
+    # the device buffer, and the resident path DONATES that buffer into
+    # the next burst's merge/sub-solve dispatch — without the copy every
+    # retained SolveResult.assignment (scheduler slot, bench bookkeeping)
+    # is clobbered in place when XLA reuses the storage (observed as
+    # garbage node indices once the localized kernel aliased it to a
+    # float scratch buffer)
+    assignment = np.array(assignment, copy=True)
+    # the padded winner, host side: the sub-solve mirror rides this fetch
+    # (the result crossed the boundary anyway — no extra transfer)
+    padded_host = assignment
     if bucketed:
         # phantom placements are an implementation detail of the padded
         # executable; no caller ever sees them
@@ -688,6 +768,14 @@ def _solve(pt: ProblemTensors, *,
         # already does the same for the common path)
         soft = soft_score_host(pt, assignment)
     timings["verify_repair_ms"] = (t() - t_verify) * 1e3
+    if resident is not None:
+        # active-set bookkeeping (solver/subsolve.py): the mirror is what
+        # the next burst's closure/frozen-base is computed against, and
+        # feasibility is the frozen-base precondition. A host repair
+        # rewrite already refreshed the mirror through adopt_host.
+        resident.note_host_assignment(
+            padded=None if moves else padded_host,
+            feasible=stats["total"] == 0)
     timings["total_ms"] = (t() - t_start) * 1e3
     _M_SOLVES.inc(backend=jax.default_backend(),
                   warm="true" if warm else "false")
@@ -709,6 +797,8 @@ def _solve(pt: ProblemTensors, *,
         violations=int(stats["total"]), pre_repair=pre_repair,
         repaired=moves or None, warm=warm or None,
         resident=resident_warm or None, fused=fused or None,
+        sub=(f"{sub_info['rows']}/{sub_info['tier']}"
+             f"({sub_info['outcome']})" if sub_info else None),
         **{k: f"{v:.1f}" for k, v in timings.items()}))
     return SolveResult(
         assignment=assignment, stats=stats, soft=soft,
@@ -719,4 +809,5 @@ def _solve(pt: ProblemTensors, *,
         accepted_moves=accepted,
         bucket=binfo.to_dict() if binfo is not None else None,
         fused_prerepair=fused,
+        subsolve=sub_info,
     )

@@ -62,9 +62,9 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["BucketConfig", "BucketInfo", "bucket_config", "bucket_size",
-           "width_bucket", "pad_problem", "pad_problem_tiers",
-           "pad_assignment", "record_bucket", "soft_score_host",
-           "stage_problem_tiers", "staging_arena_stats"]
+           "width_bucket", "subsolve_tier", "pad_problem",
+           "pad_problem_tiers", "pad_assignment", "record_bucket",
+           "soft_score_host", "stage_problem_tiers", "staging_arena_stats"]
 
 
 @dataclass(frozen=True)
@@ -132,6 +132,23 @@ def bucket_bounds(n: int, *, growth: float = 1.25, minimum: int = 64,
         tier *= growth
         out = -((-math.ceil(tier)) // align) * align
     return lower, upper
+
+
+def subsolve_tier(k: int, *, minimum: int = 256, maximum: int = 4096) -> int:
+    """Mini tier for the active-set sub-problem's row count
+    (solver/subsolve.py): the power-of-two ladder minimum, 2*minimum,
+    4*minimum, ... capped at `maximum`. Bucketed for the same reason the
+    full problem is — each distinct sub shape is its own XLA program, and
+    churn closure sizes drift burst to burst — but on a coarser ladder:
+    a handful of mini executables covers every localized solve. Returns
+    the tier, or 0 when k exceeds `maximum` (the closure is too big to
+    localize; the caller falls back to the full fused path)."""
+    if k <= 0:
+        return minimum
+    tier = minimum
+    while tier < k:
+        tier *= 2
+    return tier if tier <= maximum else 0
 
 
 def width_bucket(k: int, multiple: int = 4) -> int:

@@ -1,7 +1,7 @@
 """Compile-contract registry: the solver's hot-path executables, with the
 donation/sharding declarations each one must keep.
 
-The perf contracts (PRs 4-9) live or die on four jitted programs:
+The perf contracts (PRs 4-9, 14) live or die on five jitted programs:
 
   resident.merge    the donated single-chip delta-merge kernel
                     (solver/resident._merge_fn) — churn folds into the
@@ -11,6 +11,10 @@ The perf contracts (PRs 4-9) live or die on four jitted programs:
                     output to its input layout
   refine.warm       the fused solve pipeline (api._refine) in its warm
                     resident configuration — the steady-state dispatch
+  subsolve.localized  the churn-localized gather -> mini-anneal ->
+                    scatter -> exact-gate dispatch (subsolve._subsolve_fn);
+                    pinned donation-FREE — the original assignment must
+                    outlive a gate-rejected attempt
   sharded.anneal    the SPMD anneal + tempering dispatch
                     (sharded.anneal_sharded) on a tempered mesh
 
@@ -197,11 +201,8 @@ def _refine_cases() -> list[KernelCase]:
         rp = ResidentProblem(pt)
         rp.adopt_host(np.zeros(pt.S, np.int32), pt.node_valid, warm=False)
         prob = rp.prob
-        if jax.default_backend() == "cpu":
-            proposals = max(1, min(64, prob.S // 2))
-        else:                                        # pragma: no cover
-            from .anneal import default_proposals_per_step
-            proposals = default_proposals_per_step(prob.S)
+        from .anneal import backend_proposals_per_step
+        proposals = backend_proposals_per_step(prob.S)
         t0_d, t1_d, mw_d = rp.warm_scalars(0.1, 1e-3, 0.5)
         key = jax.random.PRNGKey(0)
         out.append(KernelCase(
@@ -213,6 +214,62 @@ def _refine_cases() -> list[KernelCase]:
                         prerepair_moves=max(16, min(prob.S, 256)),
                         skip_feasible_polish=True),
             arg_names=_REFINE_ARG_NAMES,
+            out_shardings=None))
+    return out
+
+
+_SUBSOLVE_ARG_NAMES = ("prob", "assignment", "rows", "sub_conflict",
+                       "sub_coloc", "load0", "used0", "coloc0", "topo0",
+                       "n_sub", "key", "t0", "t1", "migration_weight")
+
+
+def _subsolve_cases() -> list[KernelCase]:
+    """The churn-localized sub-solve (solver/subsolve.py) in its warm
+    production configuration: a staged resident problem, a killed-node
+    delta, the planner's own closure/frozen-base staging, and the statics
+    derived exactly as api._solve derives them."""
+    import dataclasses as _dc
+
+    import jax
+
+    from .resident import ProblemDelta, ResidentProblem
+    from .subsolve import (ActiveIndex, SubsolveConfig, _subsolve_fn,
+                           plan_active, stage_subsolve)
+
+    # permissive gates: the audit instances sit far below the production
+    # mini-tier ladder, and the contract pins kernel structure, not the
+    # production closure heuristics
+    cfg = SubsolveConfig(enabled=True, frac=1.0, min_tier=8, max_tier=4096)
+    out = []
+    for S, N in AUDIT_TIERS:
+        pt = _synthetic(S, N)
+        rp = ResidentProblem(pt)
+        rp.adopt_host(np.arange(pt.S, dtype=np.int32) % N, pt.node_valid,
+                      warm=False)
+        valid = np.asarray(pt.node_valid, dtype=bool).copy()
+        valid[0] = False                     # kill one node: evictions
+        cur = _dc.replace(pt, node_valid=valid)
+        rp.apply_delta(cur, ProblemDelta(node_valid=valid))
+        index = ActiveIndex(rp.pt)
+        pending = (rp._pending_rows if rp._pending_rows is not None
+                   else np.empty(0, dtype=np.int64))
+        plan, outcome = plan_active(index, rp.pt, rp._mirror, rp.prob.S,
+                                    rp.prob.T, pending, cfg,
+                                    G_full=rp.prob.G, Gc_full=rp.prob.Gc)
+        assert plan is not None, f"audit sub-plan fell back: {outcome}"
+        staged = stage_subsolve(rp, plan)
+        from .anneal import backend_proposals_per_step
+        t0_d, t1_d, mw_d = rp.warm_scalars(0.1, 1e-3, 0.5)
+        key = jax.random.PRNGKey(0)
+        out.append(KernelCase(
+            tier=f"{rp.prob.S}x{N}:t{plan.tier}", fn=_subsolve_fn(),
+            args=(rp.prob, rp.assignment, *staged, key, t0_d, t1_d, mw_d),
+            kwargs=dict(chains=1, steps=16, block=1,
+                        proposals_per_step=backend_proposals_per_step(
+                            plan.tier),
+                        prerepair_moves=max(16, min(plan.tier, 256)),
+                        Gc_sub=plan.Gc_sub),
+            arg_names=_SUBSOLVE_ARG_NAMES,
             out_shardings=None))
     return out
 
@@ -271,6 +328,18 @@ def hot_path_kernels() -> list[KernelContract]:
             module="fleetflow_tpu.solver.api",
             qualname="_refine",
             cases=_refine_cases),
+        KernelContract(
+            name="subsolve.localized",
+            module="fleetflow_tpu.solver.subsolve",
+            qualname="_subsolve_fn.subsolve",
+            # deliberately NO donation (must_alias empty): the original
+            # assignment must outlive the dispatch — a gate-rejected
+            # sub-solve re-seeds the full path from it — and a donated
+            # variant of this kernel deserialized from the persistent
+            # compile cache corrupted its output (r09 bring-up). The
+            # contract pins the ABSENCE: a donated_params entry
+            # appearing here is a reviewed golden diff.
+            cases=_subsolve_cases),
         KernelContract(
             name="sharded.merge",
             module="fleetflow_tpu.solver.sharded",

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from .problem import DeviceProblem, eligible_lookup
 
 __all__ = ["node_loads", "group_counts", "violation_stats", "total_violations",
-           "soft_score", "total_cost", "real_row_weights", "W_HARD"]
+           "soft_score", "total_cost", "exact_stats_and_soft",
+           "real_row_weights", "W_HARD"]
 
 W_HARD = 1e4  # weight of one hard violation vs the soft score range
 
@@ -144,3 +145,12 @@ def soft_score(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
 def total_cost(prob: DeviceProblem, assignment: jax.Array) -> jax.Array:
     """Hard violations (dominant) + soft score: the annealing objective."""
     return W_HARD * total_violations(prob, assignment) + soft_score(prob, assignment)
+
+
+def exact_stats_and_soft(prob: DeviceProblem,
+                         assignment: jax.Array) -> tuple[dict, jax.Array]:
+    """From-scratch (stats, soft) of one assignment — the acceptance gate
+    both the fused pipeline's final rebuild and the active-set sub-solve
+    (solver/subsolve.py) trust: whatever a cheaper carried/sub-problem path
+    claims, the decision that commits a placement reads these numbers."""
+    return violation_stats(prob, assignment), soft_score(prob, assignment)

@@ -177,6 +177,10 @@ class ResidentProblem:
     # scheduler's slot matching keys on it so a routing flip mid-life can
     # never hand a sharded staging to the single-chip path or vice versa
     mesh = None
+    # the single-chip staging supports churn-localized sub-solves
+    # (solver/subsolve.py); the mesh-sharded subclass runs its own SPMD
+    # anneal and opts out
+    supports_subsolve = True
 
     def __init__(self, pt, *, bucket: bool = True,
                  cfg=None):
@@ -191,6 +195,15 @@ class ResidentProblem:
         self._delta_ms: float = 0.0
         self._scalars: dict[tuple, tuple] = {}
         self._staged_fp: tuple = (None, None)
+        # active-set sub-solve state (solver/subsolve.py): host mirror of
+        # the padded device assignment as of the last solve, the host
+        # constraint index (built lazily per staging), and the row set
+        # churn deltas have touched since that solve
+        self._mirror: Optional[np.ndarray] = None
+        self._mirror_feasible: bool = False
+        self._index: Any = None
+        self._pending_rows: Optional[np.ndarray] = None
+        self._pending_churn: bool = False
         self.cold_stage(pt)
 
     # -- staging -----------------------------------------------------------
@@ -225,6 +238,13 @@ class ResidentProblem:
         self._valid_fp = np.asarray(pt.node_valid, dtype=bool).copy()
         self._cap_fp = np.asarray(pt.capacity, dtype=np.float32).copy()
         self._delta_ms = 0.0
+        # a cold staging invalidates the sub-solve state: the mirror is
+        # of a dead assignment and the index of dead tensors
+        self._mirror = None
+        self._mirror_feasible = False
+        self._index = None
+        self._pending_rows = None
+        self._pending_churn = False
         _M_REUSE.inc(outcome="cold")
 
     def compatible(self, pt, delta: Optional[ProblemDelta] = None) -> bool:
@@ -356,6 +376,39 @@ class ResidentProblem:
         self._staged_fp = (valid, cap)
         return uploads, n_real, has_demand, has_eligible
 
+    def _note_churn(self, pt, delta: Optional[ProblemDelta]) -> None:
+        """Accumulate the row set this delta touches for the active-set
+        planner (solver/subsolve.py) — called BEFORE the fingerprints
+        roll over so capacity shrink is measured against the staging the
+        mirror assignment was solved on. Node kills need no bookkeeping
+        here: stranded rows are recomputed from the post-delta tensors at
+        plan time."""
+        if not self.supports_subsolve or self._mirror is None:
+            return    # nothing to localize against (no previous solve)
+        rows = [np.empty(0, dtype=np.int64)]
+        if delta is not None:
+            if delta.demand_rows is not None:
+                rows.append(np.asarray(delta.demand_rows[0],
+                                       dtype=np.int64))
+            if delta.eligible_rows is not None:
+                rows.append(np.asarray(delta.eligible_rows[0],
+                                       dtype=np.int64))
+        # capacity shrink: frozen rows on a shrunk node may overflow the
+        # new capacity — they must join the active set (growth is safe)
+        new_cap = np.asarray(
+            delta.capacity if delta is not None and
+            delta.capacity is not None else pt.capacity, dtype=np.float32)
+        if self._cap_fp is not None and new_cap.shape == self._cap_fp.shape:
+            shrunk = (new_cap < self._cap_fp - 1e-6).any(axis=1)
+            if shrunk.any():
+                n = min(self.n_real, self._mirror.shape[0])
+                rows.append(np.nonzero(shrunk[self._mirror[:n]])[0])
+        pending = np.unique(np.concatenate(rows))
+        if self._pending_rows is not None:
+            pending = np.union1d(self._pending_rows, pending)
+        self._pending_rows = pending
+        self._pending_churn = True
+
     def apply_delta(self, pt, delta: Optional[ProblemDelta] = None) -> float:
         """Merge churn into the resident buffers on device; returns the
         delta-staging wall ms (also accumulated for the next solve's
@@ -363,6 +416,7 @@ class ResidentProblem:
         `compatible`; node_valid/capacity always re-upload from `pt` (a few
         KB — the (S, N) problem planes are what never move)."""
         t0 = time.perf_counter()
+        self._note_churn(pt, delta)
         uploads, n_real, has_demand, has_eligible = self.merge_inputs(
             pt, delta)
         valid, cap = self._staged_fp
@@ -381,6 +435,10 @@ class ResidentProblem:
         self.pt = pt
         self._valid_fp = valid.copy()
         self._cap_fp = cap.copy()
+        if self._mirror is not None:
+            # replay the merge kernel's deterministic phantom re-park so
+            # the mirror stays an exact host copy of the device assignment
+            self._mirror[self.n_real:] = int(np.argmax(valid))
         ms = (time.perf_counter() - t0) * 1e3
         self._delta_ms += ms
         _M_DELTA_MS.set(ms)
@@ -468,6 +526,7 @@ class ResidentProblem:
         padded = pad_assignment(np.asarray(assignment, dtype=np.int32),
                                 self.prob.S, np.asarray(node_valid))
         self.assignment = self._put_assignment(padded)
+        self._mirror = padded.copy()
         if warm:
             _M_HOST_XFER.inc()
 
@@ -475,3 +534,54 @@ class ResidentProblem:
         """A warm attempt had to cold-stage: problem tensors crossed the
         host boundary where the disallow guard would have fired."""
         _M_HOST_XFER.inc()
+
+    # -- active-set sub-solve hooks (solver/subsolve.py) -------------------
+
+    def note_host_assignment(self, padded=None,
+                             feasible: Optional[bool] = None) -> None:
+        """api._solve's end-of-solve note: the padded winner it fetched
+        (the sub-solve mirror — no extra transfer, the result crossed the
+        boundary anyway) and whether the committed stats were feasible
+        (the frozen-base precondition: frozen-frozen violations are zero
+        only when the previous placement was). Clears the pending churn —
+        whatever was pending is folded into this assignment now."""
+        if padded is not None:
+            arr = np.asarray(padded, dtype=np.int32)
+            if self.prob is not None and arr.shape[0] == self.prob.S:
+                self._mirror = arr.copy()
+        if feasible is not None:
+            self._mirror_feasible = bool(feasible)
+        self._pending_rows = None
+        self._pending_churn = False
+
+    def take_active_plan(self):
+        """The churn-localized sub-problem for the warm solve about to
+        dispatch, or None for the full fused path. Consumes the pending
+        churn either way. Fallback outcomes are counted here;
+        "localized"/"fallback_infeasible" are counted by the caller after
+        the exact gate rules."""
+        pending, self._pending_rows = self._pending_rows, None
+        churn, self._pending_churn = self._pending_churn, False
+        if not churn:
+            return None
+        from .subsolve import (ActiveIndex, plan_active, record_outcome,
+                               subsolve_config)
+        cfg = subsolve_config()
+        if not (cfg.enabled and self.supports_subsolve):
+            return None
+        if self._mirror is None or not self._mirror_feasible:
+            return None
+        if self._index is None:
+            # ids cannot drift on the delta path (compatible() pins them
+            # by object identity; appended arrival rows carry none), so
+            # the index built from the current tensors stays valid for
+            # the staging's whole life
+            self._index = ActiveIndex(self.pt)
+        plan, outcome = plan_active(
+            self._index, self.pt, self._mirror, self.prob.S, self.prob.T,
+            pending if pending is not None
+            else np.empty(0, dtype=np.int64), cfg,
+            G_full=self.prob.G, Gc_full=self.prob.Gc)
+        if plan is None:
+            record_outcome(outcome)
+        return plan

@@ -781,6 +781,10 @@ class ShardedResident(ResidentProblem):
     committed replicated so the warm dispatch moves nothing implicitly —
     the PR-7 transfer-guard contract, now at pod scale."""
 
+    # the SPMD anneal shards whole sweeps; churn-localized sub-solves are
+    # a single-chip optimization (solver/subsolve.py)
+    supports_subsolve = False
+
     def __init__(self, pt, *, mesh: Mesh, bucket: bool = True, cfg=None):
         self.mesh = mesh
         super().__init__(pt, bucket=bucket, cfg=cfg)

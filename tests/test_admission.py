@@ -397,3 +397,8 @@ class TestStatusSurface:
         assert st["queue_depth"] == 0
         assert st["tenants"]["t0"]["wait_p50_s"] is not None
         assert st["pressure"]["sustained"] is False
+        # the micro-solve tail is a first-class status number (ISSUE 14):
+        # a drained batch leaves p50/p99 samples behind
+        assert st["solve_ms_p50"] is not None
+        assert st["solve_ms_p99"] is not None
+        assert st["solve_ms_p99"] >= st["solve_ms_p50"] > 0

@@ -157,6 +157,53 @@ class TestExecutableReuse:
             "same-bucket solve recompiled the fused pipeline"
         assert second.bucket["hit"] is True
 
+    def test_second_size_restage_rides_arena_fast_path(self):
+        """The pipeline bench's second-size restage (ISSUE 14 satellite):
+        staging a drifted fleet size in the same tier through
+        `stage_problem_tiers` must be compile-free (pure memcpy +
+        device_put) and reuse the per-tier host arenas — r08 regressed
+        this leg 6.4 -> 62.1 ms by routing through prepare_problem +
+        on-device pad_problem_tiers (eager jnp.pad per plane)."""
+        import jax
+
+        from fleetflow_tpu.solver import (stage_problem_tiers,
+                                          staging_arena_stats)
+
+        pt = synthetic_problem(117, 16, seed=11, port_fraction=0.3,
+                               volume_fraction=0.2)
+        cfg = bucket_config()
+        prob1, info1 = stage_problem_tiers(pt, cfg)
+        jax.block_until_ready(prob1)
+        arenas_before = staging_arena_stats()
+        pt2 = _drop_rows(pt, 109)     # drifted fleet, same tier
+        old_log, watched = jax.config.jax_log_compiles, []
+        import logging
+
+        class _H(logging.Handler):
+            def emit(self, rec):
+                if "Compiling" in rec.getMessage():
+                    watched.append(rec.getMessage())
+
+        h = _H()
+        logging.getLogger("jax._src.interpreters.pxla").addHandler(h)
+        jax.config.update("jax_log_compiles", True)
+        try:
+            prob2, info2 = stage_problem_tiers(pt2, cfg)
+            jax.block_until_ready(prob2)
+        finally:
+            jax.config.update("jax_log_compiles", old_log)
+            logging.getLogger("jax._src.interpreters.pxla").removeHandler(h)
+        assert info2.padded_S == info1.padded_S
+        assert watched == [], f"arena restage compiled XLA: {watched}"
+        arenas_after = staging_arena_stats()
+        assert arenas_after["arenas"] == arenas_before["arenas"], \
+            "same-tier restage allocated new arenas"
+        assert arenas_after["arena_bytes"] == arenas_before["arena_bytes"]
+        # the restaged tensors are the real thing: same padded shape and
+        # a solvable problem
+        res = solve(pt2, prob=prob2, bucket=True, seed=12)
+        assert res.violations == 0
+
     def test_warm_reschedule_in_bucket(self):
         pt = synthetic_problem(97, 16, seed=9, port_fraction=0.2)
         base = solve(pt, seed=1, bucket=True)
