@@ -23,7 +23,7 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "containers_converged", "metrics_monotonic",
            "agents_gauge_consistent", "selfheal_converged",
            "cp_failover_converged", "admission_fair",
-           "admission_converged"]
+           "admission_converged", "slo_met"]
 
 _EPS = 1e-6
 
@@ -318,6 +318,34 @@ def admission_converged(world, snapshot=None) -> list[str]:
     return out
 
 
+def slo_met(world) -> list[str]:
+    """The SLO invariant (ROADMAP item 4: "SLO invariants instead of
+    only safety invariants"): every objective the world's rolling SLO
+    engine (obs/slo.py) declares must hold over the run's LIFETIME
+    samples — warm-reschedule latency, admission wait, verdict→converged
+    time-to-heal. Converging is necessary; this says it also happened
+    fast enough, consistently. Streams the schedule never drove (zero
+    samples) are skipped: an objective over an unexercised stream is not
+    a miss — the failing-world canaries prove the check has teeth on
+    exercised ones."""
+    engine = getattr(world.state, "slo", None)
+    if engine is None:
+        return []
+    out: list[str] = []
+    for o in engine.objectives:
+        n = engine.samples(o.stream)
+        if n == 0:
+            continue
+        observed = engine.observed_quantile(o.stream, o.quantile)
+        if observed is not None and observed > o.threshold:
+            out.append(
+                f"SLO {o.name} missed: observed "
+                f"p{o.quantile * 100:g} = {observed:.3f}{o.unit} > "
+                f"threshold {o.threshold:g}{o.unit} "
+                f"over {n} lifetime samples")
+    return out
+
+
 def metrics_monotonic(world) -> list[str]:
     """Counters never decrease across the run. The metrics registry is the
     operator's ground truth for rates and totals; a counter that went DOWN
@@ -368,6 +396,7 @@ FINAL_INVARIANTS = {
     "cp-failover-converged": cp_failover_converged,
     "admission-fair": admission_fair,
     "admission-converged": admission_converged,
+    "slo-met": slo_met,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,
 }

@@ -49,6 +49,7 @@ from ..cp.replication import StandbyReplica
 from ..cp.server import AppState
 from ..cp.store import ReplicationFenced, Store
 from ..core.errors import ControlPlaneError
+from ..obs.slo import SloEngine, get_engine, parse_slo_props, set_engine
 from ..runtime.backend import MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
 from ..sched.base import Placement, level_schedule
@@ -62,6 +63,19 @@ __all__ = ["VirtualClock", "ChaosReport", "ChaosWorld", "run_schedule",
 
 TENANT = "default"
 POOL_NAME = "workers"
+
+# The SLO objectives every chaos world runs under (the `slo-met` FINAL
+# invariant judges them — ROADMAP item 4's "SLO invariants instead of
+# only safety invariants"). heal/wait are exact VIRTUAL-clock arithmetic
+# (deterministic); placement/solve values are wall ms of real host
+# solves, so those thresholds carry CI-machine headroom — the canary
+# tests prove the invariant still has teeth.
+CHAOS_SLOS = {
+    "placement-p99-ms": 5000.0,     # per-stage churn re-solve (wall)
+    "heal-p99-s": 600.0,            # dead verdict -> reconverged (virtual)
+    "admission-wait-p99-s": 300.0,  # submit -> placed (virtual; shed age
+                                    # bounds the queue at 240 s)
+}
 
 
 class VirtualClock:
@@ -348,6 +362,13 @@ class ChaosWorld:
                                    max_queue=512, shed_age_s=240.0,
                                    pressure_age_s=20.0,
                                    pressure_sustain_s=40.0))
+        # rolling SLO engine on the VIRTUAL clock, installed as the
+        # process default so the placement/admission/reconverge
+        # observation points feed it; the slo-met FINAL invariant reads
+        # it back. A failover builds a fresh one with the promoted state
+        # (the engine is in-memory observability, not placement truth).
+        state.slo = set_engine(SloEngine(parse_slo_props(CHAOS_SLOS),
+                                         clock=self.clock.now))
         return state
 
     # -- event log ---------------------------------------------------------
@@ -921,9 +942,15 @@ def run_schedule(schedule: F.FaultSchedule, *, services: int, nodes: int,
                  stages: int = 4, pool_min: int = 2) -> ChaosReport:
     """Replay one schedule against a freshly built world. Deterministic:
     the same (schedule, sizes) reproduces the identical event log."""
+    # the world installs its virtual-clock SLO engine as the process
+    # default; restore whatever was there so a long-lived process (the
+    # test suite, a CP embedding the harness) doesn't keep observing
+    # into a dead world's frozen clock after the run
+    prev_engine = get_engine()
     runner = _Runner(schedule, services, nodes, stages, pool_min)
     try:
         return asyncio.run(runner.run())
     finally:
+        set_engine(prev_engine)
         if runner._tmp is not None:
             runner._tmp.cleanup()

@@ -757,7 +757,12 @@ def _audit_hygiene(args) -> int:
 
 
 def cmd_solve(args) -> int:
-    """TPU placement preview (no reference analog)."""
+    """TPU placement preview (no reference analog); the `trace` verb
+    renders the solver flight deck instead of solving. A stage literally
+    named "trace" stays reachable via `fleet solve -s trace` (the -s
+    flag always means a stage)."""
+    if args.stage == "trace" and not getattr(args, "stage_flag", None):
+        return cmd_solve_trace(args)
     flow = _load(args)
     stage_name = _stage(args)
     stage_obj = flow.stage(stage_name)
@@ -782,6 +787,126 @@ def cmd_solve(args) -> int:
         for node in sorted(by_node):
             print(f"  {node}: {', '.join(sorted(by_node[node]))}")
     return 0 if placement.feasible else 1
+
+
+def cmd_solve_trace(args) -> int:
+    """`fleet solve trace`: render the last N solves' in-dispatch
+    flight-deck telemetry from the flight recorder (FLEET_TRACE_FILE;
+    the solver records one `telemetry` event per adaptive dispatch) as a
+    per-sweep-block timeline — why did the gate reject? where did
+    acceptance collapse? which tier did the sub-solve pick?"""
+    path = getattr(args, "trace_file", None) \
+        or os.environ.get("FLEET_TRACE_FILE", "")
+    if not path:
+        print("no trace file: pass --trace-file or set FLEET_TRACE_FILE",
+              file=sys.stderr)
+        return 2
+    from ..obs.trace import read_trace_files
+    try:
+        events = read_trace_files(path)
+    except FileNotFoundError:
+        print(f"trace file {path!r} not found", file=sys.stderr)
+        return 2
+    solves = [e for e in events
+              if e.get("kind") == "telemetry"
+              and e.get("name") == "solve.trace"]
+    last = max(int(getattr(args, "last", 5) or 5), 1)
+    solves = solves[-last:]
+    if args.json:
+        print(json.dumps(solves, indent=1))
+        return 0
+    if not solves:
+        print("(no solve telemetry recorded — run solves with "
+              "FLEET_TRACE_FILE set and FLEET_SOLVE_TRACE_BLOCKS > 0)")
+        return 0
+    for e in solves:
+        f = e.get("fields") or {}
+        t = f.get("telemetry") or {}
+        head = (f"solve ts={e.get('ts', 0):.3f} "
+                f"S={f.get('S')} N={f.get('N')} "
+                f"{'warm' if f.get('warm') else 'cold'}"
+                f"{' resident' if f.get('resident') else ''} "
+                f"path={t.get('path', '?')} "
+                f"violations={f.get('violations')} "
+                f"total={f.get('total_ms')}ms "
+                f"[trace={e.get('trace', '')}]")
+        print(head)
+        sub = t.get("subsolve")
+        if sub:
+            print(f"  subsolve: rows={sub.get('rows')} "
+                  f"tier={sub.get('tier')} affected={sub.get('affected')} "
+                  f"outcome={sub.get('outcome')} ms={sub.get('ms')}")
+        if "init" in t:
+            # single-chip payloads carry the seed/prologue story; the
+            # sharded schema has no prologue fields
+            init = t["init"] or {}
+            print(f"  seed/prologue: violations={init.get('violations')} "
+                  f"soft={init.get('soft')} "
+                  f"prerepair_moves={t.get('prerepair_moves')} "
+                  f"exit_sweep={t.get('exit_sweep')}")
+        else:
+            print(f"  mesh={t.get('mesh', '?')} "
+                  f"exit_sweep={t.get('exit_sweep')}")
+        schema = t.get("schema") or []
+        blocks = t.get("blocks") or []
+        if not blocks:
+            if t.get("exit_sweep") == 0:
+                print("  (0-sweep exit: the prologue landed feasible — "
+                      "no sweep blocks ran)")
+            else:
+                # sharded fixed-budget scan path: sweeps ran but there
+                # was no block loop to observe
+                print("  (no per-block rows recorded for this dispatch)")
+            continue
+        print("  " + " ".join(f"{c:>14}" for c in schema))
+        prev_acc = 0.0
+        for row in blocks:
+            vals = []
+            for c, v in zip(schema, row):
+                if c == "accepted":
+                    # cumulative on device; render the per-block delta
+                    # (the acceptance collapse signal) alongside
+                    vals.append(f"{v - prev_acc:+.0f}/{v:.0f}")
+                    prev_acc = v
+                elif c in ("sweep", "swap_attempts", "swap_accepts"):
+                    vals.append(f"{v:.0f}")
+                else:
+                    vals.append(f"{v:.4g}")
+            print("  " + " ".join(f"{v:>14}" for v in vals))
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """`fleet slo status`: declared objectives vs observed rolling
+    quantiles + fast/slow burn rates (obs/slo.py, docs/guide/10)."""
+    with CpClient(args.cp) as cp:
+        out = cp.request("health", "slo.status")
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+            return 0
+        if not out.get("enabled", False):
+            print("no SLO engine on this CP (standby, or pre-SLO build)")
+            return 1
+        objectives = out.get("objectives", [])
+        if not objectives:
+            print("no objectives declared (add `slo placement-p99-ms=50 "
+                  "...` to fleetflowd.kdl)")
+        for o in objectives:
+            flag = "MET " if o["met"] else "MISS"
+            observed = (f"{o['observed']:g}{o['unit']}"
+                        if o["observed"] is not None else "-")
+            print(f"{flag} {o['name']:<26} objective "
+                  f"p{o['quantile'] * 100:g} <= {o['threshold']:g}"
+                  f"{o['unit']:<3} observed {observed:<10} "
+                  f"burn fast={o['burn_fast']:g} slow={o['burn_slow']:g} "
+                  f"({o['samples']} samples)")
+        streams = out.get("streams", {})
+        if streams:
+            print("streams:")
+            for name, s in sorted(streams.items()):
+                print(f"  {name:<20} samples={s['samples']:<7} "
+                      f"p50={s['p50']} p99={s['p99']}")
+        return 0 if all(o["met"] for o in objectives) else 1
 
 
 def cmd_chaos(args) -> int:
@@ -880,6 +1005,13 @@ def cmd_admit(args) -> int:
             p50, p99 = out["solve_ms_p50"], out["solve_ms_p99"]
             ratio = f" (p99/p50={p99 / p50:.1f}x)" if p50 else ""
             print(f"solve: p50={p50:.1f}ms p99={p99:.1f}ms{ratio}")
+        sub = out.get("subsolve") or {}
+        if sub:
+            # micro-solve dispatch outcomes (solver/subsolve.py):
+            # localized is the p99-flattening path; a rising fallback
+            # count is the first thing to check when the tail grows
+            print("subsolve: " + " ".join(
+                f"{k}={v}" for k, v in sub.items()))
         return 0
 
 
@@ -907,9 +1039,11 @@ def cmd_events(args) -> int:
         print("no trace file: pass --trace-file or set FLEET_TRACE_FILE",
               file=sys.stderr)
         return 2
-    from ..obs.trace import read_trace_file
+    # read ACROSS the keep-1 rollover (FLEET_TRACE_MAX_MB): a span whose
+    # begin predates the rotation still shows whole
+    from ..obs.trace import read_trace_files
     try:
-        events = read_trace_file(path)
+        events = read_trace_files(path)
     except FileNotFoundError:
         print(f"trace file {path!r} not found", file=sys.stderr)
         return 2
@@ -928,8 +1062,12 @@ def cmd_events(args) -> int:
                if e.get("duration_ms") is not None else "")
         err = f" error={e['error']!r}" if e.get("error") else ""
         fields = e.get("fields") or {}
-        fstr = " ".join(f"{k}={v}" for k, v in fields.items() if v is not None)
-        mark = {"begin": "▶", "end": "✓", "fail": "✗"}.get(kind, "?")
+        # nested payloads (the solve flight deck) have their own viewer
+        # (`fleet solve trace`); the timeline stays one line per event
+        fstr = " ".join(f"{k}={v}" for k, v in fields.items()
+                        if v is not None and not isinstance(v, (dict, list)))
+        mark = {"begin": "▶", "end": "✓", "fail": "✗",
+                "telemetry": "◆"}.get(kind, "?")
         print(f"{e.get('ts', 0):.3f} {mark} {pad}{e.get('logger', '')} "
               f"{e.get('name', '')}{dur}{err} "
               f"[trace={e.get('trace', '')}]"
@@ -1177,6 +1315,18 @@ def _cp_dispatch(cp: CpClient, args) -> int:
               f"redeliveries_ok={s.get('redeliveries_ok', 0)} "
               f"retried={s.get('redeliveries_retried', 0)} "
               f"parked={s.get('parked', 0)}")
+        res = out.get("resident") or {}
+        if res:
+            print(f"resident: delta_reuse={res.get('delta_reuse', 0)} "
+                  f"cold={res.get('cold_stagings', 0)} "
+                  f"host_transfers={res.get('host_transfers', 0)}")
+            sub = res.get("subsolve") or {}
+            if sub:
+                # where the heal path's churn re-solves were dispatched:
+                # localized = active-set mini anneal, fallback_* = the
+                # full fused path ran and why (docs/guide/11)
+                print("subsolve: " + " ".join(
+                    f"{k}={v}" for k, v in sub.items()))
         return 0
     if sub == "metrics":
         # the same registry GET /metrics serves, fetched over the channel
@@ -1612,10 +1762,19 @@ def build_parser() -> argparse.ArgumentParser:
     stage_args(p, positional=False)
     p.set_defaults(fn=cmd_validate)
 
-    p = sub.add_parser("solve", help="TPU placement preview")
+    p = sub.add_parser("solve", help="TPU placement preview; "
+                       "`fleet solve trace` renders the in-dispatch "
+                       "flight-deck telemetry of the last N solves "
+                       "(docs/guide/10, solver flight deck; a stage "
+                       "named 'trace' stays reachable via -s)")
     stage_args(p)
     p.add_argument("--host", action="store_true", help="force host greedy")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--trace-file",
+                   help="flight-recorder file (default: FLEET_TRACE_FILE;"
+                        " `fleet solve trace` only)")
+    p.add_argument("--last", type=int, default=5, metavar="N",
+                   help="solves to render, newest last (trace only)")
     p.set_defaults(fn=cmd_solve)
 
     p = sub.add_parser("agent", help="run the node agent (foreground)")
@@ -1799,6 +1958,17 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--json", action="store_true",
                    help="raw deploy.admit_status payload")
     p.set_defaults(fn=cmd_admit)
+
+    p = sub.add_parser("slo", help="rolling SLO engine: declared "
+                       "objectives vs observed quantiles + burn rates "
+                       "(docs/guide/10-observability.md)")
+    p.add_argument("--cp", dest="cp", help="CP endpoint host:port")
+    slos = p.add_subparsers(dest="slo_cmd", required=True)
+    q = slos.add_parser("status", help="objectives vs observed rolling "
+                        "quantiles, fast/slow burn rates, stream census")
+    q.add_argument("--json", action="store_true",
+                   help="raw health.slo.status payload")
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("chaos", help="seeded fault injection against a "
                        "simulated fleet (invariant-checked)")

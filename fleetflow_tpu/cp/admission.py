@@ -76,6 +76,18 @@ from ..core.errors import ControlPlaneError
 from ..core.model import Flow, ResourceSpec, Service
 from ..obs import get_logger, kv
 from ..obs.metrics import REGISTRY
+from ..obs.slo import observe as slo_observe
+
+# the active-set dispatch vocabulary (solver/subsolve.py); read via the
+# registry so a host-path CP's status call never imports jax
+SUBSOLVE_OUTCOMES = ("localized", "fallback_closure", "fallback_small",
+                     "fallback_infeasible")
+
+
+def subsolve_outcomes() -> dict:
+    m = REGISTRY.get("fleet_solver_subsolve_total")
+    return {o: (int(m.value(outcome=o)) if m is not None else 0)
+            for o in SUBSOLVE_OUTCOMES}
 
 log = get_logger("cp.admission")
 
@@ -902,6 +914,7 @@ class AdmissionController:
         # ONE sample per micro-solve: the p50/p99 surface measures the
         # solver tail, not how many stage streams a drain batch fanned to
         self.solve_ms_samples.append(wall_ms)
+        slo_observe("admission_solve_ms", wall_ms)
         out["violations"] = placement.violations
         self.stats["solves"] += 1
 
@@ -946,6 +959,7 @@ class AdmissionController:
                 solve3_ms = (time.perf_counter() - t_solve) * 1e3
                 out["phase_ms"]["solve"] += solve3_ms
                 self.solve_ms_samples.append(solve3_ms)
+                slo_observe("admission_solve_ms", solve3_ms)
                 if placement3.feasible and rid3:
                     t_commit = time.perf_counter()
                     self.placement.commit(rid3)
@@ -1003,6 +1017,9 @@ class AdmissionController:
                 samples = self.wait_samples.setdefault(
                     r.tenant, deque(maxlen=4096))
                 samples.append(now - r.submitted_at)
+                # admission-wait SLO stream: submit → committed placement
+                # on the engine's clock (virtual under chaos)
+                slo_observe("admission_wait_s", now - r.submitted_at)
                 out["placed"].append(r.name)
             else:
                 r.state, r.done_at = "departed", now
@@ -1131,6 +1148,11 @@ class AdmissionController:
                     "solve_ms_p99": round(float(np.percentile(
                         list(self.solve_ms_samples), 99)), 2)
                     if self.solve_ms_samples else None,
+                    # how the micro-solves were dispatched (the metrics
+                    # existed; this is where operators actually look):
+                    # localized = active-set mini anneal committed by the
+                    # exact gate, fallback_* = the full path ran and why
+                    "subsolve": subsolve_outcomes(),
                     "config": {"max_queue": self.cfg.max_queue,
                                "shed_age_s": self.cfg.shed_age_s,
                                "on_full": self.cfg.on_full,

@@ -543,8 +543,19 @@ def _health(state: "AppState"):
                                for a in db.active_alerts(p.get("tenant"))]}
         if method == "metrics":
             # the same registry the daemon's GET /metrics serves, in JSON
-            # (the channel face for `fleet cp metrics` / MCP consumers)
+            # (the channel face for `fleet cp metrics` / MCP consumers);
+            # windowed SLO gauges recompute against NOW first, same as
+            # the /metrics scrape (obs/slo.py refresh)
+            if state.slo is not None:
+                state.slo.refresh()
             return {"metrics": REGISTRY.snapshot()}
+        if method == "slo.status":
+            # rolling SLO engine (obs/slo.py): declared objectives vs
+            # observed quantiles + fast/slow burn rates, rendered by
+            # `fleet slo status`
+            if state.slo is None:
+                return {"enabled": False}
+            return state.slo.status()
         if method == "heal.status":
             # self-healing introspection (`fleet cp heal status`): lease
             # table, pending/parked convergence work, pass counters —

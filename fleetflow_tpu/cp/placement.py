@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, replace as _dc_replace
 from typing import Optional
 
@@ -30,6 +31,7 @@ from ..core.model import Flow, ResourceSpec, ServerLabels, ServerResource
 from ..lower.tensors import ProblemTensors, lower_stage
 from ..obs import get_logger, kv
 from ..obs.metrics import REGISTRY
+from ..obs.slo import observe as slo_observe
 from ..sched import (HostGreedyScheduler, Placement, TpuSolverScheduler,
                      level_schedule, place_with_fallback)
 from .models import PlacementRecord, Server
@@ -730,6 +732,7 @@ class PlacementService:
                 # burst-mates' already-re-solved positions.
                 pt = self._refresh_capacity(pt, key, overrides, server_map)
                 degraded = False
+                t_solve = time.perf_counter()
                 try:
                     if self.use_tpu:
                         # structured churn instead of a full re-staging:
@@ -767,6 +770,11 @@ class PlacementService:
                         sched, pt, initial=new,
                         place_kwargs=({"stage": key}
                                       if sched is self._sched_tpu else None))
+                # the warm-reschedule latency SLO stream (obs/slo.py):
+                # one sample per stage re-solve, relax-ladder included —
+                # this IS the placement-p99-ms an operator declares
+                slo_observe("placement_ms",
+                            (time.perf_counter() - t_solve) * 1e3)
                 # a streaming stage's tombstoned rows stay masked through
                 # churn re-solves too
                 new = self._apply_mask(key, new)

@@ -51,6 +51,7 @@ from typing import TYPE_CHECKING, Callable, Optional
 from ..core.errors import AgentCommandError, AgentUnreachable
 from ..obs import get_logger, kv, span
 from ..obs.metrics import REGISTRY
+from ..obs.slo import observe as slo_observe
 from ..obs.trace import new_trace_id, use_trace
 from ..runtime.engine import DeployRequest
 from .agent_registry import DEPLOY_TIMEOUT
@@ -99,6 +100,14 @@ class _Work:
     parked: bool = False
     reason: str = ""
     last_error: str = ""
+    # when the VERDICT that opened this debt fired (engine clock; None =
+    # unstamped — 0.0 is a legitimate reading on a virtual clock):
+    # retire-on-success observes clock() - verdict_at into the heal_s
+    # SLO stream — the verdict→converged time-to-heal (obs/slo.py).
+    # Superseding work (a fresh burst re-solve for a still-open stage)
+    # inherits the ORIGINAL stamp: the operator's question is "how long
+    # was the stage degraded", not "how long did the last attempt take".
+    verdict_at: Optional[float] = None
 
 
 class Reconverger:
@@ -146,7 +155,11 @@ class Reconverger:
                 idempotency_key=f"heal-{rec.stage_key}-r{rec.id}",
                 trace_id=new_trace_id(), attempt=rec.attempt,
                 next_try_at=self.clock(), parked=rec.parked,
-                reason=rec.reason or "resumed", last_error=rec.detail)
+                reason=rec.reason or "resumed", last_error=rec.detail,
+                # the original verdict died with the predecessor; the
+                # resumed heal clock starts here (undercounts across a
+                # failover rather than inventing a cross-process stamp)
+                verdict_at=self.clock())
             n += 1
         if n:
             self.stats["resumed"] += n
@@ -249,12 +262,16 @@ class Reconverger:
     @staticmethod
     def _resident_stats() -> dict:
         from ..obs.metrics import REGISTRY
+        from .admission import subsolve_outcomes
         reuse = REGISTRY.get("fleet_solver_resident_reuse_total")
         xfers = REGISTRY.get("fleet_solver_host_transfers_total")
         return {
             "delta_reuse": int(reuse.value(outcome="delta")) if reuse else 0,
             "cold_stagings": int(reuse.value(outcome="cold")) if reuse else 0,
             "host_transfers": int(xfers.value()) if xfers else 0,
+            # active-set dispatch outcomes (solver/subsolve.py): the heal
+            # path's churn re-solves are exactly what it localizes
+            "subsolve": subsolve_outcomes(),
         }
 
     # ------------------------------------------------------------------
@@ -333,7 +350,8 @@ class Reconverger:
                                 self._work.get(key)
                                 or _Work(stage_key=key,
                                          idempotency_key=self._next_key(key),
-                                         trace_id=trace_id),
+                                         trace_id=trace_id,
+                                         verdict_at=self.clock()),
                                 "infeasible",
                                 f"violations={placement.violations}")
                             summary["parked"].append(key)
@@ -362,10 +380,17 @@ class Reconverger:
         work. A fresh assignment supersedes older debt — and gets a fresh
         idempotency key, because the PAYLOAD changed (dedupe must only
         ever suppress replays of the same assignment)."""
+        prev = self._work.get(stage_key)
         w = _Work(stage_key=stage_key,
                   idempotency_key=self._next_key(stage_key),
                   trace_id=trace_id, next_try_at=self.clock(),
-                  reason="redeliver")
+                  reason="redeliver",
+                  # time-to-heal runs from the FIRST verdict that opened
+                  # this stage's still-unhealed debt
+                  verdict_at=(prev.verdict_at
+                              if prev is not None
+                              and prev.verdict_at is not None
+                              else self.clock()))
         self._work[stage_key] = w
         self._persist(w)
         self._set_parked_gauge()
@@ -523,6 +548,10 @@ class Reconverger:
             sp["nodes_ok"] = len(targets)
         self.stats["redeliveries_ok"] += 1
         _M_REDELIVERIES.inc(outcome="ok")
+        if w.verdict_at is not None:
+            # verdict → converged, on the engine clock (virtual in
+            # chaos): the heal-p99-s SLO stream (obs/slo.py)
+            slo_observe("heal_s", max(self.clock() - w.verdict_at, 0.0))
         self._retire(w)
         log.info("stage reconverged %s", kv(stage=key,
                                             nodes=",".join(targets)))

@@ -76,6 +76,11 @@ class ServerConfig:
     admission_queue: int = 4096
     admission_batch: int = 128
     admission_shed_age_s: float = 120.0
+    # rolling SLO objectives (obs/slo.py, docs/guide/10): objective
+    # name -> threshold, e.g. {"placement-p99-ms": 50}. The engine is
+    # built on primaries (and on promotion) even with no objectives —
+    # `fleet slo status` then reports raw stream quantiles only.
+    slo: Optional[dict] = None
 
 
 @dataclass
@@ -120,6 +125,10 @@ class AppState:
     # and when ServerConfig.admission is off. Its pressure() output is
     # the autoscaler's solver-pressure input.
     admission: Optional[AdmissionController] = None
+    # rolling SLO engine (obs/slo.py); None on standbys. Installed as
+    # the process default so the placement/admission/reconverge
+    # observation points route to it.
+    slo: Optional[object] = None
 
 
 class CpServerHandle:
@@ -252,6 +261,7 @@ async def start(config: ServerConfig, *,
         state.replicator = Replicator(
             store, config=repl_config, loop=asyncio.get_running_loop())
         state.agent_registry.epoch_source = lambda: store.epoch
+        _build_slo(state, config)
         if config.self_heal:
             _build_self_heal(state, config)
         if config.admission:
@@ -304,6 +314,14 @@ def _build_self_heal(state: AppState, config: ServerConfig) -> None:
     state.reconverger.spawn()
 
 
+def _build_slo(state: AppState, config: ServerConfig) -> None:
+    """Rolling SLO engine (obs/slo.py), installed as the process default
+    so the placement/admission/reconverge observation points feed it.
+    Primaries only — a standby serves no traffic to measure."""
+    from ..obs.slo import SloEngine, parse_slo_props, set_engine
+    state.slo = set_engine(SloEngine(parse_slo_props(config.slo or {})))
+
+
 def _build_admission(state: AppState, config: ServerConfig) -> None:
     """Streaming-admission controller + its background drain loop
     (primaries only: exactly one admission writer per epoch)."""
@@ -324,6 +342,7 @@ def _promote(state: AppState, config: ServerConfig,
     state.replicator = Replicator(
         state.store, config=repl_config, loop=asyncio.get_running_loop())
     state.agent_registry.epoch_source = lambda: state.store.epoch
+    _build_slo(state, config)
     if config.self_heal:
         _build_self_heal(state, config)
     if config.admission:

@@ -9,7 +9,7 @@ fleetflowd.kdl -> /etc/fleetflow/fleetflowd.kdl.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
 
@@ -62,6 +62,10 @@ class DaemonConfig:
     admission_queue: int = 4096
     admission_batch: int = 128
     admission_shed_age_s: float = 120.0
+    # rolling SLO objectives (docs/guide/10, "solver flight deck"):
+    # `slo placement-p99-ms=50 heal-p99-s=30 ...` — each prop is
+    # <stream>-p<NN>-<unit>=<threshold>, validated at load time
+    slo: dict = field(default_factory=dict)
     source: Optional[str] = None
 
     def expand(self) -> "DaemonConfig":
@@ -174,6 +178,15 @@ def _apply_kdl(cfg: DaemonConfig, text: str) -> None:
             interval = node.prop("interval")
             if interval is not None:
                 cfg.heal_interval_s = float(interval)
+        elif n == "slo":
+            # `slo placement-p99-ms=50 heal-p99-s=30` — every prop is an
+            # objective; validate the grammar NOW so a typo'd stream
+            # fails daemon start instead of becoming a never-sampled,
+            # vacuously-met objective
+            from ..obs.slo import parse_slo_props
+            props = {k: float(v) for k, v in node.props.items()}
+            parse_slo_props(props)
+            cfg.slo.update(props)
         elif n == "admission":
             # `admission false` disables streaming admission; props tune
             # the watermarks: `admission queue=4096 batch=128 shed-age=120`

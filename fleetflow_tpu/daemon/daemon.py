@@ -57,7 +57,8 @@ class Daemon:
             admission=cfg.admission,
             admission_queue=cfg.admission_queue,
             admission_batch=cfg.admission_batch,
-            admission_shed_age_s=cfg.admission_shed_age_s))
+            admission_shed_age_s=cfg.admission_shed_age_s,
+            slo=dict(cfg.slo)))
         if cfg.web_enabled:
             self.web = WebServer(self.cp.state)
             self.web_addr = await self.web.start(cfg.web_host, cfg.web_port)

@@ -353,6 +353,13 @@ class WebServer:
             # otherwise guaranteed to be loaded by a bare daemon
             from .. import platform as _platform  # noqa: F401
             from ..registry import aggregate as _aggregate  # noqa: F401
+            # SLO burn gauges are windowed: recompute against NOW so a
+            # quiet stream's rolled-past window scrapes as burn 0, not
+            # as the last storm's frozen peak (obs/slo.py refresh)
+            from ..obs.slo import get_engine as _slo_engine
+            eng = _slo_engine()
+            if eng is not None:
+                eng.refresh()
             return _response(
                 200, REGISTRY.render(),
                 content_type="text/plain; version=0.0.4; charset=utf-8")

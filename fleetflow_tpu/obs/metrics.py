@@ -28,11 +28,27 @@ import threading
 from typing import Iterable, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "MS_BUCKETS", "SOLVE_SECONDS_BUCKETS"]
 
 # tuned for request/solve latencies in seconds: 1ms .. 60s
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# millisecond-valued families (warm-path solver timings, admission drain
+# phases): sub-ms through the compile cliff. The dense 1–25 ms run is
+# deliberate — the warm-churn regime lives there, and a p50 move from
+# 12 → 10 ms must land in different buckets to be visible to rate()/
+# histogram_quantile() consumers.
+MS_BUCKETS = (0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 7.5, 10.0, 12.5, 15.0, 20.0,
+              25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 10000.0)
+
+# seconds-valued solve histograms with the same ms-scale resolution
+# under 25 ms that MS_BUCKETS gives the ms families: the stock
+# DEFAULT_BUCKETS jump 10 → 25 ms, which flattens exactly the regime the
+# warm path operates in.
+SOLVE_SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.0075, 0.01, 0.0125,
+                         0.015, 0.02, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                         2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 def _escape_label(v: str) -> str:

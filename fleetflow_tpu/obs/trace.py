@@ -27,7 +27,8 @@ from typing import Iterator, Optional
 
 __all__ = ["new_trace_id", "new_span_id", "current_trace_id",
            "current_span_id", "use_trace", "FlightRecorder",
-           "flight_recorder", "record_span_event", "read_trace_file"]
+           "flight_recorder", "record_span_event", "read_trace_file",
+           "read_trace_files"]
 
 _trace_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "fleet_trace_id", default="")
@@ -83,28 +84,62 @@ def _use_span(span_id: str) -> Iterator[str]:
 class FlightRecorder:
     """Append-only JSON-lines sink for span events. One line per event:
 
-        {"ts": ..., "kind": "begin"|"end"|"fail", "name": ...,
-         "logger": ..., "trace": ..., "span": ..., "parent": ...,
-         "duration_ms": ...?, "error": ...?, "fields": {...}?}
+        {"ts": ..., "kind": "begin"|"end"|"fail"|"telemetry",
+         "name": ..., "logger": ..., "trace": ..., "span": ...,
+         "parent": ..., "duration_ms": ...?, "error": ...?,
+         "fields": {...}?}
 
     Thread-safe (one lock around write+flush); line-buffered so a crashed
-    process leaves at most one torn final line, which readers skip."""
+    process leaves at most one torn final line, which readers skip.
+
+    Rotation: ``FLEET_TRACE_MAX_MB`` (unset/0 = unbounded) caps the file
+    size with a keep-1 rollover — when the next line would cross the
+    cap, the current file atomically becomes ``<path>.1`` (replacing any
+    previous generation) and a fresh file starts. The admission bench's
+    hours of micro-solve spans can no longer grow the recorder without
+    bound, and rotation happens BETWEEN lines so both generations stay
+    well-formed JSONL; readers span the boundary via
+    :func:`read_trace_files`."""
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
         self._f = None
 
+    @staticmethod
+    def _max_bytes() -> int:
+        """Rotation cap, re-read per record so tests (and operators
+        adjusting a live process) see the change without a restart."""
+        raw = os.environ.get("FLEET_TRACE_MAX_MB", "").strip()
+        try:
+            mb = float(raw) if raw else 0.0
+        except ValueError:
+            mb = 0.0
+        return int(mb * 1024 * 1024) if mb > 0 else 0
+
+    def _open_locked(self):
+        if self._f is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        return self._f
+
     def record(self, event: dict) -> None:
-        line = json.dumps(event, default=str)
+        line = json.dumps(event, default=str) + "\n"
+        cap = self._max_bytes()
         with self._lock:
-            if self._f is None:
-                d = os.path.dirname(self.path)
-                if d:
-                    os.makedirs(d, exist_ok=True)
-                self._f = open(self.path, "a", encoding="utf-8")
-            self._f.write(line + "\n")
-            self._f.flush()
+            f = self._open_locked()
+            if cap and f.tell() > 0 and f.tell() + len(line) > cap:
+                # keep-1 rollover: the full generation becomes .1
+                # (atomic replace of the previous one), a fresh file
+                # continues the stream
+                f.close()
+                self._f = None
+                os.replace(self.path, self.path + ".1")
+                f = self._open_locked()
+            f.write(line)
+            f.flush()
 
     def close(self) -> None:
         with self._lock:
@@ -154,6 +189,21 @@ def record_span_event(kind: str, name: str, logger: str, *,
     if fields:
         event["fields"] = fields
     rec.record(event)
+
+
+def read_trace_files(path: str) -> list[dict]:
+    """Read a flight-recorder stream ACROSS the keep-1 rollover: the
+    rotated generation (`<path>.1`, if present) followed by the live
+    file — a span whose begin predates the rollover and whose end
+    followed it reads back whole. The viewers (`fleet events`,
+    `fleet solve trace`) use this; :func:`read_trace_file` stays the
+    single-file primitive."""
+    out: list[dict] = []
+    rotated = path + ".1"
+    if os.path.exists(rotated):
+        out.extend(read_trace_file(rotated))
+    out.extend(read_trace_file(path))
+    return out
 
 
 def read_trace_file(path: str) -> list[dict]:

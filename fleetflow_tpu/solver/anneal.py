@@ -19,6 +19,7 @@ the feasibility gate.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -30,13 +31,50 @@ from .problem import DeviceProblem, eligible_lookup, eligible_row
 
 __all__ = ["anneal", "anneal_adaptive", "anneal_states",
            "anneal_adaptive_states", "chain_states_from_assignment",
-           "prerepair_state", "state_violation_stats", "state_soft_score",
-           "ChainState"]
+           "prerepair_state", "prerepair_state_counted",
+           "state_violation_stats", "state_soft_score",
+           "ChainState", "TRACE_COLS", "solve_trace_blocks",
+           "empty_trace"]
 
 W_CAP = 1e3     # per-unit overflow mass (normalized units)
 W_CONF = 1e4    # per conflicting co-placement
 W_ELIG = 1e6    # per ineligible placement
 W_SKEW = 1e3    # per unit of excess skew
+
+# -- in-dispatch telemetry (the solver flight deck, docs/guide/10) ----------
+# One fixed-shape f32 row per sweep-BLOCK, recorded inside the jitted
+# dispatch and returned alongside the result, so it rides the existing
+# fetch: zero extra compiles (the buffer length is the static knob below,
+# not a traced shape), zero host transfers on the warm path, and no new
+# donation edges. Column order is the schema `SolveResult.telemetry` and
+# `fleet solve trace` speak.
+TRACE_COLS = ("sweep", "temperature", "best_violations", "best_soft",
+              "live_violations", "accepted")
+
+
+def solve_trace_blocks(default: int = 16) -> int:
+    """The telemetry buffer length (sweep-block rows) — a STATIC jit knob
+    read from FLEET_SOLVE_TRACE_BLOCKS (default 16; 0 disables the
+    buffer entirely, restoring the pre-telemetry program byte for byte).
+    Static by design: a traced length would make tier drift a recompile
+    axis, which the compile-contract auditor pins against."""
+    try:
+        v = int(os.environ.get("FLEET_SOLVE_TRACE_BLOCKS", "") or default)
+    except ValueError:
+        v = default
+    return max(0, min(v, 512))
+
+
+def empty_trace(trace_blocks: int):
+    """The telemetry pytree at its zero value — the treedef every
+    returning path (adaptive, fixed-budget, 0-sweep exit) must share so
+    the telemetry can never fork an executable's output signature."""
+    return {
+        "blocks": jnp.zeros((trace_blocks, len(TRACE_COLS)), jnp.float32),
+        "filled": jnp.int32(0),
+        "init_violations": jnp.float32(0.0),
+        "init_soft": jnp.float32(0.0),
+    }
 
 
 class ChainState(NamedTuple):
@@ -85,6 +123,15 @@ def chain_states_from_assignment(prob: DeviceProblem,
 
 def prerepair_state(prob: DeviceProblem, st: ChainState,
                     max_moves: int) -> ChainState:
+    """Fused churn pre-repair (see :func:`prerepair_state_counted`);
+    returns only the repaired state — the compatibility face every
+    pre-telemetry caller keeps."""
+    st, _moves = prerepair_state_counted(prob, st, max_moves)
+    return st
+
+
+def prerepair_state_counted(prob: DeviceProblem, st: ChainState,
+                            max_moves: int) -> tuple[ChainState, jax.Array]:
     """Fused churn pre-repair: relocate services stranded on invalid or
     ineligible nodes, one per `lax.while_loop` iteration, entirely on
     device. This replaces the host `repair.py` pre-pass on the warm path
@@ -100,7 +147,11 @@ def prerepair_state(prob: DeviceProblem, st: ChainState,
     soon as nothing is stranded, so a quiet warm solve pays one mask
     reduction; `max_moves` bounds pathological churn. Feasibility of the
     incoming state is preserved: a clean relocation only ever lands on a
-    node it verified against the live carried state."""
+    node it verified against the live carried state.
+
+    Returns ``(state, moves)`` — `moves` counts the relocations actually
+    APPLIED (attempts on genuinely unplaceable services don't count):
+    the prologue half of the solver flight-deck telemetry."""
     ar = jnp.arange(prob.S)
 
     def stranded_of(st):
@@ -108,11 +159,11 @@ def prerepair_state(prob: DeviceProblem, st: ChainState,
                 | ~prob.node_valid[st.assignment])
 
     def cond(carry):
-        st, attempted, i = carry
+        st, attempted, i, _moves = carry
         return (i < max_moves) & (stranded_of(st) & ~attempted).any()
 
     def body(carry):
-        st, attempted, i = carry
+        st, attempted, i, moves = carry
         todo = stranded_of(st) & ~attempted
         s = jnp.argmax(todo)
         attempted = attempted.at[s].set(True)
@@ -152,12 +203,12 @@ def prerepair_state(prob: DeviceProblem, st: ChainState,
         assignment = st.assignment.at[s].set(
             jnp.where(can, b, a).astype(jnp.int32))
         return (ChainState(assignment, load, used, coloc, topo),
-                attempted, i + 1)
+                attempted, i + 1, moves + wi)
 
-    st, _, _ = jax.lax.while_loop(
+    st, _, _, moves = jax.lax.while_loop(
         cond, body,
-        (st, jnp.zeros(prob.S, dtype=bool), jnp.int32(0)))
-    return st
+        (st, jnp.zeros(prob.S, dtype=bool), jnp.int32(0), jnp.int32(0)))
+    return st, moves
 
 
 def state_violation_stats(prob: DeviceProblem, st: ChainState) -> dict:
@@ -537,21 +588,36 @@ def anneal(prob: DeviceProblem, init_assignments: jax.Array, key: jax.Array,
 
 @partial(jax.jit, static_argnames=("max_steps", "block",
                                    "proposals_per_step",
-                                   "exit_on_feasible_init"))
+                                   "exit_on_feasible_init", "trace_blocks"))
 def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
                            key: jax.Array, max_steps: int = 128,
                            block: int = 32, t0: float = 1.0, t1: float = 1e-3,
                            proposals_per_step: int | None = None,
                            init_states: ChainState | None = None,
-                           exit_on_feasible_init: bool = False):
+                           exit_on_feasible_init: bool = False,
+                           trace_blocks: int = 0):
     """Anneal in `block`-sweep chunks, stopping as soon as any chain has
     SEEN an exactly feasible state (or at max_steps). Returns
     (best_assignments (C, S), best_viols (C,), best_softs (C,),
-    sweeps_run scalar, accepted (C,)), where best is each chain's
-    lexicographically lowest (violations, soft) state EVER VISITED, not
-    its final state, and accepted counts the applied Metropolis moves per
-    chain across every sweep that ran — the acceptance telemetry that
-    surfaces through SolveResult and the fleet_solver_* metrics.
+    sweeps_run scalar, accepted (C,), telemetry), where best is each
+    chain's lexicographically lowest (violations, soft) state EVER
+    VISITED, not its final state, and accepted counts the applied
+    Metropolis moves per chain across every sweep that ran — the
+    acceptance telemetry that surfaces through SolveResult and the
+    fleet_solver_* metrics.
+
+    `trace_blocks` > 0 (static — see solve_trace_blocks) additionally
+    carries a fixed-shape (trace_blocks, len(TRACE_COLS)) f32 buffer
+    through the block loop and writes one row per completed sweep-block:
+    cumulative sweeps, the block-end temperature, the best-ever
+    (violations, soft) across chains, the min LIVE violation count of the
+    carried states, and the cumulative accepted-move total. The buffer is
+    observation only — it never feeds back into a proposal, a key fold or
+    an exit check, so the refined assignment is bit-identical to the
+    trace_blocks=0 program (pinned by the telemetry parity test). Blocks
+    past the buffer drop (mode="drop"): a long anneal keeps its FIRST
+    trace_blocks rows, where acceptance collapse and gate rejections
+    live.
 
     Best-ever tracking (r5): Metropolis acceptance takes uphill soft moves
     by design, so a chain's final state can be worse than one it already
@@ -602,7 +668,7 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
 
     def sweep(carry, i):
         (states, keys, best_assign, best_viol, best_soft,
-         seen_feasible, accepted) = carry
+         seen_feasible, accepted, *live) = carry
         # clamp: overflow sweeps of a rounded-up final block hold t1
         temp = t0 * decay ** jnp.minimum(
             i, max_steps - 1).astype(jnp.float32)
@@ -624,12 +690,27 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
         best_assign = jnp.where(better[:, None], states.assignment,
                                 best_assign)
         seen_feasible = seen_feasible | (viol.min() == 0)
-        return (states, keys, best_assign, best_viol, best_soft,
-                seen_feasible, accepted), None
+        out = (states, keys, best_assign, best_viol, best_soft,
+               seen_feasible, accepted)
+        if trace_blocks:
+            # thread the LIVE scores this sweep already computed out to
+            # the block boundary — the telemetry row reads them for free
+            # instead of re-running chain_scores per block (which, at the
+            # warm path's block=1, would double the per-sweep stats cost
+            # — measured as the admission p99 regrowing 30 → 65 ms)
+            out = out + (viol,)
+        return out, None
+
+    def best_soft_of(best_viol, best_soft):
+        """Soft of the lexicographically leading chain — what one
+        telemetry row can say about C chains without C columns."""
+        return jnp.min(jnp.where(best_viol == best_viol.min(),
+                                 best_soft, jnp.inf))
 
     viol0, soft0 = chain_scores(states)
+    telem0 = jnp.zeros((trace_blocks, len(TRACE_COLS)), jnp.float32)
     init = (states, keys, states.assignment, viol0, soft0,
-            viol0.min() == 0, jnp.zeros((C,), jnp.int32))
+            viol0.min() == 0, jnp.zeros((C,), jnp.int32), telem0)
 
     def cond(carry):
         *_rest, b, done = carry
@@ -637,15 +718,38 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
 
     def body(carry):
         (states, keys, best_assign, best_viol, best_soft, seen,
-         accepted, b, _done) = carry
+         accepted, telem, b, _done) = carry
         offsets = b * block + jnp.arange(block, dtype=jnp.int32)
+        inner = (states, keys, best_assign, best_viol, best_soft, seen,
+                 accepted)
+        if trace_blocks:
+            # placeholder live scores; block >= 1 so the first sweep of
+            # the block always overwrites them
+            inner = inner + (best_viol,)
+        res, _ = jax.lax.scan(sweep, inner, offsets)
         (states, keys, best_assign, best_viol, best_soft,
-         seen, accepted), _ = jax.lax.scan(
-            sweep, (states, keys, best_assign, best_viol, best_soft, seen,
-                    accepted),
-            offsets)
+         seen, accepted) = res[:7]
+        # flight-deck row for this block: PURE observation of scores the
+        # sweeps already computed (no extra reduces — pinned by the
+        # admission bench's tail assert), written with mode="drop" so
+        # rows past the static buffer vanish instead of clamping onto
+        # the last slot. trace_blocks == 0 (static) skips everything:
+        # the pre-telemetry program, byte for byte — the parity
+        # reference.
+        if trace_blocks:
+            live_viol = res[7]
+            end_sweep = (b + 1) * block
+            temp_end = t0 * decay ** jnp.minimum(
+                end_sweep - 1, max_steps - 1).astype(jnp.float32)
+            row = jnp.stack([end_sweep.astype(jnp.float32),
+                             temp_end,
+                             best_viol.min(),
+                             best_soft_of(best_viol, best_soft),
+                             live_viol.min(),
+                             accepted.sum().astype(jnp.float32)])
+            telem = telem.at[b].set(row, mode="drop")
         return (states, keys, best_assign, best_viol, best_soft, seen,
-                accepted, b + 1, seen)
+                accepted, telem, b + 1, seen)
 
     # done starts False: even an already-feasible start gets one block of
     # soft polish (the exit trades polish for latency only after that).
@@ -656,10 +760,17 @@ def anneal_adaptive_states(prob: DeviceProblem, init_assignments: jax.Array,
     # the sweep was pure latency (~30 ms of the 10k x 1k warm dispatch).
     start_done = ((viol0.min() == 0) if exit_on_feasible_init
                   else jnp.bool_(False))
-    (_, _, best_assign, best_viol, best_soft, _, accepted, b,
+    (_, _, best_assign, best_viol, best_soft, _, accepted, telem, b,
      _) = jax.lax.while_loop(cond, body, init + (jnp.int32(0),
                                                  start_done))
-    return best_assign, best_viol, best_soft, b * block, accepted
+    telemetry = {
+        "blocks": telem,
+        "filled": jnp.minimum(b, trace_blocks),
+        # the prologue/seed scores: the whole story of a 0-sweep exit
+        "init_violations": viol0.min(),
+        "init_soft": best_soft_of(viol0, soft0),
+    }
+    return best_assign, best_viol, best_soft, b * block, accepted, telemetry
 
 
 def anneal_adaptive(prob: DeviceProblem, init_assignments: jax.Array,
@@ -668,7 +779,8 @@ def anneal_adaptive(prob: DeviceProblem, init_assignments: jax.Array,
                     proposals_per_step: int | None = None):
     """Adaptive anneal; returns (assignments (C, S), sweeps_run,
     accepted (C,))."""
-    best_assign, _viol, _soft, sweeps, accepted = anneal_adaptive_states(
-        prob, init_assignments, key, max_steps=max_steps, block=block,
-        t0=t0, t1=t1, proposals_per_step=proposals_per_step)
+    best_assign, _viol, _soft, sweeps, accepted, _telem = \
+        anneal_adaptive_states(
+            prob, init_assignments, key, max_steps=max_steps, block=block,
+            t0=t0, t1=t1, proposals_per_step=proposals_per_step)
     return best_assign, sweeps, accepted

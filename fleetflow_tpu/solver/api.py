@@ -26,8 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .anneal import (anneal_adaptive_states, anneal_states,
-                     chain_states_from_assignment, prerepair_state,
+from .anneal import (TRACE_COLS, anneal_adaptive_states, anneal_states,
+                     chain_states_from_assignment, empty_trace,
+                     prerepair_state_counted, solve_trace_blocks,
                      state_soft_score, state_violation_stats)
 from .buckets import (bucket_config, pad_assignment, pad_problem_tiers,
                       record_bucket, soft_score_host, stage_problem_tiers,
@@ -40,7 +41,7 @@ from .resident import ResidentProblem, transfer_guard_ctx
 from ..core.parsecache import M_FRONTEND_PHASE_MS as _M_FRONTEND_MS
 from ..lower.tensors import ProblemTensors
 from ..obs import get_logger, kv, profile_trace
-from ..obs.metrics import REGISTRY
+from ..obs.metrics import REGISTRY, SOLVE_SECONDS_BUCKETS
 
 log = get_logger("solver")
 
@@ -49,7 +50,8 @@ _M_SOLVES = REGISTRY.counter(
     "fleet_solver_solves_total", "Placement solves by backend and start mode",
     labels=("backend", "warm"))
 _M_SOLVE_S = REGISTRY.histogram(
-    "fleet_solver_solve_duration_seconds", "End-to-end solve() wall time")
+    "fleet_solver_solve_duration_seconds", "End-to-end solve() wall time",
+    buckets=SOLVE_SECONDS_BUCKETS)
 _M_SWEEPS = REGISTRY.counter(
     "fleet_solver_sweeps_total", "Annealing sweeps run across all solves")
 _M_ACCEPTED = REGISTRY.counter(
@@ -117,6 +119,13 @@ class SolveResult:
     # "localized" = committed by the exact gate, "fallback_infeasible" =
     # the full fused path re-ran), None when the solve was full-problem
     subsolve: Optional[dict] = None
+    # in-dispatch flight-deck telemetry (docs/guide/10, "solver flight
+    # deck"): {"schema": TRACE_COLS, "blocks": [[...], ...] one row per
+    # sweep-block, "init": {violations, soft} of the prologue/seed,
+    # "prerepair_moves": fused-prologue relocations, "exit_sweep",
+    # "path": "full" | "subsolve"}. None when the dispatch ran with
+    # FLEET_SOLVE_TRACE_BLOCKS=0 or on the fixed-budget path.
+    telemetry: Optional[dict] = None
 
     @property
     def acceptance_rate(self) -> float:
@@ -152,14 +161,15 @@ def make_chain_inits(prob: DeviceProblem, seed_assignment: jax.Array,
                                    "anneal_block", "proposals_per_step",
                                    "sharding", "fused_prerepair",
                                    "prerepair_moves",
-                                   "skip_feasible_polish"))
+                                   "skip_feasible_polish", "trace_blocks"))
 def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
             t0: float, t1: float, migration_weight: float, *,
             chains: int, steps: int, warm: bool, adaptive: bool = False,
             anneal_block: int = 8,
             proposals_per_step: Optional[int] = None,
             sharding=None, fused_prerepair: bool = False,
-            prerepair_moves: int = 0, skip_feasible_polish: bool = False):
+            prerepair_moves: int = 0, skip_feasible_polish: bool = False,
+            trace_blocks: int = 0):
     """The fused device pipeline after the seed: chain fan-out, annealing,
     per-chain exact cost, best-chain selection, exact violation stats and the
     soft score of the winner — ONE dispatch, five scalars + the winning
@@ -195,9 +205,11 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
     else:
         prob_a = prob
     init_states = None
+    prerepair_applied = jnp.int32(0)
     if fused_prerepair:
         st0 = chain_states_from_assignment(prob_a, seed_assignment)
-        st0 = prerepair_state(prob_a, st0, prerepair_moves)
+        st0, prerepair_applied = prerepair_state_counted(
+            prob_a, st0, prerepair_moves)
         seed_assignment = st0.assignment
         if sharding is None:
             # warm chains are not perturbed: every chain starts from the
@@ -222,13 +234,14 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
         # prefer an infeasible chain whose warm-bonused soft undercuts
         # W_HARD (aggregate bonus gap is unbounded in the fleet size) AND
         # round the soft tie-break away in float32 at large v
-        best_assign_c, best_viol_c, best_soft_c, sweeps_run, accepted_c = \
-            anneal_adaptive_states(
+        (best_assign_c, best_viol_c, best_soft_c, sweeps_run, accepted_c,
+         telem) = anneal_adaptive_states(
                 prob_a, inits, k_anneal, max_steps=steps, block=anneal_block,
                 t0=t0, t1=t1,
                 proposals_per_step=proposals_per_step,
                 init_states=init_states,
-                exit_on_feasible_init=skip_feasible_polish)
+                exit_on_feasible_init=skip_feasible_polish,
+                trace_blocks=trace_blocks)
         accepted = accepted_c.sum()
         # exact lexicographic (violations, soft): among minimal-violation
         # chains (0 when any chain saw feasibility), best soft wins
@@ -242,6 +255,7 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
                                proposals_per_step=proposals_per_step)
         sweeps_run = jnp.int32(steps)
         accepted = jnp.int32(-1)   # fixed-budget path does not track it
+        telem = empty_trace(trace_blocks)   # same treedef as adaptive
         # rank from the CARRIED states: same exact numbers as the
         # kernels.* functions, but elementwise reduces instead of (N, G)
         # scatter rebuilds (~18 ms saved per evaluation at 10k x 1k)
@@ -282,7 +296,8 @@ def _refine(prob: DeviceProblem, seed_assignment: jax.Array, key: jax.Array,
     else:
         stats = violation_stats(prob, winner)
         soft = soft_score(prob, winner)
-    return winner, stats, soft, sweeps_run, accepted
+    telem = dict(telem, prerepair_moves=prerepair_applied)
+    return winner, stats, soft, sweeps_run, accepted, telem
 
 
 def solve(pt: ProblemTensors, **kw) -> SolveResult:
@@ -576,6 +591,12 @@ def _solve(pt: ProblemTensors, *,
         # holds the knee for this path AND the sub-solve's.
         from .anneal import backend_proposals_per_step
         proposals_per_step = backend_proposals_per_step(prob.S)
+    # flight-deck buffer length: a STATIC of every refine/subsolve
+    # executable (compiled in, like proposals_per_step), so the telemetry
+    # rides the dispatch with zero extra compiles and zero host
+    # transfers; FLEET_SOLVE_TRACE_BLOCKS=0 restores the pre-telemetry
+    # program (the parity test's reference leg)
+    trace_blocks = solve_trace_blocks()
 
     t_anneal = t()
     sharding = (NamedSharding(mesh, P(CHAIN_AXIS, None))
@@ -606,7 +627,7 @@ def _solve(pt: ProblemTensors, *,
              min(warm_block, anneal_block) if warm else anneal_block,
              proposals_per_step, fused, prerepair_moves,
              bool(resident_warm and adaptive and fused),
-             prob.n_real is not None,
+             prob.n_real is not None, trace_blocks,
              # plane layout is part of the executable identity: a packed
              # and a dense staging (or absent vs present preference) are
              # different treedefs/dtypes, hence different XLA programs
@@ -636,7 +657,8 @@ def _solve(pt: ProblemTensors, *,
         # nearly all polish moves, so the sweep bought latency only. The
         # host warm path (and the legacy-prepass A/B leg) keeps its
         # 1-block polish (same results as r05).
-        skip_feasible_polish=bool(resident_warm and adaptive and fused))
+        skip_feasible_polish=bool(resident_warm and adaptive and fused),
+        trace_blocks=trace_blocks)
     cache_before = _refine._cache_size()
     sub_info = None
     sub_cache_before = 0
@@ -652,12 +674,13 @@ def _solve(pt: ProblemTensors, *,
         staged = stage_subsolve(resident, sub_plan)
         sub_props = backend_proposals_per_step(sub_plan.tier)
         with guard_ctx():
-            best_assignment, dstats, dsoft, sweeps_run, accepted = \
-                subsolve_dispatch(
+            (best_assignment, dstats, dsoft, sweeps_run, accepted,
+             dtelem) = subsolve_dispatch(
                     prob, resident.assignment, staged, sub_plan, key,
                     t0_d, t1_d, mw_d, chains=chains, steps=steps,
                     block=min(warm_block, anneal_block),
-                    proposals_per_step=sub_props)
+                    proposals_per_step=sub_props,
+                    trace_blocks=trace_blocks)
         if overlap_host_work is not None:
             # the gate decision below synchronizes with the in-flight
             # sub dispatch, so the overlapped host work must run NOW —
@@ -692,7 +715,8 @@ def _solve(pt: ProblemTensors, *,
         # already resident; statics hash, they don't transfer); off the
         # resident path the guard is a nullcontext
         with guard_ctx():
-            best_assignment, dstats, dsoft, sweeps_run, accepted = _refine(
+            (best_assignment, dstats, dsoft, sweeps_run, accepted,
+             dtelem) = _refine(
                 prob, seed_assignment, key, t0_d, t1_d, mw_d, **refine_kw)
         if resident is not None:
             # the padded winner stays on device as the next warm seed
@@ -707,9 +731,10 @@ def _solve(pt: ProblemTensors, *,
         t_ov = t()
         overlap_host_work()
         timings["overlap_host_ms"] = (t() - t_ov) * 1e3
-    # ONE transfer for everything the host decision needs
-    assignment, dstats, soft, sweeps_run, accepted = jax.device_get(
-        (best_assignment, dstats, dsoft, sweeps_run, accepted))
+    # ONE transfer for everything the host decision needs — the
+    # flight-deck telemetry rides it (no extra fetch, no extra dispatch)
+    assignment, dstats, soft, sweeps_run, accepted, htelem = jax.device_get(
+        (best_assignment, dstats, dsoft, sweeps_run, accepted, dtelem))
     # FORCE a host copy: on the CPU backend device_get returns a VIEW of
     # the device buffer, and the resident path DONATES that buffer into
     # the next burst's merge/sub-solve dispatch — without the copy every
@@ -777,6 +802,31 @@ def _solve(pt: ProblemTensors, *,
             padded=None if moves else padded_host,
             feasible=stats["total"] == 0)
     timings["total_ms"] = (t() - t_start) * 1e3
+    # -- flight-deck payload (docs/guide/10, "solver flight deck") ---------
+    # accepted >= 0 distinguishes the adaptive dispatch (which carried a
+    # real buffer) from the fixed-budget path's zero-filled treedef twin
+    telemetry = None
+    if trace_blocks > 0 and accepted >= 0:
+        filled = int(htelem["filled"])
+        rows = np.asarray(htelem["blocks"])[:filled]
+        telemetry = {
+            "schema": list(TRACE_COLS),
+            "blocks": [[round(float(x), 6) for x in row] for row in rows],
+            "trace_blocks": trace_blocks,
+            "init": {"violations": float(htelem["init_violations"]),
+                     "soft": round(float(htelem["init_soft"]), 6)},
+            "prerepair_moves": int(htelem["prerepair_moves"]),
+            "exit_sweep": int(sweeps_run),
+            "path": ("subsolve" if sub_info is not None
+                     and sub_info["outcome"] == "localized" else "full"),
+        }
+        if sub_info is not None:
+            telemetry["subsolve"] = dict(sub_info)
+        _record_solve_trace(telemetry, S=pt.S, N=prob.N,
+                            warm=bool(warm), resident=bool(resident_warm),
+                            violations=int(stats["total"]),
+                            pre_repair=pre_repair,
+                            total_ms=round(timings["total_ms"], 3))
     _M_SOLVES.inc(backend=jax.default_backend(),
                   warm="true" if warm else "false")
     _M_SOLVE_S.observe(timings["total_ms"] / 1e3)
@@ -810,4 +860,21 @@ def _solve(pt: ProblemTensors, *,
         bucket=binfo.to_dict() if binfo is not None else None,
         fused_prerepair=fused,
         subsolve=sub_info,
+        telemetry=telemetry,
     )
+
+
+def _record_solve_trace(payload: dict, **fields) -> None:
+    """Record one solve's flight-deck telemetry as a flight-recorder span
+    payload (kind="telemetry", rendered by `fleet solve trace`). No-op —
+    one env lookup — when FLEET_TRACE_FILE is unset."""
+    from ..obs.trace import (current_span_id, current_trace_id,
+                             flight_recorder, new_span_id, new_trace_id,
+                             record_span_event)
+    if flight_recorder() is None:
+        return
+    record_span_event(
+        "telemetry", "solve.trace", "fleetflow.solver",
+        trace=current_trace_id() or new_trace_id(),
+        span=current_span_id() or new_span_id(),
+        fields={**fields, "telemetry": payload})

@@ -201,7 +201,7 @@ def _refine_cases() -> list[KernelCase]:
         rp = ResidentProblem(pt)
         rp.adopt_host(np.zeros(pt.S, np.int32), pt.node_valid, warm=False)
         prob = rp.prob
-        from .anneal import backend_proposals_per_step
+        from .anneal import backend_proposals_per_step, solve_trace_blocks
         proposals = backend_proposals_per_step(prob.S)
         t0_d, t1_d, mw_d = rp.warm_scalars(0.1, 1e-3, 0.5)
         key = jax.random.PRNGKey(0)
@@ -212,7 +212,12 @@ def _refine_cases() -> list[KernelCase]:
                         anneal_block=1, proposals_per_step=proposals,
                         sharding=None, fused_prerepair=True,
                         prerepair_moves=max(16, min(prob.S, 256)),
-                        skip_feasible_polish=True),
+                        skip_feasible_polish=True,
+                        # the flight-deck buffer length IS a static of
+                        # the warm executable (ISSUE 15): auditing with
+                        # it pins that telemetry stays compiled-in —
+                        # zero extra dispatches, no donation drift
+                        trace_blocks=solve_trace_blocks()),
             arg_names=_REFINE_ARG_NAMES,
             out_shardings=None))
     return out
@@ -258,7 +263,7 @@ def _subsolve_cases() -> list[KernelCase]:
                                     G_full=rp.prob.G, Gc_full=rp.prob.Gc)
         assert plan is not None, f"audit sub-plan fell back: {outcome}"
         staged = stage_subsolve(rp, plan)
-        from .anneal import backend_proposals_per_step
+        from .anneal import backend_proposals_per_step, solve_trace_blocks
         t0_d, t1_d, mw_d = rp.warm_scalars(0.1, 1e-3, 0.5)
         key = jax.random.PRNGKey(0)
         out.append(KernelCase(
@@ -268,7 +273,8 @@ def _subsolve_cases() -> list[KernelCase]:
                         proposals_per_step=backend_proposals_per_step(
                             plan.tier),
                         prerepair_moves=max(16, min(plan.tier, 256)),
-                        Gc_sub=plan.Gc_sub),
+                        Gc_sub=plan.Gc_sub,
+                        trace_blocks=solve_trace_blocks()),
             arg_names=_SUBSOLVE_ARG_NAMES,
             out_shardings=None))
     return out
@@ -286,10 +292,12 @@ def _anneal_sharded_cases() -> list[KernelCase]:
 
     from .sharded import ShardedResident, anneal_sharded
 
+    from .anneal import solve_trace_blocks
+
     mesh = _sharded_mesh(2, 4)
     stats_fields = ("assignment", "sweeps", "capacity", "conflicts",
                     "eligibility", "skew", "soft", "swap_attempts",
-                    "swap_accepts")
+                    "swap_accepts", "telemetry")
     decl = {f: ("P('svc')" if f == "assignment" else "P()")
             for f in stats_fields}
     out = []
@@ -306,7 +314,8 @@ def _anneal_sharded_cases() -> list[KernelCase]:
             kwargs=dict(steps=16, t0=t0_d, t1=t1_d,
                         proposals_per_step=None, mesh=mesh, adaptive=True,
                         block=8, ladder=lad_d, exchange_every=1,
-                        return_stats=True),
+                        return_stats=True,
+                        trace_blocks=solve_trace_blocks()),
             arg_names=_ANNEAL_SHARDED_ARG_NAMES,
             out_shardings=decl))
     return out

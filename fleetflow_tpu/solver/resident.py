@@ -50,7 +50,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..obs import get_logger, kv
-from ..obs.metrics import REGISTRY
+from ..obs.metrics import MS_BUCKETS, REGISTRY
 from .buckets import bucket_config, bucket_size
 
 log = get_logger("solver.resident")
@@ -63,10 +63,11 @@ _M_REUSE = REGISTRY.counter(
     "Resident-state staging decisions: delta = on-device delta applied to "
     "the resident problem, cold = full host (re)staging",
     labels=("outcome",))
-_M_DELTA_MS = REGISTRY.gauge(
+_M_DELTA_MS = REGISTRY.histogram(
     "fleet_solver_delta_stage_ms",
-    "Milliseconds spent applying on-device churn deltas for the most "
-    "recent warm solve (upload + donated merge dispatch)")
+    "Milliseconds spent applying on-device churn deltas per warm solve "
+    "(upload + donated merge dispatch)",
+    buckets=MS_BUCKETS)
 _M_HOST_XFER = REGISTRY.counter(
     "fleet_solver_host_transfers_total",
     "Warm-path solves that had to move problem tensors across the host "
@@ -441,7 +442,7 @@ class ResidentProblem:
             self._mirror[self.n_real:] = int(np.argmax(valid))
         ms = (time.perf_counter() - t0) * 1e3
         self._delta_ms += ms
-        _M_DELTA_MS.set(ms)
+        _M_DELTA_MS.observe(ms)
         _M_REUSE.inc(outcome="delta")
         return ms
 

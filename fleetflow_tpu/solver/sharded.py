@@ -156,10 +156,23 @@ class ShardedStats(NamedTuple):
     soft: jax.Array             # f32, soft score of the winner (padded rows)
     swap_attempts: jax.Array    # i32, replica-exchange attempts
     swap_accepts: jax.Array     # i32, accepted exchanges
+    # flight-deck rows, (trace_blocks, len(SHARDED_TRACE_COLS)) f32,
+    # replicated (every column is psum/pmin-derived, so the buffer is
+    # identical on every device) — zero-length when trace_blocks=0 and
+    # zero-FILLED on the fixed scan path (no block loop to observe)
+    telemetry: jax.Array
 
     @property
     def violations(self):
         return self.capacity + self.conflicts + self.eligibility + self.skew
+
+
+# per-block flight-deck schema of the sharded dispatch: the single-chip
+# TRACE_COLS story minus the live-state column (the tempered loop's
+# carried scalars are best-ever) plus the replica-exchange counters —
+# "where did acceptance collapse" becomes "did the ladder stop mixing"
+SHARDED_TRACE_COLS = ("sweep", "temperature", "best_violations",
+                      "best_soft", "swap_attempts", "swap_accepts")
 
 # pad_problem moved to solver/buckets.py (the bucketing module generalizes
 # it: same phantom construction, plus tier ladders for S/G/Gc and id-table
@@ -240,7 +253,8 @@ def per_device_bytes(prob: DeviceProblem, *,
 
 @partial(jax.jit, static_argnames=("steps", "proposals_per_step", "mesh",
                                    "adaptive", "block", "exchange_every",
-                                   "return_sweeps", "return_stats"))
+                                   "return_sweeps", "return_stats",
+                                   "trace_blocks"))
 def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                    key: jax.Array, steps: int = 64,
                    t0: float = 1.0, t1: float = 1e-3,
@@ -251,7 +265,8 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                    ladder: float = 1.3,
                    exchange_every: int = 1,
                    return_sweeps: bool = False,
-                   return_stats: bool = False):
+                   return_stats: bool = False,
+                   trace_blocks: int = 0):
     """One annealing pass with the service axis sharded over `mesh`.
 
     init_assignment: (S,) int32 (replicated input; resharded internally).
@@ -577,13 +592,35 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                   assign, viol0, soft0)
         zero_i = jnp.int32(0)
         n_blocks = -(-steps // block)
+        # flight-deck buffer: one replicated f32 row per sweep-block
+        # (every column below is psum/pmin-derived, hence identical on
+        # all devices); rows past the static length drop
+        telem0 = jnp.zeros((trace_blocks, len(SHARDED_TRACE_COLS)),
+                           jnp.float32)
+
+        def trace_row(telem, b, sweeps_f, bviol, bsoft, att, acc):
+            if not trace_blocks:   # static: pre-telemetry program intact
+                return telem
+            row = jnp.stack([
+                sweeps_f,
+                # block-end temperature on the BASE (lane-0) schedule —
+                # lane multipliers differ per replica and a replicated
+                # output may not
+                t0 * decay ** jnp.minimum(
+                    (b + 1) * block - 1, steps - 1).astype(jnp.float32),
+                bviol, bsoft,
+                att.astype(jnp.float32), acc.astype(jnp.float32)])
+            return telem.at[b].set(row, mode="drop")
 
         if not has_rep and not adaptive:
+            # fixed scan path: no block loop to observe — the buffer
+            # returns zero-filled (filled = 0 by the sweeps/block math)
             (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft), _ \
                 = jax.lax.scan(sweep, carry0,
                                jnp.arange(steps, dtype=jnp.int32))
             sweeps_run = jnp.int32(steps)
             att = acc = zero_i
+            telem = telem0
         elif not has_rep:
             def cond(carry):
                 *_rest, b, done = carry
@@ -591,20 +628,25 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
 
             def blk(carry):
                 (assign, load, used, coloc, topo, key,
-                 best_assign, best_viol, best_soft, b, _done) = carry
+                 best_assign, best_viol, best_soft, telem, b,
+                 _done) = carry
                 offsets = b * block + jnp.arange(block, dtype=jnp.int32)
                 offsets = jnp.minimum(offsets, steps - 1)  # clamp schedule
                 (assign, load, used, coloc, topo, key,
                  best_assign, best_viol, best_soft), _ = jax.lax.scan(
                     sweep, (assign, load, used, coloc, topo, key,
                             best_assign, best_viol, best_soft), offsets)
+                telem = trace_row(
+                    telem, b,
+                    jnp.minimum((b + 1) * block, steps).astype(jnp.float32),
+                    best_viol, best_soft, zero_i, zero_i)
                 return (assign, load, used, coloc, topo, key,
-                        best_assign, best_viol, best_soft, b + 1,
+                        best_assign, best_viol, best_soft, telem, b + 1,
                         best_viol == 0)
 
             (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft,
-             b_run, _done) = jax.lax.while_loop(
-                cond, blk, carry0 + (zero_i, jnp.bool_(False)))
+             telem, b_run, _done) = jax.lax.while_loop(
+                cond, blk, carry0 + (telem0, zero_i, jnp.bool_(False)))
             sweeps_run = jnp.minimum(b_run * block, steps)
             att = acc = zero_i
         else:
@@ -619,7 +661,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
 
             def blk(carry):
                 (assign, load, used, coloc, topo, key, best_assign,
-                 best_viol, best_soft, att, acc, b, _done) = carry
+                 best_viol, best_soft, att, acc, telem, b, _done) = carry
                 offsets = b * block + jnp.arange(block, dtype=jnp.int32)
                 offsets = jnp.minimum(offsets, steps - 1)  # clamp schedule
                 (assign, load, used, coloc, topo, key, best_assign,
@@ -644,13 +686,23 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                     att = att + d_att
                     acc = acc + d_acc
                 g_viol = jax.lax.pmin(best_viol, REPLICA_AXIS)
+                # the lexicographic leader ACROSS lanes (one extra scalar
+                # pmin per block): what the flight deck shows as "the
+                # ladder's best so far"
+                g_soft = jax.lax.pmin(
+                    jnp.where(best_viol == g_viol, best_soft, jnp.inf),
+                    REPLICA_AXIS)
+                telem = trace_row(
+                    telem, b,
+                    jnp.minimum((b + 1) * block, steps).astype(jnp.float32),
+                    g_viol, g_soft, att, acc)
                 done = (g_viol == 0) if adaptive else jnp.bool_(False)
                 return (assign, load, used, coloc, topo, key, best_assign,
-                        best_viol, best_soft, att, acc, b + 1, done)
+                        best_viol, best_soft, att, acc, telem, b + 1, done)
 
             (_a, _l, _u, _c, _t, _k, best_assign, best_viol, best_soft,
-             att, acc, b_run, _done) = jax.lax.while_loop(
-                cond, blk, carry0 + (zero_i, zero_i, zero_i,
+             att, acc, telem, b_run, _done) = jax.lax.while_loop(
+                cond, blk, carry0 + (zero_i, zero_i, telem0, zero_i,
                                      jnp.bool_(False)))
             sweeps_run = jnp.minimum(b_run * block, steps)
             if n_rep > 1:
@@ -690,7 +742,7 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
         else:
             capF = confF = inelF = skewF = softF = jnp.float32(0.0)
         return (best_assign, sweeps_run, capF, confF, inelF, skewF,
-                softF, att, acc)
+                softF, att, acc, telem)
 
     # the preference plane may be ABSENT (packed layout): the shard_map
     # operand list — and the executable — then simply has no pref plane,
@@ -702,7 +754,8 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
                       P(SVC_AXIS, None), P(SVC_AXIS, None),
                       P(SVC_AXIS, None),
                       P(), P(), P(), P(SVC_AXIS), P()),
-            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
+            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P(),
+                       P()))
         out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
                       prob.eligible, prob.preferred, prob.capacity,
                       prob.node_valid, prob.node_topology,
@@ -718,7 +771,8 @@ def anneal_sharded(prob: DeviceProblem, init_assignment: jax.Array,
             in_specs=(P(SVC_AXIS, None), P(SVC_AXIS, None),
                       P(SVC_AXIS, None), P(SVC_AXIS, None),
                       P(), P(), P(), P(SVC_AXIS), P()),
-            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P()))
+            out_specs=(P(SVC_AXIS), P(), P(), P(), P(), P(), P(), P(), P(),
+                       P()))
         out = sharded(prob.demand, prob.conflict_ids, prob.coloc_ids,
                       prob.eligible, prob.capacity,
                       prob.node_valid, prob.node_topology,
@@ -940,6 +994,8 @@ def solve_sharded(pt, *, resident: ShardedResident,
     # not a problem tensor (same contract as api._solve)
     key = jax.device_put(jax.random.PRNGKey(seed),
                          NamedSharding(mesh, P()))
+    from .anneal import solve_trace_blocks
+    trace_blocks = solve_trace_blocks()
     guard = transfer_guard_ctx() if warm else contextlib.nullcontext()
     cache_before = anneal_sharded._cache_size()
     with guard:
@@ -947,7 +1003,8 @@ def solve_sharded(pt, *, resident: ShardedResident,
             prob, seed_assignment, key, steps=steps, t0=t0_d, t1=t1_d,
             proposals_per_step=proposals_per_step, mesh=mesh,
             adaptive=adaptive, block=block, ladder=lad_d,
-            exchange_every=exchange_every, return_stats=True)
+            exchange_every=exchange_every, return_stats=True,
+            trace_blocks=trace_blocks)
     compile_events = anneal_sharded._cache_size() - cache_before
     # the padded winner stays mesh-resident as the next warm seed
     rp.adopt(res.assignment)
@@ -955,9 +1012,10 @@ def solve_sharded(pt, *, resident: ShardedResident,
         t_ov = t()
         overlap_host_work()
         timings["overlap_host_ms"] = (t() - t_ov) * 1e3
-    # ONE fetch for everything the host decision needs
+    # ONE fetch for everything the host decision needs (the flight-deck
+    # buffer rides it)
     (assignment, sweeps, capF, confF, inelF, skewF, _softF, att,
-     acc) = jax.device_get(tuple(res))
+     acc, htelem) = jax.device_get(tuple(res))
     assignment = np.asarray(assignment)[: pt.S]
     timings["anneal_ms"] = (t() - t_anneal) * 1e3
 
@@ -1004,6 +1062,29 @@ def solve_sharded(pt, *, resident: ShardedResident,
         _M_SWAPS.inc(att - acc, accepted="false")
     dev_bytes = per_device_bytes(prob, state=True)
     _M_SH_BYTES.set(float(sum(dev_bytes.values())))
+    # flight-deck payload: the per-block rows of the sharded dispatch
+    # (fleet solve trace renders them like the single-chip schema)
+    telemetry = None
+    if trace_blocks > 0:
+        filled = min(-(-int(sweeps) // block) if block else 0,
+                     trace_blocks)
+        rows = np.asarray(htelem)[:filled]
+        # a written row always has temperature > 0; all-zero rows are
+        # the fixed scan path's unobserved buffer — drop, don't invent
+        rows = rows[~np.all(rows == 0, axis=1)]
+        telemetry = {
+            "schema": list(SHARDED_TRACE_COLS),
+            "blocks": [[round(float(x), 6) for x in row] for row in rows],
+            "trace_blocks": trace_blocks,
+            "exit_sweep": int(sweeps),
+            "path": "sharded",
+            "mesh": f"{n_rep}x{D}",
+        }
+        from .api import _record_solve_trace
+        _record_solve_trace(telemetry, S=pt.S, N=pt.N, warm=warm,
+                            resident=warm, violations=int(stats["total"]),
+                            pre_repair=pre_repair,
+                            total_ms=round(timings["total_ms"], 3))
     log.info("solve_sharded %s", kv(
         S=pt.S, N=pt.N, padded=prob.S, mesh=f"{n_rep}x{D}",
         sweeps=int(sweeps), swaps=f"{acc}/{att}" if att else None,
@@ -1025,6 +1106,7 @@ def solve_sharded(pt, *, resident: ShardedResident,
         tempering={"replicas": n_rep, "ladder": float(ladder),
                    "exchange_every": int(exchange_every),
                    "swap_attempts": att, "swap_accepts": acc},
+        telemetry=telemetry,
     )
 
 

@@ -60,7 +60,7 @@ import numpy as np
 
 from .buckets import subsolve_tier, width_bucket
 from ..obs import get_logger, kv
-from ..obs.metrics import REGISTRY
+from ..obs.metrics import MS_BUCKETS, REGISTRY
 
 log = get_logger("solver.subsolve")
 
@@ -83,14 +83,23 @@ _M_SUB_ROWS = REGISTRY.gauge(
 _M_SUB_TIER = REGISTRY.gauge(
     "fleet_solver_subsolve_tier",
     "Padded mini-tier of the most recent active-set sub-solve")
-_M_SUB_MS = REGISTRY.gauge(
+_M_SUB_MS = REGISTRY.histogram(
     "fleet_solver_subsolve_ms",
-    "Wall milliseconds of the most recent localized sub-solve dispatch "
-    "(staging + mini anneal + scatter + exact full-problem gate)")
+    "Wall milliseconds per localized sub-solve dispatch "
+    "(staging + mini anneal + scatter + exact full-problem gate)",
+    buckets=MS_BUCKETS)
 
 
 def record_outcome(outcome: str) -> None:
     _M_SUB.inc(outcome=outcome)
+
+
+# the outcome vocabulary the operator surfaces render
+# (cp/admission.SUBSOLVE_OUTCOMES mirrors this list by name — the CP
+# reads the counter through the registry so its status calls never
+# import jax; tests pin the two lists equal)
+SUB_OUTCOMES = ("localized", "fallback_closure", "fallback_small",
+                "fallback_infeasible")
 
 
 @dataclass(frozen=True)
@@ -362,14 +371,15 @@ def _subsolve_fn():
     import jax.numpy as jnp
 
     from .anneal import (anneal_adaptive_states, chain_states_from_assignment,
-                         prerepair_state)
+                         prerepair_state_counted)
     from .kernels import exact_stats_and_soft
     from .problem import DeviceProblem
 
     def subsolve(prob, assignment, rows, sub_conflict, sub_coloc, load0,
                  used0, coloc0, topo0, n_sub, key, t0, t1,
                  migration_weight, *, chains, steps, block,
-                 proposals_per_step, prerepair_moves, Gc_sub):
+                 proposals_per_step, prerepair_moves, Gc_sub,
+                 trace_blocks=0):
         S_sub = rows.shape[0]
         rows_g = jnp.minimum(rows, prob.S - 1)   # clamp-safe gather index
         real = jnp.arange(S_sub) < n_sub
@@ -403,16 +413,19 @@ def _subsolve_fn():
             sticky_w=jnp.asarray(migration_weight, jnp.float32))
         st0 = chain_states_from_assignment(
             sub_a, seed_sub, base=(load0, used0, coloc0, topo0))
-        st0 = prerepair_state(sub_a, st0, prerepair_moves)
+        st0, prerepair_applied = prerepair_state_counted(
+            sub_a, st0, prerepair_moves)
         init_states = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x[None], (chains,) + x.shape), st0)
         inits = jnp.broadcast_to(st0.assignment[None], (chains, S_sub))
-        best_assign_c, best_viol_c, best_soft_c, sweeps_run, accepted_c = \
-            anneal_adaptive_states(
+        (best_assign_c, best_viol_c, best_soft_c, sweeps_run, accepted_c,
+         telem) = anneal_adaptive_states(
                 sub_a, inits, key, max_steps=steps, block=block,
                 t0=t0, t1=t1, proposals_per_step=proposals_per_step,
-                init_states=init_states, exit_on_feasible_init=True)
+                init_states=init_states, exit_on_feasible_init=True,
+                trace_blocks=trace_blocks)
         accepted = accepted_c.sum()
+        telem = dict(telem, prerepair_moves=prerepair_applied)
         # same lexicographic (violations, soft) rank as the full pipeline
         min_viol = best_viol_c.min()
         best = jnp.argmin(jnp.where(best_viol_c == min_viol,
@@ -433,12 +446,13 @@ def _subsolve_fn():
         # the acceptance gate: exact full-problem stats of the scattered
         # result — whatever the mini anneal believed, THIS decides
         stats, soft = exact_stats_and_soft(prob, new_assignment)
-        return new_assignment, stats, soft, sweeps_run, accepted
+        return new_assignment, stats, soft, sweeps_run, accepted, telem
 
     return jax.jit(subsolve,
                    static_argnames=("chains", "steps", "block",
                                     "proposals_per_step",
-                                    "prerepair_moves", "Gc_sub"))
+                                    "prerepair_moves", "Gc_sub",
+                                    "trace_blocks"))
 
 
 def subsolve_cache_size() -> int:
@@ -470,10 +484,11 @@ SUB_MAX_STEPS = 16   # mini-anneal sweep budget: a feasible closure exits
 
 def subsolve_dispatch(prob, assignment, staged, plan: ActivePlan, key,
                       t0, t1, migration_weight, *, chains: int, steps: int,
-                      block: int, proposals_per_step: int):
+                      block: int, proposals_per_step: int,
+                      trace_blocks: int = 0):
     """Run the localized kernel (call under the transfer guard: every
     argument is already resident). Returns the device outputs
-    (new_assignment, stats, soft, sweeps_run, accepted)."""
+    (new_assignment, stats, soft, sweeps_run, accepted, telemetry)."""
     prerepair_moves = max(16, min(plan.tier, 256))
     _M_SUB_ROWS.set(plan.n_sub)
     _M_SUB_TIER.set(plan.tier)
@@ -481,8 +496,9 @@ def subsolve_dispatch(prob, assignment, staged, plan: ActivePlan, key,
         prob, assignment, *staged, key, t0, t1, migration_weight,
         chains=chains, steps=min(steps, SUB_MAX_STEPS), block=block,
         proposals_per_step=proposals_per_step,
-        prerepair_moves=prerepair_moves, Gc_sub=plan.Gc_sub)
+        prerepair_moves=prerepair_moves, Gc_sub=plan.Gc_sub,
+        trace_blocks=trace_blocks)
 
 
 def record_subsolve_ms(ms: float) -> None:
-    _M_SUB_MS.set(ms)
+    _M_SUB_MS.observe(ms)

@@ -267,7 +267,7 @@ class TestJitSpec:
         ("solver/sharded.py", "anneal_sharded",
          ["adaptive", "block", "exchange_every", "mesh",
           "proposals_per_step", "return_stats", "return_sweeps",
-          "steps"]),
+          "steps", "trace_blocks"]),
     ])
     def test_real_anchors_resolve(self, module, qualname, expect_static):
         path = os.path.join(PKG, module)

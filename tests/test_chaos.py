@@ -195,6 +195,33 @@ class TestFullPack:
         assert r.ok, r.violations
 
 
+@pytest.mark.slow
+class TestSloScenarioCanaries:
+    """Failing-WORLD canaries for the slo-met invariant (ISSUE 15
+    acceptance): the exact scenarios CI runs green must FAIL when an
+    objective is tightened to the absurd — proof the invariant reads
+    real samples, not vacuous air."""
+
+    def test_rolling_kill_selfheal_fails_absurd_placement_slo(
+            self, monkeypatch):
+        from fleetflow_tpu.chaos import runner as chaos_runner
+        monkeypatch.setitem(chaos_runner.CHAOS_SLOS,
+                            "placement-p99-ms", 1e-6)
+        r = run_scenario("rolling-kill-selfheal", seed=7, **SMOKE)
+        assert not r.ok
+        assert any("slo-met" in v and "placement-p99-ms" in v
+                   for v in r.violations), r.violations
+
+    def test_arrival_storm_fails_absurd_wait_slo(self, monkeypatch):
+        from fleetflow_tpu.chaos import runner as chaos_runner
+        monkeypatch.setitem(chaos_runner.CHAOS_SLOS,
+                            "admission-wait-p99-s", 1e-6)
+        r = run_scenario("arrival-storm", seed=7, **SMOKE)
+        assert not r.ok
+        assert any("slo-met" in v and "admission-wait-p99-s" in v
+                   for v in r.violations), r.violations
+
+
 # --------------------------------------------------------------------------
 # canaries: every checker proven live against a broken world
 # --------------------------------------------------------------------------
@@ -393,6 +420,24 @@ class TestInvariantCanaries:
         w.clock.advance(1.0)
         ctrl.step()
         assert admission_converged(w) == []      # drained: placed + green
+
+    def test_slo_met_fires_on_missed_objective(self):
+        """A stream sample past the declared threshold must fail the
+        world; unexercised streams stay vacuous (a fault-free world
+        placed nothing)."""
+        from fleetflow_tpu.chaos.invariants import slo_met
+        w = _world()
+        assert slo_met(w) == []                  # no samples: vacuous
+        w.state.slo.observe("heal_s", 1e6)       # way past the 600 s bound
+        found = slo_met(w)
+        assert found and "heal-p99-s" in found[0]
+        assert "1000000" in found[0] or "1e+06" in found[0]
+
+    def test_slo_met_ignores_worlds_without_engine(self):
+        from fleetflow_tpu.chaos.invariants import slo_met
+        w = _world()
+        w.state.slo = None                       # pre-SLO world shape
+        assert slo_met(w) == []
 
     def test_admission_converged_fires_on_unplaced_live_service(self):
         """An arrival marked placed whose service is NOT in the settled

@@ -1,0 +1,338 @@
+"""Rolling SLO engine (fleetflow_tpu/obs/slo.py): sketch correctness,
+windowing, burn rates, objective grammar, engine wiring, and the
+observation points in the control plane."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.obs.metrics import REGISTRY
+from fleetflow_tpu.obs.slo import (KNOWN_STREAMS, QuantileSketch,
+                                   RollingQuantile, SloEngine,
+                                   get_engine, observe, parse_objective,
+                                   parse_slo_props, set_engine)
+
+
+@pytest.fixture(autouse=True)
+def _no_global_engine():
+    """Tests install their own engines; never leak one across tests."""
+    prev = get_engine()
+    set_engine(None)
+    yield
+    set_engine(prev)
+
+
+# --------------------------------------------------------------------------
+# the sketch
+# --------------------------------------------------------------------------
+
+class TestQuantileSketch:
+    def test_exact_below_capacity(self):
+        sk = QuantileSketch(k=128)
+        for v in [5.0, 1.0, 9.0, 3.0, 7.0]:
+            sk.add(v)
+        assert sk.quantile(0.0) == 1.0
+        assert sk.quantile(1.0) == 9.0
+        assert sk.quantile(0.5) == 5.0
+        assert sk.n == 5
+
+    def test_accuracy_at_scale(self):
+        rng = np.random.default_rng(7)
+        data = rng.exponential(scale=100.0, size=20_000)
+        sk = QuantileSketch(k=128)
+        for v in data:
+            sk.add(float(v))
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.quantile(data, q))
+            est = sk.quantile(q)
+            # rank error of a k=128 KLL-style sketch is a small fraction
+            # of n; translate to a loose value bound on this smooth tail
+            assert abs(est - exact) / exact < 0.25, (q, est, exact)
+
+    def test_deterministic(self):
+        data = [float((i * 37) % 1000) for i in range(5000)]
+        a, b = QuantileSketch(64), QuantileSketch(64)
+        for v in data:
+            a.add(v)
+            b.add(v)
+        assert a.levels == b.levels      # derandomized compaction
+
+    def test_merge_matches_union(self):
+        rng = np.random.default_rng(3)
+        xs = rng.normal(50, 10, 4000)
+        ys = rng.normal(500, 50, 4000)
+        a, b = QuantileSketch(128), QuantileSketch(128)
+        for v in xs:
+            a.add(float(v))
+        for v in ys:
+            b.add(float(v))
+        m = a.merge(b)
+        assert m.n == 8000
+        both = np.concatenate([xs, ys])
+        # rank-based accuracy (value distance is meaningless inside a
+        # bimodal gap): the estimate's true rank must be near 0.5
+        est = m.quantile(0.5)
+        rank = float((both < est).mean())
+        assert abs(rank - 0.5) < 0.05, (est, rank)
+        # inputs untouched
+        assert a.n == 4000 and b.n == 4000
+
+    def test_fraction_over(self):
+        sk = QuantileSketch(k=128)
+        for i in range(100):
+            sk.add(float(i))
+        assert sk.fraction_over(89.5) == pytest.approx(0.10)
+        assert sk.fraction_over(1e9) == 0.0
+        assert sk.fraction_over(-1.0) == 1.0
+
+    def test_bounded_memory(self):
+        sk = QuantileSketch(k=64)
+        for i in range(200_000):
+            sk.add(float(i % 997))
+        held = sum(len(lv) for lv in sk.levels)
+        assert held < 64 * (len(sk.levels) + 1)
+        assert len(sk.levels) < 20
+
+
+class TestRollingQuantile:
+    def test_window_expiry(self):
+        rq = RollingQuantile(window_s=60.0, buckets=6)
+        for t in range(10):
+            rq.observe(1000.0, now=float(t))
+        # inside the window the slow samples dominate
+        assert rq.sketch(now=10.0).quantile(0.5) == 1000.0
+        # 2 windows later they have rotated out entirely
+        assert rq.sketch(now=200.0) is None
+        rq.observe(1.0, now=200.0)
+        assert rq.sketch(now=200.0).quantile(0.99) == 1.0
+
+    def test_bucket_recycling_drops_stale_epoch(self):
+        rq = RollingQuantile(window_s=60.0, buckets=6)
+        rq.observe(5.0, now=0.0)
+        # same slot, much later epoch: the stale sketch must not bleed in
+        rq.observe(7.0, now=0.0 + 60.0 * 5)
+        sk = rq.sketch(now=60.0 * 5)
+        assert sk.quantile(0.0) == 7.0 and sk.n == 1
+
+
+# --------------------------------------------------------------------------
+# objective grammar
+# --------------------------------------------------------------------------
+
+class TestObjectiveGrammar:
+    def test_parse_placement(self):
+        o = parse_objective("placement-p99-ms", 50)
+        assert (o.stream, o.quantile, o.threshold, o.unit) == \
+            ("placement_ms", 0.99, 50.0, "ms")
+
+    def test_parse_multi_token_stream(self):
+        o = parse_objective("admission-wait-p99-s", 60)
+        assert o.stream == "admission_wait_s"
+
+    @pytest.mark.parametrize("bad", [
+        "placement-p99",           # no unit
+        "placement-p42-ms",        # unknown quantile
+        "placement-p99-days",      # unknown unit
+        "nosuchstream-p99-ms",     # unknown stream
+    ])
+    def test_rejects_bad_grammar(self, bad):
+        with pytest.raises(ValueError):
+            parse_objective(bad, 10)
+
+    def test_rejects_nonpositive_threshold(self):
+        with pytest.raises(ValueError):
+            parse_objective("placement-p99-ms", 0)
+
+    def test_parse_props_sorted_and_validated(self):
+        objs = parse_slo_props({"placement-p99-ms": 50,
+                                "heal-p99-s": 30})
+        assert [o.name for o in objs] == ["heal-p99-s", "placement-p99-ms"]
+        with pytest.raises(ValueError):
+            parse_slo_props({"heal-p99-s": 30, "typo-p99-ms": 1})
+
+    def test_every_known_stream_reachable(self):
+        # the grammar must be able to bind an objective to every stream
+        # the control plane feeds (else a stream is unguardable)
+        for stream in KNOWN_STREAMS:
+            base, unit = stream.rsplit("_", 1)
+            name = f"{base.replace('_', '-')}-p99-{unit}"
+            assert parse_objective(name, 1).stream == stream
+
+
+# --------------------------------------------------------------------------
+# the engine
+# --------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSloEngine:
+    def test_status_objectives_vs_observed(self):
+        clk = _Clock()
+        eng = SloEngine(parse_slo_props({"placement-p99-ms": 50}),
+                        clock=clk)
+        for i in range(100):
+            eng.observe("placement_ms", 10.0)
+            clk.t += 1.0
+        st = eng.status()
+        (o,) = st["objectives"]
+        assert o["met"] and o["samples"] == 100
+        assert o["observed"] == pytest.approx(10.0)
+        assert o["burn_fast"] == 0.0 and o["burn_slow"] == 0.0
+        assert st["streams"]["placement_ms"]["p50"] == pytest.approx(10.0)
+
+    def test_burn_rate_and_miss(self):
+        clk = _Clock()
+        eng = SloEngine(parse_slo_props({"placement-p99-ms": 50}),
+                        clock=clk)
+        # 10% of samples over threshold: p99 missed, burn = 0.10/0.01
+        for i in range(100):
+            eng.observe("placement_ms", 500.0 if i % 10 == 0 else 10.0)
+            clk.t += 0.5
+        st = eng.status()
+        (o,) = st["objectives"]
+        assert not o["met"]
+        assert o["observed"] > 50
+        assert o["burn_fast"] == pytest.approx(10.0, rel=0.2)
+        assert REGISTRY.get("fleet_slo_objective_met").value(
+            slo="placement-p99-ms") == 0.0
+        assert REGISTRY.get("fleet_slo_burn_rate").value(
+            slo="placement-p99-ms", window="fast") > 5.0
+
+    def test_burn_recovers_in_fast_window(self):
+        clk = _Clock()
+        eng = SloEngine(parse_slo_props({"placement-p99-ms": 50}),
+                        clock=clk)
+        for _ in range(50):
+            eng.observe("placement_ms", 500.0)   # a bad spell...
+            clk.t += 1.0
+        clk.t += 400.0                           # ...rotates out of fast
+        for _ in range(50):
+            eng.observe("placement_ms", 5.0)
+            clk.t += 1.0
+        (o,) = eng.status()["objectives"]
+        assert o["burn_fast"] == 0.0             # fast window clean again
+        assert o["burn_slow"] > 0.0              # the hour remembers
+
+    def test_streams_without_objectives_still_census(self):
+        eng = SloEngine(clock=_Clock())
+        eng.observe("heal_s", 2.0)
+        st = eng.status()
+        assert st["objectives"] == []
+        assert st["streams"]["heal_s"]["samples"] == 1
+
+    def test_module_observe_routes_to_installed_engine(self):
+        eng = set_engine(SloEngine(clock=_Clock()))
+        observe("heal_s", 3.0)
+        assert eng.samples("heal_s") == 1
+        set_engine(None)
+        observe("heal_s", 3.0)                   # no engine: no-op
+        assert eng.samples("heal_s") == 1
+
+    def test_observed_quantile_none_before_samples(self):
+        eng = SloEngine(clock=_Clock())
+        assert eng.observed_quantile("heal_s", 0.99) is None
+
+
+# --------------------------------------------------------------------------
+# control-plane wiring
+# --------------------------------------------------------------------------
+
+class TestControlPlaneWiring:
+    def test_daemon_config_parses_and_validates_slo(self):
+        from fleetflow_tpu.daemon.config import DaemonConfig, _apply_kdl
+        cfg = DaemonConfig()
+        _apply_kdl(cfg, 'slo placement-p99-ms=50 heal-p99-s=30')
+        assert cfg.slo == {"placement-p99-ms": 50.0, "heal-p99-s": 30.0}
+        with pytest.raises(ValueError):
+            _apply_kdl(DaemonConfig(), 'slo bogus-p99-parsecs=1')
+
+    def test_reconverge_observes_heal_time(self):
+        """A successful redelivery emits verdict→converged (on the
+        reconverger's injected clock) into the heal_s stream — the real
+        _redeliver path against a fake connected agent, reusing the
+        selfheal test harness."""
+        from test_selfheal import (FakeClock, _FakePlacement, _heal_flow,
+                                   _seed_template, _state, run)
+
+        import random
+
+        from fleetflow_tpu.cp.failure_detector import (FailureDetector,
+                                                       LeaseConfig)
+        from fleetflow_tpu.cp.reconverge import (ReconvergeConfig,
+                                                 Reconverger)
+        from fleetflow_tpu.cp.store import Store
+        from fleetflow_tpu.sched.base import Placement
+
+        clock = FakeClock()
+        eng = set_engine(SloEngine(clock=clock.now))
+        flow = _heal_flow()
+        db = Store()
+        _seed_template(db, flow)
+        placement = _FakePlacement(Placement(
+            assignment={"web": "node-1"}, levels=[["web"]], feasible=True))
+        state = _state(db, placement)
+        det = FailureDetector(LeaseConfig(), clock=clock.now)
+        rc = Reconverger(state, det, config=ReconvergeConfig(),
+                         clock=clock.now, rng=random.Random(0))
+
+        class Conn:
+            _closed = False
+            identity = "node-1"
+
+            async def send_event(self, channel, method, payload):
+                state.agent_registry.resolve_result(
+                    payload["request_id"],
+                    {"result": {"deployed": ["web"]}})
+
+        state.agent_registry.register("node-1", Conn())
+        rc._enqueue("healdemo/main", "tr1")     # verdict_at stamps here
+        clock.t += 42.0
+        summary = run(rc.step())
+        assert summary["redelivered"] == ["healdemo/main"]
+        assert eng.samples("heal_s") == 1
+        assert eng.observed_quantile("heal_s", 0.5) == pytest.approx(42.0)
+
+    def test_subsolve_outcome_vocabulary_pinned(self):
+        """The CP status surfaces read fleet_solver_subsolve_total by
+        outcome label without importing jax; the two vocabularies must
+        stay the same list."""
+        from fleetflow_tpu.cp.admission import SUBSOLVE_OUTCOMES
+        from fleetflow_tpu.solver.subsolve import SUB_OUTCOMES
+        assert SUBSOLVE_OUTCOMES == SUB_OUTCOMES
+
+    def test_admit_status_carries_subsolve_counts(self):
+        from fleetflow_tpu.cp.admission import subsolve_outcomes
+        out = subsolve_outcomes()
+        assert set(out) == {"localized", "fallback_closure",
+                            "fallback_small", "fallback_infeasible"}
+        assert all(isinstance(v, int) for v in out.values())
+
+    def test_server_installs_engine_with_config_objectives(self):
+        import asyncio
+
+        from fleetflow_tpu.cp.server import ServerConfig, start
+
+        async def go():
+            handle = await start(ServerConfig(
+                slo={"placement-p99-ms": 50}))
+            try:
+                state = handle.state
+                assert state.slo is not None
+                assert get_engine() is state.slo
+                assert [o.name for o in state.slo.objectives] == \
+                    ["placement-p99-ms"]
+                # the status channel face
+                from fleetflow_tpu.cp.handlers import _health
+                h = _health(state)
+                out = await h(None, "slo.status", {})
+                assert out["enabled"] and len(out["objectives"]) == 1
+            finally:
+                await handle.stop()
+        asyncio.run(go())
