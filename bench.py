@@ -259,6 +259,14 @@ def main() -> None:
     if os.environ.get("BENCH_ADMISSION", "1").lower() not in ("0", "false"):
         admission = _admission_scenario()
 
+    # ---- tenant multiplexer (solver/multiplex.py): batched same-tier ----
+    # warm solves in ONE vmapped dispatch. The leg pins per-lane parity
+    # with the serial path and zero recompiles across the tier x K
+    # ladder; the amortized per-stage number sits next to the serial one.
+    mux = None
+    if os.environ.get("BENCH_MUX", "1").lower() not in ("0", "false"):
+        mux = _mux_scenario()
+
     # packed problem planes (ISSUE 13): the staged layout vs the
     # analytic model; BENCH_PACKED_ASSERT=1 fails the run on divergence
     # or on any recompile inside the warm churn loop
@@ -326,6 +334,7 @@ def main() -> None:
         "sharded": sharded,
         "pipeline": pipeline,
         "admission": admission,
+        "mux": mux,
         # the same registry GET /metrics serves, embedded so BENCH_*.json
         # artifacts carry the counters the endpoint would have shown for
         # this run (solve durations, sweeps, compiles, acceptance)
@@ -1715,6 +1724,200 @@ def _sharded_child() -> None:
     }))
 
 
+def _mux_scenario() -> dict:
+    """Run the tenant-multiplexer child in a subprocess: the leg owns its
+    own device stagings (a tier x K grid of resident problems) and pins
+    the disallow transfer guard around every batched dispatch, so it must
+    not share the parent's jax state."""
+    import subprocess
+    timeout = float(os.environ.get("BENCH_MUX_TIMEOUT", "1200"))
+    env = dict(os.environ, BENCH_MUX_CHILD="1",
+               FLEET_TRANSFER_GUARD=os.environ.get(
+                   "FLEET_TRANSFER_GUARD", "disallow"))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"mux child exceeded {timeout:.0f}s"}
+    if out.returncode != 0:
+        return {"ok": False,
+                "error": (out.stderr or out.stdout).strip()[-800:]}
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"ok": False, "error": "child printed no JSON"}
+
+
+def _mux_child() -> None:
+    """Batched same-tier warm solves (solver/multiplex.py): the tenant-
+    multiplexer leg. Builds a tier x K grid of resident-warm stagings,
+    warms every (tier statics, ladder-K) executable once, then measures
+    repeated batched dispatches across the WHOLE grid with compiles
+    watched: steady state must hold ZERO recompiles — fleet-count drift
+    rides the power-of-two lane ladder, never a fresh trace — while each
+    lane's result stays bit-identical to a serial resident-warm solve of
+    the same stage (BENCH_MUX_ASSERT=1 makes either fail the run — the
+    CI smoke contract). Reports stacked-dispatch p50/p99, the amortized
+    per-stage cost at the widest K vs the serial path, and the lane
+    census (stage/pad/serial).
+
+    Prints one JSON line."""
+    from fleetflow_tpu.platform import ensure_platform
+    ensure_platform(min_devices=1, probe_timeout=240.0)
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from fleetflow_tpu.lower import synthetic_problem
+    from fleetflow_tpu.obs.metrics import REGISTRY
+    from fleetflow_tpu.solver.api import _solve
+    from fleetflow_tpu.solver.multiplex import (MuxEntry, mux_cache_size,
+                                                mux_k, solve_multiplexed)
+    from fleetflow_tpu.solver.resident import ResidentProblem
+
+    small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
+    tiers = ((60, 12), (150, 24)) if small else ((900, 100), (2000, 200))
+    k_reqs = (2, 3, 5, 8)        # ladder buckets 2, 4, 8 via mux_k
+    steps = int(os.environ.get("BENCH_MUX_STEPS", "32" if small else "64"))
+    rounds = int(os.environ.get("BENCH_MUX_ROUNDS", "6" if small else "8"))
+    k_max = max(k_reqs)
+
+    def build(S, N, seed):
+        pt = synthetic_problem(S, N, seed=seed, port_fraction=0.3,
+                               volume_fraction=0.2)
+        rp = ResidentProblem(pt)
+        _solve(pt, prob=rp.prob, resident=rp, seed=seed, steps=steps)
+        return pt, rp
+
+    def mux_lane_census() -> dict:
+        ctr = REGISTRY.get("fleet_solver_mux_lanes_total")
+        if ctr is None:
+            return {}
+        return {k[0]: int(c[0]) for k, c in sorted(ctr._children.items())}
+
+    # ---- per-lane parity: mux vs serial on identical fresh stagings ----
+    # two independent builds of the same 3 stages; the serial pass and
+    # the batched pass must produce bit-identical assignments (and the
+    # same violation count) lane by lane
+    parity_lanes = 3
+    S0, N0 = tiers[0]
+    serial_ref = []
+    for i in range(parity_lanes):
+        pt, rp = build(S0, N0, seed=i)
+        r = _solve(pt, prob=rp.prob, resident=rp, resident_warm=True,
+                   seed=100 + i, steps=steps, bucket=rp.bucket)
+        serial_ref.append(r)
+    entries = []
+    for i in range(parity_lanes):
+        pt, rp = build(S0, N0, seed=i)
+        entries.append(MuxEntry(pt=pt, resident=rp, seed=100 + i))
+    mres = solve_multiplexed(entries, steps=steps)
+    parity_ok = all(
+        np.array_equal(serial_ref[i].assignment, mres[i].assignment)
+        and serial_ref[i].violations == mres[i].violations
+        and abs(serial_ref[i].soft - mres[i].soft) < 1e-9
+        for i in range(parity_lanes))
+
+    # ---- the grid: k_max stagings per tier, shared across rounds -------
+    grid = {}
+    for (S, N) in tiers:
+        grid[(S, N)] = [MuxEntry(pt=pt, resident=rp, seed=200 + i)
+                        for i, (pt, rp) in
+                        ((i, build(S, N, seed=i)) for i in range(k_max))]
+
+    # warm-up: one dispatch per (tier, requested K) — every ladder
+    # executable the measured window will touch compiles here
+    compiles_before_warm = mux_cache_size()
+    for (S, N), es in grid.items():
+        for k in k_reqs:
+            solve_multiplexed(es[:k], steps=steps)
+    warm_compiles = mux_cache_size() - compiles_before_warm
+
+    # measured window: the whole tier x K grid, repeatedly, zero
+    # recompiles and zero serial fallbacks allowed
+    census_before = mux_lane_census()
+    compiles_before = mux_cache_size()
+    times_ms: list[float] = []
+    widest_ms: list[float] = []
+    for _ in range(rounds):
+        for (S, N), es in grid.items():
+            for k in k_reqs:
+                t0 = _time.perf_counter()
+                solve_multiplexed(es[:k], steps=steps)
+                dt = (_time.perf_counter() - t0) * 1e3
+                times_ms.append(dt)
+                if k == k_max:
+                    widest_ms.append(dt / k)
+    compiles_measured = mux_cache_size() - compiles_before
+    census_after = mux_lane_census()
+    serial_measured = (census_after.get("serial", 0)
+                       - census_before.get("serial", 0))
+
+    # serial per-stage baseline at the widest tier for the amortization
+    # headline (same stagings, same steps, one dispatch per stage)
+    serial_ms: list[float] = []
+    es = grid[tiers[-1]]
+    for _ in range(max(2, rounds // 2)):
+        for e in es[:k_max]:
+            t0 = _time.perf_counter()
+            _solve(e.pt, resident=e.resident, resident_warm=True,
+                   seed=e.seed, steps=steps, bucket=e.resident.bucket)
+            serial_ms.append((_time.perf_counter() - t0) * 1e3)
+
+    p50 = float(np.percentile(times_ms, 50))
+    p99 = float(np.percentile(times_ms, 99))
+    per_stage_mux = float(np.percentile(widest_ms, 50))
+    per_stage_serial = float(np.percentile(serial_ms, 50))
+    result = {
+        "ok": True,
+        "backend": jax.default_backend(),
+        "tiers": [f"{S}x{N}" for S, N in tiers],
+        "k_ladder": sorted({mux_k(k) for k in k_reqs}),
+        "steps": steps,
+        "parity_ok": bool(parity_ok),
+        "parity_lanes": parity_lanes,
+        "warm_compiles": int(warm_compiles),
+        "dispatches": len(times_ms),
+        "compiles_measured": int(compiles_measured),
+        "serial_fallbacks_measured": int(serial_measured),
+        "dispatch_ms_p50": round(p50, 2),
+        "dispatch_ms_p99": round(p99, 2),
+        "dispatch_tail_ratio": round(p99 / max(p50, 1e-9), 2),
+        # the headline: one stage's share of the widest batched dispatch
+        # vs what the serial warm path pays for the same stage
+        "per_stage_ms_mux_k%d" % k_max: round(per_stage_mux, 2),
+        "per_stage_ms_serial": round(per_stage_serial, 2),
+        "amortized_speedup": round(
+            per_stage_serial / max(per_stage_mux, 1e-9), 2),
+        "lane_census": census_after,
+    }
+    if os.environ.get("BENCH_MUX_ASSERT", "").lower() in \
+            ("1", "true", "on", "yes"):
+        # the CI smoke contract: per-lane parity is exact, and a steady
+        # state that recompiles (or falls off the batched path) across
+        # the tier x K ladder is not a steady state
+        assert result["parity_ok"], f"mux/serial parity broke: {result}"
+        assert result["compiles_measured"] == 0, \
+            f"mux recompiled across the tier x K ladder: {result}"
+        assert result["serial_fallbacks_measured"] == 0, \
+            f"mux fell back to serial lanes mid-window: {result}"
+        assert result["dispatches"] > 0, f"no dispatches: {result}"
+        dflt = "6.0" if small else "3.0"
+        try:
+            bound = float(os.environ.get("BENCH_MUX_TAIL", dflt))
+        except ValueError:
+            bound = float(dflt)
+        assert result["dispatch_tail_ratio"] < bound, (
+            f"mux dispatch tail re-grew: p99/p50 "
+            f"{result['dispatch_tail_ratio']} >= {bound}: {result}")
+    print(json.dumps(result))
+
+
 def _admission_scenario() -> dict:
     """Run the streaming-admission child in a subprocess: the leg owns its
     own device staging (a 10kx1k resident problem) and pins its own env
@@ -2032,5 +2235,7 @@ if __name__ == "__main__":
         _pipeline_child()
     elif os.environ.get("BENCH_ADMISSION_CHILD"):
         _admission_child()
+    elif os.environ.get("BENCH_MUX_CHILD"):
+        _mux_child()
     else:
         main()

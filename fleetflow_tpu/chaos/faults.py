@@ -220,12 +220,17 @@ class AdmissionWave(Fault):
     arrivals: int = 0
     departures: int = 0
     burst: bool = False
+    # which stage stream the wave targets, by sorted index (clamped to
+    # the flow's stage count at apply time): multi-stage storms drive
+    # several different-size streaming problems through one controller
+    stage: int = 0
 
     def expand(self):
         return [(self.at, ADMIT, {"tenant": self.tenant,
                                   "arrivals": self.arrivals,
                                   "departures": self.departures,
-                                  "burst": self.burst})]
+                                  "burst": self.burst,
+                                  "stage": self.stage})]
 
 
 @dataclass
@@ -235,6 +240,9 @@ class FaultSchedule:
     seed: int
     faults: list[Fault] = field(default_factory=list)
     horizon: float = 0.0       # virtual end-of-scenario settle point
+    # per-tenant hard admission caps (cp/admission.py tenant_caps) the
+    # runner wires into the world's AdmissionConfig; empty = uncapped
+    tenant_caps: dict[str, int] = field(default_factory=dict)
 
     def events(self) -> list[tuple[float, str, dict]]:
         """Expanded primitive timeline, stably sorted by time (ties keep

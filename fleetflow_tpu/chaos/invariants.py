@@ -23,7 +23,7 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "containers_converged", "metrics_monotonic",
            "agents_gauge_consistent", "selfheal_converged",
            "cp_failover_converged", "admission_fair",
-           "admission_converged", "slo_met"]
+           "admission_converged", "admission_quota", "slo_met"]
 
 _EPS = 1e-6
 
@@ -318,6 +318,34 @@ def admission_converged(world, snapshot=None) -> list[str]:
     return out
 
 
+def admission_quota(world) -> list[str]:
+    """Hard tenant quotas (cp/admission.py tenant_caps, tenant-storm
+    scenario): after settle, no capped tenant holds more LIVE streamed
+    services than its cap, and every quota-parked request belongs to a
+    tenant that actually has a cap. Uses the same owner census the
+    controller enforces with — a failover that rebuilt the streams must
+    still respect the caps it restored from the journal."""
+    ctrl = getattr(world.state, "admission", None)
+    caps = dict(getattr(world, "tenant_caps", {}) or {})
+    if ctrl is None or not caps:
+        return []
+    out: list[str] = []
+    live: dict[str, int] = {}
+    for stream in getattr(ctrl, "_streams", {}).values():
+        for owner in stream.owner.values():
+            live[owner] = live.get(owner, 0) + 1
+    for tenant, cap in sorted(caps.items()):
+        if live.get(tenant, 0) > int(cap):
+            out.append(f"tenant {tenant} holds {live[tenant]} live "
+                       f"streamed services over its hard cap {cap}")
+    for r in getattr(ctrl, "_parked", ()):
+        if getattr(r, "park_reason", None) == "quota" \
+                and r.tenant not in caps:
+            out.append(f"request {r.id} quota-parked but tenant "
+                       f"{r.tenant} has no cap")
+    return out
+
+
 def slo_met(world) -> list[str]:
     """The SLO invariant (ROADMAP item 4: "SLO invariants instead of
     only safety invariants"): every objective the world's rolling SLO
@@ -396,6 +424,7 @@ FINAL_INVARIANTS = {
     "cp-failover-converged": cp_failover_converged,
     "admission-fair": admission_fair,
     "admission-converged": admission_converged,
+    "admission-quota": admission_quota,
     "slo-met": slo_met,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,

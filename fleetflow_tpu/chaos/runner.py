@@ -284,11 +284,15 @@ class ChaosWorld:
     def __init__(self, flow: Flow, injector: FaultInjector,
                  clock: VirtualClock, pool_min: int = 0, seed: int = 0,
                  replicated: bool = False,
-                 store_dir: Optional[Path] = None):
+                 store_dir: Optional[Path] = None,
+                 tenant_caps: Optional[dict] = None):
         self.flow = flow
         self.clock = clock
         self.injector = injector
         self._seed = seed
+        # per-tenant hard admission caps (tenant-storm scenario); wired
+        # into every AdmissionConfig this world builds, failovers included
+        self.tenant_caps = dict(tenant_caps or {})
         injector.clock = clock
         injector.on_fire = lambda kind, target: self.log(
             "fault-fired", kind=kind, target=target)
@@ -356,12 +360,16 @@ class ChaosWorld:
         # streaming admission on the virtual clock (cp/admission.py):
         # batch_max/quantum sized so an arrival storm actually QUEUES
         # (fairness is only observable when drain capacity is contended)
+        # parked arrivals journal into the world's store (replicated
+        # worlds ship it to the standby), so a primary kill mid-storm
+        # restores accepted-but-deferred work on the promoted CP
         state.admission = AdmissionController(
-            state.placement, clock=self.clock.now,
+            state.placement, clock=self.clock.now, store=store,
             config=AdmissionConfig(batch_max=8, quantum=4.0,
                                    max_queue=512, shed_age_s=240.0,
                                    pressure_age_s=20.0,
-                                   pressure_sustain_s=40.0))
+                                   pressure_sustain_s=40.0,
+                                   tenant_caps=dict(self.tenant_caps)))
         # rolling SLO engine on the VIRTUAL clock, installed as the
         # process default so the placement/admission/reconverge
         # observation points feed it; the slo-met FINAL invariant reads
@@ -420,14 +428,18 @@ class ChaosWorld:
     # -- streaming admission (arrival-storm scenario) ----------------------
 
     def admit_wave(self, tenant: str, arrivals: int, departures: int,
-                   burst: bool = False) -> None:
+                   burst: bool = False, stage: int = 0) -> None:
         """One tenant's wave: submit `arrivals` fresh streamed services
         (tiny, eligibility-free — the delta-path shape) and depart the
         tenant's oldest live ones. Deterministic: names come from a
         per-tenant counter, demand from the world's seeded rng, and the
-        outcome (accepted vs shed) lands in the causal event log."""
+        outcome (accepted vs shed) lands in the causal event log.
+        `stage` picks the target stream by sorted index (clamped), so a
+        multi-stage storm drives several different-size streaming
+        problems through one controller."""
         ctrl = self.state.admission
-        stage_name = sorted(self.flow.stages)[0]
+        stages_sorted = sorted(self.flow.stages)
+        stage_name = stages_sorted[min(max(stage, 0), len(stages_sorted) - 1)]
         key = f"{self.flow.name}/{stage_name}"
         if burst:
             self.admission_burst_tenants.add(tenant)
@@ -631,7 +643,8 @@ class _Runner:
         self.world = ChaosWorld(
             flow, FaultInjector(), clock, pool_min=pool_min,
             seed=schedule.seed, replicated=replicated,
-            store_dir=Path(self._tmp.name) if self._tmp else None)
+            store_dir=Path(self._tmp.name) if self._tmp else None,
+            tenant_caps=getattr(schedule, "tenant_caps", {}))
         self.dirty: set[str] = set()     # stage names needing redeploy
         self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
                       "resolves": 0, "restarts": 0, "scale_actions": 0,
@@ -753,7 +766,7 @@ class _Runner:
                 self.stats["failovers"] += 1
             elif op == F.ADMIT:
                 w.admit_wave(p["tenant"], p["arrivals"], p["departures"],
-                             p.get("burst", False))
+                             p.get("burst", False), p.get("stage", 0))
             elif op == F.REDEPLOY:
                 w.log("redeploy-requested", stage=p["stage"])
                 self.dirty.add(p["stage"])

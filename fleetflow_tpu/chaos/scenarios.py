@@ -219,6 +219,49 @@ def _arrival_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
     return FaultSchedule("arrival-storm", seed, faults, horizon=horizon)
 
 
+def _tenant_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
+    """Hard-quota storm across MULTIPLE stage streams with a primary
+    kill in the middle. `team-cap` carries a hard cap of 6 and floods
+    well past it: the overflow must PARK with the quota reason —
+    accepted and journaled, never shed — while two uncapped tenants
+    stream normally on rotating stages. Mid-storm the CP PRIMARY dies
+    with quota parks outstanding; the promoted standby must restore the
+    journaled parked arrivals and place them as the capped tenant's
+    drain-phase departures free headroom (admission-quota +
+    admission-converged + slo-met judged)."""
+    rng = random.Random(seed)
+    faults: list = []
+    t = 20.0
+    i = 0
+    while t < 300.0:
+        for j, tenant in enumerate(("team-cap", "team-d", "team-e")):
+            stage = (i + j) % 3   # clamped to the flow's stage count
+            if tenant == "team-cap":
+                # flood phase: pile up quota parks; drain phase: pure
+                # departures so headroom frees and the parks place
+                n, dep = (2, 0) if t < 140.0 else (0, 1)
+            else:
+                n = rng.choice((1, 1, 2))
+                dep = rng.choice((0, 1)) if t >= 60.0 else 0
+            if n or dep:
+                faults.append(AdmissionWave(at=t, tenant=tenant,
+                                            arrivals=n, departures=dep,
+                                            stage=stage))
+        i += 1
+        t += 10.0
+    # die while the capped tenant's overflow is parked: the journaled
+    # parked arrivals (admission_parked table) ride the replication
+    # stream and must be restored by the promoted CP
+    faults.append(PrimaryKill(at=145.0, phase="burst"))
+    horizon = t + 300.0
+    tick = 15.0
+    while tick < horizon:
+        faults.append(Tick(at=tick))
+        tick += 15.0
+    return FaultSchedule("tenant-storm", seed, faults, horizon=horizon,
+                         tenant_caps={"team-cap": 6})
+
+
 SCENARIOS: dict[str, tuple[Callable, str]] = {
     "rolling-kill": (_rolling_kill,
                      "serial node kills with revival + a pool worker "
@@ -246,6 +289,12 @@ SCENARIOS: dict[str, tuple[Callable, str]] = {
                       "continuous arrivals/departures through streaming "
                       "admission with one tenant bursting 10x its weight "
                       "— DRR fairness + completeness judged"),
+    "tenant-storm": (_tenant_storm,
+                     "hard-quota storm over rotating stage streams: a "
+                     "capped tenant floods past its quota (overflow "
+                     "parks, journaled) and the CP primary dies with "
+                     "parks outstanding — the promoted standby must "
+                     "restore and place them"),
 }
 
 

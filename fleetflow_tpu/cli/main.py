@@ -758,11 +758,14 @@ def _audit_hygiene(args) -> int:
 
 def cmd_solve(args) -> int:
     """TPU placement preview (no reference analog); the `trace` verb
-    renders the solver flight deck instead of solving. A stage literally
-    named "trace" stays reachable via `fleet solve -s trace` (the -s
-    flag always means a stage)."""
+    renders the solver flight deck instead of solving, the `slots` verb
+    shows the device slot manager's residency. A stage literally named
+    "trace" or "slots" stays reachable via `fleet solve -s <stage>` (the
+    -s flag always means a stage)."""
     if args.stage == "trace" and not getattr(args, "stage_flag", None):
         return cmd_solve_trace(args)
+    if args.stage == "slots" and not getattr(args, "stage_flag", None):
+        return cmd_solve_slots(args)
     flow = _load(args)
     stage_name = _stage(args)
     stage_obj = flow.stage(stage_name)
@@ -787,6 +790,38 @@ def cmd_solve(args) -> int:
         for node in sorted(by_node):
             print(f"  {node}: {', '.join(sorted(by_node[node]))}")
     return 0 if placement.feasible else 1
+
+
+def cmd_solve_slots(args) -> int:
+    """`fleet solve slots`: the device slot manager's residency table
+    (sched/tpu.py) — which stages hold device-resident problems, their
+    bytes against the FLEET_RESIDENT_BYTES budget, idle age and eviction
+    counts, and which evicted stages kept a warm re-admission snapshot."""
+    with CpClient(args.cp) as cp:
+        out = cp.request("health", "solver.slots")
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+            return 0
+        budget = out.get("budget_bytes", 0)
+        used = out.get("resident_bytes", 0)
+        print(f"resident {used / 2**20:.1f} MiB / "
+              f"{budget / 2**20:.1f} MiB budget, "
+              f"{len(out.get('slots', []))}/{out.get('max_slots', 0)} "
+              f"slots")
+        for s in out.get("slots", []):
+            warm = "warm" if s.get("warm") else "cold"
+            print(f"  {s['stage']:<28} tier={s['tier']:<10} "
+                  f"{s['bytes'] / 2**20:>8.2f} MiB "
+                  f"idle={s['idle_s']:>8.1f}s "
+                  f"evictions={s['evictions']:<3} {warm}")
+        evicted = out.get("evicted", [])
+        if evicted:
+            print("evicted (host snapshots, warm-seed on re-admission):")
+            for e in evicted:
+                snap = "snapshot" if e.get("snapshot") else "seed-only"
+                print(f"  {e['stage']:<28} S={e['S']:<6} "
+                      f"evictions={e['evictions']:<3} {snap}")
+        return 0
 
 
 def cmd_solve_trace(args) -> int:
@@ -974,9 +1009,11 @@ def cmd_admit(args) -> int:
         if not out.get("enabled", False):
             print("streaming admission is disabled on this CP")
             return 1
+        quota = out.get("parked_quota", 0)
         print(f"queued={out['queue_depth']} "
               f"oldest={out['oldest_age_s']:.1f}s "
-              f"parked={out['parked']}")
+              f"parked={out['parked']}"
+              + (f" (quota={quota})" if quota else ""))
         pres = out.get("pressure", {})
         since = pres.get("since_s")
         print(f"pressure: {'SUSTAINED' if pres.get('sustained') else 'ok'}"
@@ -986,9 +1023,15 @@ def cmd_admit(args) -> int:
             if t.get("wait_p50_s") is not None:
                 waits = (f" wait p50={t['wait_p50_s']:.3f}s "
                          f"p99={t['wait_p99_s']:.3f}s")
+            cap = t.get("cap")
+            usage = (f" usage={t.get('usage', 0)}/{cap}"
+                     + (f" quota_parked={t['parked_quota']}"
+                        if t.get("parked_quota") else "")
+                     if cap is not None else "")
             print(f"  {tenant:<16} queued={t['queued']:<5} "
                   f"oldest={t['oldest_age_s']:>7.1f}s "
-                  f"weight={t['weight']:g} debt={t['deficit']:.1f}{waits}")
+                  f"weight={t['weight']:g} debt={t['deficit']:.1f}"
+                  f"{usage}{waits}")
         for key, s in sorted(out.get("streams", {}).items()):
             print(f"  stream {key}: rows={s['rows']} "
                   f"live_streamed={s['live_streamed']} "
@@ -999,6 +1042,7 @@ def cmd_admit(args) -> int:
               f"departed={st.get('departed', 0)} "
               f"sheds={st.get('sheds', 0)} parked={st.get('parked', 0)} "
               f"unparked={st.get('unparked', 0)} "
+              f"quota_parked={st.get('quota_parked', 0)} "
               f"solves={st.get('solves', 0)} "
               f"compactions={st.get('compactions', 0)}")
         if out.get("solve_ms_p50") is not None:
@@ -1764,12 +1808,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("solve", help="TPU placement preview; "
                        "`fleet solve trace` renders the in-dispatch "
-                       "flight-deck telemetry of the last N solves "
-                       "(docs/guide/10, solver flight deck; a stage "
-                       "named 'trace' stays reachable via -s)")
+                       "flight-deck telemetry of the last N solves, "
+                       "`fleet solve slots` the device slot manager's "
+                       "residency table (docs/guide/10+16; a stage "
+                       "named 'trace'/'slots' stays reachable via -s)")
     stage_args(p)
     p.add_argument("--host", action="store_true", help="force host greedy")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--cp", help="CP endpoint host:port (`slots` only)")
     p.add_argument("--trace-file",
                    help="flight-recorder file (default: FLEET_TRACE_FILE;"
                         " `fleet solve trace` only)")

@@ -130,6 +130,11 @@ _M_PARKED = REGISTRY.counter(
 _M_UNPARKED = REGISTRY.counter(
     "fleet_admission_unparked_total",
     "Parked arrivals re-queued after capacity freed up")
+_M_QUOTA_PARKED = REGISTRY.counter(
+    "fleet_admission_quota_parked_total",
+    "Arrivals parked by a per-tenant hard quota cap, by tenant (accepted "
+    "but deferred until the tenant's live+queued count drops under its cap)",
+    labels=("tenant",))
 _M_SOLVES = REGISTRY.counter(
     "fleet_admission_solves_total",
     "Admission micro-solves, by outcome",
@@ -177,6 +182,12 @@ class AdmissionConfig:
     batch_max: int = 128         # events per micro-solve (delta scatter tier)
     quantum: float = 8.0         # DRR credit per unit weight per visit
     tenant_weights: dict[str, float] = field(default_factory=dict)
+    # per-tenant HARD caps on streamed arrivals: live + queued + parked
+    # may never exceed the cap. Overflow arrivals PARK with reason
+    # "quota" (accepted, deferred — not shed: the client did nothing
+    # wrong, the tenant is at its purchased ceiling) and re-queue only
+    # when departures open headroom. Absent tenant = uncapped.
+    tenant_caps: dict[str, int] = field(default_factory=dict)
     # autoscaler feedback: queue age that counts as solver pressure, and
     # how long it must persist before the autoscaler provisions on it
     pressure_age_s: float = 5.0
@@ -203,6 +214,10 @@ class AdmissionRequest:
     eligible_nodes: Optional[list[str]] = None
     state: str = "queued"
     done_at: Optional[float] = None
+    # why a parked request is parked: capacity (infeasible micro-solve),
+    # depth (on_full="park" policy), quota (tenant hard cap). Drives the
+    # retry policy: quota parks wait for tenant headroom, not capacity
+    park_reason: Optional[str] = None
 
     TERMINAL = frozenset({"placed", "departed", "parked", "shed",
                           "cancelled"})
@@ -250,10 +265,14 @@ class AdmissionController:
     wait is exact arithmetic on whichever clock drives the world."""
 
     def __init__(self, placement, *, clock: Callable[[], float] = time.monotonic,
-                 config: Optional[AdmissionConfig] = None):
+                 config: Optional[AdmissionConfig] = None, store=None):
         self.placement = placement
         self.clock = clock
         self.cfg = config or AdmissionConfig()
+        # journal parked arrivals into this cp/store.py Store (table
+        # "admission_parked") so accepted-but-deferred work replicates to
+        # standbys and survives a CP failover; None = in-memory only
+        self._store = store
         self._lock = threading.Lock()
         self._queues: dict[str, deque[AdmissionRequest]] = {}
         self._deficit: dict[str, float] = {}
@@ -276,11 +295,13 @@ class AdmissionController:
         # time (stale by at most one drain tick)
         self._pressure_snapshot: dict = {"queue_depth": 0,
                                          "oldest_age_s": 0.0, "parked": 0,
+                                         "parked_quota": 0,
                                          "sustained": False,
                                          "drained": True}
         self.stats = {"admitted": 0, "departed": 0, "sheds": 0,
                       "parked": 0, "unparked": 0, "solves": 0,
-                      "compactions": 0, "batches": 0}
+                      "compactions": 0, "batches": 0, "quota_parked": 0,
+                      "restored": 0}
         # wall-ms of the most recent drain pass, by phase (drain / fold /
         # solve / commit) — surfaced through deploy.admit_status so a
         # p99 solve tail can be attributed to a phase without a profiler
@@ -291,6 +312,92 @@ class AdmissionController:
         # so a re-grown tail fails CI instead of hiding in an average
         self.solve_ms_samples: deque[float] = deque(maxlen=4096)
         self._task = None
+        self._restore_parked()
+
+    # ------------------------------------------------------------------
+    # parked-arrival journal (store table "admission_parked")
+    # ------------------------------------------------------------------
+
+    def _journal_park(self, r: AdmissionRequest, reason: str) -> None:
+        """Persist a park transition. create() overwrites by id, so a
+        re-park of a retried arrival just refreshes its record."""
+        r.park_reason = reason
+        if self._store is None or r.service is None:
+            return
+        from .models import ParkedArrival
+        svc = r.service
+        spec = {"name": svc.name, "image": svc.image,
+                "version": svc.version, "cpu": svc.resources.cpu,
+                "memory": svc.resources.memory, "disk": svc.resources.disk,
+                "labels": dict(svc.labels or {})}
+        self._store.create("admission_parked", ParkedArrival(
+            id=r.id, tenant=r.tenant, name=r.name, stage_key=r.stage_key,
+            submitted_at=r.submitted_at, seq=r.seq, reason=reason,
+            spec=spec, eligible_nodes=list(r.eligible_nodes or [])))
+
+    def _unjournal_park(self, r: AdmissionRequest) -> None:
+        """A parked arrival re-queued or went terminal: drop its record
+        (idempotent — restores and in-memory controllers both land here)."""
+        if self._store is not None:
+            self._store.delete("admission_parked", r.id)
+
+    def _restore_parked(self) -> None:
+        """Rebuild the parked set from the journal (CP failover/restart):
+        the promoted primary re-parks what the dead one accepted. Restored
+        requests keep their original seq so retry order is preserved, and
+        the id/seq counters advance past them so new submits cannot
+        collide. They retry as soon as capacity first moves — exactly the
+        contract they parked under."""
+        if self._store is None:
+            return
+        rows = self._store.list("admission_parked")
+        if not rows:
+            return
+        max_seq = max_id = 0
+        for rec in sorted(rows, key=lambda rec: rec.seq):
+            svc = self.make_arrival(dict(rec.spec))
+            r = AdmissionRequest(
+                id=rec.id, tenant=rec.tenant, kind="arrival", name=rec.name,
+                stage_key=rec.stage_key, submitted_at=rec.submitted_at,
+                seq=rec.seq, service=svc,
+                demand=np.array(svc.resources.as_tuple(), dtype=np.float64),
+                eligible_nodes=list(rec.eligible_nodes) or None,
+                state="parked", park_reason=rec.reason or "capacity")
+            self._parked.append(r)
+            self.requests[r.id] = r
+            max_seq = max(max_seq, int(rec.seq))
+            try:
+                max_id = max(max_id, int(str(rec.id).rsplit("_", 1)[1]))
+            except (IndexError, ValueError):
+                pass
+        self._ids = itertools.count(max_id + 1)
+        self._seq = itertools.count(max_seq + 1)
+        self.stats["restored"] += len(rows)
+        log.info("admission parked restored %s",
+                 kv(restored=len(rows), max_seq=max_seq))
+
+    # ------------------------------------------------------------------
+    # per-tenant hard quota caps
+    # ------------------------------------------------------------------
+
+    def _tenant_inflight(self, tenant: str) -> int:
+        """Streamed services a cap must count: live + queued arrivals +
+        parked arrivals. Departures never count — they only free."""
+        live = sum(1 for s in self._streams.values()
+                   for t in s.owner.values() if t == tenant)
+        queued = sum(1 for r in (self._queues.get(tenant) or ())
+                     if r.kind == "arrival")
+        parked = sum(1 for r in self._parked
+                     if r.tenant == tenant and r.kind == "arrival")
+        return live + queued + parked
+
+    def _quota_headroom(self, tenant: str) -> Optional[int]:
+        """Remaining arrivals the tenant's hard cap admits right now
+        (None = uncapped; may be negative when departures lag)."""
+        cap = self.cfg.tenant_caps.get(tenant)
+        if cap is None:
+            return None
+        return int(cap) - self._tenant_inflight(tenant)
 
     # ------------------------------------------------------------------
     # stage attachment
@@ -444,6 +551,19 @@ class AdmissionController:
                         f"parked service in {stream.key}")
                 deps.append(name)
 
+            # tenant hard quota (policy, not backpressure): arrivals past
+            # the cap's headroom PARK with reason "quota" — accepted and
+            # journaled, deferred until this tenant's own departures open
+            # headroom. Split BEFORE the depth watermark so a capped
+            # tenant's overflow never occupies (or sheds against) the
+            # shared queue bound
+            quota_overflow: list[Service] = []
+            headroom = self._quota_headroom(tenant)
+            if headroom is not None and svcs and len(svcs) > max(headroom, 0):
+                keep = max(headroom, 0)
+                quota_overflow = svcs[keep:]
+                svcs = svcs[:keep]
+
             # depth watermark (backpressure). Pure-departure submits are
             # exempt: they only ever FREE capacity — refusing them at a
             # full queue would turn transient backpressure into a stall
@@ -453,21 +573,29 @@ class AdmissionController:
             incoming = len(svcs) + len(deps)
             if svcs and depth + incoming > self.cfg.max_queue:
                 if self.cfg.on_full == "park":
-                    return self._park_on_full(stream, tenant, svcs, deps,
-                                              now)
-                _M_SHEDS.inc(len(svcs), reason="depth")
-                self.stats["sheds"] += len(svcs)
-                raise AdmissionRejected(
-                    f"queue depth {depth}+{incoming} exceeds "
-                    f"{self.cfg.max_queue}", reason="queue-depth",
-                    retry_after_s=max(self.cfg.drain_interval_s * 2, 1.0))
-
-            accepted = self._enqueue(stream, tenant, svcs, deps, now)
+                    result = self._park_on_full(stream, tenant, svcs, deps,
+                                                now)
+                else:
+                    _M_SHEDS.inc(len(svcs), reason="depth")
+                    self.stats["sheds"] += len(svcs)
+                    raise AdmissionRejected(
+                        f"queue depth {depth}+{incoming} exceeds "
+                        f"{self.cfg.max_queue}", reason="queue-depth",
+                        retry_after_s=max(self.cfg.drain_interval_s * 2,
+                                          1.0))
+            else:
+                accepted = self._enqueue(stream, tenant, svcs, deps, now)
+                result = {"accepted": accepted,
+                          "queued": depth + incoming,
+                          "stage": stream.key}
+            if quota_overflow:
+                ids = self._park_quota(stream, tenant, quota_overflow, now)
+                result["accepted"] = list(result["accepted"]) + ids
+                result["parked"] = result.get("parked", 0) + len(ids)
+                result["quota_parked"] = len(ids)
             self._update_pressure(now)
             self._set_queue_gauges(now)
-            return {"accepted": accepted,
-                    "queued": depth + incoming,
-                    "stage": stream.key}
+            return result
 
     def _enqueue(self, stream: _Stream, tenant: str, svcs: list[Service],
                  deps: list[str], now: float) -> list[str]:
@@ -511,6 +639,7 @@ class AdmissionController:
                 state="parked")
             self.requests[r.id] = r
             self._parked.append(r)
+            self._journal_park(r, "depth")
             accepted.append(r.id)
         n = len(svcs)
         if n:
@@ -520,6 +649,33 @@ class AdmissionController:
         self._set_queue_gauges(now)
         return {"accepted": accepted, "queued": len(svcs) + len(deps),
                 "stage": stream.key, "parked": n}
+
+    def _park_quota(self, stream: _Stream, tenant: str,
+                    svcs: list[Service], now: float) -> list[str]:
+        """Park arrivals a tenant hard cap refused headroom for. Accepted
+        (ids returned, journaled) but deferred: they re-queue only once
+        the tenant's own live+queued count drops under its cap."""
+        ids = []
+        for svc in svcs:
+            r = AdmissionRequest(
+                id=f"adm_{next(self._ids)}", tenant=tenant, kind="arrival",
+                name=svc.name, stage_key=stream.key, submitted_at=now,
+                seq=next(self._seq), service=svc,
+                demand=np.array(svc.resources.as_tuple(), dtype=np.float64),
+                state="parked")
+            self.requests[r.id] = r
+            self._parked.append(r)
+            self._journal_park(r, "quota")
+            ids.append(r.id)
+        n = len(svcs)
+        _M_PARKED.inc(n)
+        _M_QUOTA_PARKED.inc(n, tenant=tenant)
+        self.stats["parked"] += n
+        self.stats["quota_parked"] += n
+        log.info("admission quota parked %s", kv(
+            tenant=tenant, arrivals=n,
+            cap=self.cfg.tenant_caps.get(tenant)))
+        return ids
 
     # ------------------------------------------------------------------
     # deficit round robin (weighted tenant fairness)
@@ -635,7 +791,10 @@ class AdmissionController:
             q = self._queues[tenant]
             keep: deque[AdmissionRequest] = deque()
             for r in q:
-                if (r.kind == "arrival"
+                # quota-marked arrivals are exempt: their age is the cap
+                # wait the controller itself imposed when it ACCEPTED
+                # them — shedding them on requeue would betray that
+                if (r.kind == "arrival" and r.park_reason != "quota"
                         and now - r.submitted_at > self.cfg.shed_age_s):
                     r.state, r.done_at = "shed", now
                     _M_SHEDS.inc(reason="age")
@@ -648,22 +807,52 @@ class AdmissionController:
         """Parked arrivals re-queue (front, original order) once capacity
         has plausibly moved: a departure committed or a stream resynced
         since the park. Epoch-gated so an infeasible arrival cannot
-        hot-loop a solve every drain pass."""
+        hot-loop a solve every drain pass. Quota parks additionally need
+        tenant HEADROOM — a capacity epoch bump from some other tenant's
+        departure must not tunnel a capped tenant past its cap — and a
+        request whose stage is not (yet) re-attached stays parked, so a
+        freshly promoted CP cannot KeyError a restored arrival."""
         if not self._parked or self._park_epoch == self._capacity_epoch:
             return
         self._park_epoch = self._capacity_epoch
         parked, self._parked = self._parked, []
-        for r in sorted(parked, key=lambda r: r.seq, reverse=True):
+        # headroom with the parked set swapped OUT: cap - (live + queued).
+        # Every arrival we keep or requeue re-occupies one slot below.
+        headroom: dict[str, Optional[int]] = {
+            t: self._quota_headroom(t)
+            for t in {r.tenant for r in parked}}
+        requeue: list[AdmissionRequest] = []
+        for r in sorted(parked, key=lambda r: r.seq):
+            if r.stage_key not in self._streams:
+                self._parked.append(r)
+                if headroom.get(r.tenant) is not None:
+                    headroom[r.tenant] -= 1
+                continue
+            h = headroom.get(r.tenant)
+            if r.park_reason == "quota" and h is not None and h <= 0:
+                self._parked.append(r)
+                continue
+            if h is not None:
+                headroom[r.tenant] = h - 1
+            requeue.append(r)
+        for r in sorted(requeue, key=lambda r: r.seq, reverse=True):
             r.state = "queued"
+            # a quota park KEEPS its marker through the requeue: its wait
+            # includes policy-imposed cap time, which must not pollute
+            # the fairness/SLO wait surfaces when it finally places
+            if r.park_reason != "quota":
+                r.park_reason = None
+            self._unjournal_park(r)
             q = self._queues.get(r.tenant)
             if q is None:
                 q = self._queues[r.tenant] = deque()
                 self._deficit[r.tenant] = 0.0
                 self._rr.append(r.tenant)
             q.appendleft(r)
-        n = len(parked)
-        _M_UNPARKED.inc(n)
-        self.stats["unparked"] += n
+        n = len(requeue)
+        if n:
+            _M_UNPARKED.inc(n)
+            self.stats["unparked"] += n
 
     # ------------------------------------------------------------------
     # folding a batch into the streaming problem
@@ -870,6 +1059,7 @@ class AdmissionController:
                 if parked is not None:
                     self._parked.remove(parked)
                     parked.state, parked.done_at = "cancelled", now
+                    self._unjournal_park(parked)
                     r.state, r.done_at = "departed", now
                     out["departed"].append(r.name)
                 elif any(q2.name == r.name and q2.kind == "arrival"
@@ -937,6 +1127,7 @@ class AdmissionController:
         for r in arrivals:
             r.state = "parked"
             self._parked.append(r)
+            self._journal_park(r, "capacity")
         if arrivals:
             _M_PARKED.inc(len(arrivals))
             self.stats["parked"] += len(arrivals)
@@ -1012,14 +1203,19 @@ class AdmissionController:
                 stream.flow.services[r.name] = r.service
                 stage.services.append(r.name)
                 _M_ADMITTED.inc(tenant=r.tenant)
-                _M_WAIT.observe(now - r.submitted_at)
                 self.stats["admitted"] += 1
-                samples = self.wait_samples.setdefault(
-                    r.tenant, deque(maxlen=4096))
-                samples.append(now - r.submitted_at)
-                # admission-wait SLO stream: submit → committed placement
-                # on the engine's clock (virtual under chaos)
-                slo_observe("admission_wait_s", now - r.submitted_at)
+                if r.park_reason != "quota":
+                    # quota-parked waits are policy (the tenant sat at
+                    # its purchased cap), not scheduler service time —
+                    # they must not pollute the fairness percentiles or
+                    # the admission-wait SLO stream
+                    _M_WAIT.observe(now - r.submitted_at)
+                    samples = self.wait_samples.setdefault(
+                        r.tenant, deque(maxlen=4096))
+                    samples.append(now - r.submitted_at)
+                    # admission-wait SLO stream: submit → committed
+                    # placement on the engine's clock (virtual in chaos)
+                    slo_observe("admission_wait_s", now - r.submitted_at)
                 out["placed"].append(r.name)
             else:
                 r.state, r.done_at = "departed", now
@@ -1042,8 +1238,13 @@ class AdmissionController:
 
     def _update_pressure(self, now: float) -> None:
         depth, oldest = self._queue_ages(now)
+        # quota parks are EXCLUDED from pressure: provisioning nodes
+        # cannot raise a tenant's purchased cap, so counting them would
+        # hold the autoscaler hot (and block idle scale-down) forever
+        hard_parked = sum(1 for r in self._parked
+                          if r.park_reason != "quota")
         hot = (depth > 0 and oldest >= self.cfg.pressure_age_s) \
-            or bool(self._parked)
+            or bool(hard_parked)
         if hot:
             if self._pressure_since is None:
                 self._pressure_since = now
@@ -1053,10 +1254,11 @@ class AdmissionController:
             "queue_depth": depth,
             "oldest_age_s": round(oldest, 3),
             "parked": len(self._parked),
+            "parked_quota": len(self._parked) - hard_parked,
             "sustained": (self._pressure_since is not None
                           and now - self._pressure_since
                           >= self.cfg.pressure_sustain_s),
-            "drained": depth == 0 and not self._parked}
+            "drained": depth == 0 and hard_parked == 0}
 
     def _set_queue_gauges(self, now: float) -> None:
         depth, oldest = self._queue_ages(now)
@@ -1104,9 +1306,12 @@ class AdmissionController:
             now = self.clock()
             depth, oldest = self._queue_ages(now)
             tenants = {}
-            for tenant in sorted(set(self._rr) | set(self.wait_samples)):
+            for tenant in sorted(set(self._rr) | set(self.wait_samples)
+                                 | set(self.cfg.tenant_caps)
+                                 | {r.tenant for r in self._parked}):
                 q = self._queues.get(tenant) or ()
                 waits = self.wait_samples.get(tenant) or ()
+                cap = self.cfg.tenant_caps.get(tenant)
                 tenants[tenant] = {
                     "queued": len(q),
                     "oldest_age_s": round(now - q[0].submitted_at, 3)
@@ -1117,6 +1322,16 @@ class AdmissionController:
                         list(waits), 50)), 3) if waits else None,
                     "wait_p99_s": round(float(np.percentile(
                         list(waits), 99)), 3) if waits else None,
+                    # hard-quota surface (`fleet admit status`): usage is
+                    # everything the cap counts — live + queued + parked
+                    "live": sum(1 for s in self._streams.values()
+                                for t in s.owner.values() if t == tenant),
+                    "usage": self._tenant_inflight(tenant),
+                    "cap": cap,
+                    "parked_quota": sum(
+                        1 for r in self._parked
+                        if r.tenant == tenant
+                        and r.park_reason == "quota"),
                 }
             streams = {key: {"rows": s.pt.S,
                              "live_streamed": len(s.streamed),
@@ -1127,6 +1342,8 @@ class AdmissionController:
                     "queue_depth": depth,
                     "oldest_age_s": round(oldest, 3),
                     "parked": len(self._parked),
+                    "parked_quota": sum(1 for r in self._parked
+                                        if r.park_reason == "quota"),
                     "tenants": tenants,
                     "streams": streams,
                     "pressure": {
@@ -1158,7 +1375,8 @@ class AdmissionController:
                                "on_full": self.cfg.on_full,
                                "batch_max": self.cfg.batch_max,
                                "quantum": self.cfg.quantum,
-                               "weights": dict(self.cfg.tenant_weights)}}
+                               "weights": dict(self.cfg.tenant_weights),
+                               "tenant_caps": dict(self.cfg.tenant_caps)}}
 
     # ------------------------------------------------------------------
     # background drain loop (production; chaos/bench call step() directly)

@@ -556,6 +556,11 @@ def _health(state: "AppState"):
             if state.slo is None:
                 return {"enabled": False}
             return state.slo.status()
+        if method == "solver.slots":
+            # device slot-manager occupancy (sched/tpu.py): which stages
+            # are resident, their bytes against the budget, and what was
+            # evicted with a warm snapshot — `fleet solve slots`
+            return {"enabled": True, **state.placement.solver_slots()}
         if method == "heal.status":
             # self-healing introspection (`fleet cp heal status`): lease
             # table, pending/parked convergence work, pass counters —

@@ -263,6 +263,27 @@ class ParkedWork(Record):
 
 
 @dataclass
+class ParkedArrival(Record):
+    """A parked ADMISSION arrival (cp/admission.py): accepted by submit()
+    but deferred — an infeasible micro-solve, the park-on-full depth
+    policy, or a per-tenant hard quota cap. Journaled so accepted-but-
+    deferred work survives a CP failover: the promoted primary re-parks
+    these from the replicated store instead of silently forgetting work
+    the client was told was accepted. Distinct from ParkedWork, which is
+    the reconverger's per-STAGE backlog; this is per-REQUEST admission
+    state. `spec` is the make_arrival wire dict the service rebuilds
+    from; `seq` preserves submission order across the restore."""
+    tenant: str = ""
+    name: str = ""                   # streamed service name
+    stage_key: str = ""              # "{flow}/{stage}"
+    submitted_at: float = 0.0        # admission-clock submit time
+    seq: int = 0                     # controller submission sequence
+    reason: str = "capacity"         # capacity | depth | quota
+    spec: dict = field(default_factory=dict)
+    eligible_nodes: list = field(default_factory=list)
+
+
+@dataclass
 class PlacementRecord(Record):
     """A stage's COMMITTED placement (cp/placement.py): the assignment the
     fleet actually runs and the per-node demand it books. Persisted so a

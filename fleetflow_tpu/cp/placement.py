@@ -558,6 +558,12 @@ class PlacementService:
         with self._lock:
             return self._snapshot_locked()
 
+    def solver_slots(self) -> dict:
+        """Device slot-manager occupancy (sched/tpu.py slots_status):
+        per-stage resident tier/bytes/idle/evictions plus the byte
+        budget — the `fleet solve slots` payload."""
+        return self._sched_tpu.slots_status()
+
     def retained(self, stage_key: str
                  ) -> Optional[tuple[ProblemTensors, Placement]]:
         """The retained (problem, placement) pair for a stage — what

@@ -329,7 +329,8 @@ def _build_admission(state: AppState, config: ServerConfig) -> None:
         state.placement,
         config=AdmissionConfig(max_queue=config.admission_queue,
                                batch_max=config.admission_batch,
-                               shed_age_s=config.admission_shed_age_s))
+                               shed_age_s=config.admission_shed_age_s),
+        store=state.store)
     state.admission.spawn()
 
 

@@ -50,10 +50,10 @@ from pathlib import Path
 from typing import Callable, Optional, TypeVar
 
 from .models import (Alert, BuildJob, CostEntry, Deployment, DeploymentStatus,
-                     DnsRecord, ObservedContainer, ParkedWork, PlacementRecord,
-                     Project, Record, Server, ServiceRecord, StageRecord,
-                     Tenant, TenantUser, VolumeRecord, VolumeSnapshot,
-                     WorkerPool, new_id, now_ts)
+                     DnsRecord, ObservedContainer, ParkedArrival, ParkedWork,
+                     PlacementRecord, Project, Record, Server, ServiceRecord,
+                     StageRecord, Tenant, TenantUser, VolumeRecord,
+                     VolumeSnapshot, WorkerPool, new_id, now_ts)
 from ..core.errors import ControlPlaneError
 from ..obs.metrics import REGISTRY
 
@@ -99,6 +99,7 @@ _TABLES: dict[str, type] = {
     "volume_snapshots": VolumeSnapshot, "build_jobs": BuildJob,
     "cost_entries": CostEntry, "dns_records": DnsRecord,
     "parked_work": ParkedWork, "placements": PlacementRecord,
+    "admission_parked": ParkedArrival,
 }
 
 

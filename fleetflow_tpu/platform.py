@@ -275,6 +275,12 @@ _M_CACHE_ENABLED = _REGISTRY.gauge(
     "fleet_solver_compile_cache_enabled",
     "1 when the persistent XLA compilation cache (FLEET_COMPILE_CACHE)"
     " is active in this process")
+_M_CACHE_REJECTS = _REGISTRY.counter(
+    "fleet_solver_compile_cache_rejects_total",
+    "Compile-cache self-checks that failed: a known-answer probe through"
+    " the persistent cache raised or returned a wrong value, so the cache"
+    " was disabled for this process and solves fell back to fresh"
+    " compiles (a corrupt/stale cache directory must never place a fleet)")
 
 
 def maybe_enable_compile_cache(log=None) -> str | None:
@@ -332,6 +338,69 @@ def maybe_enable_compile_cache(log=None) -> str | None:
     _compile_cache_dir = path
     gauge.set(1)
     return path
+
+
+_cache_verified = False
+
+
+def verify_compile_cache(log=None) -> bool:
+    """Known-answer self-check of the persistent compile cache.
+
+    A cache directory survives jax upgrades by keying entries on version
+    and flags, but it does NOT survive torn writes (a process killed mid
+    -serialize), bit rot on shared scratch, or a truncating copy — and a
+    corrupt entry surfaces as a deserialize error (or worse, wrong
+    numerics) at first solve. Run once per process, after the backend is
+    decided and the cache is enabled: compile-and-run a tiny probe with a
+    known answer THROUGH the cache. A raise or a wrong value rejects the
+    cache — `fleet_solver_compile_cache_rejects_total` increments, the
+    cache is unhooked, and every subsequent solve compiles fresh (slow is
+    recoverable; wrong placements are not).
+
+    Returns True when the cache is enabled and passed (or already
+    verified), False when disabled or just rejected. No-op without
+    FLEET_COMPILE_CACHE.
+    """
+    global _cache_verified, _compile_cache_dir
+    if _compile_cache_dir is None:
+        return False
+    if _cache_verified:
+        return True
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _probe(x):
+        # distinctive constants: this probe's cache key should never
+        # collide with a real solver executable
+        return (x * jnp.int32(48271)
+                + jnp.arange(16, dtype=jnp.int32)).sum()
+
+    expect = 7 * 48271 * 16 + sum(range(16))
+    try:
+        got = int(jax.jit(_probe)(jnp.int32(7)))
+        ok = got == expect
+        err = None if ok else f"probe answered {got}, expected {expect}"
+    except Exception as e:  # deserialize failure, backend abort, ...
+        ok, err = False, repr(e)
+    if ok:
+        _cache_verified = True
+        return True
+    _M_CACHE_REJECTS.inc()
+    rejected_dir = _compile_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _compile_cache_dir = None
+    _M_CACHE_ENABLED.set(0)
+    msg = (f"compile cache REJECTED ({err}); dir={rejected_dir} unhooked,"
+           f" falling back to fresh compiles")
+    if log is None:
+        print(f"[fleetflow.platform] {msg}", file=sys.stderr, flush=True)
+    else:
+        log(msg)
+    return False
 
 
 def compile_cache_info() -> dict:

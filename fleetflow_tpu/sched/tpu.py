@@ -19,13 +19,30 @@ the affected rows and `solver.api._solve` plans/gates the localized
 dispatch, so every `reschedule()` caller gets it for free (the outcome is
 visible on `fleet_solver_subsolve_total{outcome}` and the debug log
 line below).
+
+Resident slots live under a SLOT MANAGER with a device-memory byte
+budget (FLEET_RESIDENT_BYTES, count-bounded too by
+FLEET_RESIDENT_STAGES): admission of a new resident evicts
+least-recently-used slots until the budget holds, using the packed-plane
+byte math (`ResidentProblem.device_nbytes`) as the accounting unit.
+Eviction keeps a HOST snapshot of the committed padded assignment
+(`ResidentProblem.eviction_snapshot` — the sub-solve mirror, so the
+snapshot costs zero device transfers), and re-admission warm-seeds from
+it through `adopt_host` instead of cold-staging: the readmitted warm
+solve runs the exact resident-warm executable, bit-identical to a
+never-evicted slot (pinned by the eviction property test). Occupancy is
+rendered by `fleet solve slots` from `slots_status()`.
+
+`place_many` is the tenant-multiplexer entry (solver/multiplex.py):
+same-tier resident-warm stages batch into ONE vmapped dispatch; the
+rest fall through to the serial path with identical results.
 """
 
 from __future__ import annotations
 
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
 import numpy as np
@@ -33,10 +50,29 @@ import numpy as np
 from .base import Placement, level_schedule, record_placement
 from ..lower.tensors import ProblemTensors
 from ..obs import get_logger, kv
+from ..obs.metrics import REGISTRY
 
 log = get_logger("sched.tpu")
 
 __all__ = ["TpuSolverScheduler"]
+
+# metric catalog: docs/guide/10-observability.md
+_M_EVICTIONS = REGISTRY.counter(
+    "fleet_sched_slot_evictions_total",
+    "Resident slots evicted by the device-memory slot manager")
+_M_READMITS = REGISTRY.counter(
+    "fleet_sched_slot_readmissions_total",
+    "Evicted stages re-admitted warm from their host snapshot")
+_M_RES_BYTES = REGISTRY.gauge(
+    "fleet_sched_resident_bytes",
+    "Device bytes held by resident stage slots (packed-plane accounting)")
+_M_RES_SLOTS = REGISTRY.gauge(
+    "fleet_sched_resident_slots", "Resident stage slots currently held")
+
+# default device budget for resident stage state: roomy on a real chip,
+# and far above what the test-scale problems allocate, so the budget
+# only bites when an operator configures it (or the fleet is real)
+_DEFAULT_BUDGET = 256 << 20
 
 
 @dataclass
@@ -49,11 +85,26 @@ class _StageSlot:
     resident: Any                                  # solver.resident.ResidentProblem
     last_assignment: Optional[np.ndarray] = None   # host warm seed for cold fallback
     key: Optional[str] = None                      # CP stage key, when the caller has one
+    nbytes: int = 0                                # device footprint at admission
+    last_used: float = 0.0                         # monotonic stamp for LRU + status
+
+
+@dataclass
+class _EvictRecord:
+    """What eviction preserves: the committed padded assignment (host
+    side — the sub-solve mirror rode the last solve's fetch, so the
+    snapshot is free) and enough metadata to validate re-admission."""
+    assignment: np.ndarray
+    feasible: bool
+    S: int                                         # real (unpadded) rows
+    evictions: int = 1                             # times this key was evicted
+    host_seed: Optional[np.ndarray] = field(default=None)
 
 
 class TpuSolverScheduler:
     def __init__(self, *, chains=None, steps: int = 128, seed: int = 0,
-                 mesh=None, bucket: Optional[bool] = None):
+                 mesh=None, bucket: Optional[bool] = None,
+                 resident_bytes: Optional[int] = None):
         # chains=None defers to the solver's backend-aware default
         # (1 on CPU, 2 on accelerators — measured r4/r5)
         self.chains = chains
@@ -63,14 +114,28 @@ class TpuSolverScheduler:
         # bucket=None -> ON for the scheduler (this is the churn/reschedule
         # path the bucketing exists for; FLEET_BUCKET=0 force-disables)
         self.bucket = bucket
-        # MRU pool of per-stage resident slots; bounded so a CP cycling
-        # through many stages cannot pin unbounded device memory
+        # slot manager state: MRU-ordered per-stage resident slots, byte-
+        # and count-bounded so a CP cycling through many stages cannot pin
+        # unbounded device memory; evicted stages keep a host snapshot so
+        # re-admission warm-seeds instead of cold-staging
         self._residents: list[_StageSlot] = []
+        self._evicted: dict[str, _EvictRecord] = {}
         try:
             self._max_residents = max(
                 1, int(os.environ.get("FLEET_RESIDENT_STAGES") or "8"))
         except ValueError:
             self._max_residents = 8
+        if resident_bytes is None:
+            try:
+                resident_bytes = max(1, int(
+                    os.environ.get("FLEET_RESIDENT_BYTES")
+                    or str(_DEFAULT_BUDGET)))
+            except ValueError:
+                resident_bytes = _DEFAULT_BUDGET
+        self._budget_bytes = int(resident_bytes)
+        # bounded: snapshots are (padded_S,) i32 vectors, but a CP churning
+        # through unbounded stage keys must not grow host memory forever
+        self._max_evicted = max(4 * self._max_residents, 64)
 
     def _bucket_enabled(self, pt: ProblemTensors) -> bool:
         from ..solver.buckets import bucket_config
@@ -79,6 +144,91 @@ class TpuSolverScheduler:
         # spread constraints bucket too since phantoms carry a traced
         # n_real mask (the former max_skew bypass is closed)
         return bucket_config().enabled
+
+    # -- slot manager ------------------------------------------------------
+
+    def _resident_bytes(self) -> int:
+        return sum(s.nbytes for s in self._residents)
+
+    def _evict(self, slot: _StageSlot) -> None:
+        """Drop a slot's device state, keeping the host snapshot of its
+        committed assignment so re-admission warm-seeds. Keyless slots
+        evict without a snapshot (no identity to re-admit under)."""
+        snap = None
+        try:
+            snap = slot.resident.eviction_snapshot()
+        except Exception:
+            snap = None
+        if slot.key is not None:
+            prev = self._evicted.pop(slot.key, None)
+            count = (prev.evictions + 1) if prev is not None else 1
+            if snap is not None:
+                self._evicted[slot.key] = _EvictRecord(
+                    assignment=snap[0], feasible=snap[1],
+                    S=int(slot.resident.n_real), evictions=count,
+                    host_seed=slot.last_assignment)
+            elif slot.last_assignment is not None:
+                # nothing committed on device yet: preserve the host seed
+                # so the fallback warm start survives eviction too
+                self._evicted[slot.key] = _EvictRecord(
+                    assignment=np.empty(0, np.int32), feasible=False,
+                    S=int(slot.last_assignment.shape[0]), evictions=count,
+                    host_seed=slot.last_assignment)
+            if len(self._evicted) > self._max_evicted:
+                # oldest-inserted falls off; dict preserves insert order
+                self._evicted.pop(next(iter(self._evicted)))
+        _M_EVICTIONS.inc()
+        log.debug("slot-evict %s", kv(
+            stage=slot.key, bytes=slot.nbytes,
+            snapshot=snap is not None))
+
+    def _admit(self, slot: _StageSlot) -> None:
+        """Insert a slot at the MRU head, then evict from the LRU tail
+        until the byte budget and the count bound hold. The newly
+        admitted slot is NEVER evicted — a stage larger than the whole
+        budget still solves (over-budget by itself), so a full budget
+        cannot deadlock admission."""
+        try:
+            slot.nbytes = int(slot.resident.device_nbytes())
+        except Exception:
+            slot.nbytes = 0
+        slot.last_used = time.monotonic()
+        self._residents.insert(0, slot)
+        while len(self._residents) > 1 and (
+                len(self._residents) > self._max_residents
+                or self._resident_bytes() > self._budget_bytes):
+            self._evict(self._residents.pop())
+        _M_RES_BYTES.set(self._resident_bytes())
+        _M_RES_SLOTS.set(len(self._residents))
+
+    def slots_status(self) -> dict:
+        """Occupancy payload for the health channel (`fleet solve slots`):
+        per-slot stage key, tier, resident bytes, last-use age and
+        eviction count, plus the manager's budget totals."""
+        now = time.monotonic()
+        slots = []
+        for s in self._residents:
+            prob = getattr(s.resident, "prob", None)
+            tier = (f"{prob.S}x{prob.N}" if prob is not None else "-")
+            evs = self._evicted.get(s.key) if s.key is not None else None
+            slots.append({
+                "stage": s.key or "-", "tier": tier,
+                "bytes": int(s.nbytes),
+                "idle_s": round(max(0.0, now - s.last_used), 3),
+                "evictions": evs.evictions if evs is not None else 0,
+                "warm": s.resident.assignment is not None,
+            })
+        parked = [{
+            "stage": k, "evictions": rec.evictions, "S": rec.S,
+            "snapshot": bool(rec.assignment.size),
+        } for k, rec in self._evicted.items()]
+        return {
+            "budget_bytes": self._budget_bytes,
+            "max_slots": self._max_residents,
+            "resident_bytes": self._resident_bytes(),
+            "slots": slots,
+            "evicted": parked,
+        }
 
     def _stage(self, pt: ProblemTensors, delta, warm: bool,
                stage_key: Optional[str] = None, mesh=None):
@@ -95,7 +245,9 @@ class TpuSolverScheduler:
         sharded staging to the single-chip solve or vice versa.
 
         Returns (slot, resident_warm): resident_warm=True means the
-        solve seeds from the device-resident previous assignment."""
+        solve seeds from the device-resident previous assignment — either
+        live in the slot, or restored from an eviction snapshot (the
+        re-admission path, bit-identical to never having been evicted)."""
         from ..solver.resident import ProblemDelta, ResidentProblem
 
         # warm delta reuse: the slot whose resident staging matches this
@@ -109,6 +261,7 @@ class TpuSolverScheduler:
                 if rp.assignment is not None and rp.compatible(pt, delta):
                     if i:
                         self._residents.insert(0, self._residents.pop(i))
+                    slot.last_used = time.monotonic()
                     if stage_key is not None:
                         # a caller may start passing stage keys mid-life:
                         # stamp the slot so keyed cold reclaims find it
@@ -166,9 +319,76 @@ class TpuSolverScheduler:
             slot.resident = resident
             if stage_key is not None:
                 slot.key = stage_key
-        self._residents.insert(0, slot)
-        del self._residents[self._max_residents:]
-        return slot, False
+
+        # re-admission: this stage was evicted with a committed snapshot
+        # and the fleet shape still matches — restore the padded
+        # assignment through adopt_host (warm=False: re-admission is
+        # staging, not a guard-violating mid-solve transfer) and run the
+        # resident-warm executable, exactly as if never evicted
+        resident_warm = False
+        rec = (self._evicted.get(stage_key)
+               if warm and stage_key is not None else None)
+        if rec is not None and slot.last_assignment is None:
+            slot.last_assignment = rec.host_seed
+        if (rec is not None and rec.assignment.size
+                and rec.S == pt.S
+                and rec.assignment.shape[0] == resident.prob.S):
+            resident.adopt_host(rec.assignment, pt.node_valid, warm=False)
+            resident.note_host_assignment(padded=rec.assignment,
+                                          feasible=rec.feasible)
+            resident_warm = True
+            _M_READMITS.inc()
+            log.debug("slot-readmit %s", kv(stage=stage_key,
+                                            evictions=rec.evictions))
+        self._admit(slot)
+        return slot, resident_warm
+
+    def _solve_one(self, pt: ProblemTensors, slot, resident_warm: bool,
+                   sh_mesh, init, overlap_host_work=None):
+        from ..solver import solve
+        rp = slot.resident
+        if sh_mesh is not None:
+            from ..solver.sharded import solve_sharded
+            return solve_sharded(pt, resident=rp,
+                                 resident_warm=resident_warm,
+                                 init_assignment=init, steps=self.steps,
+                                 seed=self.seed,
+                                 overlap_host_work=overlap_host_work)
+        # bucket flag comes from the slot's OWN staging, not a fresh
+        # env read: rp.prob was padded (or not) under the config
+        # captured at cold-stage time, and a mid-life FLEET_BUCKET
+        # flip must not make _solve skip the phantom-row slice on an
+        # already-padded staging
+        return solve(pt, prob=rp.prob, chains=self.chains,
+                     steps=self.steps, seed=self.seed, mesh=self.mesh,
+                     init_assignment=init, bucket=rp.bucket,
+                     resident=rp, resident_warm=resident_warm,
+                     overlap_host_work=overlap_host_work)
+
+    def _finalize(self, pt: ProblemTensors, res, slot, ms: float,
+                  stage: Optional[str]) -> Placement:
+        slot.last_assignment = res.assignment
+        slot.last_used = time.monotonic()
+        sub = getattr(res, "subsolve", None)
+        if sub is not None:
+            # the churn rode the mini-tier path (or tried to): the line
+            # an operator correlates with a reschedule latency change
+            log.debug("active-set %s", kv(
+                stage=stage, rows=sub["rows"], tier=sub["tier"],
+                outcome=sub["outcome"], ms=sub["ms"]))
+        placement = Placement(
+            assignment={pt.service_names[i]: pt.node_names[int(res.assignment[i])]
+                        for i in range(pt.S)},
+            levels=level_schedule(pt),
+            feasible=res.feasible,
+            violations=res.violations,
+            soft=res.soft,
+            source="tpu-anneal",
+            solve_ms=ms,
+            raw=res.assignment,
+        )
+        record_placement(placement)
+        return placement
 
     def place(self, pt: ProblemTensors, *, warm_start: bool = False,
               delta=None, overlap_host_work=None,
@@ -188,7 +408,6 @@ class TpuSolverScheduler:
         from ..platform import ensure_platform
         ensure_platform(min_devices=1)
         # imported lazily so the host path never pays JAX startup
-        from ..solver import solve
         from ..solver.sharded import sharded_route
 
         t0 = time.perf_counter()
@@ -199,7 +418,6 @@ class TpuSolverScheduler:
         sh_mesh = sharded_route(pt) if self.mesh is None else None
         slot, resident_warm = self._stage(pt, delta, warm_start, stage,
                                           mesh=sh_mesh)
-        rp = slot.resident
 
         # cold fallback on a warm request still warm-starts from THIS
         # stage's last HOST assignment when shapes line up (the
@@ -210,47 +428,63 @@ class TpuSolverScheduler:
                 and slot.last_assignment is not None
                 and slot.last_assignment.shape[0] == pt.S):
             init = slot.last_assignment
-        if sh_mesh is not None:
-            from ..solver.sharded import solve_sharded
-            res = solve_sharded(pt, resident=rp,
-                                resident_warm=resident_warm,
-                                init_assignment=init, steps=self.steps,
-                                seed=self.seed,
-                                overlap_host_work=overlap_host_work)
-        else:
-            # bucket flag comes from the slot's OWN staging, not a fresh
-            # env read: rp.prob was padded (or not) under the config
-            # captured at cold-stage time, and a mid-life FLEET_BUCKET
-            # flip must not make _solve skip the phantom-row slice on an
-            # already-padded staging
-            res = solve(pt, prob=rp.prob, chains=self.chains,
-                        steps=self.steps, seed=self.seed, mesh=self.mesh,
-                        init_assignment=init, bucket=rp.bucket,
-                        resident=rp, resident_warm=resident_warm,
-                        overlap_host_work=overlap_host_work)
-        slot.last_assignment = res.assignment
+        res = self._solve_one(pt, slot, resident_warm, sh_mesh, init,
+                              overlap_host_work=overlap_host_work)
         ms = (time.perf_counter() - t0) * 1e3
-        sub = getattr(res, "subsolve", None)
-        if sub is not None:
-            # the churn rode the mini-tier path (or tried to): the line
-            # an operator correlates with a reschedule latency change
-            log.debug("active-set %s", kv(
-                stage=stage, rows=sub["rows"], tier=sub["tier"],
-                outcome=sub["outcome"], ms=sub["ms"]))
+        return self._finalize(pt, res, slot, ms, stage)
 
-        placement = Placement(
-            assignment={pt.service_names[i]: pt.node_names[int(res.assignment[i])]
-                        for i in range(pt.S)},
-            levels=level_schedule(pt),
-            feasible=res.feasible,
-            violations=res.violations,
-            soft=res.soft,
-            source="tpu-anneal",
-            solve_ms=ms,
-            raw=res.assignment,
-        )
-        record_placement(placement)
-        return placement
+    def place_many(self, requests: list[dict]) -> list[Placement]:
+        """Batched placement across stages — the tenant multiplexer
+        entry. Each request is a dict with keys `pt` (required), `delta`,
+        `warm_start`, `stage`. Every request stages through the slot
+        manager first; the resident-warm single-chip stages then batch
+        same-tier into ONE vmapped dispatch (solver/multiplex.py), the
+        rest solve serially. Results come back in request order, each
+        identical to what a solo `place()` would have produced (parity is
+        property-pinned)."""
+        from ..platform import ensure_platform
+        ensure_platform(min_devices=1)
+        from ..solver.multiplex import MuxEntry, solve_multiplexed
+        from ..solver.sharded import sharded_route
+
+        t0 = time.perf_counter()
+        staged = []
+        for req in requests:
+            pt = req["pt"]
+            warm = bool(req.get("warm_start"))
+            sh_mesh = sharded_route(pt) if self.mesh is None else None
+            slot, resident_warm = self._stage(
+                pt, req.get("delta"), warm, req.get("stage"), mesh=sh_mesh)
+            staged.append((pt, slot, resident_warm, sh_mesh,
+                           req.get("stage"), warm))
+
+        results: list = [None] * len(staged)
+        mux_idx = [i for i, (_, slot, rw, mesh, _, _w) in enumerate(staged)
+                   if rw and mesh is None and slot.resident.mesh is None]
+        if len(mux_idx) >= 2:
+            entries = [MuxEntry(pt=staged[i][0],
+                                resident=staged[i][1].resident,
+                                seed=self.seed, stage=staged[i][4])
+                       for i in mux_idx]
+            mres = solve_multiplexed(entries, chains=self.chains,
+                                     steps=self.steps)
+            for i, r in zip(mux_idx, mres):
+                results[i] = r
+        for i, (pt, slot, resident_warm, sh_mesh, _stg,
+                warm) in enumerate(staged):
+            if results[i] is not None:
+                continue
+            init = None
+            if (warm and not resident_warm
+                    and slot.last_assignment is not None
+                    and slot.last_assignment.shape[0] == pt.S):
+                init = slot.last_assignment
+            results[i] = self._solve_one(pt, slot, resident_warm,
+                                         sh_mesh, init)
+        ms = (time.perf_counter() - t0) * 1e3
+        return [self._finalize(pt, res, slot, ms, stg)
+                for (pt, slot, _rw, _mesh, stg, _w), res
+                in zip(staged, results)]
 
     def reschedule(self, pt: ProblemTensors, *, delta=None,
                    overlap_host_work=None,

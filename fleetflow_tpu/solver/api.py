@@ -312,9 +312,13 @@ def solve(pt: ProblemTensors, **kw) -> SolveResult:
     tempering) instead of the single-chip pipeline; explicit staging
     kwargs (prob/resident/mesh) always pin the call to this path."""
     # idempotent: callers that never pass through platform.ensure_platform
-    # (library embedding, tests) still get FLEET_COMPILE_CACHE honored
-    from ..platform import maybe_enable_compile_cache
-    maybe_enable_compile_cache()
+    # (library embedding, tests) still get FLEET_COMPILE_CACHE honored.
+    # The self-check runs HERE, not in ensure_platform: the probe compiles
+    # against a backend, and ensure_platform runs before the backend
+    # decision is final
+    from ..platform import maybe_enable_compile_cache, verify_compile_cache
+    if maybe_enable_compile_cache() is not None:
+        verify_compile_cache()
     with profile_trace("solve"):
         from .sharded import maybe_solve_sharded
         res = maybe_solve_sharded(pt, **kw)

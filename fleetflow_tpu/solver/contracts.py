@@ -223,6 +223,57 @@ def _refine_cases() -> list[KernelCase]:
     return out
 
 
+def _mux_refine_cases() -> list[KernelCase]:
+    """multiplex._mux_refine — the batched (vmapped) warm pipeline — at
+    K=2 stacked lanes per audit tier, statics derived exactly as
+    multiplex._solve_batch derives them. The leading lane axis is a
+    recompile axis by design (bucketed on the mux_k ladder); the
+    contract pins that the batched executable keeps the serial warm
+    path's structure: no donation, no host callbacks, packed planes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..lower import synthetic_problem
+    from .anneal import backend_proposals_per_step, solve_trace_blocks
+    from .multiplex import stack_problems
+    from .multiplex import _mux_refine
+    from .resident import ResidentProblem
+
+    K = 2
+    out = []
+    for S, N in AUDIT_TIERS:
+        lanes = []
+        for lane in range(K):
+            pt = synthetic_problem(S, N, seed=lane, port_fraction=0.3,
+                                   volume_fraction=0.2)
+            rp = ResidentProblem(pt)
+            rp.adopt_host(np.zeros(pt.S, np.int32), pt.node_valid,
+                          warm=False)
+            lanes.append(rp)
+        prob = lanes[0].prob
+        stacked = stack_problems([rp.prob for rp in lanes])
+        seeds = jnp.stack([rp.assignment for rp in lanes])
+        keys = jnp.stack([jax.random.PRNGKey(i) for i in range(K)])
+        scal = [rp.warm_scalars(0.1, 1e-3, 0.5) for rp in lanes]
+        t0v = jnp.stack([s[0] for s in scal])
+        t1v = jnp.stack([s[1] for s in scal])
+        mwv = jnp.stack([s[2] for s in scal])
+        out.append(KernelCase(
+            tier=f"{prob.S}x{N}:k{K}", fn=_mux_refine,
+            args=(stacked, seeds, keys, t0v, t1v, mwv),
+            kwargs=dict(chains=1, steps=16, warm=True, adaptive=True,
+                        anneal_block=1,
+                        proposals_per_step=backend_proposals_per_step(
+                            prob.S),
+                        fused_prerepair=True,
+                        prerepair_moves=max(16, min(prob.S, 256)),
+                        skip_feasible_polish=True,
+                        trace_blocks=solve_trace_blocks()),
+            arg_names=_REFINE_ARG_NAMES,
+            out_shardings=None))
+    return out
+
+
 _SUBSOLVE_ARG_NAMES = ("prob", "assignment", "rows", "sub_conflict",
                        "sub_coloc", "load0", "used0", "coloc0", "topo0",
                        "n_sub", "key", "t0", "t1", "migration_weight")
@@ -337,6 +388,14 @@ def hot_path_kernels() -> list[KernelContract]:
             module="fleetflow_tpu.solver.api",
             qualname="_refine",
             cases=_refine_cases),
+        KernelContract(
+            name="mux.anneal",
+            module="fleetflow_tpu.solver.multiplex",
+            qualname="_mux_refine",
+            # like refine.warm, donation-free by design: every lane's
+            # resident seed must outlive the dispatch (it re-seeds the
+            # serial path if the batch's exact gate rejects a lane)
+            cases=_mux_refine_cases),
         KernelContract(
             name="subsolve.localized",
             module="fleetflow_tpu.solver.subsolve",

@@ -536,6 +536,28 @@ class ResidentProblem:
         host boundary where the disallow guard would have fired."""
         _M_HOST_XFER.inc()
 
+    def eviction_snapshot(self) -> Optional[tuple[np.ndarray, bool]]:
+        """Host snapshot for the scheduler's slot manager (sched/tpu.py):
+        the committed PADDED assignment mirror + its feasibility flag.
+        Padded — not the real-row slice — so a re-admission
+        ``adopt_host`` restores the exact device seed, phantom parking
+        included, and the readmitted warm solve is bit-identical to a
+        never-evicted one. Costs no device transfer: the mirror is
+        maintained host-side by note_host_assignment/adopt_host. None
+        before the first committed solve (nothing worth snapshotting)."""
+        if self._mirror is None:
+            return None
+        return np.array(self._mirror, copy=True), bool(self._mirror_feasible)
+
+    def device_nbytes(self) -> int:
+        """Resident device footprint: per-plane byte accounting over the
+        staged problem + assignment. Packed planes count at their uint32
+        width (solver/problem.py packed-plane math) — this is the number
+        the slot manager's byte budget enforces at runtime."""
+        import jax
+        leaves = jax.tree_util.tree_leaves((self.prob, self.assignment))
+        return int(sum(int(x.size) * x.dtype.itemsize for x in leaves))
+
     # -- active-set sub-solve hooks (solver/subsolve.py) -------------------
 
     def note_host_assignment(self, padded=None,

@@ -39,12 +39,12 @@ def _world(services=20, nodes=4, stages=1):
     return runner.world
 
 
-def _ctrl(world, **cfg) -> AdmissionController:
+def _ctrl(world, store=None, **cfg) -> AdmissionController:
     defaults = dict(batch_max=8, quantum=4.0, max_queue=64,
                     shed_age_s=0.0)
     defaults.update(cfg)
     return AdmissionController(world.state.placement,
-                               clock=world.clock.now,
+                               clock=world.clock.now, store=store,
                                config=AdmissionConfig(**defaults))
 
 
@@ -402,3 +402,138 @@ class TestStatusSurface:
         assert st["solve_ms_p50"] is not None
         assert st["solve_ms_p99"] is not None
         assert st["solve_ms_p99"] >= st["solve_ms_p50"] > 0
+
+
+class TestTenantQuota:
+    """Hard per-tenant caps (PR 16): overflow PARKS with reason="quota"
+    (accepted, journaled, never shed), quota parks stay out of the
+    pressure/SLO surfaces, and each departure requeues the oldest park
+    exactly up to the cap."""
+
+    def _capped(self, w, store=None, cap=2):
+        return _ctrl(w, store=store, tenant_caps={"acme": cap})
+
+    def test_overflow_parks_not_sheds(self):
+        w = _world()
+        ctrl = self._capped(w)
+        ctrl.attach(w.flow, "app0")
+        res = ctrl.submit("acme", arrivals=[{"name": f"q{i}", "cpu": 0.05,
+                                             "memory": 8.0}
+                                            for i in range(4)])
+        assert res.get("quota_parked") == 2
+        assert not res.get("shed")
+        st = ctrl.status()
+        assert st["parked_quota"] == 2
+        assert st["tenants"]["acme"]["cap"] == 2
+        assert st["tenants"]["acme"]["usage"] == 4   # live+queued+parked
+        _drain(w, ctrl)
+        st = ctrl.status()
+        assert st["tenants"]["acme"]["live"] == 2    # never over the cap
+        assert st["parked_quota"] == 2               # overflow still safe
+
+    def test_quota_parks_excluded_from_pressure(self):
+        """Capacity cannot be provisioned around a policy cap: with only
+        quota parks outstanding the autoscaler signal must read drained."""
+        w = _world()
+        ctrl = self._capped(w)
+        ctrl.attach(w.flow, "app0")
+        ctrl.submit("acme", arrivals=[{"name": f"q{i}", "cpu": 0.05,
+                                       "memory": 8.0} for i in range(4)])
+        _drain(w, ctrl)
+        p = ctrl.pressure()
+        assert p["parked_quota"] == 2
+        assert p["drained"] is True
+
+    def test_departures_requeue_parks_up_to_cap(self):
+        w = _world()
+        ctrl = self._capped(w)
+        key = ctrl.attach(w.flow, "app0")
+        ctrl.submit("acme", arrivals=[{"name": f"q{i}", "cpu": 0.05,
+                                       "memory": 8.0} for i in range(4)])
+        _drain(w, ctrl)
+        ctrl.submit("acme", departures=["q0", "q1"])
+        _drain(w, ctrl)
+        st = ctrl.status()
+        assert st["tenants"]["acme"]["live"] == 2
+        assert st["parked_quota"] == 0
+        assert sorted(ctrl.live_names(key))[-2:] == ["q2", "q3"]
+
+    def test_quota_parks_exempt_from_age_shed(self):
+        """A quota park's age is the wait the controller itself imposed
+        when it ACCEPTED the arrival — the age-shed watermark must not
+        turn that acceptance into a retroactive shed on requeue."""
+        w = _world()
+        ctrl = _ctrl(w, shed_age_s=2.0, tenant_caps={"acme": 1})
+        key = ctrl.attach(w.flow, "app0")
+        ctrl.submit("acme", arrivals=[{"name": "q0", "cpu": 0.05,
+                                       "memory": 8.0},
+                                      {"name": "q1", "cpu": 0.05,
+                                       "memory": 8.0}])
+        _drain(w, ctrl)
+        w.clock.advance(30.0)              # far past the shed watermark
+        ctrl.submit("acme", departures=["q0"])
+        _drain(w, ctrl)
+        st = ctrl.status()
+        assert ctrl.stats["sheds"] == 0
+        assert st["parked_quota"] == 0
+        assert "q1" in ctrl.live_names(key)
+
+
+class TestParkedJournal:
+    """Parked arrivals are journaled into the store's admission_parked
+    table (PR 16): rows persist on park, clear on requeue/terminal, and
+    a rebuilt controller on the same store — the failover path —
+    restores the parked set before serving."""
+
+    def _capped(self, w, store, cap=2):
+        return _ctrl(w, store=store, tenant_caps={"acme": cap})
+
+    def test_journal_rows_track_park_lifecycle(self):
+        from fleetflow_tpu.cp.store import Store
+        w = _world()
+        store = Store.connect_memory()
+        ctrl = self._capped(w, store)
+        ctrl.attach(w.flow, "app0")
+        ctrl.submit("acme", arrivals=[{"name": f"q{i}", "cpu": 0.05,
+                                       "memory": 8.0} for i in range(4)])
+        assert len(store.list("admission_parked")) == 2
+        _drain(w, ctrl)
+        ctrl.submit("acme", departures=["q0", "q1"])
+        _drain(w, ctrl)
+        # requeued-and-placed parks must delete their journal rows
+        assert len(store.list("admission_parked")) == 0
+
+    def test_rebuilt_controller_restores_parked_set(self):
+        from fleetflow_tpu.cp.store import Store
+        w = _world()
+        store = Store.connect_memory()
+        ctrl = self._capped(w, store)
+        key = ctrl.attach(w.flow, "app0")
+        ctrl.submit("acme", arrivals=[{"name": f"q{i}", "cpu": 0.05,
+                                       "memory": 8.0} for i in range(4)])
+        _drain(w, ctrl)
+        assert ctrl.status()["parked_quota"] == 2
+
+        # the failover: a NEW controller over the same store (standby
+        # promotion rebuilds admission from the replicated journal)
+        ctrl2 = self._capped(w, store)
+        ctrl2.attach(w.flow, "app0")
+        st2 = ctrl2.status()
+        assert st2["stats"]["restored"] == 2
+        assert st2["parked_quota"] == 2
+
+        # id/seq counters advanced past the restored rows: new submits
+        # must not collide with restored request ids
+        r3 = ctrl2.submit("beta", arrivals=[{"name": "b1", "cpu": 0.05,
+                                             "memory": 8.0}])
+        assert len(r3["accepted"]) == 1
+
+        # departures on the RESTORED controller open headroom: the
+        # restored parks place — the journaled work survived the kill
+        ctrl2.submit("acme", departures=["q0", "q1"])
+        _drain(w, ctrl2)
+        st2 = ctrl2.status()
+        assert st2["parked_quota"] == 0
+        assert len(store.list("admission_parked")) == 0
+        live = ctrl2.live_names(key)
+        assert "q2" in live and "q3" in live

@@ -180,6 +180,53 @@ class TestSmoke:
         assert a.events == b.events
         assert a.digest() == b.digest()
 
+    def test_tenant_storm_smoke(self):
+        """Hard quotas + the parked-arrival journal (PR 16): a capped
+        tenant floods past its quota (overflow PARKS, never sheds), the
+        primary dies with parks outstanding, and the promoted CP
+        restores and places them as drain-phase departures free
+        headroom — judged by admission-quota + admission-converged."""
+        r = run_scenario("tenant-storm", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        assert r.stats["admissions"] > 30
+        assert r.stats["failovers"] == 1     # the mid-storm kill fired
+        events = {e["event"] for e in r.events}
+        assert "admit" in events
+        assert "cp-failover" in events
+
+    def test_tenant_storm_quota_journal_census(self):
+        """The evidence behind the green invariant: the cap actually
+        bit (parks were journaled and restored across the kill), the
+        capped tenant never exceeded its holdings cap, and the drain
+        left no journal rows behind."""
+        import asyncio
+
+        from fleetflow_tpu.chaos import build_schedule
+        schedule = build_schedule("tenant-storm", 7, SMOKE["services"],
+                                  SMOKE["nodes"])
+        assert schedule.tenant_caps == {"team-cap": 6}
+        runner = _Runner(schedule, SMOKE["services"], SMOKE["nodes"],
+                         SMOKE["stages"], SMOKE["pool_min"])
+        report = asyncio.run(runner.run())
+        assert report.ok, report.violations
+        ctrl = runner.world.state.admission
+        # the promoted controller REPLAYED the dead primary's parks
+        assert ctrl.stats["restored"] > 0
+        assert ctrl.stats["unparked"] > 0
+        live = {}
+        for stream in ctrl._streams.values():
+            for owner in stream.owner.values():
+                live[owner] = live.get(owner, 0) + 1
+        assert live.get("team-cap", 0) <= 6
+        # fully drained: every restored park placed, journal empty
+        assert len(runner.world.state.store.list("admission_parked")) == 0
+
+    def test_tenant_storm_same_seed_same_digest(self):
+        a = run_scenario("tenant-storm", seed=11, **SMOKE)
+        b = run_scenario("tenant-storm", seed=11, **SMOKE)
+        assert a.events == b.events
+        assert a.digest() == b.digest()
+
 
 @pytest.mark.slow
 class TestFullPack:

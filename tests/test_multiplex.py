@@ -1,0 +1,210 @@
+"""Tenant multiplexer tests (solver/multiplex.py).
+
+The load-bearing property is PARITY: a lane of a batched vmapped solve
+must be bit-identical to the serial resident-warm solve of the same
+stage with the same seed — assignment, exact violation stats, soft
+score, sweep count, even the flight-deck telemetry rows. The
+multiplexer is a latency optimization, never a semantics fork; these
+tests pin the strong form of that claim, plus the ladder bucketing,
+the zero-recompile repeat-dispatch property, and the serial fallback
+for entries that cannot batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fleetflow_tpu.lower import synthetic_problem
+from fleetflow_tpu.solver.api import _solve
+from fleetflow_tpu.solver.multiplex import (MuxEntry, mux_cache_size,
+                                            mux_k, solve_multiplexed,
+                                            stack_problems)
+from fleetflow_tpu.solver.resident import ProblemDelta, ResidentProblem
+
+S, N = 60, 12
+
+
+def _build(seed, steps=32):
+    """A resident-warm stage: staged, cold-solved, assignment adopted."""
+    pt = synthetic_problem(S, N, seed=seed, port_fraction=0.3,
+                           volume_fraction=0.2)
+    rp = ResidentProblem(pt)
+    cold = _solve(pt, prob=rp.prob, resident=rp, seed=seed, steps=steps)
+    return pt, rp, cold
+
+
+def _build_churned(seed, steps=32):
+    """A resident-warm stage with real churn (one node killed), so the
+    warm anneal has actual stranded services to sweep on."""
+    pt, rp, _ = _build(seed, steps)
+    valid = np.asarray(pt.node_valid, bool).copy()
+    valid[seed % N] = False
+    cur = dataclasses.replace(pt, node_valid=valid)
+    rp.apply_delta(cur, ProblemDelta(node_valid=valid))
+    return cur, rp
+
+
+class TestLadder:
+    def test_pow2_ladder(self):
+        assert [mux_k(k) for k in (0, 1, 2, 3, 4, 5, 8, 9, 16)] == \
+            [1, 1, 2, 4, 4, 8, 8, 16, 16]
+
+    def test_ladder_cap(self):
+        assert mux_k(100) == 16            # default FLEET_MUX_MAX
+        assert mux_k(100, maximum=4) == 4
+        assert mux_k(3, maximum=2) == 2
+
+    def test_ladder_env_override(self, monkeypatch):
+        monkeypatch.setenv("FLEET_MUX_MAX", "4")
+        assert mux_k(9) == 4
+        monkeypatch.setenv("FLEET_MUX_MAX", "not-a-number")
+        assert mux_k(9) == 16              # malformed -> default
+
+    def test_stack_rejects_mismatched_tiers(self):
+        _, rp_a, _ = _build(0)
+        pt_b = synthetic_problem(24, 6, seed=1)
+        rp_b = ResidentProblem(pt_b)
+        with pytest.raises(ValueError):
+            stack_problems([rp_a.prob, rp_b.prob])
+
+
+class TestParity:
+    K = 3
+
+    def test_batched_lanes_bit_identical_to_serial(self):
+        """Double-build: serial references and mux entries start from
+        bit-identical resident states (same seeds -> same cold solves),
+        then one batched dispatch must reproduce each serial warm solve
+        exactly."""
+        serial = []
+        for i in range(self.K):
+            pt, rp, cold = _build(i)
+            res = _solve(pt, prob=rp.prob, resident=rp, resident_warm=True,
+                         seed=100 + i, steps=32, bucket=rp.bucket)
+            serial.append((cold.assignment.copy(), res))
+
+        entries = []
+        for i in range(self.K):
+            pt, rp, cold = _build(i)
+            # the rebuilt cold state must match the reference build, or
+            # the parity comparison below compares different problems
+            assert np.array_equal(cold.assignment, serial[i][0])
+            entries.append(MuxEntry(pt=pt, resident=rp, seed=100 + i))
+
+        mres = solve_multiplexed(entries, steps=32)
+        assert len(mres) == self.K
+        for i in range(self.K):
+            sref, m = serial[i][1], mres[i]
+            assert np.array_equal(sref.assignment, m.assignment), i
+            assert sref.stats == m.stats, i
+            assert abs(sref.soft - m.soft) < 1e-9, i
+            assert m.feasible == sref.feasible
+            assert m.timings_ms["mux_k"] == float(mux_k(self.K))
+            assert m.timings_ms["mux_lane"] == float(i)
+
+    def test_churned_lanes_match_serial_sweeps_and_telemetry(self,
+                                                            monkeypatch):
+        """Real anneal work (a killed node per lane): per-lane adaptive
+        early exit and the telemetry buffer must match the serial path
+        row for row — vmap masking may not leak between lanes."""
+        monkeypatch.setenv("FLEET_SUBSOLVE", "0")
+        serial = []
+        for i in range(self.K):
+            cur, rp = _build_churned(i)
+            serial.append(_solve(cur, prob=rp.prob, resident=rp,
+                                 resident_warm=True, seed=100 + i,
+                                 steps=32, bucket=rp.bucket))
+
+        entries = []
+        for i in range(self.K):
+            cur, rp = _build_churned(i)
+            entries.append(MuxEntry(pt=cur, resident=rp, seed=100 + i))
+        mres = solve_multiplexed(entries, steps=32)
+
+        for i in range(self.K):
+            sref, m = serial[i], mres[i]
+            assert np.array_equal(sref.assignment, m.assignment), i
+            assert sref.steps == m.steps, i     # same early-exit sweep
+            assert abs(sref.soft - m.soft) < 1e-9, i
+            if sref.telemetry is not None and m.telemetry is not None:
+                assert sref.telemetry["blocks"] == m.telemetry["blocks"]
+                assert m.telemetry["path"] == "mux"
+                assert m.telemetry["mux"]["lane"] == i
+
+
+class TestDispatch:
+    def test_repeat_dispatch_zero_recompiles(self):
+        """Second batched call at the same (tier, ladder K) must reuse
+        the compiled executable — K is bucketed exactly so that
+        fleet-count drift inside a rung never recompiles."""
+        entries = []
+        for i in range(2):
+            pt, rp, _ = _build(10 + i)
+            entries.append(MuxEntry(pt=pt, resident=rp, seed=7 + i))
+        solve_multiplexed(entries, steps=32)   # warm the (tier, K=2) rung
+        before = mux_cache_size()
+        again = solve_multiplexed(entries, steps=32)
+        assert mux_cache_size() == before
+        assert all(r is not None for r in again)
+
+    def test_padded_batch_same_rung(self):
+        """3 lanes pad to the K=4 rung; padding must not recompile once
+        the rung is warm, and every real lane still gets a result."""
+        entries = []
+        for i in range(3):
+            pt, rp, _ = _build(20 + i)
+            entries.append(MuxEntry(pt=pt, resident=rp, seed=7 + i))
+        res = solve_multiplexed(entries, steps=32)
+        assert len(res) == 3
+        assert all(r.timings_ms["mux_k"] == 4.0 for r in res)
+        before = mux_cache_size()
+        solve_multiplexed(entries, steps=32)
+        assert mux_cache_size() == before
+
+
+class TestSerialFallback:
+    def test_singleton_group_falls_back_to_serial(self):
+        pt, rp, _ = _build(30)
+        ref = _solve(pt, prob=rp.prob, resident=rp, resident_warm=True,
+                     seed=5, steps=32, bucket=rp.bucket)
+        pt2, rp2, _ = _build(30)
+        [m] = solve_multiplexed([MuxEntry(pt=pt2, resident=rp2, seed=5)],
+                                steps=32)
+        assert np.array_equal(ref.assignment, m.assignment)
+        assert "mux_k" not in m.timings_ms   # serial path, not a batch of 1
+
+    def test_ineligible_resident_falls_back_to_serial(self):
+        """A staging with no adopted assignment is not resident-warm and
+        must take the serial path — with a real result, not a crash."""
+        pt = synthetic_problem(S, N, seed=40, port_fraction=0.3,
+                               volume_fraction=0.2)
+        rp = ResidentProblem(pt)           # never solved: assignment None
+        pt2, rp2, _ = _build(41)
+        res = solve_multiplexed([MuxEntry(pt=pt, resident=rp, seed=1),
+                                 MuxEntry(pt=pt2, resident=rp2, seed=2)],
+                                steps=32)
+        assert len(res) == 2
+        assert all(r is not None and r.assignment.shape == (S,)
+                   for r in res)
+
+    def test_mixed_tiers_split_into_groups(self):
+        """Two tiers in one call: each same-tier pair batches, nothing
+        mis-batches across tiers (stacking across tiers would be a
+        treedef error — grouping must prevent it from ever happening)."""
+        entries = []
+        for i in range(2):
+            pt, rp, _ = _build(50 + i)
+            entries.append(MuxEntry(pt=pt, resident=rp, seed=i))
+        for i in range(2):
+            pt = synthetic_problem(24, 6, seed=60 + i)
+            rp = ResidentProblem(pt)
+            _solve(pt, prob=rp.prob, resident=rp, seed=60 + i, steps=16)
+            entries.append(MuxEntry(pt=pt, resident=rp, seed=i))
+        res = solve_multiplexed(entries, steps=16)
+        assert len(res) == 4
+        assert all(r is not None for r in res)
+        assert res[0].assignment.shape == (S,)
+        assert res[2].assignment.shape == (24,)

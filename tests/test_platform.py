@@ -285,3 +285,96 @@ class TestGraftEntry:
             {"FLEET_FORCE_CPU": "1", "XLA_FLAGS": ""}, timeout=420.0)
         assert out.returncode == 0, out.stderr
         assert "GATE ok 4" in out.stdout
+
+
+class TestCompileCacheVerify:
+    """Known-answer self-check of the persistent compile cache (PR 16):
+    a corrupt cache entry must surface as a REJECT (counter bump, cache
+    unhooked, fresh compiles) — never as wrong solver numerics."""
+
+    @staticmethod
+    def _registry():
+        from fleetflow_tpu.obs.metrics import REGISTRY
+        return REGISTRY
+
+    def _arm(self, monkeypatch, tmp_path):
+        """Pretend the cache was enabled for this process, with the
+        module globals restored on teardown."""
+        monkeypatch.setattr(fp, "_compile_cache_dir", str(tmp_path))
+        monkeypatch.setattr(fp, "_cache_verified", False)
+
+    def test_noop_without_cache(self, monkeypatch):
+        monkeypatch.setattr(fp, "_compile_cache_dir", None)
+        monkeypatch.setattr(fp, "_cache_verified", False)
+        assert fp.verify_compile_cache() is False
+
+    def test_pass_path_is_idempotent(self, monkeypatch, tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        rejects = self._registry().get(
+            "fleet_solver_compile_cache_rejects_total")
+        before = rejects.value()
+        assert fp.verify_compile_cache() is True     # real probe runs
+        assert fp._cache_verified is True
+        assert fp.verify_compile_cache() is True     # cached verdict
+        assert rejects.value() == before
+        assert fp._compile_cache_dir == str(tmp_path)
+
+    def test_wrong_answer_rejects_and_unhooks(self, monkeypatch, tmp_path):
+        import jax
+        self._arm(monkeypatch, tmp_path)
+        rejects = self._registry().get(
+            "fleet_solver_compile_cache_rejects_total")
+        enabled = self._registry().get("fleet_solver_compile_cache_enabled")
+        before = rejects.value()
+        # a corrupt deserialize surfacing as wrong numerics: the jitted
+        # probe returns a value that is not the known answer
+        monkeypatch.setattr(jax, "jit", lambda f: (lambda *a: 0))
+        logs = []
+        assert fp.verify_compile_cache(log=logs.append) is False
+        assert rejects.value() == before + 1
+        assert enabled.value() == 0
+        assert fp._compile_cache_dir is None         # unhooked
+        assert fp.compile_cache_info()["enabled"] is False
+        assert any("REJECTED" in m for m in logs)
+
+    def test_probe_raise_rejects(self, monkeypatch, tmp_path):
+        import jax
+
+        def _boom(f):
+            def run(*a):
+                raise RuntimeError("corrupt deserialize")
+            return run
+
+        self._arm(monkeypatch, tmp_path)
+        rejects = self._registry().get(
+            "fleet_solver_compile_cache_rejects_total")
+        before = rejects.value()
+        monkeypatch.setattr(jax, "jit", _boom)
+        assert fp.verify_compile_cache(log=lambda m: None) is False
+        assert rejects.value() == before + 1
+        assert fp._cache_verified is False
+        # the next verify (cache already unhooked) is a quiet no-op
+        assert fp.verify_compile_cache() is False
+
+    def test_solve_path_invokes_verify_once(self, tmp_path):
+        """End-to-end in a child process: FLEET_COMPILE_CACHE set, the
+        first solve() enables AND verifies the cache (probe passes on a
+        fresh dir), and the enabled gauge stays up."""
+        out = run_py(
+            "import os, fleetflow_tpu.platform as fp;"
+            "from fleetflow_tpu.obs.metrics import REGISTRY;"
+            "from fleetflow_tpu.lower import synthetic_problem;"
+            "from fleetflow_tpu.solver.api import solve;"
+            "res = solve(synthetic_problem(24, 6, seed=0), steps=8);"
+            "print('FEAS', res.feasible);"
+            "print('VER', fp._cache_verified);"
+            "print('REJ', int(REGISTRY.get("
+            "'fleet_solver_compile_cache_rejects_total').value()));"
+            "print('EN', int(REGISTRY.get("
+            "'fleet_solver_compile_cache_enabled').value()))",
+            {"JAX_PLATFORMS": "cpu",
+             "FLEET_COMPILE_CACHE": str(tmp_path / "cc")}, timeout=300.0)
+        assert out.returncode == 0, out.stderr
+        assert "VER True" in out.stdout
+        assert "REJ 0" in out.stdout
+        assert "EN 1" in out.stdout

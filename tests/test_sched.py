@@ -114,3 +114,92 @@ class TestStagedCacheInvalidation:
         assert second.feasible
         assert not np.any(np.asarray(second.raw) == 0), (
             "dead node still occupied: staged mask is stale")
+
+
+class TestSlotManager:
+    """Device-memory slot manager (PR 16): per-stage byte accounting,
+    LRU eviction to a budget, and warm re-admission from the host
+    snapshot. The two property tests the ISSUE pins: evict -> readmit
+    re-solves BIT-IDENTICALLY to the never-evicted path, and a budget
+    smaller than one slot degrades to one-at-a-time operation instead
+    of deadlocking."""
+
+    def _pts(self, n=3):
+        return {k: synthetic_problem(60, 12, seed=i, port_fraction=0.3,
+                                     volume_fraction=0.2)
+                for i, k in enumerate("ABCDEFGH"[:n])}
+
+    def test_evict_readmit_warm_seeds_bit_identically(self, monkeypatch):
+        monkeypatch.setenv("FLEET_SUBSOLVE", "0")
+        pts = self._pts()
+
+        # control: all three stages stay resident
+        ctl = TpuSolverScheduler(steps=32)
+        for k in "ABC":
+            ctl.place(pts[k], stage=k)
+        ref = ctl.reschedule(pts["A"], stage="A")
+
+        # pressured: 2 slots -> placing C evicts A (LRU); the later
+        # reschedule(A) re-admits from A's host snapshot
+        monkeypatch.setenv("FLEET_RESIDENT_STAGES", "2")
+        hot = TpuSolverScheduler(steps=32)
+        for k in "ABC":
+            hot.place(pts[k], stage=k)
+        st = hot.slots_status()
+        assert sorted(s["stage"] for s in st["slots"]) == ["B", "C"]
+        assert [e["stage"] for e in st["evicted"]] == ["A"]
+        assert st["evicted"][0]["snapshot"]      # warm snapshot captured
+        got = hot.reschedule(pts["A"], stage="A")
+        assert np.array_equal(ref.raw, got.raw)
+        assert got.feasible == ref.feasible
+
+    def test_tiny_byte_budget_never_deadlocks(self, monkeypatch):
+        """A 1-byte budget is smaller than any slot: the newly admitted
+        slot must never be its own eviction victim, so placement still
+        converges with exactly one (over-budget) slot resident."""
+        monkeypatch.setenv("FLEET_SUBSOLVE", "0")
+        pts = self._pts()
+        tiny = TpuSolverScheduler(steps=32, resident_bytes=1)
+        for k in "ABC":
+            placement = tiny.place(pts[k], stage=k)
+            assert placement.feasible
+        st = tiny.slots_status()
+        assert len(st["slots"]) == 1
+        assert st["slots"][0]["stage"] == "C"    # MRU survives
+        assert st["budget_bytes"] == 1
+        assert st["resident_bytes"] > 0          # accounting is live
+
+    def test_slots_status_shape(self):
+        pts = self._pts(2)
+        sched = TpuSolverScheduler(steps=32)
+        for k in "AB":
+            sched.place(pts[k], stage=k)
+        st = sched.slots_status()
+        assert {"budget_bytes", "max_slots", "resident_bytes",
+                "slots", "evicted"} <= set(st)
+        for s in st["slots"]:
+            assert {"stage", "tier", "bytes", "idle_s", "evictions",
+                    "warm"} <= set(s)
+            assert s["bytes"] > 0
+        total = sum(s["bytes"] for s in st["slots"])
+        assert st["resident_bytes"] == total
+
+    def test_place_many_matches_solo_reschedules(self, monkeypatch):
+        """The batched path through solve_multiplexed must commit the
+        same placements the solo warm reschedules would."""
+        monkeypatch.setenv("FLEET_SUBSOLVE", "0")
+        pts = self._pts()
+        solo_sched = TpuSolverScheduler(steps=32)
+        for k in "ABC":
+            solo_sched.place(pts[k], stage=k)
+        solo = {k: solo_sched.reschedule(pts[k], stage=k) for k in "ABC"}
+
+        many = TpuSolverScheduler(steps=32)
+        for k in "ABC":
+            many.place(pts[k], stage=k)
+        batch = many.place_many([{"pt": pts[k], "warm_start": True,
+                                  "stage": k} for k in "ABC"])
+        assert len(batch) == 3
+        for k, res in zip("ABC", batch):
+            assert np.array_equal(solo[k].raw, res.raw), k
+            assert res.feasible == solo[k].feasible
