@@ -20,13 +20,31 @@ happens to run. This package pins them statically, on every change:
   hygiene    FJ001+ AST rules over solver/ and cp/ (host sync inside jit,
              numpy/env reads in traced code, blocking calls in async
              handlers, awaits under the store lock), riding the lint/
-             Diagnostic machinery
+             Diagnostic machinery — strictly lexical, one function at a
+             time
+  callgraph  interprocedural call graph over the package (imports,
+             methods, functools.partial, decorator unwrapping, factory
+             dispatch like ``self._merge()(...)``) — the step hygiene
+             cannot take
+  dataflow   FJ007+ taint rules on top of the call graph: use-after-
+             donate (incl. the PR 14 device_get-view clobber), traced
+             values leaking into host control flow, env reads feeding
+             static jit args (recompile storms), deep host syncs under
+             hot-path executables, trace-time global writes
+  baseline   accepted-findings ledger (audit_baseline.json, keyed
+             rule+path+function) so new rules land strict in CI without
+             blocking on intentional findings
 
-Surfaces: `fleet audit kernels` / `fleet audit hygiene` (cli/main.py) and
-the pinned CI step. docs/guide/15-static-analysis.md is the operator's
-guide.
+Surfaces: `fleet audit kernels` / `fleet audit hygiene` / `fleet audit
+dataflow` / `fleet audit all` (cli/main.py) and the pinned CI step.
+docs/guide/15-static-analysis.md is the operator's guide.
 """
 
+from .baseline import (Baseline, apply_baseline, default_baseline_path,
+                       load_baseline, write_baseline)
+from .callgraph import CallGraph, build_graph
+from .dataflow import (DATAFLOW_RULES, dataflow_lint_paths,
+                       dataflow_lint_source, default_hot_roots)
 from .hygiene import HYGIENE_RULES, hygiene_lint_paths, hygiene_lint_source
 from .jitspec import JitDecl, extract_jit_decl
 
@@ -34,6 +52,17 @@ __all__ = [
     "HYGIENE_RULES",
     "hygiene_lint_paths",
     "hygiene_lint_source",
+    "DATAFLOW_RULES",
+    "dataflow_lint_paths",
+    "dataflow_lint_source",
+    "default_hot_roots",
+    "CallGraph",
+    "build_graph",
+    "Baseline",
+    "load_baseline",
+    "apply_baseline",
+    "write_baseline",
+    "default_baseline_path",
     "JitDecl",
     "extract_jit_decl",
     "audit_kernels",

@@ -1,0 +1,605 @@
+"""Interprocedural call graph over Python source, AST only.
+
+The hygiene pass (FJ001-FJ006) is strictly lexical: it sees a jit root's
+own body and nothing past the first call boundary. The dataflow rules
+(FJ007-FJ011, analysis/dataflow.py) need the step the lexical pass cannot
+take — *who calls whom*, across modules, with enough resolution power to
+follow the shapes this codebase actually dispatches through:
+
+  direct calls          ``merge(prob, a)``, ``mod.solve(pt)`` through the
+                        per-module import table
+  methods               ``self.apply_delta(...)`` in a class body;
+                        ``ClassName.m(...)``; ``x = ClassName(...)`` then
+                        ``x.m()`` (local construction); and a unique-name
+                        fallback — ``resident.adopt(x)`` resolves when
+                        exactly one class in the graph defines ``adopt``
+  functools.partial     ``g = partial(f, ...)`` then ``g(...)``
+  decorators            a decorated def still resolves to its own body
+                        (``@lru_cache`` on ``_merge_fn`` does not hide it)
+  factory dispatch      ``self._merge()(prob, assignment, ...)``: the
+                        inner call resolves to a function whose return is
+                        (transitively) a ``jax.jit(fn, donate_argnums=...)``
+                        wrap — the outer call is then a dispatch of that
+                        jitted fn, donation metadata included
+
+Everything is conservative under-approximation: an unresolvable call is
+simply absent from the graph (the dataflow pass treats it as a taint
+pass-through, never as evidence of safety). Stdlib-only ON PURPOSE, same
+contract as hygiene.py: scripts/selflint.py runs the dataflow pass in
+dependency-free environments, so importing this module must never pull
+jax or numpy.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from .jitspec import JitDecl, _decl_from_call, _is_jit_name
+
+__all__ = ["CallGraph", "FunctionInfo", "ClassInfo", "build_graph",
+           "module_name_for"]
+
+_Def = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+# attribute names too generic to resolve through the unique-method-name
+# fallback (they collide with dict/str/list builtins constantly)
+_GENERIC_METHODS = {
+    "get", "items", "keys", "values", "copy", "append", "update", "pop",
+    "setdefault", "split", "join", "strip", "format", "read", "write",
+    "close", "add", "remove", "clear", "extend", "sort", "index", "count",
+    "encode", "decode", "startswith", "endswith", "lower", "upper",
+    "replace", "sum", "mean", "min", "max", "reshape", "astype", "item",
+    "flatten", "tolist", "all", "any", "set", "put", "send", "recv",
+}
+
+# a `# fleet-audit: hot-path` comment on (or immediately above) a def
+# marks it as a hot-path root for FJ010 without a contracts.py entry —
+# the hook the canary fixtures use
+_HOT_MARK = "fleet-audit: hot-path"
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method definition in the graph."""
+    module: str                    # dotted module name
+    qualname: str                  # lexical path inside the module
+    path: str                      # source path (as given to the builder)
+    node: _Def
+    cls: Optional[str] = None      # enclosing class lexical qualname
+    jit: Optional[JitDecl] = None  # jit declaration, when one exists
+    # positional parameter names, then kw-only (for arg->param mapping)
+    pos_params: list[str] = field(default_factory=list)
+    kw_params: list[str] = field(default_factory=list)
+    hot_mark: bool = False         # `# fleet-audit: hot-path` marker
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def all_params(self) -> list[str]:
+        return [*self.pos_params, *self.kw_params]
+
+    def is_method(self) -> bool:
+        return self.cls is not None and self.pos_params[:1] == ["self"]
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    qualname: str                  # lexical qualname of the class
+    bases: list[str] = field(default_factory=list)   # dotted base names
+    methods: dict[str, str] = field(default_factory=dict)  # name -> fn key
+    # attributes passed in a donated position of some dispatch inside the
+    # class's own methods: self.<attr> is a donated device slot
+    donated_attrs: set[str] = field(default_factory=set)
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+
+@dataclass
+class _Module:
+    name: str
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    # import alias -> dotted target ("jax", "fleetflow_tpu.solver.api",
+    # "fleetflow_tpu.solver.api.solve")
+    imports: dict[str, str] = field(default_factory=dict)
+    # module-level `g = jax.jit(f, ...)` / `g = partial(f, ...)` aliases
+    fn_aliases: dict[str, str] = field(default_factory=dict)  # -> local fn
+    # names bound at module top level (FJ011's module-global set)
+    globals: set[str] = field(default_factory=set)
+    # local function names passed to pure_callback/io_callback (host side)
+    host_cb: set[str] = field(default_factory=set)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def module_name_for(path: str, package_root: Optional[str]) -> str:
+    """Dotted module name for a source path. Files outside the package
+    root (e.g. canary fixtures) get their bare stem as the module name."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if package_root:
+        root = os.path.abspath(package_root)
+        apath = os.path.abspath(path)
+        parent = os.path.dirname(root)
+        if apath.startswith(root + os.sep) or apath == root:
+            rel = os.path.relpath(apath, parent)
+            mod = rel[:-3] if rel.endswith(".py") else rel
+            mod = mod.replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            return mod
+    return stem
+
+
+def _params(fn: _Def) -> tuple[list[str], list[str]]:
+    a = fn.args
+    return ([p.arg for p in (*a.posonlyargs, *a.args)],
+            [p.arg for p in a.kwonlyargs])
+
+
+def _jit_from_decorators(fn: _Def) -> Optional[JitDecl]:
+    for dec in fn.decorator_list:
+        if isinstance(dec, (ast.Name, ast.Attribute)) and _is_jit_name(dec):
+            return _decl_from_call(ast.Call(func=dec, args=[], keywords=[]),
+                                   fn)
+        if isinstance(dec, ast.Call):
+            if _is_jit_name(dec.func):
+                return _decl_from_call(dec, fn)
+            if _dotted(dec.func) in ("partial", "functools.partial") \
+                    and dec.args and _is_jit_name(dec.args[0]):
+                return _decl_from_call(dec, fn)
+    return None
+
+
+def _is_cached_decorator(dec: ast.AST) -> bool:
+    name = _dotted(dec.func if isinstance(dec, ast.Call) else dec)
+    return name in ("lru_cache", "functools.lru_cache", "cache",
+                    "functools.cache", "cached_property",
+                    "functools.cached_property")
+
+
+class CallGraph:
+    """The package-wide index: functions, classes, imports, jit decls."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, _Module] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        # method name -> class keys defining it (unique-name fallback)
+        self._method_index: dict[str, list[str]] = {}
+        # fn key -> key of the local def its return value IS (for
+        # factory-dispatch resolution: `return jax.jit(merge, ...)` or
+        # `return _merge_fn()`); "CALL:<key>" marks a transitive hop
+        self._returned_fn: dict[str, str] = {}
+        # attribute names that are donated slots on SOME class (the
+        # dataflow view heuristic reads this set)
+        self.donated_attr_names: set[str] = set()
+
+    # -- construction ------------------------------------------------------
+
+    def add_source(self, path: str, source: str, module: str) -> None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return              # selflint's syntax check owns parse errors
+        mod = _Module(name=module, path=path, tree=tree,
+                      lines=source.splitlines())
+        self.modules[module] = mod
+        self._index_imports(mod)
+        self._index_defs(mod)
+        self._index_module_jit_calls(mod)
+
+    def finalize(self) -> None:
+        """Second pass once every module is indexed: late-attach jit
+        decls recorded before their defs existed, then per-class donated
+        slots (needs call resolution, so it must run after all defs
+        exist)."""
+        for local, call in getattr(self, "_pending_jit", []):
+            fi = self.functions.get(local)
+            if fi is not None and fi.jit is None:
+                fi.jit = _decl_from_call(call, fi.node)
+        for cls in self.classes.values():
+            for mname, fkey in cls.methods.items():
+                fn = self.functions.get(fkey)
+                if fn is None:
+                    continue
+                for call in ast.walk(fn.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    decl = self.dispatch_decl(fn, call)
+                    if decl is None or not decl.donated_params:
+                        continue
+                    for pos, argname in enumerate(decl.params):
+                        if argname not in decl.donated_params:
+                            continue
+                        if pos < len(call.args):
+                            d = _dotted(call.args[pos])
+                            if d.startswith("self."):
+                                attr = d.split(".", 1)[1]
+                                cls.donated_attrs.add(attr)
+                                self.donated_attr_names.add(attr)
+
+    def _index_imports(self, mod: _Module) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module
+                if node.level:
+                    # relative import: resolve against this module's pkg
+                    parts = mod.name.split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + [node.module])
+                for a in node.names:
+                    if a.name != "*":
+                        mod.imports[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _index_defs(self, mod: _Module) -> None:
+        hot_lines = {i + 2 for i, ln in enumerate(mod.lines)
+                     if _HOT_MARK in ln}
+
+        def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{prefix}{child.name}"
+                    pos, kw = _params(child)
+                    line_txt = (mod.lines[child.lineno - 1]
+                                if child.lineno <= len(mod.lines) else "")
+                    marked = (_HOT_MARK in line_txt
+                              or child.lineno in hot_lines
+                              or any(getattr(d, "lineno", 0) in hot_lines
+                                     or _HOT_MARK in
+                                     (mod.lines[d.lineno - 1]
+                                      if 0 < getattr(d, "lineno", 0)
+                                      <= len(mod.lines) else "")
+                                     for d in child.decorator_list))
+                    info = FunctionInfo(
+                        module=mod.name, qualname=q, path=mod.path,
+                        node=child, cls=cls,
+                        jit=_jit_from_decorators(child),
+                        pos_params=pos, kw_params=kw, hot_mark=marked)
+                    self.functions[info.key] = info
+                    if cls is not None and "." not in q[len(cls) + 1:]:
+                        ck = f"{mod.name}:{cls}"
+                        self.classes[ck].methods[child.name] = info.key
+                        if child.name not in _GENERIC_METHODS:
+                            self._method_index.setdefault(
+                                child.name, []).append(ck)
+                    self._index_returned_fn(mod, info)
+                    visit(child, q + ".", cls)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{prefix}{child.name}"
+                    self.classes[f"{mod.name}:{q}"] = ClassInfo(
+                        module=mod.name, qualname=q,
+                        bases=[_dotted(b) for b in child.bases])
+                    visit(child, q + ".", q)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(mod.tree, "", None)
+        # module-level bindings (FJ011) + host-callback functions
+        for node in mod.tree.body:
+            for tgt in getattr(node, "targets", []) or \
+                    ([node.target] if isinstance(
+                        node, (ast.AnnAssign, ast.AugAssign)) else []):
+                if isinstance(tgt, ast.Name):
+                    mod.globals.add(tgt.id)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                mod.globals.add(node.name)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if any(name == w or name.endswith("." + w) for w in
+                       ("pure_callback", "io_callback", "callback")) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    mod.host_cb.add(node.args[0].id)
+
+    def _index_returned_fn(self, mod: _Module, info: FunctionInfo) -> None:
+        """Record what a factory's return value IS, when statically
+        evident: a local def name, a jax.jit(localdef, ...) wrap (the
+        decl lands on the local def), or a call to another known factory
+        (stored as a transitive hop)."""
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Name):
+                local = f"{mod.name}:{info.qualname}.{v.id}"
+                if local in self.functions or True:
+                    self._returned_fn[info.key] = local
+                return
+            if isinstance(v, ast.Call):
+                if _is_jit_name(v.func) and v.args and \
+                        isinstance(v.args[0], ast.Name):
+                    local = f"{mod.name}:{info.qualname}.{v.args[0].id}"
+                    self._returned_fn[info.key] = local
+                    # attach the decl to the wrapped local def
+                    fi = self.functions.get(local)
+                    if fi is not None and fi.jit is None:
+                        fi.jit = _decl_from_call(v, fi.node)
+                    else:
+                        self._pending_jit = getattr(
+                            self, "_pending_jit", [])
+                        self._pending_jit.append((local, v))
+                    return
+                self._returned_fn[info.key] = f"CALL:{info.key}:{v!r}"
+                # remember the call so returned_callable can resolve it
+                self._returned_call = getattr(self, "_returned_call", {})
+                self._returned_call[info.key] = v
+                return
+
+    def _index_module_jit_calls(self, mod: _Module) -> None:
+        """`g = jax.jit(f, ...)` / `g = partial(f, ...)` at module (or
+        any) level: g becomes an alias of f, and a jit wrap attaches its
+        decl to f."""
+        for node in ast.walk(mod.tree):
+            call: Optional[ast.Call] = None
+            target: Optional[str] = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                call, target = node.value, node.targets[0].id
+            elif isinstance(node, ast.Call):
+                call = node
+            if call is None:
+                continue
+            is_jit = _is_jit_name(call.func)
+            is_partial = _dotted(call.func) in ("partial",
+                                                "functools.partial")
+            if not (is_jit or is_partial):
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            inner = call.args[0].id
+            fi = self._find_in_module(mod.name, inner)
+            if fi is None:
+                continue
+            if is_jit and fi.jit is None:
+                fi.jit = _decl_from_call(call, fi.node)
+            if target is not None:
+                mod.fn_aliases[target] = fi.key
+
+    def _find_in_module(self, module: str,
+                        name: str) -> Optional[FunctionInfo]:
+        """A def called `name` anywhere in `module` (module level
+        preferred, then any nesting depth — jit wrap calls usually sit
+        next to the def they wrap)."""
+        fi = self.functions.get(f"{module}:{name}")
+        if fi is not None:
+            return fi
+        for key, cand in self.functions.items():
+            if cand.module == module and cand.name == name:
+                return cand
+        return None
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_name(self, caller: FunctionInfo,
+                     name: str) -> Optional[FunctionInfo]:
+        """A bare Name in `caller`'s body -> FunctionInfo, walking the
+        lexical scope chain, then module level, then import aliases."""
+        mod = self.modules.get(caller.module)
+        # lexical chain: caller.qualname prefixes, innermost first
+        parts = caller.qualname.split(".")
+        for depth in range(len(parts), -1, -1):
+            prefix = ".".join(parts[:depth])
+            q = f"{prefix}.{name}" if prefix else name
+            fi = self.functions.get(f"{caller.module}:{q}")
+            if fi is not None:
+                return fi
+        if mod is not None:
+            alias = mod.fn_aliases.get(name)
+            if alias is not None:
+                return self.functions.get(alias)
+            target = mod.imports.get(name)
+            if target is not None and "." in target:
+                tmod, _, tname = target.rpartition(".")
+                fi = self.functions.get(f"{tmod}:{tname}")
+                if fi is not None:
+                    return fi
+        return None
+
+    def resolve_class(self, module: str, name: str) -> Optional[ClassInfo]:
+        ci = self.classes.get(f"{module}:{name}")
+        if ci is not None:
+            return ci
+        mod = self.modules.get(module)
+        if mod is not None:
+            target = mod.imports.get(name)
+            if target and "." in target:
+                tmod, _, tname = target.rpartition(".")
+                return self.classes.get(f"{tmod}:{tname}")
+        return None
+
+    def method_on(self, cls: ClassInfo, name: str, *,
+                  _seen: Optional[set] = None) -> Optional[FunctionInfo]:
+        """Resolve a method on a class, walking base classes inside the
+        graph (single inheritance chains; cycles guarded)."""
+        _seen = _seen or set()
+        if cls.key in _seen:
+            return None
+        _seen.add(cls.key)
+        fkey = cls.methods.get(name)
+        if fkey is not None:
+            return self.functions.get(fkey)
+        for base in cls.bases:
+            bci = self.resolve_class(cls.module, base.split(".")[-1]) \
+                if "." not in base else self.resolve_class(
+                    cls.module, base.split(".")[-1])
+            if bci is not None:
+                fi = self.method_on(bci, name, _seen=_seen)
+                if fi is not None:
+                    return fi
+        return None
+
+    def resolve_call(self, caller: FunctionInfo, call: ast.Call,
+                     local_types: Optional[dict] = None) \
+            -> Optional[FunctionInfo]:
+        """Resolve a call expression to its FunctionInfo, or None.
+        `local_types` maps local variable names to ClassInfo keys
+        (maintained by the dataflow interpreter for `x = ClassName(...)`
+        locals)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            fi = self.resolve_name(caller, func.id)
+            if fi is not None:
+                return fi
+            # ClassName(...) -> __init__ (constructor edge)
+            ci = self.resolve_class(caller.module, func.id)
+            if ci is not None:
+                return self.method_on(ci, "__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            base = func.value
+            # self.m(...)
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and caller.cls is not None:
+                ci = self.classes.get(f"{caller.module}:{caller.cls}")
+                if ci is not None:
+                    fi = self.method_on(ci, attr)
+                    if fi is not None:
+                        return fi
+            # x.m(...) with x a known-constructed local
+            if isinstance(base, ast.Name) and local_types:
+                ck = local_types.get(base.id)
+                if ck is not None:
+                    ci = self.classes.get(ck)
+                    if ci is not None:
+                        fi = self.method_on(ci, attr)
+                        if fi is not None:
+                            return fi
+            # mod.f(...) / pkg.mod.f(...) through the import table
+            dotted = _dotted(func)
+            if dotted:
+                root = dotted.split(".")[0]
+                mod = self.modules.get(caller.module)
+                target = mod.imports.get(root) if mod else None
+                if target is not None:
+                    full = target + dotted[len(root):]
+                    tmod, _, tname = full.rpartition(".")
+                    fi = self.functions.get(f"{tmod}:{tname}")
+                    if fi is not None:
+                        return fi
+                    # mod.Class.method
+                    parts = full.split(".")
+                    if len(parts) >= 3:
+                        ci = self.classes.get(
+                            ".".join(parts[:-2]) + ":" + parts[-2])
+                        if ci is not None:
+                            return self.method_on(ci, parts[-1])
+            # ClassName.m(...) in the same module
+            if isinstance(base, ast.Name):
+                ci = self.resolve_class(caller.module, base.id)
+                if ci is not None:
+                    fi = self.method_on(ci, attr)
+                    if fi is not None:
+                        return fi
+            # unique-method-name fallback: exactly one class defines it
+            owners = self._method_index.get(attr, [])
+            if len(owners) == 1:
+                ci = self.classes.get(owners[0])
+                if ci is not None:
+                    return self.method_on(ci, attr)
+            return None
+        if isinstance(func, ast.Call):
+            # factory dispatch: f(...)(args) — resolve what f returns
+            inner = self.resolve_call(caller, func, local_types)
+            if inner is not None:
+                return self.returned_callable(inner)
+        return None
+
+    def returned_callable(self, fn: FunctionInfo,
+                          depth: int = 0) -> Optional[FunctionInfo]:
+        """The function `fn`'s return value IS, following factory chains
+        (`_merge` -> `_merge_fn()` -> `jax.jit(merge, ...)` -> merge) up
+        to 8 hops. Decorators on the factories (lru_cache) are ignored —
+        the body is what we read."""
+        if depth > 8:
+            return None
+        target = self._returned_fn.get(fn.key)
+        if target is None:
+            return None
+        if target.startswith("CALL:"):
+            call = getattr(self, "_returned_call", {}).get(fn.key)
+            if call is None:
+                return None
+            inner = self.resolve_call(fn, call)
+            if inner is None:
+                return None
+            out = self.returned_callable(inner, depth + 1)
+            return out if out is not None else inner
+        fi = self.functions.get(target)
+        if fi is None:
+            # `return name` where name is not a local def — maybe a
+            # module-level alias or sibling def
+            name = target.rsplit(".", 1)[-1]
+            fi = self.resolve_name(fn, name)
+        return fi
+
+    def dispatch_decl(self, caller: FunctionInfo,
+                      call: ast.Call,
+                      local_types: Optional[dict] = None) \
+            -> Optional[JitDecl]:
+        """When `call` dispatches a jitted executable (directly, through
+        an alias, or through a factory like ``self._merge()(...)``),
+        return its JitDecl — donation + statics metadata included."""
+        fi = self.resolve_call(caller, call, local_types)
+        if fi is not None and fi.jit is not None:
+            return fi.jit
+        return None
+
+    def is_host_callback(self, fn: FunctionInfo) -> bool:
+        mod = self.modules.get(fn.module)
+        return mod is not None and fn.name in mod.host_cb
+
+    def jit_roots(self) -> list[FunctionInfo]:
+        return [f for f in self.functions.values() if f.jit is not None]
+
+    def module_globals(self, module: str) -> set[str]:
+        mod = self.modules.get(module)
+        return mod.globals if mod is not None else set()
+
+
+def build_graph(paths: list[str],
+                package_root: Optional[str] = None,
+                rel_to: Optional[str] = None) -> CallGraph:
+    """Parse every file and build the package call graph. `paths` are
+    files; `package_root` (a directory named like the package) anchors
+    dotted module names; diagnostics later use the paths verbatim, so
+    pass them pre-relativized when CI-stable spans matter."""
+    g = CallGraph()
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        shown = os.path.relpath(path, rel_to) if rel_to else path
+        g.add_source(shown, source, module_name_for(path, package_root))
+    g.finalize()
+    return g

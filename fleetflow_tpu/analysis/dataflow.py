@@ -1,0 +1,1075 @@
+"""FJ007-FJ011 — interprocedural dataflow rules over the call graph.
+
+hygiene.py proves what a *single function body* can prove; this module
+takes the step past the call boundary. On top of analysis/callgraph.py it
+seeds taints at known sources and pushes them through calls, returns,
+assignments, and dataclass field access with a small fixed-point lattice,
+then evaluates five rules the lexical pass is structurally blind to:
+
+  FJ007  error    use of a donated buffer after dispatch — including the
+                  PR 14 pattern where `device_get`/slicing produced a
+                  live VIEW of an array that a later dispatch donates
+  FJ008  error    traced value reaching Python control flow or a
+                  `bool()`/comparison context through any call depth
+  FJ009  warning  unbounded host value (env/config read) flowing into a
+                  `static_argnames` parameter: every distinct value is a
+                  fresh XLA compile (the PR 4 ladder storm, statically)
+  FJ010  error    implicit host sync (`np.asarray`/`float()`/`.item()` on
+                  a traced value) reachable from a registered hot-path
+                  executable (solver/contracts.py) one or more calls deep
+                  — depth 0 is hygiene's FJ001/FJ003 territory
+  FJ011  error    module-global mutable state written inside a traced
+                  region: the write happens once at trace time, then
+                  never again on the compiled path
+
+The lattice is deliberately small. A value's taint is a set drawn from
+{traced, unbounded, view} plus symbolic placeholders P<i> ("whatever
+taint the i-th parameter has"); joins are set union, transfer functions
+only ever add, so per-function summaries recomputed from callee
+summaries are monotone and the fixed point terminates. Precision follows
+the codebase's idioms, not the general case: static dataclass fields
+(``field(metadata=dict(static=True))``) shed the traced taint on
+attribute access, shape/dtype accessors are benign, ``lru_cache``-
+wrapped env readers count as read-once (bounded) while uncached ones
+stay unbounded, and a donated name rebound in the *same statement* as
+its dispatch (``self.prob, self.assignment = merge(self.prob, ...)``) is
+the sanctioned donation idiom, not a use-after-free.
+
+Suppression: trailing ``# noqa: FJ0xx`` (hygiene's grammar), or an
+``audit_baseline.json`` entry keyed rule+path+function
+(analysis/baseline.py). Stdlib-only ON PURPOSE — scripts/selflint.py
+runs this pass in dependency-free environments.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from typing import Optional, Union
+
+from ..lint.diagnostics import Diagnostic, Severity
+from .callgraph import (CallGraph, FunctionInfo, build_graph,
+                        module_name_for)
+from .hygiene import _noqa_codes, iter_python_files
+
+_Def = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+__all__ = ["DataflowRule", "DATAFLOW_RULES", "dataflow_lint_paths",
+           "dataflow_lint_source", "default_hot_roots"]
+
+
+@dataclass(frozen=True)
+class DataflowRule:
+    code: str
+    slug: str
+    severity: Severity
+    doc: str
+
+
+DATAFLOW_RULES: list[DataflowRule] = [
+    DataflowRule("FJ007", "use-after-donate", Severity.ERROR,
+                 "donated buffer (or a live view of one) used after the "
+                 "dispatch that donates it"),
+    DataflowRule("FJ008", "traced-control-flow", Severity.ERROR,
+                 "traced value reaches Python control flow / bool() "
+                 "through a call chain"),
+    DataflowRule("FJ009", "unbounded-static-arg", Severity.WARNING,
+                 "unbounded host value flows into a static jit argument "
+                 "(recompile per distinct value)"),
+    DataflowRule("FJ010", "deep-host-sync", Severity.ERROR,
+                 "implicit host sync on a traced value reachable from a "
+                 "hot-path executable"),
+    DataflowRule("FJ011", "global-write-in-trace", Severity.ERROR,
+                 "module-global state written inside a traced region "
+                 "(happens once, at trace time)"),
+]
+
+_RULE = {r.code: r for r in DATAFLOW_RULES}
+
+TRACED = "traced"
+UNBOUNDED = "unbounded"
+VIEW = "view"          # result aliases device memory (device_get on CPU)
+
+# attribute reads that never carry the base value's taint forward as data
+_BENIGN_ATTRS = {"shape", "dtype", "ndim", "size", "name", "sharding",
+                 "itemsize", "nbytes"}
+
+# calls whose result on a device array is (or may be) a VIEW of it — the
+# PR 14 class: jax.device_get on the CPU backend returns a zero-copy
+# view; np.asarray is copy-free when the dtype already matches
+_VIEW_SUFFIXES = ("device_get", "asarray")
+
+# calls that defensively COPY (break the alias); np.array copies by
+# default, np.copy always, .copy() on an ndarray always
+_COPY_SUFFIXES = ("array", "copy", "deepcopy", "ascontiguousarray")
+
+# builtins whose result is a host scalar — taint-wise they only keep
+# the unbounded-cardinality component (a traced operand is a SINK
+# concern, recorded separately)
+_SCALAR_BUILTINS = ("int", "float", "str", "bool", "len", "min", "max",
+                    "abs", "round")
+
+_ENV_READS = ("os.getenv", "getenv")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_view_call(name: str) -> bool:
+    leaf, root = name.split(".")[-1], name.split(".")[0]
+    if leaf == "device_get":
+        return True
+    # jnp.asarray is a DEVICE op — only numpy's asarray aliases host mem
+    return leaf == "asarray" and root in ("np", "numpy")
+
+
+def _is_copy_call(name: str) -> bool:
+    leaf = name.split(".")[-1]
+    if leaf in ("copy", "deepcopy", "ascontiguousarray"):
+        return True
+    return leaf == "array" and "." in name      # np.array copies
+
+
+def _is_sync_call(name: str) -> bool:
+    leaf, root = name.split(".")[-1], name.split(".")[0]
+    if leaf == "device_get":
+        return True
+    # jnp/jax.numpy stay on device; np.* pulls to host
+    return leaf in ("asarray", "array") and root in ("np", "numpy")
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        if name in _ENV_READS:
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and _dotted(node.func.value) == "os.environ":
+            return True
+    if isinstance(node, ast.Subscript) \
+            and _dotted(node.value) == "os.environ":
+        return True
+    return False
+
+
+def _static_fields(graph: CallGraph) -> set[str]:
+    """Dataclass field names declared ``static=True`` anywhere in the
+    graph (DeviceProblem.S etc.): attribute access on them sheds the
+    traced taint — they are Python ints by contract, hashed into the
+    executable identity, never tracers."""
+    out: set[str] = set()
+    for mod in graph.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AnnAssign) \
+                    or not isinstance(node.target, ast.Name) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if _dotted(call.func).split(".")[-1] != "field":
+                continue
+            for kw in call.keywords:
+                if kw.arg == "metadata" and "static" in ast.dump(kw.value):
+                    out.add(node.target.id)
+    return out
+
+
+def default_hot_roots(graph: CallGraph) -> set[str]:
+    """Function keys registered as hot-path executables: the
+    KernelContract(module=..., qualname=...) entries in
+    solver/contracts.py, plus anything carrying the
+    ``# fleet-audit: hot-path`` marker (the fixture hook)."""
+    roots: set[str] = set()
+    for mod in graph.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func).split(".")[-1] != "KernelContract":
+                continue
+            kws = {kw.arg: kw.value for kw in node.keywords}
+            m, q = kws.get("module"), kws.get("qualname")
+            if isinstance(m, ast.Constant) and isinstance(q, ast.Constant):
+                roots.add(f"{m.value}:{q.value}")
+    for fn in graph.functions.values():
+        if fn.hot_mark:
+            roots.add(fn.key)
+    return roots
+
+
+@dataclass
+class Sink:
+    kind: str            # "bool" | "sync" | "static" | "global"
+    file: str
+    line: int
+    col: int
+    detail: str          # human fragment for the message
+    fn_key: str          # function the sink is lexically in
+    depth: int = 0       # call depth below the summarized function
+    static_target: str = ""   # "static": jitted fn + param the value hits
+
+
+@dataclass
+class Summary:
+    """What one function does with its parameters — symbolically.
+
+    Recomputed from scratch each fixed-point pass (callee summaries are
+    the only carried state), so growth is monotone in the callee lattice
+    and list fields never accumulate duplicates across passes.
+    """
+    # param index -> its taint flows into the return value
+    param_to_ret: set[int] = field(default_factory=set)
+    # concrete taints the return value carries regardless of params
+    ret_taints: set[str] = field(default_factory=set)
+    # param index -> sinks its taint reaches here (or in callees);
+    # index -1 is the concrete channel: unbounded-into-static flows
+    # discovered in THIS function (FJ009 evidence, reported once)
+    param_sinks: dict[int, list[Sink]] = field(default_factory=dict)
+    # param indices stored into a donated device slot (self.<attr>)
+    param_to_donated_slot: set[int] = field(default_factory=set)
+    # param indices whose return value is a VIEW of them
+    ret_view_of: set[int] = field(default_factory=set)
+    # self.<attr> slots a call to this method donates (directly or via
+    # self.m() calls) — the FJ007 method-donation arm reads this
+    donates_self_slots: set[str] = field(default_factory=set)
+    # module-global names written in this function's own body
+    global_writes: list[Sink] = field(default_factory=list)
+    # env/config reads inside this fn (potential FJ009 sources)
+    env_reads: list[tuple[int, int]] = field(default_factory=list)
+    cached: bool = False     # lru_cache-wrapped: env reads are read-once
+
+    def size(self) -> tuple:
+        return (len(self.param_to_ret), len(self.ret_taints),
+                sum(len(v) for v in self.param_sinks.values()),
+                len(self.param_to_donated_slot), len(self.ret_view_of),
+                len(self.donates_self_slots), len(self.global_writes),
+                len(self.env_reads))
+
+
+class _SummaryBuilder:
+    def __init__(self, graph: CallGraph, static_fields: set[str]):
+        self.graph = graph
+        self.static_fields = static_fields
+        self.summaries: dict[str, Summary] = {}
+        self._cached_keys: set[str] = set()
+        for k, fn in graph.functions.items():
+            cached = any(
+                _dotted(d.func if isinstance(d, ast.Call) else d)
+                in ("lru_cache", "functools.lru_cache", "cache",
+                    "functools.cache")
+                for d in fn.node.decorator_list)
+            if cached:
+                self._cached_keys.add(k)
+            self.summaries[k] = Summary(cached=cached)
+
+    def run(self) -> dict[str, Summary]:
+        for _ in range(12):                       # bounded fixed point
+            before = {k: s.size() for k, s in self.summaries.items()}
+            for fn in self.graph.functions.values():
+                self._summarize(fn)
+            if {k: s.size() for k, s in self.summaries.items()} == before:
+                break
+        return self.summaries
+
+    # -- expression taint evaluation --------------------------------------
+
+    def _eval(self, fn: FunctionInfo, expr: ast.AST,
+              env: dict[str, set[str]],
+              local_types: dict[str, str]) -> set[str]:
+        """Taint set of an expression under `env` (name -> taints)."""
+        if isinstance(expr, ast.Name):
+            return set(env.get(expr.id, ()))
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _BENIGN_ATTRS \
+                    or expr.attr in self.static_fields:
+                return set()
+            return self._eval(fn, expr.value, env, local_types)
+        if isinstance(expr, ast.Subscript):
+            if _dotted(expr.value) == "os.environ":
+                return {UNBOUNDED}
+            return self._eval(fn, expr.value, env, local_types)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out: set[str] = set()
+            for e in expr.elts:
+                out |= self._eval(fn, e, env, local_types)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = set()
+            for v in expr.values:
+                if v is not None:
+                    out |= self._eval(fn, v, env, local_types)
+            return out
+        if isinstance(expr, ast.BinOp):
+            return self._eval(fn, expr.left, env, local_types) | \
+                self._eval(fn, expr.right, env, local_types)
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(fn, expr.operand, env, local_types)
+        if isinstance(expr, ast.IfExp):
+            return (self._eval(fn, expr.body, env, local_types)
+                    | self._eval(fn, expr.orelse, env, local_types))
+        if isinstance(expr, ast.Compare):
+            # `x is None` / `x is not None` are identity checks on the
+            # Python structure, never tracer concretizations
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return set()
+            out = self._eval(fn, expr.left, env, local_types)
+            for c in expr.comparators:
+                out |= self._eval(fn, c, env, local_types)
+            return out
+        if isinstance(expr, ast.BoolOp):
+            out = set()
+            for v in expr.values:
+                out |= self._eval(fn, v, env, local_types)
+            return out
+        if isinstance(expr, ast.Starred):
+            return self._eval(fn, expr.value, env, local_types)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(fn, expr, env, local_types)
+        return set()
+
+    def _eval_call(self, fn: FunctionInfo, call: ast.Call,
+                   env: dict[str, set[str]],
+                   local_types: dict[str, str]) -> set[str]:
+        name = _dotted(call.func)
+        joined: set[str] = set()
+        for a in call.args:
+            joined |= self._eval(fn, a, env, local_types)
+        for kw in call.keywords:
+            joined |= self._eval(fn, kw.value, env, local_types)
+
+        if _is_env_read(call):
+            return {UNBOUNDED}
+        if name in _SCALAR_BUILTINS:
+            return {t for t in joined if t == UNBOUNDED
+                    or t.startswith("P")}
+
+        callee = self.graph.resolve_call(fn, call, local_types)
+        if callee is not None:
+            s = self.summaries.get(callee.key)
+            if s is not None:
+                out = set(s.ret_taints)
+                # env reads inside an *uncached* callee make its return
+                # unbounded; lru_cache-wrapped readers are read-once
+                if s.env_reads and not s.cached:
+                    out.add(UNBOUNDED)
+                mapping = self._map_args(callee, call)
+                for pi in s.param_to_ret:
+                    expr_i = mapping.get(pi)
+                    if expr_i is not None:
+                        out |= self._taint_of_arg(fn, call, expr_i, env,
+                                                  local_types)
+                return out
+        # unresolved: conservative pass-through of argument taints
+        # (jnp.where(mask, a, b) keeps 'traced' flowing)
+        return joined
+
+    def _taint_of_arg(self, fn: FunctionInfo, call: ast.Call,
+                      expr_i: Union[int, str],
+                      env: dict[str, set[str]],
+                      local_types: dict[str, str]) -> set[str]:
+        if isinstance(expr_i, int):
+            if expr_i < len(call.args):
+                return self._eval(fn, call.args[expr_i], env, local_types)
+            return set()
+        for kw in call.keywords:
+            if kw.arg == expr_i:
+                return self._eval(fn, kw.value, env, local_types)
+        return set()
+
+    def _map_args(self, callee: FunctionInfo, call: ast.Call) \
+            -> dict[int, Union[int, str, None]]:
+        """callee param index -> caller arg position (int) or kw name.
+        Methods skip the self slot; positions past a *args expansion are
+        unmapped (conservative)."""
+        params = callee.all_params
+        offset = 1 if callee.is_method() else 0
+        out: dict[int, Union[int, str, None]] = {}
+        for i, p in enumerate(params):
+            if i < offset:
+                continue
+            pos = i - offset
+            if pos < len(call.args) \
+                    and not any(isinstance(a, ast.Starred)
+                                for a in call.args[:pos + 1]):
+                out[i] = pos
+            else:
+                out[i] = p if any(kw.arg == p for kw in call.keywords) \
+                    else None
+        return out
+
+    # -- per-function summarization ---------------------------------------
+
+    def _summarize(self, fn: FunctionInfo) -> None:
+        s = Summary(cached=fn.key in self._cached_keys)
+        params = fn.all_params
+        env: dict[str, set[str]] = {p: {f"P{i}"}
+                                    for i, p in enumerate(params)}
+        local_types: dict[str, str] = {}
+        # dict literals assigned to a name: per-key taints, so a later
+        # **name expansion maps keys onto callee static params
+        dict_keys: dict[str, dict[str, set[str]]] = {}
+        mod_globals = self.graph.module_globals(fn.module)
+        declared_globals: set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global):
+                declared_globals.update(node.names)
+        donated_attrs = self._donated_attrs_for(fn)
+
+        def sink(kind: str, node: ast.AST, detail: str) -> Sink:
+            return Sink(kind=kind, file=fn.path,
+                        line=getattr(node, "lineno", 0),
+                        col=getattr(node, "col_offset", 0) + 1,
+                        detail=detail, fn_key=fn.key)
+
+        def add_sink(pi: int, snk: Sink) -> None:
+            lst = s.param_sinks.setdefault(pi, [])
+            if not any(x.line == snk.line and x.col == snk.col
+                       and x.kind == snk.kind and x.file == snk.file
+                       for x in lst):
+                lst.append(snk)
+
+        def record(taints: set[str], snk: Sink) -> None:
+            for t in taints:
+                if t.startswith("P"):
+                    try:
+                        add_sink(int(t[1:]), snk)
+                    except ValueError:
+                        pass
+
+        def static_sink(node: ast.AST, decl, pname: str,
+                        taints: set[str]) -> None:
+            snk = Sink(kind="static", file=fn.path,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       detail=f"static arg `{pname}` of jitted "
+                              f"`{decl.fn_name}`",
+                       fn_key=fn.key,
+                       static_target=f"{decl.fn_name}.{pname}")
+            record(taints, snk)
+            if UNBOUNDED in taints:
+                add_sink(-1, snk)
+
+        def handle_call(call: ast.Call) -> None:
+            name = _dotted(call.func)
+            # concretization / sync sinks on the first operand
+            tgt: Optional[ast.AST] = None
+            kind = ""
+            if name in ("float", "int") and len(call.args) == 1:
+                tgt, kind = call.args[0], "sync"
+            elif name == "bool" and len(call.args) == 1:
+                tgt, kind = call.args[0], "bool"
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "item" and not call.args:
+                tgt, kind = call.func.value, "sync"
+            elif _is_sync_call(name) and call.args:
+                tgt, kind = call.args[0], "sync"
+            if tgt is not None:
+                taints = self._eval(fn, tgt, env, local_types)
+                label = name or "item"
+                record(taints, sink(kind, call, f"`{label}(...)`"))
+
+            # static-argnames sinks at a jit dispatch
+            decl = self.graph.dispatch_decl(fn, call, local_types)
+            if decl is not None and decl.donated_params:
+                for pos, pname in enumerate(decl.params):
+                    if pname in decl.donated_params \
+                            and pos < len(call.args) \
+                            and not any(isinstance(a, ast.Starred)
+                                        for a in call.args[:pos + 1]):
+                        d = _dotted(call.args[pos])
+                        if d.startswith("self."):
+                            s.donates_self_slots.add(d.split(".", 1)[1])
+            if decl is not None and decl.static_args:
+                for pos, a in enumerate(call.args):
+                    if isinstance(a, ast.Starred):
+                        break
+                    if pos < len(decl.params) \
+                            and decl.params[pos] in decl.static_args:
+                        static_sink(a, decl, decl.params[pos],
+                                    self._eval(fn, a, env, local_types))
+                for kw in call.keywords:
+                    if kw.arg in decl.static_args:
+                        static_sink(kw.value, decl, kw.arg,
+                                    self._eval(fn, kw.value, env,
+                                               local_types))
+                    elif kw.arg is None and isinstance(kw.value, ast.Name):
+                        for k, taints in dict_keys.get(
+                                kw.value.id, {}).items():
+                            if k in decl.static_args:
+                                static_sink(kw.value, decl, k, taints)
+
+            # inherit the resolved callee's symbolic sinks, one deeper
+            callee = self.graph.resolve_call(fn, call, local_types)
+            if callee is None:
+                return
+            cs = self.summaries.get(callee.key)
+            if cs is None:
+                return
+            if isinstance(call.func, ast.Attribute) \
+                    and _dotted(call.func.value) == "self":
+                s.donates_self_slots |= cs.donates_self_slots
+            mapping = self._map_args(callee, call)
+            for pi, sinks in cs.param_sinks.items():
+                if pi < 0:
+                    continue        # concrete flows report where found
+                expr_i = mapping.get(pi)
+                if expr_i is None:
+                    continue
+                taints = self._taint_of_arg(fn, call, expr_i, env,
+                                            local_types)
+                if not taints:
+                    continue
+                for snk in sinks:
+                    deeper = replace(snk, depth=snk.depth + 1)
+                    record(taints, deeper)
+                    if UNBOUNDED in taints and snk.kind == "static":
+                        add_sink(-1, deeper)
+
+        def bind(tgt: ast.AST, taints: set[str]) -> None:
+            if isinstance(tgt, ast.Name):
+                env[tgt.id] = set(taints)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    bind(e, taints)
+            elif isinstance(tgt, ast.Starred):
+                bind(tgt.value, taints)
+
+        def handle_assign(child: ast.AST, targets: list[ast.AST],
+                          value: ast.AST) -> None:
+            taints = self._eval(fn, value, env, local_types)
+            for tgt in targets:
+                bind(tgt, taints)
+                if isinstance(tgt, ast.Name):
+                    if isinstance(value, ast.Call) \
+                            and isinstance(value.func, ast.Name):
+                        ci = self.graph.resolve_class(fn.module,
+                                                      value.func.id)
+                        if ci is not None:
+                            local_types[tgt.id] = ci.key
+                    if isinstance(value, ast.Call) \
+                            and _dotted(value.func) == "dict":
+                        dict_keys[tgt.id] = {
+                            kw.arg: self._eval(fn, kw.value, env,
+                                               local_types)
+                            for kw in value.keywords if kw.arg}
+                    elif isinstance(value, ast.Dict):
+                        dict_keys[tgt.id] = {
+                            k.value: self._eval(fn, v, env, local_types)
+                            for k, v in zip(value.keys, value.values)
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)}
+                    if tgt.id in declared_globals:
+                        s.global_writes.append(sink(
+                            "global", child,
+                            f"module global `{tgt.id}`"))
+                elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    base = _dotted(tgt.value)
+                    root = base.split(".")[0] if base else ""
+                    if root and root in mod_globals and root not in env \
+                            and root != "self" and root != "cls" \
+                            and root not in local_types:
+                        s.global_writes.append(sink(
+                            "global", child,
+                            f"module global `{base}`"))
+                    if isinstance(tgt, ast.Attribute) \
+                            and base == "self" \
+                            and tgt.attr in donated_attrs:
+                        for t in taints:
+                            if t.startswith("P"):
+                                try:
+                                    s.param_to_donated_slot.add(
+                                        int(t[1:]))
+                                except ValueError:
+                                    pass
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue        # nested defs summarize themselves
+                if isinstance(child, ast.Assign):
+                    handle_assign(child, child.targets, child.value)
+                elif isinstance(child, ast.AnnAssign) \
+                        and child.value is not None:
+                    handle_assign(child, [child.target], child.value)
+                elif isinstance(child, ast.AugAssign):
+                    if isinstance(child.target, ast.Name) \
+                            and child.target.id in declared_globals:
+                        s.global_writes.append(sink(
+                            "global", child,
+                            f"module global `{child.target.id}`"))
+                elif isinstance(child, ast.Return) \
+                        and child.value is not None:
+                    taints = self._eval(fn, child.value, env, local_types)
+                    for t in taints:
+                        if t.startswith("P"):
+                            try:
+                                s.param_to_ret.add(int(t[1:]))
+                            except ValueError:
+                                pass
+                        else:
+                            s.ret_taints.add(t)
+                    v = child.value
+                    if isinstance(v, ast.Call) \
+                            and _is_view_call(_dotted(v.func)) and v.args:
+                        for t in self._eval(fn, v.args[0], env,
+                                            local_types):
+                            if t.startswith("P"):
+                                try:
+                                    s.ret_view_of.add(int(t[1:]))
+                                except ValueError:
+                                    pass
+                        s.ret_taints.add(VIEW)
+                elif isinstance(child, (ast.If, ast.While)):
+                    record(self._eval(fn, child.test, env, local_types),
+                           sink("bool", child.test,
+                                "an `if`/`while` condition"))
+                elif isinstance(child, ast.Assert):
+                    record(self._eval(fn, child.test, env, local_types),
+                           sink("bool", child.test, "an `assert`"))
+                if isinstance(child, ast.Call):
+                    handle_call(child)
+                if _is_env_read(child):
+                    s.env_reads.append(
+                        (getattr(child, "lineno", 0),
+                         getattr(child, "col_offset", 0) + 1))
+                walk(child)
+
+        walk(fn.node)
+        self.summaries[fn.key] = s
+
+    def _donated_attrs_for(self, fn: FunctionInfo) -> set[str]:
+        if fn.cls is None:
+            return set()
+        ci = self.graph.classes.get(f"{fn.module}:{fn.cls}")
+        return ci.donated_attrs if ci is not None else set()
+
+
+# ---------------------------------------------------------------------------
+# FJ007: use-after-donate, statement-ordered, per function
+# ---------------------------------------------------------------------------
+
+class _DonationChecker:
+    """Walks one function's statements in source order tracking three
+    facts: which local names alias which buffers, which buffers a
+    dispatch has donated, and which names are live VIEWS of a buffer.
+
+    Buffers are named by spelling: a local is its own buffer (``a``), an
+    attribute chain is a slot buffer (``resident.assignment``). Donation
+    events come from (a) a jit dispatch with ``donate_argnums`` resolved
+    through the call graph — a donated name rebound by the SAME statement
+    is the sanctioned idiom and stays clean, though views taken of it
+    earlier still die — and (b) a method call whose summary says it
+    donates ``self`` slots (``resident.apply_delta(...)`` kills any view
+    of ``resident.assignment``). Copies (``np.array``, ``.copy()``)
+    launder a view back into an owned buffer.
+    """
+
+    def __init__(self, graph: CallGraph, summaries: dict[str, Summary],
+                 fn: FunctionInfo):
+        self.graph = graph
+        self.summaries = summaries
+        self.fn = fn
+        self.views: dict[str, set[str]] = {}      # name -> viewed buffers
+        self.alias: dict[str, str] = {}           # name -> attr buffer
+        self.donated_names: dict[str, int] = {}   # un-rebound, w/ line
+        self.donated_buffers: dict[str, int] = {} # every donation event
+        self.local_types: dict[str, str] = {}
+        self.findings: list[tuple[ast.AST, str]] = []
+
+    # buffers an expression's value aliases, digging through view calls,
+    # slices and plain name aliases; None = owned/opaque value
+    def _view_sources(self, expr: ast.AST) -> set[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.views:
+                return set(self.views[expr.id])
+            if expr.id in self.alias:
+                return {self.alias[expr.id]}
+            return set()
+        if isinstance(expr, ast.Subscript):
+            # slicing a VIEW stays a view (numpy-land); slicing a device
+            # array produces a fresh buffer, so no dotted fallback here
+            return self._view_sources(expr.value)
+        if isinstance(expr, ast.Call):
+            name = _dotted(expr.func)
+            if _is_copy_call(name):
+                return set()
+            if _is_view_call(name) and expr.args:
+                a = expr.args[0]
+                inner = self._view_sources(a)
+                if inner:
+                    return inner
+                d = _dotted(a)
+                return {d} if d else set()
+            callee = self.graph.resolve_call(self.fn, expr,
+                                             self.local_types)
+            if callee is not None:
+                cs = self.summaries.get(callee.key)
+                if cs is not None and cs.ret_view_of:
+                    out: set[str] = set()
+                    for pi in cs.ret_view_of:
+                        pos = pi - (1 if callee.is_method() else 0)
+                        if 0 <= pos < len(expr.args):
+                            d = _dotted(expr.args[pos])
+                            inner = self._view_sources(expr.args[pos])
+                            out |= inner if inner else ({d} if d else set())
+                    return out
+        if isinstance(expr, ast.Attribute):
+            # not a view by itself — it IS the slot; only device_get /
+            # slicing of it creates the host-side alias
+            return set()
+        return set()
+
+    def _header_nodes(self, stmt: ast.stmt) -> list[ast.AST]:
+        """Nodes belonging to this statement's own expressions, NOT to
+        nested statement bodies (those get their own `_step` from the
+        recursion — double-processing would apply inner donations at the
+        compound header and misorder the use checks)."""
+        nested: list[ast.stmt] = []
+        for attr in ("body", "orelse", "finalbody"):
+            v = getattr(stmt, attr, None)
+            if isinstance(v, list) and v and isinstance(v[0], ast.stmt):
+                nested.extend(v)
+        for h in getattr(stmt, "handlers", []) or []:
+            nested.extend(h.body)
+        skip = {id(n) for s in nested for n in ast.walk(s)}
+        return [n for n in ast.walk(stmt) if id(n) not in skip]
+
+    def _donations_of(self, stmt: ast.stmt) -> tuple[set[str], set[str]]:
+        """(donated names from direct dispatch, buffers from method
+        calls donating self slots) in one statement's own expressions."""
+        direct: set[str] = set()
+        via_method: set[str] = set()
+        for call in (n for n in self._header_nodes(stmt)
+                     if isinstance(n, ast.Call)):
+            decl = self.graph.dispatch_decl(self.fn, call,
+                                            self.local_types)
+            if decl is not None and decl.donated_params:
+                for pos, pname in enumerate(decl.params):
+                    if pname not in decl.donated_params:
+                        continue
+                    if pos < len(call.args) and not any(
+                            isinstance(a, ast.Starred)
+                            for a in call.args[:pos + 1]):
+                        d = _dotted(call.args[pos])
+                        if d:
+                            direct.add(d)
+                for kw in call.keywords:
+                    if kw.arg in decl.donated_params:
+                        d = _dotted(kw.value)
+                        if d:
+                            direct.add(d)
+            if isinstance(call.func, ast.Attribute):
+                callee = self.graph.resolve_call(self.fn, call,
+                                                 self.local_types)
+                if callee is not None:
+                    cs = self.summaries.get(callee.key)
+                    if cs is not None and cs.donates_self_slots:
+                        base = _dotted(call.func.value)
+                        for attr in cs.donates_self_slots:
+                            via_method.add(f"{base}.{attr}")
+        return direct, via_method
+
+    def _loads_in(self, stmt: ast.stmt,
+                  skip: set[int]) -> list[tuple[str, ast.AST]]:
+        """(spelling, node) for every Name and dotted-attribute load in
+        the statement's own expressions."""
+        out = []
+        for n in self._header_nodes(stmt):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.append((n.id, n))
+            elif isinstance(n, ast.Attribute) \
+                    and isinstance(n.ctx, ast.Load):
+                d = _dotted(n)
+                if d:
+                    out.append((d, n))
+        return out
+
+    def _targets_of(self, stmt: ast.stmt) -> list[ast.AST]:
+        if isinstance(stmt, ast.Assign):
+            out = []
+            for t in stmt.targets:
+                out.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                           else [t])
+            return out
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) \
+                and getattr(stmt, "value", None) is not None:
+            return [stmt.target]
+        return []
+
+    def check(self) -> list[tuple[ast.AST, str]]:
+        self._run_body(self.fn.node.body)
+        return self.findings
+
+    def _run_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            self._step(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                sub_body = getattr(stmt, attr, None)
+                if isinstance(sub_body, list) and sub_body \
+                        and isinstance(sub_body[0], ast.stmt):
+                    self._run_body(sub_body)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._run_body(h.body)
+
+    def _step(self, stmt: ast.stmt) -> None:
+        targets = self._targets_of(stmt)
+        target_names = {_dotted(t) for t in targets}
+        target_ids = {id(n) for t in targets for n in ast.walk(t)}
+        direct, via_method = self._donations_of(stmt)
+
+        # 1. uses of already-dead buffers (before this statement's own
+        #    donation lands; the dispatch's own args are uses of the
+        #    still-live buffer)
+        for name, node in self._loads_in(stmt, skip=target_ids):
+            if name in self.donated_names:
+                self.findings.append((
+                    node,
+                    f"`{name}` was donated to a dispatch on line "
+                    f"{self.donated_names[name]} and is dead here — "
+                    f"XLA owns the buffer; copy before dispatch or "
+                    f"re-use the dispatch result"))
+            stale = self.views.get(name, set()) & set(self.donated_buffers)
+            if stale:
+                buf = sorted(stale)[0]
+                self.findings.append((
+                    node,
+                    f"`{name}` is a live view of `{buf}`, donated on "
+                    f"line {self.donated_buffers[buf]} — on the CPU "
+                    f"backend `device_get` aliases device memory, so "
+                    f"this read sees the clobbered buffer; copy with "
+                    f"`np.array(..., copy=True)` before the dispatch"))
+
+        # 2. escape arm: returning/storing a live view of a donated SLOT
+        #    without a copy — the PR 14 shape even when the killing
+        #    dispatch happens later, in another method
+        escape_val: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            escape_val = stmt.value
+        elif isinstance(stmt, ast.Assign) \
+                and any(isinstance(t, ast.Attribute) for t in targets):
+            escape_val = stmt.value
+        if escape_val is not None:
+            for n in ast.walk(escape_val):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    slots = {b for b in self.views.get(n.id, set())
+                             if "." in b and b.split(".")[-1]
+                             in self.graph.donated_attr_names}
+                    if slots:
+                        buf = sorted(slots)[0]
+                        self.findings.append((
+                            n,
+                            f"`{n.id}` is a live view of donated slot "
+                            f"`{buf}` and escapes this function without "
+                            f"a copy — the next warm dispatch donates "
+                            f"the slot and clobbers it in place (the "
+                            f"PR 14 bug class); materialize with "
+                            f"`np.array(..., copy=True)` first"))
+
+        # 3. this statement's donation events land; a donated name
+        #    rebound by the SAME statement (the apply_delta idiom) comes
+        #    back alive immediately, but views taken earlier still died
+        for d in direct:
+            self.donated_buffers.setdefault(d, stmt.lineno)
+            if d not in target_names:
+                self.donated_names.setdefault(d, stmt.lineno)
+        for b in via_method:
+            self.donated_buffers.setdefault(b, stmt.lineno)
+
+        # 4. bindings: rebound names come back to life; views/aliases
+        #    propagate through plain assignments
+        if isinstance(stmt, ast.Assign) and len(targets) >= 1:
+            for t in targets:
+                tn = _dotted(t)
+                if isinstance(t, ast.Name):
+                    self.donated_names.pop(tn, None)
+                    srcs = self._view_sources(stmt.value)
+                    if srcs and not (isinstance(stmt.value, ast.Call)
+                                     and _is_copy_call(
+                                         _dotted(stmt.value.func))):
+                        self.views[tn] = srcs
+                    else:
+                        self.views.pop(tn, None)
+                    if isinstance(stmt.value, ast.Attribute):
+                        self.alias[tn] = _dotted(stmt.value)
+                    else:
+                        self.alias.pop(tn, None)
+                    if isinstance(stmt.value, ast.Call) \
+                            and isinstance(stmt.value.func, ast.Name):
+                        ci = self.graph.resolve_class(
+                            self.fn.module, stmt.value.func.id)
+                        if ci is not None:
+                            self.local_types[tn] = ci.key
+
+
+# ---------------------------------------------------------------------------
+# rule evaluation over the whole graph
+# ---------------------------------------------------------------------------
+
+def _analyze(graph: CallGraph) -> list[Diagnostic]:
+    statics_fields = _static_fields(graph)
+    summaries = _SummaryBuilder(graph, statics_fields).run()
+    hot = default_hot_roots(graph)
+    out: list[Diagnostic] = []
+    seen: set[tuple] = set()
+
+    def emit(code: str, file: str, line: int, col: int, message: str,
+             function: str) -> None:
+        key = (code, file, line, col)
+        if key in seen:
+            return
+        seen.add(key)
+        r = _RULE[code]
+        out.append(Diagnostic(
+            code=code, severity=r.severity, message=message, file=file,
+            line=line, col=col, rule=r.slug, function=function))
+
+    def fn_of(key: str) -> str:
+        return key.split(":", 1)[1] if ":" in key else key
+
+    # FJ008 / FJ010: symbolic sinks reached from traced root params
+    for root in graph.jit_roots():
+        s = summaries.get(root.key)
+        if s is None:
+            continue
+        statics = set(root.jit.static_args) if root.jit else set()
+        for i, p in enumerate(root.all_params):
+            if p in statics or p == "self":
+                continue
+            for snk in s.param_sinks.get(i, []):
+                if snk.kind == "bool":
+                    emit("FJ008", snk.file, snk.line, snk.col,
+                         f"traced value (param `{p}` of jitted "
+                         f"`{root.name}`) reaches {snk.detail}"
+                         + (f" {snk.depth} call(s) deep"
+                            if snk.depth else "")
+                         + " — Python branching on a tracer raises "
+                           "ConcretizationError at best, silently "
+                           "constant-folds at worst; use jnp.where/"
+                           "lax.cond or mark the argument static",
+                         fn_of(snk.fn_key))
+                elif snk.kind == "sync" and snk.depth >= 1 \
+                        and root.key in hot:
+                    emit("FJ010", snk.file, snk.line, snk.col,
+                         f"implicit host sync {snk.detail} on a traced "
+                         f"value, {snk.depth} call(s) below hot-path "
+                         f"executable `{root.name}` — a device round-"
+                         f"trip per dispatch the transfer-guard benches "
+                         f"forbid; keep it in jnp or move it past the "
+                         f"dispatch",
+                         fn_of(snk.fn_key))
+
+    # FJ009: concrete unbounded-into-static flows, where discovered
+    for key, s in summaries.items():
+        for snk in s.param_sinks.get(-1, []):
+            emit("FJ009", snk.file, snk.line, snk.col,
+                 f"unbounded host value (env/config read, uncached) "
+                 f"flows into {snk.detail} — every distinct value "
+                 f"compiles a fresh executable (the PR 4 recompile "
+                 f"storm); cache the read or bound its range",
+                 fn_of(snk.fn_key))
+
+    # FJ011: global writes in functions reachable from a traced region
+    edges: dict[str, set[str]] = {}
+    for key, fn in graph.functions.items():
+        callees: set[str] = set()
+        local_types: dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                ci = graph.resolve_class(fn.module, node.value.func.id)
+                if ci is not None:
+                    local_types[node.targets[0].id] = ci.key
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                callee = graph.resolve_call(fn, node, local_types)
+                if callee is not None \
+                        and not graph.is_host_callback(callee):
+                    callees.add(callee.key)
+        edges[key] = callees
+    reached: set[str] = set()
+    frontier = [r.key for r in graph.jit_roots()
+                if not graph.is_host_callback(graph.functions[r.key])]
+    via: dict[str, str] = {k: k for k in frontier}
+    while frontier:
+        k = frontier.pop()
+        if k in reached:
+            continue
+        reached.add(k)
+        for c in edges.get(k, ()):
+            if c not in reached:
+                via.setdefault(c, via.get(k, k))
+                frontier.append(c)
+    for key in sorted(reached):
+        s = summaries.get(key)
+        if s is None:
+            continue
+        root_key = via.get(key, key)
+        for snk in s.global_writes:
+            emit("FJ011", snk.file, snk.line, snk.col,
+                 f"write to {snk.detail} inside traced code (reached "
+                 f"from jit root `{fn_of(root_key)}`) — it executes "
+                 f"once at trace time and never again on the compiled "
+                 f"path; thread state through carry values or keep it "
+                 f"host-side",
+                 fn_of(snk.fn_key))
+
+    # FJ007: statement-ordered donation tracking, every function
+    for key, fn in graph.functions.items():
+        for node, message in _DonationChecker(graph, summaries,
+                                              fn).check():
+            emit("FJ007", fn.path, getattr(node, "lineno", 0),
+                 getattr(node, "col_offset", 0) + 1, message,
+                 fn.qualname)
+
+    # noqa suppression against the real source lines, then stable order
+    lines_by_path = {m.path: m.lines for m in graph.modules.values()}
+    kept: list[Diagnostic] = []
+    for d in out:
+        lines = lines_by_path.get(d.file or "", [])
+        if d.line and d.line <= len(lines):
+            codes = _noqa_codes(lines[d.line - 1])
+            if codes is not None and (not codes or d.code in codes):
+                continue
+        kept.append(d)
+    kept.sort(key=lambda d: (d.file or "", d.line, d.col, d.code))
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def dataflow_lint_paths(roots: list[str],
+                        rel_to: Optional[str] = None,
+                        package_root: Optional[str] = None) \
+        -> list[Diagnostic]:
+    """Run FJ007-FJ011 over files/directories. `package_root` anchors
+    dotted module names (pass the fleetflow_tpu package directory) so
+    contracts.py hot-root keys resolve; paths in diagnostics are
+    relative to `rel_to` when given (CI-stable spans)."""
+    graph = build_graph(iter_python_files(roots),
+                        package_root=package_root, rel_to=rel_to)
+    return _analyze(graph)
+
+
+def dataflow_lint_source(source: str,
+                         path: str = "<string>") -> list[Diagnostic]:
+    """Run FJ007-FJ011 over one source text (fixtures, tests)."""
+    graph = CallGraph()
+    graph.add_source(path, source, module_name_for(path, None))
+    graph.finalize()
+    return _analyze(graph)

@@ -656,12 +656,29 @@ def cmd_validate(args) -> int:
     return _run_lint(args, fmt="text", strict=False)
 
 
+def _baseline_args(q) -> None:
+    """Attach the shared accepted-findings-ledger flags (hygiene,
+    dataflow and the `all` aggregate read the same file)."""
+    q.add_argument("--baseline", metavar="FILE",
+                   help="accepted-findings ledger (audit_baseline.json: "
+                        "rule+path+function keys with counts); matched "
+                        "findings are suppressed, stale entries reported")
+    q.add_argument("--update-baseline", action="store_true",
+                   help="regenerate the baseline file from the current "
+                        "findings (defaults to ./audit_baseline.json "
+                        "when --baseline is not given)")
+
+
 def cmd_audit(args) -> int:
     """Static analysis over the CODEBASE (not the fleet config): the
     compile-contract auditor and the JAX/async hygiene linter
     (docs/guide/15-static-analysis.md)."""
     if args.audit_cmd == "kernels":
         return _audit_kernels(args)
+    if args.audit_cmd == "dataflow":
+        return _audit_dataflow(args)
+    if args.audit_cmd == "all":
+        return _audit_all(args)
     return _audit_hygiene(args)
 
 
@@ -723,16 +740,48 @@ def _audit_kernels(args) -> int:
     return 0
 
 
-def _audit_hygiene(args) -> int:
-    """Run the FJ001+ JAX/async hygiene rules over solver/ and cp/ (or
-    explicit paths). Exit 0 = clean (warnings allowed unless --strict),
-    1 = findings at the gating severity."""
-    from ..analysis import hygiene_lint_paths
+def _audit_baseline(diags, args):
+    """Accepted-findings ledger plumbing shared by hygiene, dataflow and
+    the `all` aggregate. ``--update-baseline`` regenerates the ledger
+    from the current findings; ``--baseline FILE`` suppresses accepted
+    ones (count-capped per rule+path+function, stale entries reported).
+
+    Returns ``(kept, forced_exit)`` — ``forced_exit`` is None unless the
+    baseline itself settles the run: 0 after a write, 2 when the ledger
+    is unreadable (the internal-error leg of the audit exit contract —
+    a baseline that silently loaded empty would fail CI with noise)."""
+    from ..analysis import (apply_baseline, default_baseline_path,
+                            load_baseline, write_baseline)
+    path = getattr(args, "baseline", None)
+    if getattr(args, "update_baseline", False):
+        path = path or default_baseline_path()
+        b = write_baseline(diags, path)
+        print(f"audit: baseline written to {path} "
+              f"({sum(b.entries.values())} accepted finding(s))")
+        return [], 0
+    if not path:
+        return diags, None
+    try:
+        base = load_baseline(path)
+    except (OSError, ValueError) as e:
+        print(f"audit: cannot read baseline {path}: {e}", file=sys.stderr)
+        return diags, 2
+    kept, suppressed, stale = apply_baseline(diags, base)
+    if suppressed:
+        print(f"audit: {suppressed} accepted finding(s) suppressed by "
+              f"{path}", file=sys.stderr)
+    for rule, p, fn in stale:
+        print(f"audit: stale baseline entry {rule} {p}:"
+              f"{fn or '<module>'} — the code it excused is gone; drop "
+              f"it (--update-baseline)", file=sys.stderr)
+    return kept, None
+
+
+def _emit_audit(diags, args, *, tool: str, label: str) -> int:
+    """Shared tail of the source-analysis audits: render in the chosen
+    format and apply the exit contract (0 clean, 1 findings at the
+    gating severity)."""
     from ..lint import Severity, severity_counts
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    roots = args.paths or [os.path.join(pkg_root, "solver"),
-                           os.path.join(pkg_root, "cp")]
-    diags = hygiene_lint_paths(roots, rel_to=os.getcwd())
     errors, warnings = severity_counts(diags)
     failing = bool(errors or (args.strict and warnings))
     if args.format == "json":
@@ -742,17 +791,166 @@ def _audit_hygiene(args) -> int:
         return 1 if failing else 0
     if args.format == "sarif":
         from ..lint.sarif import to_sarif
-        print(json.dumps(to_sarif(diags, tool="fleet-audit-hygiene"),
-                         indent=2))
+        print(json.dumps(to_sarif(diags, tool=tool), indent=2))
         return 1 if failing else 0
     for d in diags:
         stream = sys.stderr if d.severity is Severity.ERROR else sys.stdout
         print(d.format(), file=stream)
     if failing:
-        print(f"hygiene: {errors} error(s), {warnings} warning(s)",
+        print(f"{label}: {errors} error(s), {warnings} warning(s)",
               file=sys.stderr)
         return 1
-    print(f"hygiene clean ({errors} error(s), {warnings} warning(s))")
+    print(f"{label} clean ({errors} error(s), {warnings} warning(s))")
+    return 0
+
+
+def _audit_hygiene(args) -> int:
+    """Run the FJ001+ JAX/async hygiene rules over solver/ and cp/ (or
+    explicit paths). Exit 0 = clean (warnings allowed unless --strict),
+    1 = findings at the gating severity, 2 = unreadable baseline."""
+    from ..analysis import hygiene_lint_paths
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.paths or [os.path.join(pkg_root, "solver"),
+                           os.path.join(pkg_root, "cp")]
+    diags = hygiene_lint_paths(roots, rel_to=os.getcwd())
+    diags, forced = _audit_baseline(diags, args)
+    if forced is not None:
+        return forced
+    return _emit_audit(diags, args, tool="fleet-audit-hygiene",
+                       label="hygiene")
+
+
+def _audit_dataflow(args) -> int:
+    """Run the FJ007+ interprocedural taint rules over the whole package
+    (or explicit paths): use-after-donate incl. the device_get-view
+    clobber, traced values reaching host control flow, env reads feeding
+    static jit args, deep host syncs under hot-path executables, and
+    trace-time global writes. Exit 0 = clean, 1 = findings at the gating
+    severity, 2 = internal error (parse failure, unreadable baseline)."""
+    from ..analysis import dataflow_lint_paths
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.paths or [pkg_root]
+    try:
+        diags = dataflow_lint_paths(roots, rel_to=os.getcwd(),
+                                    package_root=pkg_root)
+    except (OSError, SyntaxError, RecursionError) as e:
+        print(f"audit: dataflow pass failed: {e}", file=sys.stderr)
+        return 2
+    diags, forced = _audit_baseline(diags, args)
+    if forced is not None:
+        return forced
+    return _emit_audit(diags, args, tool="fleet-audit-dataflow",
+                       label="dataflow")
+
+
+def _kernel_audit_diags(args):
+    """Run the compile-contract auditor and express its outcome as
+    Diagnostic records so `fleet audit all` can merge all three passes
+    into one report / one SARIF document. Returns ``(diags,
+    internal_error)`` — internal_error mirrors _audit_kernels' exit-2
+    leg (contract file unreadable, lowering machinery down)."""
+    from ..lint.diagnostics import Diagnostic, Severity
+    diags, internal = [], False
+    try:
+        from .. import platform as plat
+        if os.environ.get("FLEET_FORCE_CPU") == "1" \
+                or os.environ.get("JAX_PLATFORMS", "").strip() \
+                in ("", "cpu"):
+            plat.force_cpu(8)
+        from ..analysis.auditor import (audit_kernels, contract_diff,
+                                        default_contract_path)
+        contract_path = getattr(args, "contract", None) \
+            or default_contract_path()
+        report = audit_kernels()
+        skip_sev = (Severity.INFO if getattr(args, "allow_skips", False)
+                    else Severity.ERROR)
+        for s in report.skipped:
+            diags.append(Diagnostic(
+                code="FK000", severity=skip_sev,
+                message=f"kernel skipped (insufficient devices): {s}",
+                rule="kernel-skipped", stage="audit-kernels",
+                hint="rerun with FLEET_FORCE_CPU=1 or --allow-skips"))
+        for v in report.violations:
+            diags.append(Diagnostic(
+                code="FK001", severity=Severity.ERROR, message=str(v),
+                rule="compile-contract-violation", stage="audit-kernels"))
+        try:
+            with open(contract_path, encoding="utf-8") as f:
+                pinned = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"audit: cannot read contract file {contract_path}: "
+                  f"{e}", file=sys.stderr)
+            return diags, True
+        for d in contract_diff(report, pinned):
+            diags.append(Diagnostic(
+                code="FK002", severity=Severity.ERROR, message=str(d),
+                rule="compile-contract-drift", stage="audit-kernels",
+                file=os.path.relpath(contract_path),
+                hint="if intentional: fleet audit kernels --update"))
+    except Exception as e:  # lowering needs jax + a virtual mesh
+        print(f"audit: kernels pass failed: {e}", file=sys.stderr)
+        internal = True
+    return diags, internal
+
+
+def _audit_all(args) -> int:
+    """Aggregate gate: kernels + hygiene + dataflow in one invocation
+    with one merged exit contract (0 = every pass clean, 1 = findings at
+    the gating severity, 2 = any pass hit an internal error) and — under
+    --format sarif — ONE combined SARIF document, one run per pass, for
+    the CI artifact."""
+    from ..analysis import dataflow_lint_paths, hygiene_lint_paths
+    from ..lint import Severity, severity_counts
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    kdiags, internal_error = _kernel_audit_diags(args)
+    hdiags = hygiene_lint_paths([os.path.join(pkg_root, "solver"),
+                                 os.path.join(pkg_root, "cp")],
+                                rel_to=os.getcwd())
+    try:
+        ddiags = dataflow_lint_paths([pkg_root], rel_to=os.getcwd(),
+                                     package_root=pkg_root)
+    except (OSError, SyntaxError, RecursionError) as e:
+        print(f"audit: dataflow pass failed: {e}", file=sys.stderr)
+        ddiags, internal_error = [], True
+
+    diags, forced = _audit_baseline(kdiags + hdiags + ddiags, args)
+    if forced is not None:
+        return forced
+
+    errors, warnings = severity_counts(diags)
+    failing = bool(errors or (args.strict and warnings))
+    exit_code = 2 if internal_error else (1 if failing else 0)
+    if args.format == "json":
+        print(json.dumps({
+            "ok": exit_code == 0, "errors": errors, "warnings": warnings,
+            "internal_error": internal_error,
+            "diagnostics": [d.to_dict() for d in diags]}, indent=2))
+        return exit_code
+    if args.format == "sarif":
+        from ..lint.sarif import to_sarif
+        kset = {id(d) for d in kdiags}
+        hset = {id(d) for d in hdiags}
+        doc = to_sarif([d for d in diags if id(d) in kset],
+                       tool="fleet-audit-kernels")
+        for part, tool in (
+                ([d for d in diags if id(d) in hset],
+                 "fleet-audit-hygiene"),
+                ([d for d in diags if id(d) not in kset
+                  and id(d) not in hset], "fleet-audit-dataflow")):
+            doc["runs"] += to_sarif(part, tool=tool)["runs"]
+        print(json.dumps(doc, indent=2))
+        return exit_code
+    for d in diags:
+        stream = sys.stderr if d.severity is Severity.ERROR else sys.stdout
+        print(d.format(), file=stream)
+    if exit_code:
+        print(f"audit all: {errors} error(s), {warnings} warning(s)"
+              + (", internal error" if internal_error else ""),
+              file=sys.stderr)
+        return exit_code
+    print(f"audit all clean: kernels + hygiene + dataflow "
+          f"({errors} error(s), {warnings} warning(s))")
     return 0
 
 
@@ -1799,6 +1997,38 @@ def build_parser() -> argparse.ArgumentParser:
                    default="text")
     q.add_argument("--strict", action="store_true",
                    help="treat warnings as errors (exit 1)")
+    _baseline_args(q)
+    q.set_defaults(fn=cmd_audit)
+    q = auds.add_parser("dataflow", help="FJ007+ interprocedural taint "
+                        "rules over the whole package: use-after-donate "
+                        "(incl. device_get views of donated buffers), "
+                        "traced values in host control flow, env reads "
+                        "feeding static jit args, deep host syncs under "
+                        "hot-path executables, trace-time global writes")
+    q.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: the whole "
+                        "fleetflow_tpu package, so cross-module calls "
+                        "resolve)")
+    q.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
+    q.add_argument("--strict", action="store_true",
+                   help="treat warnings as errors (exit 1)")
+    _baseline_args(q)
+    q.set_defaults(fn=cmd_audit)
+    q = auds.add_parser("all", help="aggregate gate: kernels + hygiene + "
+                        "dataflow in one invocation, one merged exit "
+                        "contract (0 clean / 1 findings / 2 internal "
+                        "error) and one combined SARIF document")
+    q.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
+    q.add_argument("--strict", action="store_true",
+                   help="treat warnings as errors (exit 1)")
+    q.add_argument("--contract",
+                   help="kernel contract file (default: tests/goldens/"
+                        "compile_contract.json in the source checkout)")
+    q.add_argument("--allow-skips", action="store_true",
+                   help="tolerate kernels skipped for lack of devices")
+    _baseline_args(q)
     q.set_defaults(fn=cmd_audit)
 
     p = sub.add_parser("validate", help="load config + check placements "

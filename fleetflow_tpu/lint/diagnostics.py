@@ -44,6 +44,7 @@ class Diagnostic:
     rule: str = ""                 # rule slug, e.g. "dependency-cycle"
     stage: Optional[str] = None    # stage the finding applies to, if any
     hint: str = ""                 # optional fix suggestion
+    function: str = ""             # enclosing function (audit baseline key)
 
     def span(self) -> str:
         f = self.file or "<config>"
@@ -71,6 +72,8 @@ class Diagnostic:
             d["stage"] = self.stage
         if self.hint:
             d["hint"] = self.hint
+        if self.function:
+            d["function"] = self.function
         return d
 
 

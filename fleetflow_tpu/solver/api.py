@@ -499,8 +499,12 @@ def _solve(pt: ProblemTensors, *,
             # legacy host pre-pass requested (A/B): the seed deliberately
             # round-trips the host — fetch the real rows, repair, re-upload
             # (adopt_host counts the transfer)
-            seed_np = _legacy_host_prepass(np.asarray(
-                jax.device_get(seed_assignment), dtype=np.int32)[:pt.S])
+            # np.array, not asarray: device_get of the resident slot is a
+            # VIEW on the CPU backend and the slot is donated into the
+            # next merge dispatch — the host pre-pass must own its copy
+            seed_np = _legacy_host_prepass(np.array(
+                jax.device_get(seed_assignment), dtype=np.int32,
+                copy=True)[:pt.S])
             resident.adopt_host(seed_np, pt.node_valid, warm=True)
             seed_assignment = resident.assignment
     elif warm:

@@ -1016,7 +1016,12 @@ def solve_sharded(pt, *, resident: ShardedResident,
     # buffer rides it)
     (assignment, sweeps, capF, confF, inelF, skewF, _softF, att,
      acc, htelem) = jax.device_get(tuple(res))
-    assignment = np.asarray(assignment)[: pt.S]
+    # FORCE a host copy before slicing: on the CPU backend device_get
+    # returns a VIEW of the device buffer, and the padded winner was just
+    # adopted as the mesh-resident seed (rp.adopt above) — the next warm
+    # sharded dispatch DONATES that buffer, clobbering every retained
+    # result in place (the same aliasing api._solve pins against)
+    assignment = np.array(assignment, dtype=np.int32, copy=True)[: pt.S]
     timings["anneal_ms"] = (t() - t_anneal) * 1e3
 
     t_verify = t()

@@ -24,6 +24,15 @@ beats exhaustiveness for a gate):
                   jit, numpy/env reads in traced code, blocking calls in
                   async handlers, awaits under the store lock. ERROR-
                   severity findings gate; warnings print but don't.
+  FJ007+          the interprocedural dataflow rules (fleetflow_tpu/
+                  analysis/dataflow.py, also stdlib-only) over the whole
+                  package: use-after-donate incl. device_get views of
+                  donated buffers, traced values reaching host control
+                  flow at any call depth, env reads feeding static jit
+                  args, deep host syncs under hot-path executables,
+                  trace-time global writes. ERROR-severity findings gate
+                  after the accepted-findings ledger (audit_baseline.json)
+                  is applied; warnings print but don't.
 
 Exit 0 clean, 1 findings (one per line: path:line: code message).
 """
@@ -212,6 +221,39 @@ def check_hygiene() -> tuple[list[str], int]:
     return gating, warnings
 
 
+def check_dataflow() -> tuple[list[str], int]:
+    """The FJ007+ interprocedural pass over the whole package, with the
+    accepted-findings ledger (audit_baseline.json) applied first so
+    intentional findings (per-call env knobs) don't gate. Returns
+    (gating findings, warning count) — ERROR severity gates, the same
+    contract `fleet audit dataflow` (without --strict) applies."""
+    sys.path.insert(0, REPO)
+    try:
+        from fleetflow_tpu.analysis.baseline import (apply_baseline,
+                                                     load_baseline)
+        from fleetflow_tpu.analysis.dataflow import dataflow_lint_paths
+        from fleetflow_tpu.lint.diagnostics import Severity
+    except Exception as e:         # pragma: no cover - package broken
+        return [f"fleetflow_tpu/analysis: dataflow pass unavailable "
+                f"({e})"], 0
+    pkg = os.path.join(REPO, "fleetflow_tpu")
+    diags = dataflow_lint_paths([pkg], rel_to=REPO, package_root=pkg)
+    baseline_path = os.path.join(REPO, "audit_baseline.json")
+    if os.path.exists(baseline_path):
+        try:
+            diags, _, _ = apply_baseline(diags,
+                                         load_baseline(baseline_path))
+        except ValueError as e:
+            return [f"audit_baseline.json: {e}"], 0
+    gating = [d.format() for d in diags if d.severity is Severity.ERROR]
+    warnings = 0
+    for d in diags:
+        if d.severity is not Severity.ERROR:
+            warnings += 1
+            print(d.format(), file=sys.stderr)
+    return gating, warnings
+
+
 def main() -> int:
     findings: list[str] = []
     for path in iter_py_files():
@@ -226,10 +268,13 @@ def main() -> int:
         findings.extend(check_unused_imports(rel, tree, source))
     hygiene, hygiene_warnings = check_hygiene()
     findings.extend(hygiene)
+    dataflow, dataflow_warnings = check_dataflow()
+    findings.extend(dataflow)
     for f in findings:
         print(f)
     print(f"selflint: {len(findings)} finding(s) "
-          f"({hygiene_warnings} hygiene warning(s)) over "
+          f"({hygiene_warnings} hygiene warning(s), "
+          f"{dataflow_warnings} dataflow warning(s)) over "
           f"{len(iter_py_files())} files", file=sys.stderr)
     return 1 if findings else 0
 

@@ -1,0 +1,47 @@
+# The sanctioned idioms the analyzer must NOT flag — the counterpart
+# of every canary in this directory:
+#   * np.array(..., copy=True) before the donating call launders the
+#     device_get view (the PR 14 fix idiom),
+#   * the donated names rebound in the SAME statement
+#     (`self.prob, self.assignment = self._merge()(self.prob, ...)`)
+#     is the resident-update idiom, not a use-after-donate,
+#   * `x is None` on a traced value is an identity check, never a
+#     tracer concretization.
+import jax
+import numpy as np
+
+
+def _merge_fn():
+    def merge(prob, assignment):
+        return prob, assignment
+    return jax.jit(merge, donate_argnums=(0, 1))
+
+
+class Resident:
+    def __init__(self, prob, assignment):
+        self.prob = prob
+        self.assignment = assignment
+
+    def _merge(self):
+        return _merge_fn()
+
+    def apply_delta(self):
+        self.prob, self.assignment = self._merge()(self.prob,
+                                                   self.assignment)
+
+
+def _maybe(x):
+    if x is None:
+        return 0
+    return x
+
+
+@jax.jit
+def step(x):
+    return _maybe(x)
+
+
+def solve(resident):
+    assignment = np.array(jax.device_get(resident.assignment), copy=True)
+    resident.apply_delta()
+    return assignment

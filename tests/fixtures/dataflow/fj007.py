@@ -1,0 +1,18 @@
+# FJ007 canary: direct use-after-donate through a factory dispatch.
+# `a` is donated into the merge executable (donate_argnums resolves
+# through _merge_fn's returned jax.jit) and then read afterwards — on a
+# real device that read touches a deallocated (or re-filled) buffer.
+# tests/test_audit.py asserts the analyzer flags the `a.sum()` line.
+import jax
+
+
+def _merge_fn():
+    def merge(prob, assignment):
+        return prob, assignment
+    return jax.jit(merge, donate_argnums=(0, 1))
+
+
+def dispatch(prob, a):
+    out = _merge_fn()(prob, a)
+    total = a.sum()
+    return out, total

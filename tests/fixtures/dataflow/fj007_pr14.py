@@ -1,0 +1,34 @@
+# FJ007 canary, the PR 14 bug class: on the CPU backend
+# jax.device_get returns a zero-copy VIEW of the device buffer; when
+# apply_delta() donates resident.assignment into the merge executable,
+# the retained host view is clobbered in place. The fix idiom is
+# np.array(..., copy=True) BEFORE the donating call (see clean.py).
+# Exercises the whole interprocedural chain: factory resolution
+# (self._merge() -> _merge_fn() -> jax.jit(..., donate_argnums)),
+# donated-slot discovery on the class, and view tracking.
+import jax
+
+
+def _merge_fn():
+    def merge(prob, assignment):
+        return prob, assignment
+    return jax.jit(merge, donate_argnums=(0, 1))
+
+
+class Resident:
+    def __init__(self, prob, assignment):
+        self.prob = prob
+        self.assignment = assignment
+
+    def _merge(self):
+        return _merge_fn()
+
+    def apply_delta(self):
+        self.prob, self.assignment = self._merge()(self.prob,
+                                                   self.assignment)
+
+
+def solve(resident):
+    assignment = jax.device_get(resident.assignment)
+    resident.apply_delta()
+    return assignment

@@ -1,0 +1,17 @@
+# FJ008 canary: a traced value reaching Python control flow one call
+# below the jit root. `x` is a tracer inside `step`; `_decide`'s
+# `if x > 0` concretizes it (TracerBoolConversionError at trace time,
+# or worse, a silently-baked branch). The lexical hygiene pass cannot
+# see this — the comparison is in a different function.
+import jax
+
+
+def _decide(x):
+    if x > 0:
+        return 1
+    return 0
+
+
+@jax.jit
+def step(x, y):
+    return _decide(x) + y

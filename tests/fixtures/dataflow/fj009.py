@@ -1,0 +1,21 @@
+# FJ009 canary: an unbounded host value (uncached env read) flowing
+# into a static jit argument through a helper's return value — every
+# distinct FLEET_BLOCKS value compiles a fresh executable (the PR 4
+# recompile storm).
+import os
+from functools import partial
+
+import jax
+
+
+def blocks():
+    return int(os.environ.get("FLEET_BLOCKS", "16"))
+
+
+@partial(jax.jit, static_argnames=("nb",))
+def kernel(x, nb):
+    return x * nb
+
+
+def solve(x):
+    return kernel(x, nb=blocks())

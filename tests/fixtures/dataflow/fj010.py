@@ -1,0 +1,17 @@
+# FJ010 canary: an implicit host sync (np.asarray + float on a traced
+# value) buried one call below a hot-path executable. At depth 0 the
+# lexical FJ001/FJ003 rules own this; the dataflow rule exists for the
+# depth >= 1 case. The hot-path marker comment stands in for a
+# KernelContract registration.
+import jax
+import numpy as np
+
+
+def _stat(x):
+    return float(np.asarray(x).mean())
+
+
+# fleet-audit: hot-path
+@jax.jit
+def hot(x):
+    return _stat(x) + x

@@ -1,0 +1,18 @@
+# FJ011 canary: a module-global write inside a function reachable from
+# a jit root. The write happens at TRACE time only — it runs once per
+# compilation, not once per call, so the counter silently stops
+# counting the moment the executable is cached.
+import jax
+
+_CALLS = 0
+
+
+def _bump(x):
+    global _CALLS
+    _CALLS = _CALLS + 1
+    return x
+
+
+@jax.jit
+def step(x):
+    return _bump(x)

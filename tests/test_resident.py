@@ -525,3 +525,41 @@ class TestZeroSweepTrustedStats:
         # 3. the reported soft is the exact host objective of the winner
         assert res.soft == pytest.approx(
             soft_score_host(pt2, res.assignment), abs=1e-6)
+
+
+class TestResultOwnership:
+    """Regression for the api._solve legacy-prepass fetch site (the
+    PR 14 bug class): the resident-warm `prerepair=True` leg round-trips
+    the resident assignment slot through `jax.device_get`, which on the
+    CPU backend returns a zero-copy VIEW of the device buffer — and that
+    slot is donated into the next warm merge dispatch. The fix forces
+    `np.array(..., copy=True)` before the host pre-pass; this test holds
+    a result fetched on that leg bit-identical through later warm
+    dispatches."""
+
+    def test_prepass_result_survives_later_warm_dispatches(self):
+        rng = np.random.default_rng(17)
+        pt = synthetic_problem(73, 12, seed=17, port_fraction=0.3,
+                               volume_fraction=0.2)
+        rp = ResidentProblem(pt)
+        solve(pt, prob=rp.prob, resident=rp, seed=17, steps=16,
+              bucket=True)
+        pt, delta = _churn_step(pt, rng)
+        rp.apply_delta(pt, delta)
+        res = solve(pt, prob=rp.prob, resident=rp, resident_warm=True,
+                    seed=18, steps=16, bucket=True, prerepair=True)
+        assert "prerepair_ms" in res.timings_ms   # the leg under test ran
+        kept = res.assignment
+        # ownership: the result's base must be a host-owned copy, never
+        # a wrapper over the resident device slot
+        assert kept.base is None or kept.base.flags["OWNDATA"], \
+            "solve returned a view of the resident assignment slot"
+        pinned = kept.copy()
+        for step in range(3):
+            pt, delta = _churn_step(pt, rng)
+            rp.apply_delta(pt, delta)
+            solve(pt, prob=rp.prob, resident=rp, resident_warm=True,
+                  seed=19 + step, steps=16, bucket=True)
+        assert np.array_equal(kept, pinned), \
+            "warm result clobbered in place by a later warm dispatch" \
+            " (donated device_get view — the PR 14 aliasing class)"

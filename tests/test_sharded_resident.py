@@ -334,3 +334,42 @@ class TestShardedRouting:
         res2 = solve(pt, prob=rp.prob, resident=rp, steps=STEPS, seed=33,
                      bucket=rp.bucket)
         assert res2.tempering is None
+
+
+class TestShardedResultOwnership:
+    """Regression for the solve_sharded fetch site (the PR 14 bug class
+    on the pod-scale path): the winner came off the mesh via
+    `jax.device_get(tuple(res))` and was sliced with np.asarray — on the
+    CPU backend that is a zero-copy VIEW of the very buffer `rp.adopt`
+    had just made the mesh-resident seed. The next warm sharded dispatch
+    DONATES that buffer, clobbering every retained result in place. The
+    fix forces `np.array(..., copy=True)` before the slice; this test
+    pins both legs of the contract: the returned array OWNS its memory
+    (on a 1x1 mesh the assembled fetch is single-shard, so asarray would
+    hand back the raw zero-copy view — the mutation-sensitive case) and
+    results fetched before churn stay bit-identical through later warm
+    dispatches."""
+
+    @pytest.mark.parametrize("dims", [(1, 1), (2, 4)])
+    def test_result_survives_later_warm_dispatches(self, dims):
+        _need_devices(8)
+        rng = np.random.default_rng(14)
+        pt = synthetic_problem(73, 12, seed=14, port_fraction=0.3,
+                               volume_fraction=0.2)
+        mesh = tempering_mesh(*dims)
+        rp = ShardedResident(pt, mesh=mesh)
+        base = solve_sharded(pt, resident=rp, steps=STEPS, seed=14)
+        kept = base.assignment
+        # ownership: the slice's base must be a host-owned copy, never a
+        # wrapper over the device buffer rp.adopt just made the warm seed
+        assert kept.base is None or kept.base.flags["OWNDATA"], \
+            "solve_sharded returned a view of the mesh-resident seed"
+        pinned = kept.copy()
+        for step in range(3):
+            pt, delta = _churn_step(pt, rng)
+            rp.apply_delta(pt, delta)
+            solve_sharded(pt, resident=rp, resident_warm=True,
+                          steps=STEPS, seed=140 + step)
+        assert np.array_equal(kept, pinned), \
+            "sharded result clobbered in place by a later warm dispatch" \
+            " (donated device_get view — the PR 14 aliasing class)"
