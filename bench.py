@@ -179,6 +179,15 @@ def main() -> None:
                            port_fraction=0.2, volume_fraction=0.1)
     prob = prepare_problem(pt)
 
+    # whole-run TSDB recorder: per-leg series history in the artifact
+    # (BENCH_TSDB=0 for a bare run; the obs_overhead leg below measures
+    # the sampler's cost against an un-sampled twin loop)
+    obsr = None
+    if os.environ.get("BENCH_TSDB", "1").lower() not in ("0", "false"):
+        obsr = _BenchObs()
+    leg = (obsr.leg if obsr is not None
+           else (lambda name: contextlib.nullcontext()))
+
     # warm-up: compile every kernel on the final shapes
     t_warm = time.perf_counter()
     solve(pt, prob=prob, chains=chains, steps=steps, seed=0,
@@ -187,11 +196,12 @@ def main() -> None:
     print(f"[bench] warm-up (compile) {time.perf_counter() - t_warm:.1f}s "
           f"on backend={backend}", file=sys.stderr, flush=True)
 
-    t0 = time.perf_counter()
-    res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1,
-                seed_batch=seed_batch, anneal_block=block,
-                proposals_per_step=proposals)
-    elapsed = time.perf_counter() - t0
+    with leg("headline"):
+        t0 = time.perf_counter()
+        res = solve(pt, prob=prob, chains=chains, steps=steps, seed=1,
+                    seed_batch=seed_batch, anneal_block=block,
+                    proposals_per_step=proposals)
+        elapsed = time.perf_counter() - t0
 
     # BASELINE config 5: streaming reschedule under node churn, now an
     # N-BURST loop through the DEVICE-RESIDENT warm path
@@ -205,9 +215,10 @@ def main() -> None:
     # churn sequence the pre-resident way (staged problem + host
     # pre-repair + host seed upload, r05's path) for the speedup and
     # soft-parity comparison.
-    resched = _resident_churn_loop(
-        pt, chains=resched_chains, steps=steps, block=block,
-        warm_block=warm_block, proposals=proposals)
+    with leg("resident_churn"):
+        resched = _resident_churn_loop(
+            pt, chains=resched_chains, steps=steps, block=block,
+            warm_block=warm_block, proposals=proposals)
     reschedule_ms = resched["p50_ms"]
     runs = resched["runs"]
 
@@ -220,9 +231,11 @@ def main() -> None:
     # headline 10kx1k numbers stay comparable across rounds.
     burst = None
     if os.environ.get("BENCH_BURST", "1").lower() not in ("0", "false"):
-        burst = _burst_scenario(S, N, chains=resched_chains, steps=steps,
-                                block=block, warm_block=warm_block,
-                                proposals=proposals)
+        with leg("burst"):
+            burst = _burst_scenario(S, N, chains=resched_chains,
+                                    steps=steps, block=block,
+                                    warm_block=warm_block,
+                                    proposals=proposals)
 
     # ---- sharded scenario (VERDICT r3 item 2): SPMD mega-solve ----------
     # The service-axis sharded anneal at full size over an 8-device mesh,
@@ -230,7 +243,8 @@ def main() -> None:
     # backend is a single chip (real ICI once >= 8 chips are visible).
     sharded = None
     if os.environ.get("BENCH_SHARDED", "1").lower() not in ("0", "false"):
-        sharded = _sharded_scenario()
+        with leg("sharded"):
+            sharded = _sharded_scenario()
 
     # ---- pipeline scenario (VERDICT r4 item 3): config -> placement -----
     # The FULL production path from KDL text (multi-fleet registry, like
@@ -240,13 +254,15 @@ def main() -> None:
     # solve-only number must not hide what config costs at the same scale.
     pipeline = None
     if os.environ.get("BENCH_PIPELINE", "1").lower() not in ("0", "false"):
-        pipeline = _pipeline_scenario(S, N, chains=chains, steps=steps,
-                                      seed_batch=seed_batch, block=block,
-                                      proposals=proposals)
-        # cold-vs-warm process split: two fresh processes sharing one
-        # persistent compile cache — the warm one must lose the cliff
-        if os.environ.get("BENCH_COLDWARM", "1").lower() not in ("0", "false"):
-            pipeline["cold_warm"] = _coldwarm_scenario()
+        with leg("pipeline"):
+            pipeline = _pipeline_scenario(S, N, chains=chains, steps=steps,
+                                          seed_batch=seed_batch,
+                                          block=block, proposals=proposals)
+            # cold-vs-warm process split: two fresh processes sharing one
+            # persistent compile cache — the warm one must lose the cliff
+            if os.environ.get("BENCH_COLDWARM", "1").lower() \
+                    not in ("0", "false"):
+                pipeline["cold_warm"] = _coldwarm_scenario()
 
     # ---- streaming admission (ROADMAP item 5): sustained placements/s ---
     # An open-loop Poisson+diurnal arrival generator drives the admission
@@ -257,7 +273,8 @@ def main() -> None:
     # users is a stream, not a burst.
     admission = None
     if os.environ.get("BENCH_ADMISSION", "1").lower() not in ("0", "false"):
-        admission = _admission_scenario()
+        with leg("admission"):
+            admission = _admission_scenario()
 
     # ---- tenant multiplexer (solver/multiplex.py): batched same-tier ----
     # warm solves in ONE vmapped dispatch. The leg pins per-lane parity
@@ -265,7 +282,22 @@ def main() -> None:
     # ladder; the amortized per-stage number sits next to the serial one.
     mux = None
     if os.environ.get("BENCH_MUX", "1").lower() not in ("0", "false"):
-        mux = _mux_scenario()
+        with leg("mux"):
+            mux = _mux_scenario()
+
+    # ---- collector overhead (ISSUE 18): the fleet horizon must be free -
+    # The warm churn loop twice — collector off vs on — pins the
+    # sampler's tax on the hot path; BENCH_OBS_ASSERT=1 gates p50 within
+    # 5%, 0 recompiles, disallow guard intact.
+    obs_overhead = None
+    if os.environ.get("BENCH_OBS", "1").lower() not in ("0", "false"):
+        with leg("obs_overhead"):
+            obs_overhead = _obs_overhead_leg(
+                pt, chains=resched_chains, steps=steps, block=block,
+                warm_block=warm_block, proposals=proposals)
+        if os.environ.get("BENCH_OBS_ASSERT", "").lower() \
+                in ("1", "true", "on", "yes"):
+            _assert_obs(obs_overhead)
 
     # packed problem planes (ISSUE 13): the staged layout vs the
     # analytic model; BENCH_PACKED_ASSERT=1 fails the run on divergence
@@ -335,6 +367,11 @@ def main() -> None:
         "pipeline": pipeline,
         "admission": admission,
         "mux": mux,
+        "obs_overhead": obs_overhead,
+        # per-leg TSDB summary (ISSUE 18 satellite): windowed
+        # min/mean/max/p99 per fleet_* series for every leg above —
+        # series HISTORY, where "metrics" below is only the final frame
+        "tsdb_summary": obsr.summary() if obsr is not None else None,
         # the same registry GET /metrics serves, embedded so BENCH_*.json
         # artifacts carry the counters the endpoint would have shown for
         # this run (solve durations, sweeps, compiles, acceptance)
@@ -345,6 +382,126 @@ def main() -> None:
 def _metrics_snapshot() -> dict:
     from fleetflow_tpu.obs.metrics import REGISTRY
     return REGISTRY.snapshot()
+
+
+class _BenchObs:
+    """Whole-run TSDB recorder (ISSUE 18 satellite): a background
+    collector samples the registry at a steady cadence while the legs
+    run, and each leg marks its window so the artifact carries per-leg
+    series history (min/mean/max/p99) instead of only the final counter
+    values — a regression in a MIDDLE leg is visible even after later
+    legs moved the registry on. BENCH_TSDB=0 disables (the overhead leg
+    measures the sampler's cost explicitly)."""
+
+    def __init__(self, interval_s: float = 0.25):
+        from fleetflow_tpu.obs.collector import Collector
+        from fleetflow_tpu.obs.tsdb import TimeSeriesDB
+        self.tsdb = TimeSeriesDB(capacity_per_series=4096, max_series=2048)
+        self.collector = Collector(self.tsdb, interval_s=interval_s)
+        self.windows: dict[str, tuple] = {}
+        self.collector.start_thread()
+
+    @contextlib.contextmanager
+    def leg(self, name: str):
+        self.collector.sample_once()       # pin the window's first frame
+        t0 = self.tsdb.clock()
+        try:
+            yield
+        finally:
+            self.collector.sample_once()   # ...and its last
+            self.windows[name] = (t0, self.tsdb.clock())
+
+    def summary(self) -> dict:
+        self.collector.stop_thread()
+        out: dict = {"stats": self.tsdb.stats(), "legs": {}}
+        for name, (t0, t1) in self.windows.items():
+            rows = {}
+            for row in self.tsdb.aggregate_range(t0, t1):
+                if not row["name"].startswith("fleet_"):
+                    continue
+                sel = ",".join(f"{k}={v}" for k, v in
+                               sorted(row["labels"].items()))
+                key = row["name"] + (f"{{{sel}}}" if sel else "")
+                agg = row["agg"]
+                rows[key] = {
+                    "min": round(agg["min"], 6),
+                    "mean": round(agg["mean"], 6),
+                    "max": round(agg["max"], 6),
+                    "p99": round(agg["p99"], 6),
+                    "count": agg["count"],
+                }
+            out["legs"][name] = {"window_s": round(t1 - t0, 3),
+                                 "series": rows}
+        return out
+
+
+def _obs_overhead_leg(pt, *, chains, steps, block, warm_block,
+                      proposals) -> dict:
+    """Sampler-overhead gate (ISSUE 18): the SAME warm churn loop run
+    collector-off then collector-on (a dedicated TSDB + registry scrape
+    thread at a fast cadence), so the artifact pins what the fleet
+    horizon costs the hot path. The loop still runs under the disallow
+    transfer guard with 0 recompiles — the collector reads host-side
+    registry state only, and BENCH_OBS_ASSERT=1 fails the run if the
+    on-p50 regresses more than 5% (+0.5 ms timer-noise slack) or any
+    compile/transfer sneaks in."""
+    from fleetflow_tpu.obs.collector import Collector
+    from fleetflow_tpu.obs.tsdb import TimeSeriesDB
+
+    kw = dict(chains=chains, steps=steps, block=block,
+              warm_block=warm_block, proposals=proposals)
+    off = _resident_churn_loop(pt, **kw)
+    tsdb = TimeSeriesDB(capacity_per_series=4096, max_series=2048)
+    interval = float(os.environ.get("BENCH_OBS_INTERVAL", "0.05"))
+    coll = Collector(tsdb, interval_s=interval)
+    # bracket the loop with explicit ticks: a fully-warm loop can finish
+    # inside the first sampler interval, and the gate must still have
+    # sampled the loop's registry state
+    coll.sample_once()
+    coll.start_thread()
+    try:
+        on = _resident_churn_loop(pt, **kw)
+    finally:
+        coll.stop_thread()
+        coll.sample_once()
+    ratio = (on["p50_ms"] / off["p50_ms"]) if off["p50_ms"] else 1.0
+    return {
+        "p50_off_ms": off["p50_ms"],
+        "p50_on_ms": on["p50_ms"],
+        "p99_off_ms": off["p99_ms"],
+        "p99_on_ms": on["p99_ms"],
+        "overhead_ratio": round(ratio, 4),
+        "sampler_interval_s": interval,
+        "sampler_samples": tsdb.stats()["samples_total"],
+        "sampler_series": tsdb.stats()["series"],
+        "compiles_on": on["compiles_total"],
+        "transfer_guard": on["transfer_guard"],
+    }
+
+
+def _assert_obs(obs: dict) -> None:
+    """BENCH_OBS_ASSERT=1: fail the run when the collector measurably
+    taxes the warm path."""
+    breaches = []
+    slack_ms = 0.5
+    if obs["p50_on_ms"] > obs["p50_off_ms"] * 1.05 + slack_ms:
+        breaches.append(
+            f"collector-on warm p50 {obs['p50_on_ms']:.2f} ms exceeds "
+            f"collector-off {obs['p50_off_ms']:.2f} ms by more than 5% "
+            f"(ratio {obs['overhead_ratio']:.3f})")
+    if obs["compiles_on"] != 0:
+        breaches.append(f"collector-on churn loop recompiled "
+                        f"{obs['compiles_on']} time(s)")
+    if obs["transfer_guard"] != "disallow":
+        breaches.append(f"transfer guard was {obs['transfer_guard']!r}, "
+                        f"not 'disallow'")
+    if obs["sampler_samples"] <= 0:
+        breaches.append("the sampler thread recorded no samples — the "
+                        "overhead leg measured nothing")
+    if breaches:
+        print(json.dumps({"obs_assert": "FAIL", "breaches": breaches}),
+              file=sys.stderr, flush=True)
+        sys.exit(1)
 
 
 def _packed_report(prob) -> dict:

@@ -88,6 +88,12 @@ class AgentConfig:
     # instead of re-running the deploy). Sized to outlive the CP's
     # redelivery backoff ladder.
     idempotency_window_s: float = 900.0
+    # fleet horizon (docs/guide/10-observability.md): piggyback a compact
+    # snapshot of this node's metrics registry on every heartbeat — the
+    # CP folds it into agent-labeled TSDB series for `fleet top`. At the
+    # default cadence this is a few KiB per 30 s; set False to ship
+    # liveness-only heartbeats.
+    ship_metrics: bool = True
 
 
 class Agent:
@@ -233,9 +239,17 @@ class Agent:
         the failure is logged and counted, so a half-dead session is
         visible on this node's metrics BEFORE the CP's lease expires."""
         while True:
+            payload: dict = {"version": self.config.version}
+            if self.config.ship_metrics:
+                try:
+                    from ..obs.collector import compact_snapshot
+                    payload["metrics"] = compact_snapshot()
+                except Exception:
+                    # telemetry must never cost liveness: a snapshot
+                    # failure ships a plain heartbeat
+                    pass
             try:
-                await self.conn.send_event("agent", "heartbeat",
-                                           {"version": self.config.version})
+                await self.conn.send_event("agent", "heartbeat", payload)
             except Exception as e:
                 _M_SEND_FAILURES.inc(loop="heartbeat")
                 log.debug("heartbeat send failed %s", kv(

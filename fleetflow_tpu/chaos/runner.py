@@ -50,6 +50,7 @@ from ..cp.server import AppState
 from ..cp.store import ReplicationFenced, Store
 from ..core.errors import ControlPlaneError
 from ..obs.slo import SloEngine, get_engine, parse_slo_props, set_engine
+from ..obs.tsdb import TimeSeriesDB
 from ..runtime.backend import MockBackend
 from ..runtime.engine import DeployEngine, DeployRequest
 from ..sched.base import Placement, level_schedule
@@ -253,6 +254,12 @@ class ChaosReport:
     events: list[dict] = field(default_factory=list)
     violations: list[str] = field(default_factory=list)
     stats: dict = field(default_factory=dict)
+    # fleet-horizon capture (obs/tsdb.py snapshot(), schema-versioned
+    # with its own content digest). Deliberately OUTSIDE digest(): the
+    # replayable-repro contract hashes the causal event log, and the
+    # capture is derived telemetry — its own `digest` key pins ITS
+    # determinism separately (tests/test_collector.py)
+    tsdb: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -266,11 +273,14 @@ class ChaosReport:
         return hashlib.sha256(blob).hexdigest()
 
     def to_dict(self) -> dict:
-        return {"scenario": self.scenario, "seed": self.seed,
-                "services": self.services, "nodes": self.nodes,
-                "stages": self.stages, "ok": self.ok,
-                "digest": self.digest(), "stats": self.stats,
-                "violations": self.violations, "events": self.events}
+        out = {"scenario": self.scenario, "seed": self.seed,
+               "services": self.services, "nodes": self.nodes,
+               "stages": self.stages, "ok": self.ok,
+               "digest": self.digest(), "stats": self.stats,
+               "violations": self.violations, "events": self.events}
+        if self.tsdb is not None:
+            out["tsdb"] = self.tsdb
+        return out
 
 
 class ChaosWorld:
@@ -302,6 +312,14 @@ class ChaosWorld:
         self.replicated = replicated
         self._store_dir = store_dir
         self._store_gen = 1
+        # fleet-horizon capture (obs/tsdb.py): one TSDB on the VIRTUAL
+        # clock for the whole scenario — it survives failover (the
+        # promoted state gets a fresh collector bound to the same store,
+        # so series run straight through the kill, which is exactly the
+        # history a post-mortem wants). registry=None in _wire_obs keeps
+        # process-global residue out of the pinned capture schema.
+        self.tsdb = TimeSeriesDB(clock=clock.now)
+        self.obs_collector = None
         store = Store(self._store_path("cp"), clock=clock.now)
         self.state = self._build_state(store)
         # the self-healing pair, on the VIRTUAL clock (lease expiry and
@@ -377,7 +395,27 @@ class ChaosWorld:
         # (the engine is in-memory observability, not placement truth).
         state.slo = set_engine(SloEngine(parse_slo_props(CHAOS_SLOS),
                                          clock=self.clock.now))
+        self._wire_obs(state)
         return state
+
+    def _wire_obs(self, state: AppState) -> None:
+        """Bind a fresh collector over this state's subsystems into the
+        world's single TSDB. Called from _build_state, so a failover
+        re-binds the sources to the promoted AppState while the series
+        history continues uninterrupted. No loop runs: the _Runner calls
+        `sample_obs()` at deterministic reconcile boundaries."""
+        from ..cp.server import collector_sources
+        from ..obs.collector import Collector
+        collector = Collector(self.tsdb, registry=None,
+                              clock=self.clock.now)
+        for src in collector_sources(state):
+            collector.add_source(src)
+        self.obs_collector = collector
+        state.collector = collector
+
+    def sample_obs(self) -> None:
+        if self.obs_collector is not None:
+            self.obs_collector.sample_once(now=self.clock.now())
 
     # -- event log ---------------------------------------------------------
 
@@ -884,6 +922,9 @@ class _Runner:
         for stage_name in sorted(self.dirty):
             if await self._deploy(stage_name):
                 self.dirty.discard(stage_name)
+        # one TSDB tick per reconcile: the capture's sample count equals
+        # the reconcile count, so two runs of a seed agree exactly
+        self.world.sample_obs()
 
     def _check_instant(self) -> list[str]:
         found = check_instant(self.world)
@@ -947,7 +988,8 @@ class _Runner:
             scenario=self.schedule.scenario, seed=self.schedule.seed,
             services=self.n_services, nodes=self.n_nodes,
             stages=self.n_stages, events=w.events,
-            violations=violations, stats=dict(self.stats))
+            violations=violations, stats=dict(self.stats),
+            tsdb=w.tsdb.snapshot())
         return report
 
 

@@ -15,6 +15,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Optional
 
 from ..core.errors import (CloudError, ConfigNotFound, ControlPlaneError,
@@ -1178,6 +1179,17 @@ def cmd_chaos(args) -> int:
         with open(args.json, "w", encoding="utf-8") as f:
             json.dump(report.to_dict(), f, indent=1)
         print(f"  full report -> {args.json}")
+    if getattr(args, "tsdb_out", None):
+        # the fleet-horizon capture: every series the collector sampled
+        # at reconcile boundaries, schema-versioned with its own content
+        # digest — written NEXT TO the event-log digest so a repro ships
+        # both the causal log and the telemetry it produced
+        with open(args.tsdb_out, "w", encoding="utf-8") as f:
+            json.dump(report.tsdb or {}, f, indent=1, sort_keys=True)
+        n = len((report.tsdb or {}).get("series", []))
+        print(f"  tsdb capture ({n} series, digest "
+              f"{(report.tsdb or {}).get('digest', '-')[:16]}...) "
+              f"-> {args.tsdb_out}")
     if report.violations:
         print(f"  {len(report.violations)} INVARIANT VIOLATION(S):")
         for v in report.violations:
@@ -1192,6 +1204,139 @@ def cmd_chaos(args) -> int:
         return 1
     print("  all invariants hold")
     return 0
+
+
+def _fmt_metric(v) -> str:
+    if v is None:
+        return "-"
+    try:
+        return f"{float(v):.6g}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _print_obs_rows(series: list, header: Optional[str] = None,
+                    filter_substr: Optional[str] = None) -> None:
+    """Render obs.query aggregate rows grouped by origin: the CP's own
+    series first, then one section per agent (series the heartbeat
+    shipping labeled `agent=<slug>`) — the shared formatter behind
+    `fleet top` and `fleet cp metrics --watch`."""
+    if header:
+        print(header)
+    groups: dict[str, list] = {}
+    for row in series:
+        if filter_substr and filter_substr not in row["name"]:
+            continue
+        if row.get("agg", {}).get("count", 0) == 0:
+            continue
+        groups.setdefault(row["labels"].get("agent", ""), []).append(row)
+    for agent in sorted(groups):
+        title = f"agent {agent}" if agent else "control plane"
+        print(f"-- {title} ({len(groups[agent])} series)")
+        for row in groups[agent]:
+            labels = {k: v for k, v in row["labels"].items()
+                      if k != "agent"}
+            sel = ",".join(f'{k}="{v}"'
+                           for k, v in sorted(labels.items()))
+            sel = "{" + sel + "}" if sel else ""
+            agg = row["agg"]
+            cols = (f"last={_fmt_metric(agg.get('last'))} "
+                    f"mean={_fmt_metric(agg.get('mean'))} "
+                    f"p99={_fmt_metric(agg.get('p99'))}")
+            if agg.get("rate") is not None:
+                cols += f" rate={_fmt_metric(agg['rate'])}/s"
+            print(f"  {row['name']}{sel} {cols}")
+
+
+def cmd_top(args) -> int:
+    """Live fleet-wide telemetry: windowed aggregates over every TSDB
+    series the CP's collector holds — its own deep gauges plus the
+    heartbeat-shipped, agent-labeled series from every connected node
+    (docs/guide/10-observability.md). `--once` renders one frame and
+    exits (scripting/CI); otherwise redraws every --interval seconds."""
+    with CpClient(args.cp) as cp:
+        def render() -> int:
+            out = cp.request("health", "obs.query",
+                             {"window_s": args.window})
+            if not out.get("enabled", False):
+                print("obs collector is disabled on this CP (standby, "
+                      "or started with collector=False)")
+                return 1
+            st = out.get("collector", {})
+            agents = ", ".join(st.get("agents", [])) or "-"
+            header = (f"fleet top | window {args.window:g}s | "
+                      f"{st.get('series', 0)} series, "
+                      f"{st.get('samples_total', 0)} samples | "
+                      f"agents: {agents}")
+            _print_obs_rows(out["series"], header=header,
+                            filter_substr=args.filter)
+            return 0
+
+        if args.once:
+            return render()
+        try:
+            while True:
+                print("\x1b[2J\x1b[H", end="")
+                rc = render()
+                if rc != 0:
+                    return rc
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def cmd_obs(args) -> int:
+    """TSDB query/export face (docs/guide/10-observability.md): windowed
+    aggregates (`query`), the series census (`series`), and offline
+    dumps (`export` — OpenMetrics text or JSONL)."""
+    with CpClient(args.cp) as cp:
+        if args.obs_cmd == "series":
+            out = cp.request("health", "obs.series")
+            if not out.get("enabled", False):
+                print("obs collector is disabled on this CP")
+                return 1
+            if args.json:
+                print(json.dumps(out, indent=2))
+                return 0
+            for s in out["series"]:
+                sel = ",".join(f'{k}="{v}"'
+                               for k, v in sorted(s["labels"].items()))
+                sel = "{" + sel + "}" if sel else ""
+                print(f"{s['name']}{sel} [{s['kind']}]")
+            st = out["stats"]
+            print(f"{st['series']} series, {st['samples_total']} samples "
+                  f"({st['dropped_series']} series dropped at the "
+                  f"{st['max_series']}-series cap)")
+            return 0
+        if args.obs_cmd == "export":
+            out = cp.request("health", "obs.export",
+                             {"format": args.format})
+            if not out.get("enabled", False):
+                print("obs collector is disabled on this CP")
+                return 1
+            if args.output:
+                with open(args.output, "w", encoding="utf-8") as f:
+                    f.write(out["text"])
+                print(f"{args.format} dump -> {args.output}")
+            else:
+                sys.stdout.write(out["text"])
+            return 0
+        # query
+        payload: dict = {"window_s": args.window}
+        if args.name:
+            payload["name"] = args.name
+        if args.label:
+            payload["labels"] = dict(
+                kv.split("=", 1) for kv in args.label)
+        out = cp.request("health", "obs.query", payload)
+        if not out.get("enabled", False):
+            print("obs collector is disabled on this CP")
+            return 1
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        _print_obs_rows(out["series"])
+        return 0
 
 
 def cmd_admit(args) -> int:
@@ -1572,22 +1717,49 @@ def _cp_dispatch(cp: CpClient, args) -> int:
         return 0
     if sub == "metrics":
         # the same registry GET /metrics serves, fetched over the channel
-        # protocol and printed as name{labels} value lines (--json for the
-        # full structured snapshot with HELP text and histogram sums)
-        snap = cp.request("health", "metrics")["metrics"]
-        if getattr(args, "json", False):
-            return show(snap)
-        for name, fam in sorted(snap.items()):
-            for v in fam["values"]:
-                labels = ",".join(f'{k}="{val}"'
-                                  for k, val in sorted(v["labels"].items()))
-                sel = f"{{{labels}}}" if labels else ""
-                if fam["type"] == "histogram":
-                    print(f"  {name}{sel} count={v['count']} "
-                          f"sum={v['sum']:.6g}")
+        # protocol and printed as name{labels} value lines (--format json
+        # for the full structured snapshot with HELP text and histogram
+        # sums; --json kept as an alias). --watch N re-renders every N
+        # seconds THROUGH THE TSDB query path (obs.query), so each line
+        # carries windowed rate/p99 context a point snapshot can't
+        def _render_snapshot() -> int:
+            snap = cp.request("health", "metrics")["metrics"]
+            if getattr(args, "json", False) \
+                    or getattr(args, "format", "text") == "json":
+                return show(snap)
+            for name, fam in sorted(snap.items()):
+                for v in fam["values"]:
+                    labels = ",".join(
+                        f'{k}="{val}"'
+                        for k, val in sorted(v["labels"].items()))
+                    sel = f"{{{labels}}}" if labels else ""
+                    if fam["type"] == "histogram":
+                        print(f"  {name}{sel} count={v['count']} "
+                              f"sum={v['sum']:.6g}")
+                    else:
+                        print(f"  {name}{sel} {v['value']:g}")
+            return 0
+
+        watch = getattr(args, "watch", None)
+        if not watch:
+            return _render_snapshot()
+        try:
+            while True:
+                out = cp.request("health", "obs.query",
+                                 {"window_s": max(float(watch) * 6, 30.0)})
+                print("\x1b[2J\x1b[H", end="")
+                if not out.get("enabled", False):
+                    # no collector on this CP (standby, or disabled):
+                    # degrade to re-printing the point snapshot
+                    _render_snapshot()
                 else:
-                    print(f"  {name}{sel} {v['value']:g}")
-        return 0
+                    _print_obs_rows(out["series"],
+                                    header=f"every {watch}s | window "
+                                           f"{out['window_s']:g}s | "
+                                           "ctrl-c to exit")
+                time.sleep(float(watch))
+        except KeyboardInterrupt:
+            return 0
     if sub == "tenant":
         verb = args.verb
         if verb == "status":
@@ -2137,7 +2309,13 @@ def build_parser() -> argparse.ArgumentParser:
     q = cps.add_parser("metrics", help="dump the CP metrics registry "
                        "(the JSON face of GET /metrics)")
     q.add_argument("--json", action="store_true",
-                   help="full structured snapshot with HELP text")
+                   help="full structured snapshot with HELP text "
+                        "(alias for --format json)")
+    q.add_argument("--format", choices=["text", "json"], default="text",
+                   help="output shape (default: text lines)")
+    q.add_argument("--watch", type=float, metavar="N",
+                   help="re-render every N seconds through the TSDB "
+                        "query path (windowed rate/p99 per series)")
     q = cps.add_parser("replication", help="replication status: role, "
                        "fencing epoch, standby lag "
                        "(docs/guide/13-cp-replication.md)")
@@ -2246,6 +2424,46 @@ def build_parser() -> argparse.ArgumentParser:
                    help="raw health.slo.status payload")
     p.set_defaults(fn=cmd_slo)
 
+    p = sub.add_parser("top", help="live fleet-wide telemetry: CP deep "
+                       "gauges + per-agent heartbeat-shipped series "
+                       "(docs/guide/10-observability.md)")
+    p.add_argument("--cp", dest="cp", help="CP endpoint host:port")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (scripting/CI)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="redraw cadence in seconds (default: 2)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="aggregate window in seconds (default: 60)")
+    p.add_argument("--filter", help="only series whose name contains "
+                   "this substring")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("obs", help="time-series store: windowed queries, "
+                       "series census, OpenMetrics/JSONL export")
+    p.add_argument("--cp", dest="cp", help="CP endpoint host:port")
+    obss = p.add_subparsers(dest="obs_cmd", required=True)
+    q = obss.add_parser("query", help="windowed aggregates per series "
+                        "(count/min/max/mean/last, counter rate, "
+                        "p50/p90/p99)")
+    q.add_argument("--name", help="exact series name")
+    q.add_argument("--label", action="append", metavar="K=V",
+                   help="label subset filter (repeatable; e.g. "
+                   "--label agent=node-1)")
+    q.add_argument("--window", type=float, default=60.0,
+                   help="window in seconds (default: 60)")
+    q.add_argument("--json", action="store_true",
+                   help="raw obs.query payload")
+    q = obss.add_parser("series", help="list series names/labels/kinds "
+                        "+ store stats")
+    q.add_argument("--json", action="store_true",
+                   help="raw obs.series payload")
+    q = obss.add_parser("export", help="dump retained samples")
+    q.add_argument("--format", choices=["openmetrics", "jsonl"],
+                   default="openmetrics")
+    q.add_argument("--output", "-o", help="write to this path instead "
+                   "of stdout")
+    p.set_defaults(fn=cmd_obs)
+
     p = sub.add_parser("chaos", help="seeded fault injection against a "
                        "simulated fleet (invariant-checked)")
     chs = p.add_subparsers(dest="chaos_cmd", required=True)
@@ -2260,6 +2478,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="autoscaler worker-pool floor (0 = no pool)")
     q.add_argument("--json", help="write the full report (events, "
                    "violations, digest) to this path")
+    q.add_argument("--tsdb-out", dest="tsdb_out",
+                   help="write the scenario's TSDB capture (every series "
+                   "sampled at reconcile boundaries, deterministic "
+                   "schema + content digest) to this path")
     q.add_argument("--expect-digest", dest="expect_digest",
                    help="fail unless the event-log digest equals this "
                    "(CI pinning: same seed must replay byte-identically)")

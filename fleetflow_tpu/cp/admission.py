@@ -1300,6 +1300,19 @@ class AdmissionController:
                         and n not in pending]
             return [n for _seq, n in sorted(out)]
 
+    def queue_census(self) -> dict:
+        """Per-tenant (queued, oldest_age_s) plus totals — the cheap
+        slice of status() the obs collector deep-samples every tick:
+        no percentile math, no stream walk, one short lock hold."""
+        with self._lock:
+            now = self.clock()
+            depth, oldest = self._queue_ages(now)
+            tenants = {t: {"queued": len(q),
+                           "oldest_age_s": now - q[0].submitted_at}
+                       for t, q in self._queues.items() if q}
+            return {"queue_depth": depth, "oldest_age_s": oldest,
+                    "parked": len(self._parked), "tenants": tenants}
+
     def status(self) -> dict:
         """The `fleet admit status` / deploy.admit_status payload."""
         with self._lock:

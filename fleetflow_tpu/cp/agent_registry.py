@@ -140,6 +140,12 @@ class AgentRegistry:
     def connection_of(self, slug: str) -> Optional[Connection]:
         return self._agents.get(slug)
 
+    def inflight(self) -> int:
+        """Commands awaiting a command_result — the fan-out depth the
+        obs collector samples (TSDB series fleet_agent_commands_in_flight):
+        ROADMAP item 3's registry bottleneck shows up here first."""
+        return len(self._pending)
+
     # ------------------------------------------------------------------
     async def send_command(self, slug: str, command: str,
                            payload: dict | None = None,

@@ -104,7 +104,7 @@ _READ_METHODS = frozenset({
     "get", "list", "history", "status", "overview", "summary", "alerts",
     "logs", "logs.live", "show", "snapshots", "ps", "pool.list",
     "user.list", "ping", "reservations", "metrics", "heal.status",
-    "admit_status",
+    "admit_status", "obs.query", "obs.series", "obs.export",
 })
 def _timed(channel: str, handler):
     """Wrap a channel handler with the request-latency histogram + error
@@ -570,6 +570,35 @@ def _health(state: "AppState"):
                    else {"enabled": True, **state.reconverger.status()})
             out["replication"] = _replication_status(state)
             return out
+        if method in ("obs.query", "obs.series", "obs.export"):
+            # TSDB channel face (obs/tsdb.py): the windowed store behind
+            # `fleet top` / `fleet obs` — standby-safe reads (the standby
+            # simply has no collector, so enabled=False)
+            coll = state.collector
+            if coll is None:
+                return {"enabled": False}
+            tsdb = coll.tsdb
+            if method == "obs.series":
+                return {"enabled": True, "series": [
+                    {"name": s.name, "labels": s.labels_dict(),
+                     "kind": s.kind}
+                    for s in tsdb.match(p.get("name"), p.get("labels"))],
+                    "stats": tsdb.stats()}
+            if method == "obs.export":
+                fmt = p.get("format", "openmetrics")
+                if fmt == "jsonl":
+                    return {"enabled": True, "format": fmt,
+                            "text": tsdb.export_jsonl()}
+                if fmt == "openmetrics":
+                    return {"enabled": True, "format": fmt,
+                            "text": tsdb.render_openmetrics()}
+                raise ValueError(f"unknown export format {fmt!r}")
+            window = float(p.get("window_s", 60.0))
+            return {"enabled": True, "window_s": window,
+                    "collector": coll.status(),
+                    "series": tsdb.aggregate(
+                        name=p.get("name"), labels=p.get("labels"),
+                        window_s=window)}
         raise ValueError(f"unknown method health.{method}")
     return handle
 
@@ -1126,6 +1155,21 @@ async def _run_build(state: "AppState", job_id: str, worker: str) -> None:
 # agent channel (the duplex session, handlers/agent.rs)
 # --------------------------------------------------------------------------
 
+def _ingest_heartbeat_metrics(state: "AppState", slug: str, p: dict) -> None:
+    """Fold a heartbeat's piggybacked metrics snapshot into the CP's
+    TSDB as agent-labeled series (the fleet-wide half of `fleet top`).
+    Malformed snapshots must never fail the heartbeat itself — liveness
+    detection outranks telemetry."""
+    snap = p.get("metrics")
+    if not snap or state.collector is None:
+        return
+    try:
+        state.collector.ingest_agent_snapshot(slug, snap)
+    except Exception:
+        _log.debug("heartbeat metrics ingest failed for %s", slug,
+                   exc_info=True)
+
+
 def _agent(state: "AppState"):
     registered: dict[int, str] = {}   # id(conn) -> slug
     state._agent_conn_slugs = registered
@@ -1176,6 +1220,7 @@ def _agent(state: "AppState"):
             db.heartbeat(slug, version=p.get("version", ""))
             if state.failure_detector is not None:
                 state.failure_detector.observe_heartbeat(slug)
+            _ingest_heartbeat_metrics(state, slug, p)
             return {"ok": True}
         raise ValueError(f"unknown method agent.{method}")
 
@@ -1192,6 +1237,7 @@ def _agent(state: "AppState"):
             db.heartbeat(slug, version=p.get("version", ""))
             if state.failure_detector is not None:
                 state.failure_detector.observe_heartbeat(slug)
+            _ingest_heartbeat_metrics(state, slug, p)
         elif method == "alert":
             kind = p.get("kind", "unknown")
             if p.get("resolved"):

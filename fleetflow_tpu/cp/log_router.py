@@ -123,6 +123,16 @@ class LogRouter:
         topic."""
         return self._subs.get(sid)
 
+    def backlog(self) -> tuple[int, list[dict]]:
+        """(total queued lines, per-subscriber census) — the collector's
+        deep gauge: the aggregate rides `/metrics`, the per-subscriber
+        rows go TSDB-only (subscriber ids are unbounded cardinality).
+        qsize() is a plain length read; safe from the sampler."""
+        subs = [{"subscriber": s.id, "prefix": s.prefix,
+                 "queued": s.queue.qsize(), "dropped": s.dropped}
+                for s in self._subs.values()]
+        return sum(s["queued"] for s in subs), subs
+
     # ------------------------------------------------------------------
     def retained(self, topic: str, limit: Optional[int] = None) -> list[LogEntry]:
         """The cached tail served to CLI/MCP/REST without touching the agent

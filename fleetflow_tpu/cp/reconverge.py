@@ -235,6 +235,12 @@ class Reconverger:
         chaos liveness invariant requires to be empty after settle."""
         return sorted(k for k, w in self._work.items() if not w.parked)
 
+    def debt(self) -> int:
+        """Count of stages with active (non-parked) redelivery work —
+        the collector's deep gauge (fleet_reconverge_redelivery_debt).
+        A plain dict scan; safe from the sampler cadence."""
+        return sum(1 for w in self._work.values() if not w.parked)
+
     def status(self) -> dict:
         """`fleet cp heal status` payload."""
         now = self.clock()

@@ -81,6 +81,15 @@ class ServerConfig:
     # built on primaries (and on promotion) even with no objectives —
     # `fleet slo status` then reports raw stream quantiles only.
     slo: Optional[dict] = None
+    # fleet-horizon collector (obs/collector.py + obs/tsdb.py): the
+    # cadence sampler feeding the in-process time-series store behind
+    # `fleet top`, `fleet obs query/export` and the obs.query channel.
+    # Primaries only (built again on promotion) — a standby's series
+    # would be all zeros with no agents attached.
+    collector: bool = True
+    collector_interval_s: float = 5.0
+    collector_capacity: int = 512          # samples retained per series
+    collector_max_series: int = 4096       # series-cardinality cap
 
 
 @dataclass
@@ -129,6 +138,10 @@ class AppState:
     # the process default so the placement/admission/reconverge
     # observation points route to it.
     slo: Optional[object] = None
+    # fleet-horizon collector (obs/collector.py); None on standbys and
+    # when ServerConfig.collector is off. The obs.query channel and the
+    # agent heartbeat handler both reach it through here.
+    collector: Optional[object] = None
 
 
 class CpServerHandle:
@@ -151,6 +164,8 @@ class CpServerHandle:
             self.state.reconverger.stop()
         if self.state.admission is not None:
             self.state.admission.stop()
+        if self.state.collector is not None:
+            self.state.collector.stop()
         await self.server.stop()
         self.state.store.flush()
 
@@ -266,6 +281,8 @@ async def start(config: ServerConfig, *,
             _build_self_heal(state, config)
         if config.admission:
             _build_admission(state, config)
+        if config.collector:
+            _build_collector(state, config)
 
     server = ProtocolServer(
         name=config.name, authenticate=authenticate, ssl_context=ssl_ctx,
@@ -334,6 +351,115 @@ def _build_admission(state: AppState, config: ServerConfig) -> None:
     state.admission.spawn()
 
 
+def collector_sources(state: AppState) -> list:
+    """The CP's deep-gauge sources for the obs collector: callables
+    run every sampling tick that read live subsystem state the registry
+    scrape can't see (per-tenant queues, per-subscriber backlogs, slot
+    byte accounting). Each both sets the registry gauges (so GET
+    /metrics agrees) and RETURNS (name, labels, value, kind) entries —
+    the chaos runner reuses these sources with registry=None, where the
+    returned entries are the only way samples reach the capture (the
+    process-global registry carries cross-test residue that must never
+    leak into a pinned artifact). The collector dedups name+labels
+    within a tick, so the double reporting never double-records."""
+    from ..obs.collector import (_M_LOG_BACKLOG, _M_RECONV_DEBT,
+                                 _M_RES_BUDGET, _M_TENANT_DEPTH,
+                                 _M_TENANT_OLDEST)
+
+    tenants_seen: set = set()
+
+    def _slo(now):
+        if state.slo is not None:
+            state.slo.refresh()
+        return ()
+
+    def _admission(now):
+        adm = state.admission
+        if adm is None:
+            return ()
+        census = adm.queue_census()
+        out = [("fleet_admission_queue_depth", {},
+                float(census["queue_depth"])),
+               ("fleet_admission_oldest_age_seconds", {},
+                float(census["oldest_age_s"])),
+               ("fleet_admission_parked", {}, float(census["parked"]))]
+        live = set(census["tenants"])
+        for tenant, row in census["tenants"].items():
+            _M_TENANT_DEPTH.set(row["queued"], tenant=tenant)
+            _M_TENANT_OLDEST.set(row["oldest_age_s"], tenant=tenant)
+            out.append(("fleet_admission_tenant_queue_depth",
+                        {"tenant": tenant}, float(row["queued"])))
+            out.append(("fleet_admission_tenant_oldest_age_seconds",
+                        {"tenant": tenant}, float(row["oldest_age_s"])))
+        # a tenant whose queue drained must read 0, not freeze at its
+        # last depth
+        for tenant in tenants_seen - live:
+            _M_TENANT_DEPTH.set(0, tenant=tenant)
+            _M_TENANT_OLDEST.set(0.0, tenant=tenant)
+            out.append(("fleet_admission_tenant_queue_depth",
+                        {"tenant": tenant}, 0.0))
+            out.append(("fleet_admission_tenant_oldest_age_seconds",
+                        {"tenant": tenant}, 0.0))
+        tenants_seen.update(live)
+        return out
+
+    def _log_router(now):
+        total, subs = state.log_router.backlog()
+        _M_LOG_BACKLOG.set(total)
+        out = [("fleet_log_router_backlog_lines", {}, float(total))]
+        # per-subscriber rows are TSDB-only: subscriber ids are
+        # unbounded cardinality, so they must not become registry
+        # label children
+        for s in subs:
+            out.append(("fleet_log_router_subscriber_backlog_lines",
+                        {"subscriber": str(s["subscriber"])},
+                        float(s["queued"])))
+        return out
+
+    def _reconverge(now):
+        rec = state.reconverger
+        if rec is None:
+            return ()
+        debt = rec.debt()
+        _M_RECONV_DEBT.set(debt)
+        return [("fleet_reconverge_redelivery_debt", {}, float(debt)),
+                ("fleet_reconverge_parked_stages", {},
+                 float(len(rec.parked_stage_keys())))]
+
+    def _agents(now):
+        return [("fleet_agents_connected", {},
+                 float(len(state.agent_registry.list_connected()))),
+                ("fleet_agent_commands_in_flight", {},
+                 float(state.agent_registry.inflight()))]
+
+    def _slots(now):
+        slots = state.placement.solver_slots()
+        _M_RES_BUDGET.set(slots["budget_bytes"])
+        return [("fleet_sched_resident_budget_bytes", {},
+                 float(slots["budget_bytes"])),
+                ("fleet_sched_resident_bytes", {},
+                 float(slots["resident_bytes"])),
+                ("fleet_solver_resident_bytes_drift", {},
+                 float(slots.get("bytes_drift", 0)))]
+
+    return [_slo, _admission, _log_router, _reconverge, _agents, _slots]
+
+
+def _build_collector(state: AppState, config: ServerConfig) -> None:
+    """The fleet-horizon sampler (obs/collector.py): registry scrape +
+    deep sources into the in-process TSDB, on the server's asyncio loop.
+    Primaries only (rebuilt on promotion, like the SLO engine)."""
+    from ..obs.collector import Collector
+    from ..obs.tsdb import TimeSeriesDB
+    tsdb = TimeSeriesDB(capacity_per_series=config.collector_capacity,
+                        max_series=config.collector_max_series)
+    collector = Collector(tsdb, interval_s=config.collector_interval_s)
+    for src in collector_sources(state):
+        collector.add_source(src)
+    state.collector = collector
+    collector.spawn()
+
+
 def _promote(state: AppState, config: ServerConfig,
              repl_config: ReplicationConfig) -> None:
     """Standby -> primary flip (StandbyRunner.on_promote): open the
@@ -351,5 +477,9 @@ def _promote(state: AppState, config: ServerConfig,
         # batching state, not placement truth — that is journaled); a
         # client's next deploy.submit re-attaches
         _build_admission(state, config)
+    if config.collector:
+        # fresh horizon: the standby's (empty) store is replaced, not
+        # merged — series begin at promotion, like the SLO windows
+        _build_collector(state, config)
     log.warning("standby promoted: now serving as primary %s", kv(
         epoch=state.store.epoch, name=config.name))

@@ -85,6 +85,17 @@ _M_SAMPLES = REGISTRY.counter(
     "fleet_slo_samples_total",
     "Latency samples folded into the SLO engine, per stream",
     labels=("stream",))
+_M_STREAM_Q = REGISTRY.gauge(
+    "fleet_slo_stream_quantile",
+    "Observed lifetime quantile per observation stream, in the stream's "
+    "unit — the same deterministic-sketch tails the slo-met chaos "
+    "invariant judges, exported so external scrapers see them",
+    labels=("stream", "quantile"))
+
+# the percentiles every stream exports (satellite, ISSUE 18): matches
+# the _QUANTILES grammar minus p999 (too noisy below ~10k samples)
+EXPORTED_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p95", 0.95),
+                      ("p99", 0.99))
 
 # the observation streams the control plane feeds today; objectives may
 # only bind to these (a typo'd stream would otherwise be a silently
@@ -331,9 +342,11 @@ class SloEngine:
             st.slow.observe(value, now)
             st.count += 1
             _M_SAMPLES.inc(stream=stream)
-            if (self._by_stream.get(stream)
-                    and (st.last_refresh is None
-                         or now - st.last_refresh >= GAUGE_REFRESH_S)):
+            # every stream refreshes at cadence now (not only objective-
+            # bound ones): the quantile exposition gauges must track
+            # streams nobody declared an objective for yet
+            if (st.last_refresh is None
+                    or now - st.last_refresh >= GAUGE_REFRESH_S):
                 st.last_refresh = now
                 self._refresh_locked(stream, st, now)
 
@@ -341,6 +354,12 @@ class SloEngine:
                         now: float) -> None:
         # ONE window merge per ring, shared by every objective bound to
         # the stream (they differ only in quantile/threshold)
+        for label, q in EXPORTED_QUANTILES:
+            v = st.life.quantile(q)
+            if v is not None:
+                _M_STREAM_Q.set(v, stream=stream, quantile=label)
+        if not self._by_stream.get(stream):
+            return
         fast = st.fast.sketch(now)
         slow = st.slow.sketch(now)
         for o in self._by_stream.get(stream, ()):
@@ -365,9 +384,8 @@ class SloEngine:
         now = self.clock()
         with self._lock:
             for stream, st in self._streams.items():
-                if self._by_stream.get(stream):
-                    st.last_refresh = now
-                    self._refresh_locked(stream, st, now)
+                st.last_refresh = now
+                self._refresh_locked(stream, st, now)
 
     # -- introspection -------------------------------------------------
 

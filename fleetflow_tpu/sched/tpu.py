@@ -68,6 +68,12 @@ _M_RES_BYTES = REGISTRY.gauge(
     "Device bytes held by resident stage slots (packed-plane accounting)")
 _M_RES_SLOTS = REGISTRY.gauge(
     "fleet_sched_resident_slots", "Resident stage slots currently held")
+_M_RES_DRIFT = REGISTRY.gauge(
+    "fleet_solver_resident_bytes_drift",
+    "Live device bytes of resident slots minus the slot manager's "
+    "admission-time accounting — nonzero drift means a slot's buffers "
+    "grew or shrank after admission (refreshed by slots_status / the "
+    "obs collector's cross-check)")
 
 # default device budget for resident stage state: roomy on a real chip,
 # and far above what the test-scale problems allocate, so the budget
@@ -201,6 +207,23 @@ class TpuSolverScheduler:
         _M_RES_BYTES.set(self._resident_bytes())
         _M_RES_SLOTS.set(len(self._residents))
 
+    def byte_drift(self) -> int:
+        """Live device bytes minus the accounted admission-time bytes,
+        summed over resident slots — the cross-check the profiling hook
+        (ISSUE 18) exports: the slot manager budgets on admission-time
+        `device_nbytes`, so any post-admission buffer growth (a resident
+        re-staged larger in place, an adopted oversized assignment) is
+        invisible to eviction until it drifts this gauge off zero. A
+        host-side walk of buffer shapes; no device sync."""
+        drift = 0
+        for s in self._residents:
+            try:
+                drift += int(s.resident.device_nbytes()) - int(s.nbytes)
+            except Exception:
+                continue
+        _M_RES_DRIFT.set(drift)
+        return drift
+
     def slots_status(self) -> dict:
         """Occupancy payload for the health channel (`fleet solve slots`):
         per-slot stage key, tier, resident bytes, last-use age and
@@ -226,6 +249,7 @@ class TpuSolverScheduler:
             "budget_bytes": self._budget_bytes,
             "max_slots": self._max_residents,
             "resident_bytes": self._resident_bytes(),
+            "bytes_drift": self.byte_drift(),
             "slots": slots,
             "evicted": parked,
         }

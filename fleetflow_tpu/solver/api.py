@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -73,12 +74,61 @@ _M_BUCKET = REGISTRY.counter(
 _M_PAD_WASTE = REGISTRY.gauge(
     "fleet_solver_bucket_pad_waste_ratio",
     "Phantom fraction of the most recent bucketed solve's service rows")
+_M_INFLIGHT = REGISTRY.gauge(
+    "fleet_solver_dispatches_in_flight",
+    "Solver anneal dispatches currently executing (full fused + "
+    "localized sub-solve) — deep-sampled by the obs collector")
+_M_DISPATCH_DELTA = REGISTRY.gauge(
+    "fleet_solver_dispatch_device_delta_bytes",
+    "Device bytes_in_use delta across the most recent profiled dispatch "
+    "(FLEET_PROFILE_SOLVER=1; stays 0 when the backend reports no "
+    "allocator stats, e.g. CPU)")
 
 DEFAULT_STEPS = 128   # batched sweeps (anneal.default_proposals_per_step wide)
 
 __all__ = ["solve", "SolveResult", "make_chain_inits"]
 
 CHAIN_AXIS = "chains"
+
+
+def _device_bytes_in_use() -> Optional[int]:
+    """Allocator-reported bytes on the first local device, or None when
+    the backend has no stats (CPU). A host-side allocator read — no
+    device sync, safe under the disallow transfer guard."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return int(stats.get("bytes_in_use", 0))
+
+
+@contextlib.contextmanager
+def _dispatch_scope(label: str):
+    """Every hot anneal dispatch runs inside this scope. Always: the
+    in-flight gauge the obs collector deep-samples. Opt-in
+    (FLEET_PROFILE_SOLVER=1): a jax.profiler TraceAnnotation named per
+    dispatch (visible inside the FLEET_PROFILE_DIR trace around the
+    whole solve) plus the device bytes_in_use delta the dispatch left
+    behind, exported as a gauge so a leaking dispatch shows up as a
+    climbing delta, not an eventual OOM."""
+    profile = os.environ.get("FLEET_PROFILE_SOLVER", "").lower() in (
+        "1", "true", "on", "yes")
+    before = _device_bytes_in_use() if profile else None
+    _M_INFLIGHT.inc()
+    try:
+        if profile:
+            with jax.profiler.TraceAnnotation(f"fleet:{label}"):
+                yield
+        else:
+            yield
+    finally:
+        _M_INFLIGHT.dec()
+        if profile:
+            after = _device_bytes_in_use()
+            if before is not None and after is not None:
+                _M_DISPATCH_DELTA.set(after - before)
 
 
 @dataclass
@@ -681,7 +731,7 @@ def _solve(pt: ProblemTensors, *,
         # base) stage BEFORE the guard arms — the merge-upload discipline
         staged = stage_subsolve(resident, sub_plan)
         sub_props = backend_proposals_per_step(sub_plan.tier)
-        with guard_ctx():
+        with guard_ctx(), _dispatch_scope("subsolve"):
             (best_assignment, dstats, dsoft, sweeps_run, accepted,
              dtelem) = subsolve_dispatch(
                     prob, resident.assignment, staged, sub_plan, key,
@@ -722,7 +772,7 @@ def _solve(pt: ProblemTensors, *,
         # transfer inside the warm dispatch raises (every input above is
         # already resident; statics hash, they don't transfer); off the
         # resident path the guard is a nullcontext
-        with guard_ctx():
+        with guard_ctx(), _dispatch_scope("refine"):
             (best_assignment, dstats, dsoft, sweeps_run, accepted,
              dtelem) = _refine(
                 prob, seed_assignment, key, t0_d, t1_d, mw_d, **refine_kw)

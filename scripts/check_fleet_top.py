@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CI gate: `fleet top --once` against a live CP with two real agents.
+
+Boots an in-process CP (fast collector cadence) plus two Agents on
+MockBackend, waits until heartbeat-shipped metric snapshots have landed
+as `agent=<slug>` labeled TSDB series, then runs the ACTUAL CLI path —
+`fleet top --once --cp host:port` over the real socket — and asserts
+the rendered frame contains:
+
+  - the header line with both agent slugs (collector.status() agents);
+  - a `-- control plane` section (the CP's own registry/deep-gauge
+    series);
+  - one `-- agent <slug>` section per connected node.
+
+This is the fleet-horizon acceptance criterion (ISSUE 18): fleet-wide
+series merged from heartbeats must be visible through the operator
+surface, not just present in the store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import importlib
+import io
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# the in-process CP is plaintext; a stale mesh CA under ~/.local/state
+# must not make the CLI half dial TLS
+os.environ["FLEET_CP_CA"] = "none"
+
+SLUGS = ("top-node-1", "top-node-2")
+
+
+def main() -> int:
+    from fleetflow_tpu.agent import Agent, AgentConfig
+    from fleetflow_tpu.cp.server import ServerConfig, start
+    from fleetflow_tpu.obs.collector import wait_for_series
+    from fleetflow_tpu.runtime import MockBackend
+
+    # `from .main import main` in cli/__init__ shadows the module
+    # attribute, so resolve the module explicitly
+    cli_main = importlib.import_module("fleetflow_tpu.cli.main")
+
+    async def go() -> tuple[int, str]:
+        loop = asyncio.get_running_loop()
+        handle = await start(
+            ServerConfig(collector_interval_s=0.1),
+            backend_factory=lambda: MockBackend(auto_pull=True))
+        agents, tasks = [], []
+        try:
+            for slug in SLUGS:
+                cfg = AgentConfig(
+                    cp_host=handle.host, cp_port=handle.port, slug=slug,
+                    heartbeat_interval_s=0.1, monitor_interval_s=0.1,
+                    capacity={"cpu": 8, "memory": 16384, "disk": 100000})
+                agent = Agent(cfg, backend=MockBackend(auto_pull=True),
+                              sleep=lambda d: None)
+                agents.append(agent)
+                tasks.append(asyncio.ensure_future(agent.run()))
+
+            # heartbeats carry compact_snapshot(); wait (off-loop — the
+            # helper blocks on wall clock) until BOTH agents' snapshots
+            # have merged into agent-labeled series
+            coll = handle.state.collector
+            assert coll is not None, "ServerConfig.collector is on"
+            for slug in SLUGS:
+                ok = await loop.run_in_executor(
+                    None, lambda s=slug: wait_for_series(
+                        coll, labels={"agent": s}, timeout=15.0))
+                if not ok:
+                    raise AssertionError(
+                        f"no agent-labeled series for {slug} after 15s "
+                        f"(collector status: {coll.status()})")
+
+            def run_top() -> tuple[int, str]:
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    rc = cli_main.main(
+                        ["top", "--once",
+                         "--cp", f"{handle.host}:{handle.port}"])
+                return rc, buf.getvalue()
+
+            # the CLI spins its own event loop — run it off-thread so
+            # this loop keeps serving the socket underneath it
+            return await loop.run_in_executor(None, run_top)
+        finally:
+            for agent in agents:
+                agent.stop()
+            for task in tasks:
+                with contextlib.suppress(Exception):
+                    await asyncio.wait_for(task, 5)
+            await handle.stop()
+
+    rc, out = asyncio.run(asyncio.wait_for(go(), 60))
+
+    errors = []
+    if rc != 0:
+        errors.append(f"fleet top --once exited {rc}")
+    first = out.splitlines()[0] if out.splitlines() else ""
+    if not first.startswith("fleet top |"):
+        errors.append(f"missing header line, got: {first!r}")
+    for slug in SLUGS:
+        if slug not in first:
+            errors.append(f"agent {slug} missing from header: {first!r}")
+        if f"-- agent {slug} (" not in out:
+            errors.append(f"no rendered section for agent {slug}")
+    if "-- control plane (" not in out:
+        errors.append("no control-plane section in the frame")
+    if "fleet_agents_connected" not in out:
+        errors.append("CP deep series fleet_agents_connected not shown")
+
+    if errors:
+        print("fleet top smoke FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  {e}", file=sys.stderr)
+        print("---- frame ----", file=sys.stderr)
+        print(out, file=sys.stderr)
+        return 1
+    lines = len(out.splitlines())
+    print(f"fleet top --once OK ({lines} lines, agents: "
+          f"{', '.join(SLUGS)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

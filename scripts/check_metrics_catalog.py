@@ -41,6 +41,7 @@ def registered() -> set[str]:
     import fleetflow_tpu.cp.autoscaler      # noqa: F401
     import fleetflow_tpu.cp.handlers        # noqa: F401 (server loads lazily)
     import fleetflow_tpu.cp.server          # noqa: F401
+    import fleetflow_tpu.obs.collector      # noqa: F401 (server loads lazily)
     import fleetflow_tpu.obs.slo            # noqa: F401
     import fleetflow_tpu.platform           # noqa: F401 (compile-cache gauge)
     import fleetflow_tpu.registry.aggregate  # noqa: F401

@@ -47,6 +47,9 @@ REQUIRED = (
     "fleet_admission_queue_depth",          # streaming admission
     "fleet_autoscaler_pressure",            # admission -> autoscaler loop
     "fleet_cloud_provider_degraded_total",  # misconfigured-provider alarm
+    "fleet_obs_samples_total",              # TSDB collector
+    "fleet_slo_stream_quantile",            # SLO quantile export
+    "fleet_solver_dispatches_in_flight",    # device profiling hooks
 )
 
 _SAMPLE = re.compile(

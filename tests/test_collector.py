@@ -1,0 +1,317 @@
+"""Collector tests (obs/collector.py): the cadence sampler that feeds
+the fleet-horizon TSDB, the heartbeat snapshot contract, and the live
+surfaces on top.
+
+Five layers:
+  - compact_snapshot: the agent-side heartbeat payload (deterministic
+    order, schema-versioned, truncation-capped);
+  - sample_once: registry scrape + deep sources in ONE deduped batch per
+    tick (source-returned entries override the scrape), source failures
+    isolated;
+  - ingest_agent_snapshot: agent-labeled merge, malformed-entry
+    tolerance, the per-snapshot entry cap;
+  - the chaos capture contract: same seed => byte-identical TSDB
+    snapshot digest embedded in the report (registry=None keeps
+    process-global residue out of the pinned artifact);
+  - the obs.* channel methods over a live CP (series census, windowed
+    query, both export formats, the disabled-collector answer) and the
+    heartbeat -> agent-labeled-series end-to-end path with a real Agent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from fleetflow_tpu.agent import Agent, AgentConfig
+from fleetflow_tpu.chaos import run_scenario
+from fleetflow_tpu.cp import ServerConfig, start
+from fleetflow_tpu.cp.protocol import ProtocolClient
+from fleetflow_tpu.obs.collector import (MAX_SNAPSHOT_ENTRIES,
+                                         SNAPSHOT_SCHEMA, Collector,
+                                         compact_snapshot)
+from fleetflow_tpu.obs.metrics import MetricsRegistry
+from fleetflow_tpu.obs.tsdb import TimeSeriesDB
+from fleetflow_tpu.runtime import MockBackend
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("work_total", "c").inc(3)
+    reg.gauge("depth", "g").set(7)
+    reg.histogram("lat", "h").observe(0.5)
+    return reg
+
+
+def _collector(**kw) -> tuple[Collector, TimeSeriesDB, FakeClock]:
+    clock = FakeClock()
+    tsdb = TimeSeriesDB(clock=clock)
+    kw.setdefault("registry", None)
+    return Collector(tsdb, clock=clock, **kw), tsdb, clock
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+# --------------------------------------------------------------------------
+# compact_snapshot (the heartbeat payload)
+# --------------------------------------------------------------------------
+
+class TestCompactSnapshot:
+    def test_schema_and_flattening(self):
+        snap = compact_snapshot(_registry())
+        assert snap["schema"] == SNAPSHOT_SCHEMA
+        assert not snap["truncated"]
+        by_name = {e[0]: e for e in snap["m"]}
+        assert by_name["work_total"][2:] == [3.0, "counter"]
+        assert by_name["depth"][2:] == [7.0, "gauge"]
+        # histograms cross the wire as _sum/_count counters
+        assert by_name["lat_sum"][2:] == [0.5, "counter"]
+        assert by_name["lat_count"][2:] == [1.0, "counter"]
+
+    def test_deterministic_order_and_json_safe(self):
+        a, b = compact_snapshot(_registry()), compact_snapshot(_registry())
+        assert a == b
+        assert json.loads(json.dumps(a)) == a
+
+    def test_truncation_cap(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("many", "g", labels=("i",))
+        for i in range(20):
+            g.set(float(i), i=str(i))
+        snap = compact_snapshot(reg, max_entries=5)
+        assert snap["truncated"] and len(snap["m"]) == 5
+
+
+# --------------------------------------------------------------------------
+# sample_once
+# --------------------------------------------------------------------------
+
+class TestSampleOnce:
+    def test_registry_scrape_lands_in_tsdb(self):
+        coll, tsdb, clock = _collector(registry=_registry())
+        clock.t = 10.0
+        recorded = coll.sample_once()
+        assert recorded == 4
+        (s,) = tsdb.match("depth")
+        assert s.kind == "gauge" and s.last() == (10.0, 7.0)
+        (s,) = tsdb.match("work_total")
+        assert s.kind == "counter"
+        assert coll.status()["last_sample_t"] == 10.0
+
+    def test_source_entries_override_the_scrape(self):
+        reg = MetricsRegistry()
+        reg.gauge("depth", "g").set(1)
+        coll, tsdb, _ = _collector(registry=reg)
+        coll.add_source(lambda now: [("depth", {}, 42.0)])
+        coll.sample_once()
+        (s,) = tsdb.match("depth")
+        # exactly ONE sample this tick, the source's value
+        assert s.total == 1 and s.last()[1] == 42.0
+
+    def test_tsdb_only_source_defaults_to_gauge(self):
+        coll, tsdb, _ = _collector()
+        coll.add_source(lambda now: [
+            ("backlog", {"subscriber": "s1"}, 5.0),
+            ("acks", {}, 9.0, "counter")])
+        assert coll.sample_once(now=3.0) == 2
+        (s,) = tsdb.match("backlog")
+        assert s.kind == "gauge" and s.labels == (("subscriber", "s1"),)
+        (s,) = tsdb.match("acks")
+        assert s.kind == "counter"
+
+    def test_failing_source_does_not_kill_the_pass(self):
+        coll, tsdb, _ = _collector()
+
+        def bad(now):
+            raise RuntimeError("boom")
+
+        coll.add_source(bad)
+        coll.add_source(lambda now: [("ok", {}, 1.0)])
+        assert coll.sample_once(now=0.0) == 1
+        assert tsdb.names() == ["ok"]
+
+    def test_registry_none_records_nothing_by_itself(self):
+        # the chaos shape: no scrape, no process-global residue
+        coll, tsdb, _ = _collector()
+        assert coll.sample_once(now=0.0) == 0
+        assert len(tsdb) == 0
+
+
+# --------------------------------------------------------------------------
+# agent snapshot ingest
+# --------------------------------------------------------------------------
+
+class TestIngestAgentSnapshot:
+    def test_labels_every_series_with_the_slug(self):
+        coll, tsdb, _ = _collector()
+        n = coll.ingest_agent_snapshot(
+            "node-1", compact_snapshot(_registry()), now=1.0)
+        assert n == 4
+        assert len(tsdb.match(labels={"agent": "node-1"})) == 4
+        (s,) = tsdb.match("work_total")
+        assert dict(s.labels)["agent"] == "node-1"
+        assert s.kind == "counter"
+        assert coll.status()["agents"] == ["node-1"]
+
+    def test_wrong_schema_rejected_whole(self):
+        coll, tsdb, _ = _collector()
+        assert coll.ingest_agent_snapshot("n", {"schema": 99, "m": [
+            ["x", {}, 1.0, "gauge"]]}) == 0
+        assert coll.ingest_agent_snapshot("n", "not-a-dict") == 0
+        assert len(tsdb) == 0
+
+    def test_malformed_entries_skipped_not_raised(self):
+        coll, tsdb, _ = _collector()
+        n = coll.ingest_agent_snapshot("n", {
+            "schema": SNAPSHOT_SCHEMA,
+            "m": [["good", {}, 1.0, "gauge"],
+                  ["short"],
+                  ["nan-ish", {}, "not-a-float", "gauge"],
+                  None,
+                  ["also-good", {"k": "v"}, 2.0]]}, now=0.0)
+        assert n == 2
+        assert tsdb.names() == ["also-good", "good"]
+
+    def test_entry_cap_bounds_one_snapshot(self):
+        coll, tsdb, _ = _collector()
+        m = [[f"m{i}", {}, float(i), "gauge"]
+             for i in range(MAX_SNAPSHOT_ENTRIES + 8)]
+        n = coll.ingest_agent_snapshot(
+            "n", {"schema": SNAPSHOT_SCHEMA, "m": m}, now=0.0)
+        assert n == MAX_SNAPSHOT_ENTRIES
+
+
+# --------------------------------------------------------------------------
+# chaos capture: the deterministic artifact
+# --------------------------------------------------------------------------
+
+class TestChaosCapture:
+    def test_same_seed_identical_tsdb_digest(self):
+        kw = dict(seed=11, services=20, nodes=4, stages=1, pool_min=0)
+        a = run_scenario("rolling-kill", **kw)
+        b = run_scenario("rolling-kill", **kw)
+        assert a.tsdb is not None and a.tsdb["series"]
+        assert a.tsdb["digest"] == b.tsdb["digest"]
+        assert a.tsdb == b.tsdb
+        # the capture rides the report dict (what --tsdb-out writes) but
+        # stays OUT of the pinned event-log digest
+        assert "tsdb" in a.to_dict()
+        assert a.digest() == b.digest()
+
+    def test_capture_holds_world_series_only(self):
+        r = run_scenario("rolling-kill", seed=11, services=20, nodes=4,
+                         stages=1, pool_min=0)
+        names = {s["name"] for s in r.tsdb["series"]}
+        # deep-source series are present; raw process-global registry
+        # families (e.g. solver timings from other tests) are not
+        assert "fleet_agents_connected" in names
+        assert all(n.startswith("fleet_") for n in names)
+
+
+# --------------------------------------------------------------------------
+# the live surfaces: obs.* channel + heartbeat e2e
+# --------------------------------------------------------------------------
+
+async def _connect(handle) -> ProtocolClient:
+    cli, _ = await ProtocolClient.connect(handle.host, handle.port,
+                                          identity="cli")
+    return cli
+
+
+class TestObsChannel:
+    def test_series_query_export_over_live_cp(self):
+        async def go():
+            handle = await start(ServerConfig(collector_interval_s=0.05))
+            try:
+                coll = handle.state.collector
+                assert coll is not None
+                for _ in range(100):
+                    if len(coll.tsdb):
+                        break
+                    await asyncio.sleep(0.02)
+                cli = await _connect(handle)
+                series = await cli.request("health", "obs.series")
+                query = await cli.request("health", "obs.query",
+                                          {"window_s": 60.0})
+                om = await cli.request("health", "obs.export",
+                                       {"format": "openmetrics"})
+                jl = await cli.request("health", "obs.export",
+                                       {"format": "jsonl"})
+                await cli.close()
+                return series, query, om, jl
+            finally:
+                await handle.stop()
+
+        series, query, om, jl = run(go())
+        assert series["enabled"] and series["stats"]["series"] > 0
+        names = {s["name"] for s in series["series"]}
+        assert "fleet_agents_connected" in names
+        assert query["enabled"] and query["window_s"] == 60.0
+        assert any(r["agg"]["count"] > 0 for r in query["series"])
+        assert om["format"] == "openmetrics"
+        assert om["text"].rstrip().endswith("# EOF")
+        rows = [json.loads(ln) for ln in jl["text"].splitlines()]
+        assert rows and all("samples" in r for r in rows)
+
+    def test_disabled_collector_answers_not_errors(self):
+        async def go():
+            handle = await start(ServerConfig(collector=False))
+            try:
+                cli = await _connect(handle)
+                out = await cli.request("health", "obs.query",
+                                        {"window_s": 5.0})
+                await cli.close()
+                return out
+            finally:
+                await handle.stop()
+
+        assert run(go()) == {"enabled": False}
+
+    def test_heartbeat_ships_agent_labeled_series(self):
+        async def go():
+            handle = await start(
+                ServerConfig(collector_interval_s=0.05),
+                backend_factory=lambda: MockBackend(auto_pull=True))
+            agent = Agent(
+                AgentConfig(cp_host=handle.host, cp_port=handle.port,
+                            slug="node-1", heartbeat_interval_s=0.05,
+                            monitor_interval_s=0.05,
+                            capacity={"cpu": 8, "memory": 16384,
+                                      "disk": 100000}),
+                backend=MockBackend(auto_pull=True),
+                sleep=lambda d: None)
+            task = asyncio.ensure_future(agent.run())
+            try:
+                coll = handle.state.collector
+                for _ in range(200):
+                    if coll.tsdb.match(labels={"agent": "node-1"}):
+                        break
+                    await asyncio.sleep(0.02)
+                cli = await _connect(handle)
+                out = await cli.request(
+                    "health", "obs.query",
+                    {"window_s": 60.0, "labels": {"agent": "node-1"}})
+                await cli.close()
+                return out, coll.status()
+            finally:
+                agent.stop()
+                await asyncio.wait_for(task, 5)
+                await handle.stop()
+
+        out, status = run(go())
+        assert out["enabled"]
+        rows = [r for r in out["series"]
+                if r["labels"].get("agent") == "node-1"]
+        assert rows, "no agent-labeled series reached the CP TSDB"
+        assert all(r["labels"]["agent"] == "node-1" for r in out["series"])
+        assert status["agents"] == ["node-1"]

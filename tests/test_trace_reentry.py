@@ -1,0 +1,211 @@
+"""Trace-context re-entry tests (obs/trace.py + obs.span).
+
+The module docstring makes a sharp promise: contextvars follow
+async/await but NOT `loop.run_in_executor` threads, so thread-hopping
+code re-enters the trace explicitly from the id it carried
+(`with use_trace(req.trace_id)` — the DeployEngine pattern). These
+tests pin that contract:
+
+  - adopt/keep/mint/restore semantics of use_trace itself;
+  - the executor hop really does drop the context, and explicit
+    re-entry really does restore it (flight-recorder events from the
+    hopped thread join the SAME trace);
+  - span-failure extras under concurrency: failing spans racing on
+    many threads each record their OWN extras, error, and trace id —
+    the contextvar isolation means no cross-thread bleed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from fleetflow_tpu.obs import get_logger, span
+from fleetflow_tpu.obs.trace import (current_span_id, current_trace_id,
+                                     read_trace_file, use_trace)
+
+log = get_logger("test.trace")
+
+
+# --------------------------------------------------------------------------
+# use_trace semantics
+# --------------------------------------------------------------------------
+
+class TestUseTrace:
+    def test_adopts_explicit_id_and_restores(self):
+        assert current_trace_id() == ""
+        with use_trace("cafe0123feed4567") as tid:
+            assert tid == "cafe0123feed4567"
+            assert current_trace_id() == tid
+        assert current_trace_id() == ""
+
+    def test_keeps_active_trace_when_none_given(self):
+        with use_trace("aaaa000011112222"):
+            with use_trace() as inner:
+                assert inner == "aaaa000011112222"
+            # inner exit must not tear down the outer trace
+            assert current_trace_id() == "aaaa000011112222"
+
+    def test_mints_fresh_id_outside_any_trace(self):
+        with use_trace() as a:
+            assert a and current_trace_id() == a
+        with use_trace() as b:
+            assert b and b != a
+        assert current_trace_id() == ""
+
+    def test_sequential_operations_cannot_leak_into_each_other(self):
+        seen = []
+        for _ in range(3):
+            with use_trace() as tid:
+                seen.append(tid)
+        assert len(set(seen)) == 3
+        assert current_trace_id() == ""
+
+
+# --------------------------------------------------------------------------
+# the executor hop
+# --------------------------------------------------------------------------
+
+class TestExecutorHop:
+    def test_plain_thread_does_not_inherit_the_trace(self):
+        got = {}
+
+        def worker():
+            got["tid"] = current_trace_id()
+            got["sid"] = current_span_id()
+
+        with use_trace("feedbeef00000001"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert got == {"tid": "", "sid": ""}
+
+    def test_run_in_executor_drops_context_reentry_restores(self):
+        """The documented DeployEngine pattern end-to-end: the executor
+        thread starts traceless, `use_trace(carried_id)` re-enters, and
+        the id is gone again once the re-entry block exits."""
+        def worker(carried: str) -> tuple[str, str, str]:
+            before = current_trace_id()
+            with use_trace(carried):
+                during = current_trace_id()
+            return before, during, current_trace_id()
+
+        async def go():
+            with use_trace() as tid:
+                loop = asyncio.get_running_loop()
+                with ThreadPoolExecutor(1) as pool:
+                    return tid, await loop.run_in_executor(
+                        pool, worker, current_trace_id())
+
+        tid, (before, during, after) = asyncio.run(go())
+        assert before == ""          # the hop dropped the context
+        assert during == tid         # explicit re-entry joined the trace
+        assert after == ""           # and restored cleanly
+
+    def test_hopped_spans_join_the_same_flight_recorder_trace(
+            self, tmp_path, monkeypatch):
+        """Spans on both sides of the hop must share one trace id in the
+        recorded events — that is what makes `fleet events --trace`
+        render a deploy as ONE timeline. The hopped span's parent link
+        is absent: span ids are contextvars too, so parentage does not
+        cross the executor boundary (only the trace id is carried)."""
+        trace_file = tmp_path / "hop.jsonl"
+        monkeypatch.setenv("FLEET_TRACE_FILE", str(trace_file))
+
+        def worker(carried: str) -> None:
+            with use_trace(carried):
+                with span(log, "agent.work") as s:
+                    s["hop"] = 1
+
+        async def go():
+            with span(log, "cp.execute"):
+                loop = asyncio.get_running_loop()
+                with ThreadPoolExecutor(1) as pool:
+                    await loop.run_in_executor(
+                        pool, worker, current_trace_id())
+
+        asyncio.run(go())
+        events = read_trace_file(str(trace_file))
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"], []).append(e)
+        assert {e["kind"] for e in by_name["cp.execute"]} == \
+            {"begin", "end"}
+        assert {e["kind"] for e in by_name["agent.work"]} == \
+            {"begin", "end"}
+        tids = {e["trace"] for e in events}
+        assert len(tids) == 1, f"hop split the trace: {tids}"
+        hopped = by_name["agent.work"][0]
+        assert "parent" not in hopped
+        # the extra recorded at exit survived the hop too
+        end = [e for e in by_name["agent.work"]
+               if e["kind"] == "end"][0]
+        assert end["fields"] == {"hop": 1}
+
+
+# --------------------------------------------------------------------------
+# span-failure extras under concurrent spans
+# --------------------------------------------------------------------------
+
+class TestConcurrentFailureExtras:
+    def test_fail_event_merges_fields_and_extras(self, tmp_path,
+                                                 monkeypatch):
+        trace_file = tmp_path / "fail.jsonl"
+        monkeypatch.setenv("FLEET_TRACE_FILE", str(trace_file))
+        with pytest.raises(RuntimeError, match="boom"):
+            with span(log, "deploy.step", stage="prod") as s:
+                s["placed"] = 7
+                raise RuntimeError("boom")
+        (fail,) = [e for e in read_trace_file(str(trace_file))
+                   if e["kind"] == "fail"]
+        assert fail["name"] == "deploy.step"
+        assert fail["error"] == "boom"
+        assert fail["duration_ms"] >= 0
+        # kwargs AND body-collected extras, merged
+        assert fail["fields"] == {"stage": "prod", "placed": 7}
+
+    def test_racing_failing_spans_keep_their_own_extras(self, tmp_path,
+                                                        monkeypatch):
+        """N threads x M failing spans, all overlapping on a barrier:
+        every fail event must carry exactly its own thread's extras and
+        trace id — one mixed-up pair means the contextvar isolation (or
+        the recorder's line atomicity) broke."""
+        trace_file = tmp_path / "race.jsonl"
+        monkeypatch.setenv("FLEET_TRACE_FILE", str(trace_file))
+        workers, rounds = 4, 25
+        barrier = threading.Barrier(workers)
+
+        def storm(who: int) -> None:
+            tid = f"{who:016x}"
+            barrier.wait()
+            for i in range(rounds):
+                with use_trace(tid):
+                    try:
+                        with span(log, "storm.op", who=who) as s:
+                            s["round"] = i
+                            raise ValueError(f"w{who}r{i}")
+                    except ValueError:
+                        pass
+
+        threads = [threading.Thread(target=storm, args=(w,))
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        events = read_trace_file(str(trace_file))
+        fails = [e for e in events if e["kind"] == "fail"]
+        assert len(fails) == workers * rounds
+        for e in fails:
+            who = e["fields"]["who"]
+            assert e["trace"] == f"{who:016x}"
+            assert e["error"] == f"w{who}r{e['fields']['round']}"
+        # every (who, round) pair recorded exactly once — no event was
+        # lost or doubled under the write lock
+        pairs = {(e["fields"]["who"], e["fields"]["round"])
+                 for e in fails}
+        assert len(pairs) == workers * rounds
