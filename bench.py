@@ -299,6 +299,18 @@ def main() -> None:
                 in ("1", "true", "on", "yes"):
             _assert_obs(obs_overhead)
 
+    # ---- agent fan-out (ISSUE 19): 10k agents on one CP ----------------
+    # The sharded control-plane delivery machinery against a simulated
+    # fleet: serial-loop baseline vs send_batch shard lanes, redelivery
+    # storm, and the failure-detector sweep at n vs 10n leases.
+    # BENCH_AGENTS_ASSERT=1 gates the >= 5x (2x small) speedup, metric
+    # coalescing, sweep sublinearity and scan/heap verdict parity.
+    agents = None
+    if os.environ.get("BENCH_AGENTS", "1").lower() not in ("0", "false"):
+        from fleetflow_tpu.cp.bench_agents import agents_scenario
+        with leg("agents"):
+            agents = agents_scenario(small=small)
+
     # packed problem planes (ISSUE 13): the staged layout vs the
     # analytic model; BENCH_PACKED_ASSERT=1 fails the run on divergence
     # or on any recompile inside the warm churn loop
@@ -368,6 +380,7 @@ def main() -> None:
         "admission": admission,
         "mux": mux,
         "obs_overhead": obs_overhead,
+        "agents": agents,
         # per-leg TSDB summary (ISSUE 18 satellite): windowed
         # min/mean/max/p99 per fleet_* series for every leg above —
         # series HISTORY, where "metrics" below is only the final frame

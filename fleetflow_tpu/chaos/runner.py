@@ -47,6 +47,7 @@ from ..cp.placement import PlacementService
 from ..cp.reconverge import ReconvergeConfig, Reconverger
 from ..cp.replication import StandbyReplica
 from ..cp.server import AppState
+from ..cp.shards import ShardTable
 from ..cp.store import ReplicationFenced, Store
 from ..core.errors import ControlPlaneError
 from ..obs.slo import SloEngine, get_engine, parse_slo_props, set_engine
@@ -365,10 +366,18 @@ class ChaosWorld:
             return None
         return str(self._store_dir / f"{name}{self._store_gen}.json")
 
+    # CP worker shards for every chaos world (cp/shards.py): FIXED, not
+    # read from FLEET_CP_SHARDS — the shard layout shapes batch lanes
+    # and log routing, and a pinned digest must not depend on the env.
+    # Sharding is therefore ACTIVE in every pinned scenario.
+    CP_SHARDS = 4
+
     def _build_state(self, store: Store) -> AppState:
+        shard_table = ShardTable(self.CP_SHARDS)
         state = AppState(
-            store=store, auth=NoAuth(), agent_registry=AgentRegistry(),
-            log_router=LogRouter(),
+            store=store, auth=NoAuth(),
+            agent_registry=AgentRegistry(shard_table=shard_table),
+            log_router=LogRouter(shard_table=shard_table),
             placement=PlacementService(store),
             backend_factory=lambda: MockBackend(auto_pull=True),
             server_provider_factory=self._provider_factory,

@@ -1702,6 +1702,16 @@ def _cp_dispatch(cp: CpClient, args) -> int:
               f"redeliveries_ok={s.get('redeliveries_ok', 0)} "
               f"retried={s.get('redeliveries_retried', 0)} "
               f"parked={s.get('parked', 0)}")
+        sh = out.get("shards") or {}
+        if sh.get("census"):
+            # per-shard occupancy/in-flight (docs/guide/17-cp-sharding):
+            # which partition of the fleet is loaded or behind
+            print(f"shards: count={sh.get('count', 1)} "
+                  f"debt={sh.get('debt', 0)}")
+            for row in sh["census"]:
+                print(f"  shard {row['shard']:<3} "
+                      f"agents={row['agents']:<6} "
+                      f"inflight={row['inflight']}")
         res = out.get("resident") or {}
         if res:
             print(f"resident: delta_reuse={res.get('delta_reuse', 0)} "

@@ -36,10 +36,25 @@ Thread-safe (heartbeats land on the asyncio loop; sweeps may run on
 executor threads). The clock is injectable and MONOTONIC — wall-clock
 jumps must not kill a fleet (time.monotonic in production, the chaos
 VirtualClock in tests/scenarios).
+
+Sweep cost (ISSUE 19): the sweep used to scan EVERY lease under the
+lock on every tick — O(agents) per tick, and at 10k leases the scan
+dominated the reconverge loop while holding the lock heartbeats need.
+The default sweep now pops a min-expiry heap of attention times (lease
+deadlines / suspect-grace expiries / damp-hold releases): a quiet fleet
+costs O(expired · log n) per sweep, independent of fleet size.
+Heartbeats invalidate LAZILY — renewing a lease just moves its
+deadline; the stale heap entry pops at the old deadline, re-derives the
+lease's real state, and re-schedules itself. Entry staleness is tracked
+with per-lease generation counters; `use_heap=False` retains the full
+scan, which doubles as the property-test oracle (the two sweeps must
+emit identical verdict streams on any schedule) and the bench's
+unsharded baseline.
 """
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
@@ -105,38 +120,70 @@ class _Lease:
     # verdict timestamps (dead + revive) for flap counting
     verdicts: deque = field(default_factory=lambda: deque(maxlen=32))
     damped_logged: bool = False      # one damped log/metric per hold
+    # generation of this lease's live min-expiry-heap entry; -1 = no
+    # timed attention scheduled (DEAD leases wait on a heartbeat, not
+    # the clock). A popped entry with a stale generation is discarded.
+    gen: int = -1
 
 
 class FailureDetector:
     def __init__(self, config: Optional[LeaseConfig] = None, *,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 use_heap: bool = True):
         self.config = config or LeaseConfig()
         self.clock = clock
+        self.use_heap = use_heap
         self._lock = threading.Lock()
         self._leases: dict[str, _Lease] = {}
         self._pending: list[LeaseEvent] = []   # revives awaiting a sweep
+        # min-expiry heap of (attention_time, slug, generation)
+        self._heap: list[tuple[float, str, int]] = []
+        self._gen = 0
+        # incremental per-state census (the fleet_lease_agents gauge
+        # without an O(agents) recount per sweep)
+        self._counts = {ALIVE: 0, SUSPECT: 0, DEAD: 0}
 
     # ------------------------------------------------------------------
     # observations (called from the agent channel / registry paths)
     # ------------------------------------------------------------------
 
+    def _schedule(self, slug: str, lease: _Lease, at: float) -> None:
+        """(Re)arm the lease's heap entry; any previous entry for the
+        slug goes stale (generation mismatch) and is dropped on pop."""
+        if not self.use_heap:
+            return
+        self._gen += 1
+        lease.gen = self._gen
+        heapq.heappush(self._heap, (at, slug, self._gen))
+
     def observe_heartbeat(self, slug: str) -> None:
         """Renew the lease. A heartbeat from a SUSPECT agent revives it
         silently; from a DEAD one it queues a node-online verdict (the
-        reconverger retries parked work against returned capacity)."""
+        reconverger retries parked work against returned capacity).
+
+        Heap note: renewing an ALIVE lease does NOT touch the heap (the
+        10k-agents-heartbeating hot path) — the entry at the old
+        deadline lazily re-derives and re-arms itself when it pops."""
         now = self.clock()
         with self._lock:
             lease = self._leases.get(slug)
             if lease is None:
                 lease = self._leases[slug] = _Lease()
+                self._counts[ALIVE] += 1
                 _M_TRANSITIONS.inc(to=ALIVE)
             lease.deadline = now + self.config.lease_s
             lease.connected = True
+            if lease.gen == -1:
+                # fresh lease, or revive of a DEAD one (no timed
+                # attention while dead): arm the expiry timer
+                self._schedule(slug, lease, lease.deadline)
             if lease.state == ALIVE:
                 return
             was = lease.state
             lease.state = ALIVE
             lease.damped_logged = False
+            self._counts[was] -= 1
+            self._counts[ALIVE] += 1
             _M_TRANSITIONS.inc(to=ALIVE)
             log.info("agent revived %s", kv(slug=slug, was=was))
             if was == DEAD:
@@ -159,6 +206,8 @@ class FailureDetector:
             lease = self._leases[slug] = _Lease()
             lease.deadline = now + self.config.lease_s
             lease.connected = False
+            self._counts[ALIVE] += 1
+            self._schedule(slug, lease, lease.deadline)
             _M_TRANSITIONS.inc(to=ALIVE)
             log.debug("lease primed %s", kv(slug=slug,
                                             lease_s=self.config.lease_s))
@@ -176,6 +225,12 @@ class FailureDetector:
             if lease.state == ALIVE:
                 lease.state = SUSPECT
                 lease.suspect_since = now
+                self._counts[ALIVE] -= 1
+                self._counts[SUSPECT] += 1
+                # the fast path moves attention EARLIER than the armed
+                # lease deadline: re-arm at the grace expiry
+                self._schedule(slug, lease,
+                               now + self.config.suspect_grace_s)
                 _M_TRANSITIONS.inc(to=SUSPECT)
                 log.debug("agent suspect %s", kv(slug=slug,
                                                  reason="disconnect"))
@@ -184,7 +239,9 @@ class FailureDetector:
         """Server deleted/deprovisioned: stop tracking (no verdict — the
         operator path already ran its own node_event)."""
         with self._lock:
-            self._leases.pop(slug, None)
+            lease = self._leases.pop(slug, None)
+            if lease is not None:
+                self._counts[lease.state] -= 1
 
     # ------------------------------------------------------------------
     # the sweep (called by the reconverger loop / chaos runner)
@@ -196,50 +253,118 @@ class FailureDetector:
                    if t > cutoff) >= self.config.flap_threshold
 
     def sweep(self) -> list[LeaseEvent]:
-        """Advance every lease against the clock; return the verdicts
+        """Advance the leases against the clock; return the verdicts
         (DEAD + queued revives) since the last sweep, sorted by slug for
-        deterministic replay."""
+        deterministic replay.
+
+        Two equivalent engines behind one contract (their verdict
+        streams are property-tested identical on seeded schedules):
+        the default expiry heap touches only due leases — O(expired ·
+        log n); `use_heap=False` scans the full table — O(agents) — and
+        serves as oracle and bench baseline."""
         now = self.clock()
-        cfg = self.config
-        out: list[LeaseEvent] = []
         with self._lock:
             out, self._pending = self._pending, []
-            for slug in sorted(self._leases):
-                lease = self._leases[slug]
-                if lease.state == ALIVE and now > lease.deadline:
-                    lease.state = SUSPECT
-                    lease.suspect_since = now
-                    _M_TRANSITIONS.inc(to=SUSPECT)
-                    log.info("agent suspect %s", kv(
-                        slug=slug, reason="lease-expired",
-                        lease_s=cfg.lease_s))
-                if lease.state != SUSPECT:
-                    continue
-                suspect_for = now - lease.suspect_since
-                if suspect_for < cfg.suspect_grace_s:
-                    continue
-                if self._flapping(lease, now) and suspect_for < cfg.damp_hold_s:
-                    if not lease.damped_logged:
-                        lease.damped_logged = True
-                        _M_DAMPED.inc()
-                        log.warning("dead verdict damped %s", kv(
-                            slug=slug, hold_s=cfg.damp_hold_s,
-                            window_s=cfg.flap_window_s))
-                    continue
-                lease.state = DEAD
-                lease.damped_logged = False
-                lease.verdicts.append(now)
-                _M_TRANSITIONS.inc(to=DEAD)
-                log.warning("agent dead %s", kv(
-                    slug=slug, suspect_for_s=round(suspect_for, 1)))
-                out.append(LeaseEvent(slug, False, now, DEAD))
-            counts = {ALIVE: 0, SUSPECT: 0, DEAD: 0}
-            for lease in self._leases.values():
-                counts[lease.state] += 1
-            for state, n in counts.items():
+            if self.use_heap:
+                self._sweep_heap(now, out)
+            else:
+                self._sweep_scan(now, out)
+            for state, n in self._counts.items():
                 _M_AGENTS.set(n, state=state)
         out.sort(key=lambda e: e.slug)
         return out
+
+    def _sweep_scan(self, now: float, out: list[LeaseEvent]) -> None:
+        """The original full-table sweep (lock held by caller)."""
+        for slug in sorted(self._leases):
+            self._advance(slug, self._leases[slug], now, out)
+
+    def _sweep_heap(self, now: float, out: list[LeaseEvent]) -> None:
+        """Pop only the leases whose attention time has arrived (lock
+        held by caller). Stale entries (generation mismatch after a
+        disconnect re-arm, or a forgotten slug) are discarded; live ones
+        re-derive the lease's true condition at `now` — a heartbeat that
+        moved the deadline since the entry was pushed simply re-arms at
+        the new deadline (lazy invalidation)."""
+        repush: list[tuple[float, str, int]] = []
+        while self._heap and self._heap[0][0] <= now:
+            _, slug, gen = heapq.heappop(self._heap)
+            lease = self._leases.get(slug)
+            if lease is None or lease.gen != gen:
+                continue
+            lease.gen = -1
+            nxt = self._advance(slug, lease, now, out)
+            if nxt is not None:
+                # defer the push: an entry at exactly `now` must wait
+                # for the NEXT sweep, not loop inside this one
+                self._gen += 1
+                lease.gen = self._gen
+                repush.append((nxt, slug, self._gen))
+        for entry in repush:
+            heapq.heappush(self._heap, entry)
+        if len(self._heap) > max(64, 4 * len(self._leases)):
+            self._compact()
+
+    def _advance(self, slug: str, lease: _Lease, now: float,
+                 out: list[LeaseEvent]) -> Optional[float]:
+        """Advance ONE lease's state machine to `now`; returns when it
+        next needs clock attention (None: only a heartbeat can move it).
+        This is the single transition body both sweep engines share, so
+        they cannot drift."""
+        cfg = self.config
+        if lease.state == ALIVE:
+            if not now > lease.deadline:
+                return lease.deadline
+            lease.state = SUSPECT
+            lease.suspect_since = now
+            self._counts[ALIVE] -= 1
+            self._counts[SUSPECT] += 1
+            _M_TRANSITIONS.inc(to=SUSPECT)
+            log.info("agent suspect %s", kv(
+                slug=slug, reason="lease-expired", lease_s=cfg.lease_s))
+        if lease.state != SUSPECT:
+            return None               # DEAD: waits on a heartbeat
+        suspect_for = now - lease.suspect_since
+        if suspect_for < cfg.suspect_grace_s:
+            return lease.suspect_since + cfg.suspect_grace_s
+        if self._flapping(lease, now) and suspect_for < cfg.damp_hold_s:
+            if not lease.damped_logged:
+                lease.damped_logged = True
+                _M_DAMPED.inc()
+                log.warning("dead verdict damped %s", kv(
+                    slug=slug, hold_s=cfg.damp_hold_s,
+                    window_s=cfg.flap_window_s))
+            # earliest possible flip: the hold expires, or enough
+            # verdicts age out of the flap window — whichever is first
+            vs = list(lease.verdicts)
+            unflap_at = vs[-cfg.flap_threshold] + cfg.flap_window_s
+            return min(lease.suspect_since + cfg.damp_hold_s, unflap_at)
+        lease.state = DEAD
+        lease.damped_logged = False
+        lease.verdicts.append(now)
+        self._counts[SUSPECT] -= 1
+        self._counts[DEAD] += 1
+        _M_TRANSITIONS.inc(to=DEAD)
+        log.warning("agent dead %s", kv(
+            slug=slug, suspect_for_s=round(suspect_for, 1)))
+        out.append(LeaseEvent(slug, False, now, DEAD))
+        return None
+
+    def _compact(self) -> None:
+        """Rebuild the heap with one entry per timed lease, shedding the
+        stale-generation residue disconnect re-arms leave behind. The
+        rebuilt times are safe LOWER bounds (an early pop just
+        re-derives and re-arms)."""
+        self._heap = []
+        for slug, lease in self._leases.items():
+            if lease.gen == -1:
+                continue
+            at = (lease.deadline if lease.state == ALIVE
+                  else lease.suspect_since + self.config.suspect_grace_s)
+            self._gen += 1
+            lease.gen = self._gen
+            self._heap.append((at, slug, self._gen))
+        heapq.heapify(self._heap)
 
     def requeue(self, events: list[LeaseEvent]) -> None:
         """The reconverger failed to process these verdicts (e.g. the

@@ -569,6 +569,15 @@ def _health(state: "AppState"):
             out = ({"enabled": False} if state.reconverger is None
                    else {"enabled": True, **state.reconverger.status()})
             out["replication"] = _replication_status(state)
+            # per-shard occupancy/in-flight (cp/shards.py) + the
+            # reconverger's aggregate debt, so the shard rows answer
+            # "which partition is behind" next to the work table
+            out["shards"] = {
+                "count": (state.agent_registry.shard_table.shards
+                          if state.agent_registry.shard_table else 1),
+                "census": state.agent_registry.shard_census(),
+                "debt": (state.reconverger.debt()
+                         if state.reconverger else 0)}
             return out
         if method in ("obs.query", "obs.series", "obs.export"):
             # TSDB channel face (obs/tsdb.py): the windowed store behind
@@ -828,12 +837,10 @@ async def execute_down(state: "AppState", req: DeployRequest,
                        if state.agent_registry.is_connected(s)]
             missing = [s for s in placed_nodes if s not in targets]
             if targets:
-                results = await asyncio.gather(*[
-                    state.agent_registry.send_command(
-                        slug, "deploy.down",
-                        {"request": req.to_dict(), "remove": remove},
-                        timeout=DEPLOY_TIMEOUT)
-                    for slug in targets], return_exceptions=True)
+                results = await state.agent_registry.send_batch(
+                    [(slug, "deploy.down",
+                      {"request": req.to_dict(), "remove": remove})
+                     for slug in targets], timeout=DEPLOY_TIMEOUT)
                 nodes = {slug: (str(r) if isinstance(r, Exception) else r)
                          for slug, r in zip(targets, results)}
                 errors = [s for s, r in zip(targets, results)
@@ -948,17 +955,18 @@ async def _execute_deploy(state: "AppState", req: DeployRequest,
             if not placement.feasible:
                 raise ValueError(
                     f"placement infeasible: {placement.violations}")
-            results = await asyncio.gather(*[
-                state.agent_registry.send_command(
-                    slug, "deploy.execute",
-                    {"request": DeployRequest(
-                        flow=req.flow, stage_name=req.stage_name,
-                        target_services=req.target_services,
-                        no_pull=req.no_pull, no_prune=req.no_prune,
-                        node=slug, trace_id=req.trace_id).to_dict(),
-                     "assignment": placement.assignment},
-                    timeout=DEPLOY_TIMEOUT)
-                for slug in targets], return_exceptions=True)
+            # batched shard-parallel fan-out (cp/shards.py): the deploy
+            # engine hands the registry the whole per-node command set
+            # and each shard lane pipelines its slice
+            results = await state.agent_registry.send_batch(
+                [(slug, "deploy.execute",
+                  {"request": DeployRequest(
+                      flow=req.flow, stage_name=req.stage_name,
+                      target_services=req.target_services,
+                      no_pull=req.no_pull, no_prune=req.no_prune,
+                      node=slug, trace_id=req.trace_id).to_dict(),
+                   "assignment": placement.assignment})
+                 for slug in targets], timeout=DEPLOY_TIMEOUT)
             errors = [str(r) for r in results if isinstance(r, Exception)]
             if errors:
                 if rid:

@@ -3,9 +3,20 @@
 Analog of controlplane log_router.rs: topics named
 `logs/{server}/{container}`, a retained ring buffer of 200 lines per topic
 (:31), and subscribers with topic-prefix + minimum-level filters (:48-67).
-Subscribers are asyncio queues; slow consumers drop oldest (bounded queues
+Subscribers drain lane queues; slow consumers drop oldest (bounded lanes
 never block the publisher — same motivation as the reference's lock-scope
 discipline, agent_registry.rs:104-112).
+
+Sharded backpressure (docs/guide/17-cp-sharding.md): each subscriber's
+buffer is split into PER-SHARD LANES keyed by the publishing agent's
+shard (cp/shards.py hashes the topic's server segment). A log storm from
+one shard's agents — or a consumer stuck mid-drain on one shard's
+output — fills and drops only that shard's lane; every other shard's
+lines keep flowing to the same subscriber. Drops are counted per lane
+(`fleet_cp_shard_log_dropped_total{shard=}`) on top of the aggregate,
+so "which partition is being flooded" is one metric query. A router
+without a shard table degrades to a single lane with the exact bounded
+drop-oldest semantics the unsharded router had.
 """
 
 from __future__ import annotations
@@ -17,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .models import now_ts
+from .shards import ShardTable
 from ..obs.metrics import REGISTRY
 
 __all__ = ["LogEntry", "LogRouter", "RETAIN_LINES"]
@@ -52,25 +64,93 @@ def topic_for(server: str, container: str) -> str:
     return f"logs/{server}/{container}"
 
 
+class _LaneQueue:
+    """Per-shard lane buffers behind an asyncio.Queue-shaped facade.
+
+    Consumers keep the queue API they always had (`await get()`,
+    `get_nowait()`, `qsize()`, `empty()`); internally each publishing
+    shard owns a bounded deque of `lane_size` lines, and a ready-token
+    queue (one token per buffered line, in publish order) wakes the
+    reader. Drop-oldest within a lane evicts a line AND leaves the token
+    count intact (one out, one in), so tokens == buffered lines always.
+    """
+
+    def __init__(self, lane_size: int):
+        self.lane_size = lane_size
+        self._lanes: dict[int, deque[LogEntry]] = {}
+        self._ready: asyncio.Queue[int] = asyncio.Queue()
+
+    # -- publisher side (router only) ----------------------------------
+    def _push(self, shard: int, entry: LogEntry) -> bool:
+        """Append to the shard's lane; returns False when the lane was
+        full and its oldest line was evicted to make room."""
+        lane = self._lanes.get(shard)
+        if lane is None:
+            lane = self._lanes[shard] = deque()
+        if len(lane) >= self.lane_size:
+            lane.popleft()              # drop oldest, never block
+            lane.append(entry)
+            return False
+        lane.append(entry)
+        self._ready.put_nowait(shard)
+        return True
+
+    def _pop(self, shard: int) -> LogEntry:
+        return self._lanes[shard].popleft()
+
+    # -- consumer side (asyncio.Queue surface) -------------------------
+    async def get(self) -> LogEntry:
+        return self._pop(await self._ready.get())
+
+    def get_nowait(self) -> LogEntry:
+        return self._pop(self._ready.get_nowait())   # raises QueueEmpty
+
+    def qsize(self) -> int:
+        return self._ready.qsize()
+
+    def empty(self) -> bool:
+        return self._ready.empty()
+
+    def full(self) -> bool:
+        """Every populated lane at capacity — diagnostic only; the
+        router checks individual lanes, not the whole subscriber."""
+        return bool(self._lanes) and all(
+            len(lane) >= self.lane_size for lane in self._lanes.values())
+
+
 @dataclass
 class _Subscriber:
     id: int
     prefix: str
     min_level: int
-    queue: asyncio.Queue
-    # lines evicted from THIS subscriber's full queue — slow-consumer
+    queue: _LaneQueue
+    # lines evicted from THIS subscriber's full lanes — slow-consumer
     # drops were previously silent (satellite, ISSUE 3); the aggregate
-    # rides fleet_log_lines_dropped_total
+    # rides fleet_log_lines_dropped_total, the per-shard split
+    # fleet_cp_shard_log_dropped_total
     dropped: int = 0
+    dropped_by_shard: dict = field(default_factory=dict)
 
 
 class LogRouter:
-    def __init__(self, retain: int = RETAIN_LINES, queue_size: int = 1000):
+    def __init__(self, retain: int = RETAIN_LINES, queue_size: int = 1000,
+                 shard_table: Optional[ShardTable] = None):
         self._retained: dict[str, deque[LogEntry]] = {}
         self._subs: dict[int, _Subscriber] = {}
         self._ids = itertools.count(1)
         self.retain = retain
+        # per-LANE capacity: sharding must never shrink what a consumer
+        # of a single agent's logs could buffer before drops started
         self.queue_size = queue_size
+        self.shard_table = shard_table
+
+    def _shard_of_topic(self, topic: str) -> int:
+        if self.shard_table is None:
+            return 0
+        # topic layout logs/{server}/{container}: the SERVER owns the
+        # line, so its lane is the publishing agent's registry shard
+        parts = topic.split("/", 2)
+        return self.shard_table.shard_of(parts[1] if len(parts) > 1 else "")
 
     # ------------------------------------------------------------------
     def publish(self, entry: LogEntry) -> int:
@@ -81,19 +161,19 @@ class LogRouter:
         _M_PUBLISHED.inc()
         delivered = 0
         lvl = _LEVELS.get(entry.level, 2)
+        shard = self._shard_of_topic(entry.topic)   # once per entry
         for sub in self._subs.values():
             if not entry.topic.startswith(sub.prefix):
                 continue
             if lvl < sub.min_level:
                 continue
-            if sub.queue.full():        # drop oldest, never block
-                try:
-                    sub.queue.get_nowait()
-                    sub.dropped += 1
-                    _M_DROPPED.inc()
-                except asyncio.QueueEmpty:
-                    pass
-            sub.queue.put_nowait(entry)
+            if not sub.queue._push(shard, entry):
+                sub.dropped += 1
+                sub.dropped_by_shard[shard] = (
+                    sub.dropped_by_shard.get(shard, 0) + 1)
+                _M_DROPPED.inc()
+                if self.shard_table is not None:
+                    self.shard_table.count_log_drop(shard)
             delivered += 1
         if delivered:
             _M_DELIVERED.inc(delivered)
@@ -106,11 +186,11 @@ class LogRouter:
 
     # ------------------------------------------------------------------
     def subscribe(self, prefix: str = "logs/",
-                  min_level: str = "trace") -> tuple[int, asyncio.Queue]:
+                  min_level: str = "trace") -> tuple[int, _LaneQueue]:
         sid = next(self._ids)
         sub = _Subscriber(id=sid, prefix=prefix,
                           min_level=_LEVELS.get(min_level, 0),
-                          queue=asyncio.Queue(self.queue_size))
+                          queue=_LaneQueue(self.queue_size))
         self._subs[sid] = sub
         return sid, sub.queue
 

@@ -530,17 +530,19 @@ class Reconverger:
                 reason="not-connected")
         with span(log, "heal.redeliver", stage=key,
                   nodes=",".join(targets), attempt=w.attempt) as sp:
-            results = await asyncio.gather(*[
-                registry.send_command(
-                    slug, "deploy.execute",
-                    {"request": DeployRequest(
-                        flow=req.flow, stage_name=req.stage_name,
-                        no_pull=req.no_pull, no_prune=req.no_prune,
-                        node=slug, trace_id=w.trace_id).to_dict(),
-                     "assignment": assignment,
-                     "idempotency_key": w.idempotency_key},
-                    timeout=DEPLOY_TIMEOUT)
-                for slug in targets], return_exceptions=True)
+            # one BATCH to the registry (not one awaited future per
+            # node): each target rides its owning shard's bounded
+            # pipeline lane — cp/shards.py — and the per-command metric
+            # labels + fencing epoch are resolved once for the batch
+            results = await registry.send_batch(
+                [(slug, "deploy.execute",
+                  {"request": DeployRequest(
+                      flow=req.flow, stage_name=req.stage_name,
+                      no_pull=req.no_pull, no_prune=req.no_prune,
+                      node=slug, trace_id=w.trace_id).to_dict(),
+                   "assignment": assignment,
+                   "idempotency_key": w.idempotency_key})
+                 for slug in targets], timeout=DEPLOY_TIMEOUT)
             failures = [r for r in results if isinstance(r, Exception)]
             if failures:
                 # prefer the retryable classification: if ANY node failed

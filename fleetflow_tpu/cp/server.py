@@ -24,6 +24,7 @@ from .protocol import ProtocolServer
 from .reconverge import ReconvergeConfig, Reconverger
 from .replication import (ReplicationConfig, Replicator, StandbyReplica,
                           StandbyRunner)
+from .shards import ShardTable, shards_from_env
 from .store import Store
 from ..obs import get_logger, kv
 
@@ -90,6 +91,12 @@ class ServerConfig:
     collector_interval_s: float = 5.0
     collector_capacity: int = 512          # samples retained per series
     collector_max_series: int = 4096       # series-cardinality cap
+    # control-plane fan-out sharding (cp/shards.py, docs/guide/17):
+    # agents are consistent-hashed onto this many worker shards; every
+    # fan-out path (registry batches, log lanes, verdict coalescing)
+    # runs shard-parallel. 0 = take FLEET_CP_SHARDS from the env
+    # (default 4); 1 = effectively unsharded.
+    cp_shards: int = 0
 
 
 @dataclass
@@ -210,11 +217,12 @@ async def start(config: ServerConfig, *,
         from .crypto import SecretBox
         secret_box = SecretBox.from_env()
 
+    shard_table = ShardTable(config.cp_shards or shards_from_env())
     state = AppState(
         store=store,
         auth=auth,
-        agent_registry=AgentRegistry(),
-        log_router=LogRouter(),
+        agent_registry=AgentRegistry(shard_table=shard_table),
+        log_router=LogRouter(shard_table=shard_table),
         placement=PlacementService(store, use_tpu=config.use_tpu_solver),
         name=config.name,
         secret_box=secret_box,
@@ -442,7 +450,22 @@ def collector_sources(state: AppState) -> list:
                 ("fleet_solver_resident_bytes_drift", {},
                  float(slots.get("bytes_drift", 0)))]
 
-    return [_slo, _admission, _log_router, _reconverge, _agents, _slots]
+    def _shards(now):
+        # per-shard occupancy + in-flight depth (cp/shards.py): shard
+        # ids are a small fixed set, so the occupancy gauge also lives
+        # in the registry; the in-flight split is TSDB-only like the
+        # aggregate fleet_agent_commands_in_flight above
+        out = []
+        for row in state.agent_registry.shard_census():
+            labels = {"shard": str(row["shard"])}
+            out.append(("fleet_cp_shard_agents", labels,
+                        float(row["agents"])))
+            out.append(("fleet_cp_shard_inflight", labels,
+                        float(row["inflight"])))
+        return out
+
+    return [_slo, _admission, _log_router, _reconverge, _agents, _slots,
+            _shards]
 
 
 def _build_collector(state: AppState, config: ServerConfig) -> None:

@@ -114,6 +114,9 @@ def main() -> int:
         errors.append("no control-plane section in the frame")
     if "fleet_agents_connected" not in out:
         errors.append("CP deep series fleet_agents_connected not shown")
+    if "fleet_cp_shard_agents" not in out:
+        errors.append("per-shard occupancy fleet_cp_shard_agents not "
+                      "shown (ISSUE 19: fleet top shard rows)")
 
     if errors:
         print("fleet top smoke FAILED:", file=sys.stderr)

@@ -50,6 +50,7 @@ REQUIRED = (
     "fleet_obs_samples_total",              # TSDB collector
     "fleet_slo_stream_quantile",            # SLO quantile export
     "fleet_solver_dispatches_in_flight",    # device profiling hooks
+    "fleet_cp_shard_agents",                # CP shard table (ISSUE 19)
 )
 
 _SAMPLE = re.compile(

@@ -241,6 +241,23 @@ class TestFullPack:
         r = run_scenario("rolling-kill", seed=7, services=1000, nodes=100)
         assert r.ok, r.violations
 
+    def test_acceptance_scale_selfheal_sharded(self):
+        # ISSUE 19 acceptance: every invariant (selfheal-converged,
+        # slo-met included) holds with sharding + the detector heap
+        # active at 10x the smoke agent count — the kill/heal cycle
+        # rides the batched redelivery fan-out and heap sweeps
+        r = run_scenario("rolling-kill-selfheal", seed=7, services=1000,
+                         nodes=100, stages=2, pool_min=2)
+        assert r.ok, r.violations
+        assert r.stats["heals"] > 0
+
+    def test_acceptance_scale_cp_failover_sharded(self):
+        # cp-failover-converged at 10x agents: the standby's rebuilt
+        # registry/detector shard state must reconverge the same world
+        r = run_scenario("cp-failover", seed=7, services=1000,
+                         nodes=100, stages=2, pool_min=2)
+        assert r.ok, r.violations
+
 
 @pytest.mark.slow
 class TestSloScenarioCanaries:

@@ -1,0 +1,393 @@
+"""Sharded control-plane fan-out tests (ISSUE 19).
+
+Covers the shard table (determinism, balance, minimal-move resize), the
+registry's batched shard-parallel delivery (alignment, metric coalescing,
+disconnect-mid-batch fast-fail), the log router's per-shard backpressure
+lanes, and the failure detector's expiry-heap sweep — including the
+property test that the heap and scan engines emit IDENTICAL verdict
+streams on seeded random schedules (the heap is an index over who needs
+attention, never a second state machine).
+"""
+
+import asyncio
+import random
+from collections import Counter
+
+import pytest
+
+from fleetflow_tpu.core.errors import AgentUnreachable
+from fleetflow_tpu.cp.agent_registry import AgentRegistry
+from fleetflow_tpu.cp.failure_detector import (ALIVE, DEAD, SUSPECT,
+                                               FailureDetector, LeaseConfig)
+from fleetflow_tpu.cp.log_router import LogRouter
+from fleetflow_tpu.cp.shards import (DEFAULT_SHARDS, ShardTable,
+                                     shards_from_env)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+# ---------------------------------------------------------------------------
+# shard table
+# ---------------------------------------------------------------------------
+
+class TestShardTable:
+    def test_deterministic_across_instances(self):
+        a, b = ShardTable(4), ShardTable(4)
+        slugs = [f"node-{i}" for i in range(500)]
+        assert [a.shard_of(s) for s in slugs] == \
+               [b.shard_of(s) for s in slugs]
+
+    def test_single_shard_owns_everything(self):
+        t = ShardTable(1)
+        assert {t.shard_of(f"n{i}") for i in range(100)} == {0}
+
+    def test_balance_within_reason(self):
+        t = ShardTable(4)
+        counts = Counter(t.shard_of(f"srv-{i:04d}") for i in range(2000))
+        assert set(counts) == {0, 1, 2, 3}
+        # vnode smoothing: no shard more than 2x the fair share
+        assert max(counts.values()) < 2 * (2000 / 4)
+
+    def test_partition_has_every_bucket(self):
+        t = ShardTable(8)
+        part = t.partition([f"n{i}" for i in range(3)])
+        assert sorted(part) == list(range(8))
+        assert sum(len(v) for v in part.values()) == 3
+
+    def test_resize_moves_about_one_nth(self):
+        t = ShardTable(4)
+        slugs = [f"srv-{i:04d}" for i in range(1000)]
+        before = {s: t.shard_of(s) for s in slugs}
+        moved = t.resize(5, slugs)
+        assert moved == sum(1 for s in slugs if t.shard_of(s) != before[s])
+        # consistent hashing: ~1/5 move, NOT the ~4/5 a mod-N table would
+        assert 100 <= moved <= 350
+        assert t.resize(5, slugs) == 0   # no-op resize moves nothing
+
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.delenv("FLEET_CP_SHARDS", raising=False)
+        assert shards_from_env() == DEFAULT_SHARDS
+        monkeypatch.setenv("FLEET_CP_SHARDS", "8")
+        assert shards_from_env() == 8
+        monkeypatch.setenv("FLEET_CP_SHARDS", "garbage")
+        assert shards_from_env() == DEFAULT_SHARDS
+        monkeypatch.setenv("FLEET_CP_SHARDS", "0")
+        assert shards_from_env() == DEFAULT_SHARDS
+        monkeypatch.setenv("FLEET_CP_SHARDS", "1")
+        assert shards_from_env() == 1
+
+
+# ---------------------------------------------------------------------------
+# batched delivery
+# ---------------------------------------------------------------------------
+
+class AckConn:
+    """Acks every command after `delay` via the normal correlation path;
+    records the envelopes it saw (fencing-epoch assertions)."""
+
+    def __init__(self, registry, delay=0.0):
+        self.registry = registry
+        self.delay = delay
+        self.envelopes = []
+        self._closed = False
+
+    async def send_event(self, channel, method, payload=None):
+        env = payload or {}
+        self.envelopes.append(env)
+        rid = env.get("request_id")
+        if rid:
+            asyncio.get_running_loop().call_later(
+                self.delay, self.registry.resolve_result, rid,
+                {"result": {"ok": True, "cmd": method}})
+
+
+class SilentConn:
+    """Accepts the send and never answers — the disconnect-mid-batch
+    victim's session."""
+
+    _closed = False
+
+    async def send_event(self, channel, method, payload=None):
+        return None
+
+
+class TestSendBatch:
+    def test_results_align_with_items(self):
+        async def go():
+            reg = AgentRegistry(shard_table=ShardTable(4))
+            for i in range(20):
+                reg.register(f"a{i}", AckConn(reg))
+            items = [(f"a{i}", "cmd.x", {"i": i}) for i in range(20)]
+            items.append(("ghost", "cmd.x", None))   # never registered
+            results = await reg.send_batch(items, timeout=5)
+            assert len(results) == 21
+            for r in results[:20]:
+                assert r == {"ok": True, "cmd": "cmd.x"}
+            assert isinstance(results[20], AgentUnreachable)
+            assert results[20].reason == "not-connected"
+        run(go())
+
+    def test_metric_and_epoch_coalescing(self):
+        async def go():
+            reg = AgentRegistry(shard_table=ShardTable(4))
+            epochs = []
+
+            def epoch():
+                epochs.append(1)
+                return 7
+
+            reg.epoch_source = epoch
+            conns = {}
+            for i in range(30):
+                conns[f"a{i}"] = AckConn(reg)
+                reg.register(f"a{i}", conns[f"a{i}"])
+            items = [(f"a{i}", "deploy.execute" if i % 2 else "deploy.down",
+                      None) for i in range(30)]
+            await reg.send_batch(items, timeout=5)
+            stats = reg.last_batch_stats
+            assert stats["items"] == 30
+            assert stats["label_lookups"] == 2     # distinct commands
+            assert stats["epoch_lookups"] == 1
+            assert len(epochs) == 1                # resolved once, not 30x
+            # ...but every envelope still carries the fence
+            for conn in conns.values():
+                for env in conn.envelopes:
+                    assert env["epoch"] == 7
+        run(go())
+
+    def test_empty_batch(self):
+        async def go():
+            reg = AgentRegistry(shard_table=ShardTable(4))
+            assert await reg.send_batch([]) == []
+            assert reg.last_batch_stats["items"] == 0
+        run(go())
+
+    def test_disconnect_mid_batch_fails_only_its_futures(self):
+        """Satellite: a member dropping mid-fan-out fails ITS commands
+        immediately (the `_pending` fast-fail contract) while every other
+        lane member completes normally — no batch abort, no waiting out
+        the per-call timeout."""
+        async def go():
+            reg = AgentRegistry(shard_table=ShardTable(4))
+            victim_conn = SilentConn()
+            reg.register("victim", victim_conn)
+            for i in range(8):
+                reg.register(f"ok{i}", AckConn(reg, delay=0.15))
+            items = ([("victim", "deploy.execute", None)] +
+                     [(f"ok{i}", "deploy.execute", None) for i in range(8)]
+                     + [("victim", "deploy.down", None)])
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            task = asyncio.ensure_future(reg.send_batch(items, timeout=30))
+            await asyncio.sleep(0.02)     # everything sent, all pending
+            reg.unregister("victim", victim_conn)
+            results = await task
+            took = loop.time() - t0
+            # both victim commands failed as disconnected, NOT timeout
+            for idx in (0, len(items) - 1):
+                assert isinstance(results[idx], AgentUnreachable)
+                assert results[idx].reason == "disconnected"
+            for r in results[1:-1]:
+                assert r == {"ok": True, "cmd": "deploy.execute"}
+            # the batch completed on the survivors' ack latency, nowhere
+            # near the 30s timeout the victim would have burned
+            assert took < 5
+        run(go())
+
+    def test_rebalance_recounts_census(self):
+        async def go():
+            reg = AgentRegistry(shard_table=ShardTable(4))
+            for i in range(100):
+                reg.register(f"srv-{i:03d}", AckConn(reg))
+            moved = reg.rebalance(8)
+            assert moved > 0
+            census = reg.shard_census()
+            assert [row["shard"] for row in census] == list(range(8))
+            assert sum(row["agents"] for row in census) == 100
+            assert all(row["inflight"] == 0 for row in census)
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# log router lanes
+# ---------------------------------------------------------------------------
+
+def _two_servers_on_different_shards(table):
+    base = "sha"
+    sa = table.shard_of(base)
+    for i in range(1000):
+        other = f"shb-{i}"
+        if table.shard_of(other) != sa:
+            return base, other
+    raise AssertionError("no second shard found")
+
+
+class TestLogLanes:
+    def test_slow_shard_drops_do_not_starve_others(self):
+        async def go():
+            table = ShardTable(4)
+            a, b = _two_servers_on_different_shards(table)
+            router = LogRouter(queue_size=3, shard_table=table)
+            sid, q = router.subscribe(prefix="logs/")
+            # a storm from server A overfills ITS lane only
+            for i in range(10):
+                router.publish_line(a, "c", f"a{i}")
+            for i in range(2):
+                router.publish_line(b, "c", f"b{i}")
+            sub = router.subscriber(sid)
+            assert sub.dropped == 7
+            assert sub.dropped_by_shard == {table.shard_of(a): 7}
+            assert q.qsize() == 5            # 3 from A's lane + 2 from B
+            # drop-oldest within the lane: A's survivors are the newest
+            got = [q.get_nowait().line for _ in range(5)]
+            assert got == ["a7", "a8", "a9", "b0", "b1"]
+            assert q.empty()
+        run(go())
+
+    def test_per_lane_capacity_not_shared(self):
+        async def go():
+            table = ShardTable(4)
+            a, b = _two_servers_on_different_shards(table)
+            router = LogRouter(queue_size=5, shard_table=table)
+            sid, q = router.subscribe(prefix="logs/")
+            for i in range(5):
+                router.publish_line(a, "c", f"a{i}")
+            # A's lane is exactly full; B still buffers its full 5
+            for i in range(5):
+                router.publish_line(b, "c", f"b{i}")
+            assert router.subscriber(sid).dropped == 0
+            assert q.qsize() == 10
+        run(go())
+
+    def test_unsharded_router_single_lane_semantics(self):
+        async def go():
+            router = LogRouter(queue_size=4)
+            sid, q = router.subscribe(prefix="logs/")
+            for i in range(6):
+                router.publish_line("s", "c", f"l{i}")
+            assert router.subscriber(sid).dropped == 2
+            assert [q.get_nowait().line for _ in range(4)] == \
+                   ["l2", "l3", "l4", "l5"]
+        run(go())
+
+    def test_async_get_wakes_in_publish_order(self):
+        async def go():
+            table = ShardTable(4)
+            a, b = _two_servers_on_different_shards(table)
+            router = LogRouter(queue_size=10, shard_table=table)
+            _, q = router.subscribe(prefix="logs/")
+
+            async def drain(n):
+                return [(await q.get()).line for _ in range(n)]
+
+            reader = asyncio.ensure_future(drain(4))
+            await asyncio.sleep(0.01)
+            router.publish_line(a, "c", "a0")
+            router.publish_line(b, "c", "b0")
+            router.publish_line(a, "c", "a1")
+            router.publish_line(b, "c", "b1")
+            assert await reader == ["a0", "b0", "a1", "b1"]
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# failure detector: heap engine vs scan oracle
+# ---------------------------------------------------------------------------
+
+_CFG = LeaseConfig(lease_s=10.0, suspect_grace_s=5.0, flap_window_s=60.0,
+                   flap_threshold=3, damp_hold_s=20.0)
+
+
+def _event_key(e):
+    return (e.slug, e.online, e.state, round(e.at, 6))
+
+
+class TestDetectorHeap:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_verdict_stream_matches_scan_oracle(self, seed):
+        """Property test: on a seeded random schedule of heartbeats,
+        disconnects, forgets and clock advances, the heap sweep and the
+        full-table scan emit identical verdict streams and leave every
+        lease in the same state. The schedule is dense enough to hit
+        revives, flap damping and damp-release paths."""
+        rng = random.Random(seed)
+        box = [1000.0]
+        clock = lambda: box[0]                      # noqa: E731
+        scan = FailureDetector(_CFG, clock=clock, use_heap=False)
+        heap = FailureDetector(_CFG, clock=clock, use_heap=True)
+        slugs = [f"n{i}" for i in range(30)]
+        events_scan, events_heap = [], []
+        for _ in range(400):
+            op = rng.random()
+            if op < 0.35:
+                s = rng.choice(slugs)
+                scan.observe_heartbeat(s)
+                heap.observe_heartbeat(s)
+            elif op < 0.55:
+                s = rng.choice(slugs)
+                scan.observe_disconnect(s)
+                heap.observe_disconnect(s)
+            elif op < 0.58:
+                s = rng.choice(slugs)
+                scan.forget(s)
+                heap.forget(s)
+            elif op < 0.65:
+                box[0] += rng.uniform(0.0, 30.0)
+            else:
+                box[0] += rng.uniform(0.0, 4.0)
+                events_scan.extend(map(_event_key, scan.sweep()))
+                events_heap.extend(map(_event_key, heap.sweep()))
+        # drain: advance far enough that every pending expiry fires
+        for _ in range(12):
+            box[0] += 30.0
+            events_scan.extend(map(_event_key, scan.sweep()))
+            events_heap.extend(map(_event_key, heap.sweep()))
+        assert events_scan == events_heap
+        assert len(events_scan) > 0            # the schedule did things
+        for s in slugs:
+            assert scan.state_of(s) == heap.state_of(s)
+
+    def test_alive_heartbeats_do_not_grow_heap(self):
+        """The 10k-agents-heartbeating hot path: renewing an ALIVE lease
+        must not push heap entries (lazy invalidation)."""
+        box = [0.0]
+        det = FailureDetector(_CFG, clock=lambda: box[0], use_heap=True)
+        for i in range(50):
+            det.observe_heartbeat(f"n{i}")
+        size0 = len(det._heap)
+        for _ in range(100):
+            box[0] += 1.0
+            for i in range(50):
+                det.observe_heartbeat(f"n{i}")
+        assert len(det._heap) == size0
+
+    def test_heap_compacts_after_rearm_churn(self):
+        """Disconnect re-arms bump generations and strand stale entries;
+        the sweep must shed them once they outnumber the leases."""
+        box = [0.0]
+        det = FailureDetector(_CFG, clock=lambda: box[0], use_heap=True)
+        for i in range(50):
+            det.observe_heartbeat(f"n{i}")
+        for _ in range(20):
+            for i in range(50):
+                det.observe_disconnect(f"n{i}")
+                det.observe_heartbeat(f"n{i}")
+        det.sweep()
+        assert len(det._heap) <= max(64, 4 * 50)
+
+    def test_disconnect_then_grace_is_dead_then_revives(self):
+        box = [0.0]
+        det = FailureDetector(_CFG, clock=lambda: box[0], use_heap=True)
+        det.observe_heartbeat("n0")
+        det.observe_disconnect("n0")
+        assert det.state_of("n0") == SUSPECT
+        box[0] += _CFG.suspect_grace_s + 0.1
+        evs = det.sweep()
+        assert [(e.slug, e.online) for e in evs] == [("n0", False)]
+        assert det.state_of("n0") == DEAD
+        det.observe_heartbeat("n0")
+        evs = det.sweep()
+        assert [(e.slug, e.online) for e in evs] == [("n0", True)]
+        assert det.state_of("n0") == ALIVE

@@ -723,15 +723,24 @@ class TestSelfHealE2E:
                           for s in agents):
                 await asyncio.sleep(0.02)
 
-            # spy on redelivery to pin the idempotency-key contract
+            # spy on redelivery to pin the idempotency-key contract —
+            # fan-outs ride the batched shard path (send_batch), single
+            # commands the per-call path, so both are tapped
             sent = []
             orig_send = handle.state.agent_registry.send_command
+            orig_batch = handle.state.agent_registry.send_batch
 
             async def spy(slug, command, payload=None, timeout=60.0):
                 sent.append((slug, command, dict(payload or {})))
                 return await orig_send(slug, command, payload,
                                        timeout=timeout)
+
+            async def spy_batch(items, timeout=60.0):
+                for slug, command, payload in items:
+                    sent.append((slug, command, dict(payload or {})))
+                return await orig_batch(items, timeout=timeout)
             handle.state.agent_registry.send_command = spy
+            handle.state.agent_registry.send_batch = spy_batch
 
             cli, _ = await ProtocolClient.connect(handle.host, handle.port,
                                                   identity="cli")
