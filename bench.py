@@ -276,6 +276,18 @@ def main() -> None:
         with leg("admission"):
             admission = _admission_scenario()
 
+    # ---- world simulator (chaos/worldgen.py, ISSUE 20): generator- ------
+    # shaped churn through the resident warm path. Diurnal/hotspot
+    # arrivals + exponential departures drive streaming admission while
+    # correlated spot-reclamation storms hit ~30% of a pool at once via
+    # the coalesced node_events path. BENCH_WORLD_ASSERT=1 gates zero
+    # recompiles / zero host transfers under the disallow guard and a
+    # bounded reschedule p99 during the storms.
+    world = None
+    if os.environ.get("BENCH_WORLD", "1").lower() not in ("0", "false"):
+        with leg("world"):
+            world = _world_scenario()
+
     # ---- tenant multiplexer (solver/multiplex.py): batched same-tier ----
     # warm solves in ONE vmapped dispatch. The leg pins per-lane parity
     # with the serial path and zero recompiles across the tier x K
@@ -378,6 +390,7 @@ def main() -> None:
         "sharded": sharded,
         "pipeline": pipeline,
         "admission": admission,
+        "world": world,
         "mux": mux,
         "obs_overhead": obs_overhead,
         "agents": agents,
@@ -2389,6 +2402,339 @@ def _admission_child() -> None:
     print(json.dumps(result))
 
 
+def _world_scenario() -> dict:
+    """Run the world-simulator churn child in a subprocess: like the
+    admission leg it owns its device staging and pins its own env
+    (transfer guard, compile watch), so it must not share the parent's
+    jax state."""
+    import subprocess
+    timeout = float(os.environ.get("BENCH_WORLD_TIMEOUT", "1500"))
+    env = dict(os.environ, BENCH_WORLD_CHILD="1")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"world child exceeded {timeout:.0f}s"}
+    if out.returncode != 0:
+        return {"ok": False,
+                "error": (out.stderr or out.stdout).strip()[-800:]}
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"ok": False, "error": "child printed no JSON"}
+
+
+def _world_child() -> None:
+    """Generator-shaped churn through the resident warm path (ISSUE 20):
+    the world simulator's traffic model — diurnal Poisson arrivals with
+    a rotating tenant hotspot, exponential lifetimes scheduling
+    departures — drives streaming admission on the virtual clock, while
+    correlated SPOT RECLAMATION STORMS (warning -> ~30% of a declared
+    pool dies in one instant -> later revival) hit the coalesced
+    `placement.node_events` path mid-window, exactly as the chaos
+    runner applies a worldgen schedule.
+
+    After warm-up compiles every variant (scatter tiers, the warm churn
+    re-solve, the fallback full solve), the measured window runs under
+    FLEET_TRANSFER_GUARD=disallow with compiles watched. Reports
+    sustained placements/s, admission wait quantiles, and the storm
+    reschedule p50/p99 (wall ms per coalesced node_events burst).
+    BENCH_WORLD_ASSERT=1 gates zero recompiles, zero host transfers,
+    and reschedule p99 under BENCH_WORLD_RESCHED_MS (the CI smoke
+    contract). Prints one JSON line."""
+    from fleetflow_tpu.platform import ensure_platform
+    ensure_platform(min_devices=1, probe_timeout=240.0)
+    import math
+
+    import jax
+    import numpy as np
+
+    from fleetflow_tpu.chaos.runner import (VirtualClock, make_flow,
+                                            node_slug)
+    from fleetflow_tpu.cp.admission import (AdmissionConfig,
+                                            AdmissionController,
+                                            AdmissionRejected)
+    from fleetflow_tpu.cp.models import ServerCapacity
+    from fleetflow_tpu.cp.placement import PlacementService
+    from fleetflow_tpu.cp.store import Store
+    from fleetflow_tpu.obs.metrics import REGISTRY
+
+    small = os.environ.get("BENCH_SMALL", "").lower() not in ("", "0", "false")
+    S, N = (900, 100) if small else (9200, 1000)
+    rate = float(os.environ.get("BENCH_WORLD_RATE",
+                                "6" if small else "40"))
+    mean_life = float(os.environ.get("BENCH_WORLD_LIFE", "20"))
+    virtual_s = float(os.environ.get("BENCH_WORLD_SECONDS", "90"))
+    warm_s = max(12.0, 2.5 * mean_life)
+    period = 30.0
+    batch_max = 128
+    tenants = ("team-ap", "team-eu", "team-us")
+    hotspot_every = 20.0
+    hotspot_boost = 3.0
+    # the declared spot pool: the TAIL 30% of the fleet; each storm
+    # reclaims 60% of it in one coalesced burst, revives it 10 s later
+    pool = [node_slug(i) for i in range(int(N * 0.7), N)]
+    storm_victims = pool[:max(1, int(len(pool) * 0.6))]
+    storm_every = 30.0
+
+    clock = VirtualClock()
+    store = Store(None, clock=clock.now)
+    slugs = [node_slug(i) for i in range(N)]
+    flow = make_flow(S, 1, slugs, seed=0)
+    # capacity sized for 2x headroom over base + streamed steady state
+    # WITH the storm's victims dead (the survivors absorb the fallout)
+    surviving = N - len(storm_victims)
+    per_node_cpu = max(
+        2.0 * (0.15 * S + 0.1 * rate * mean_life) / surviving, 1.0)
+    for slug in slugs:
+        store.register_server(slug, tenant="default", hostname=slug)
+        rec = store.server_by_slug(slug)
+        store.update("servers", rec.id, status="online",
+                     capacity=ServerCapacity(cpu=per_node_cpu,
+                                             memory=per_node_cpu * 2048.0,
+                                             disk=10240.0))
+    placement = PlacementService(store, use_tpu=True)
+    ctrl = AdmissionController(
+        placement, clock=clock.now,
+        config=AdmissionConfig(batch_max=batch_max, max_queue=4096,
+                               shed_age_s=0.0))
+
+    t_base = time.perf_counter()
+    ctrl.attach(flow, "app0")
+    baseline_s = time.perf_counter() - t_base
+    print(f"[bench] world baseline solve {baseline_s:.1f}s "
+          f"({S}x{N}, backend={jax.default_backend()})",
+          file=sys.stderr, flush=True)
+
+    rng = np.random.default_rng(0)
+    seq = [0]
+    pending_departures: list[tuple[float, str]] = []
+    live: list[str] = []
+
+    def hot_tenant(t: float):
+        slot = int(t // hotspot_every)
+        return tenants[(slot - 1) % len(tenants)] if slot % 2 else None
+
+    def submit_tick(now: float, t: float) -> int:
+        """One generator tick: the worldgen traffic shape — diurnal
+        Poisson rate split across tenants by weight, the hot tenant
+        boosted — with due departures riding each tenant's wave."""
+        lam = max(rate * (1.0 + 0.6 * math.sin(2 * math.pi * t / period)),
+                  0.0)
+        hot = hot_tenant(t)
+        weights = [hotspot_boost if tn == hot else 1.0 for tn in tenants]
+        wsum = sum(weights)
+        due = [n for (d, n) in pending_departures if d <= now and n in live]
+        shed = 0
+        for tn, wt in zip(tenants, weights):
+            k = int(rng.poisson(lam * wt / wsum))
+            specs = []
+            for _ in range(k):
+                seq[0] += 1
+                specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                              "memory": 64.0})
+            deps, due = due[: len(due) // 2], due[len(due) // 2:]
+            if not specs and not deps:
+                continue
+            try:
+                ctrl.submit(tn, arrivals=specs, departures=deps)
+                done = set(deps)
+                pending_departures[:] = [
+                    (d, n) for (d, n) in pending_departures
+                    if n not in done]
+                for s in specs:
+                    pending_departures.append(
+                        (now + float(rng.exponential(mean_life)),
+                         s["name"]))
+            except AdmissionRejected:
+                shed += len(specs)
+        return shed
+
+    def drain(now: float) -> dict:
+        out = ctrl.step(now)
+        live.extend(out["placed"])
+        for n in out["departed"]:
+            if n in live:
+                live.remove(n)
+        return out
+
+    # ---- warm-up: compile the cold stage, scatter tiers, the warm churn
+    # re-solve (one full storm + revival), all OUTSIDE the guard --------
+    for k in (1, 20, batch_max):
+        specs = []
+        for _ in range(k):
+            seq[0] += 1
+            specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                          "memory": 64.0})
+        ctrl.submit("team-ap", arrivals=specs)
+        clock.advance(1.0)
+        drain(clock.now())
+    # one more full batch so the live pool can fund the lattice warm below
+    specs = []
+    for _ in range(batch_max):
+        seq[0] += 1
+        specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                      "memory": 64.0})
+    ctrl.submit("team-ap", arrivals=specs)
+    clock.advance(1.0)
+    drain(clock.now())
+    # mixed-batch scatter-tier LATTICE: departures land demand-only rows
+    # while arrivals land demand+eligible rows, so one drain's two
+    # scatter planes pad to INDEPENDENT tiers — a departure-backlog
+    # spike mid-window yields e.g. (demand 128, eligible 8), a distinct
+    # merge executable the diagonal-only warm above never builds
+    for n_dep, n_arr in ((30, 0), (100, 2), (90, 20)):
+        deps = list(live[:n_dep])
+        specs = []
+        for _ in range(n_arr):
+            seq[0] += 1
+            specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                          "memory": 64.0})
+        ctrl.submit("team-ap", arrivals=specs, departures=deps)
+        clock.advance(1.0)
+        drain(clock.now())
+    # one drain with the active-set path disabled: compiles the FULL
+    # warm fused variant — the fallback a gate-rejected sub-solve
+    # re-runs (a 30%-pool storm displacement always rejects the gate),
+    # which must never compile inside the measured window
+    sub_prev = os.environ.get("FLEET_SUBSOLVE")
+    os.environ["FLEET_SUBSOLVE"] = "0"
+    try:
+        specs = []
+        for _ in range(8):
+            seq[0] += 1
+            specs.append({"name": f"gen-{seq[0]:06d}", "cpu": 0.1,
+                          "memory": 64.0})
+        ctrl.submit("team-ap", arrivals=specs)
+        clock.advance(1.0)
+        drain(clock.now())
+    finally:
+        if sub_prev is None:
+            os.environ.pop("FLEET_SUBSOLVE", None)
+        else:
+            os.environ["FLEET_SUBSOLVE"] = sub_prev
+    t = 0.0
+    while t < warm_s:
+        submit_tick(clock.now(), t)
+        clock.advance(1.0)
+        drain(clock.now())
+        t += 1.0
+    # warm the coalesced-churn executable with a full-size storm burst
+    placement.node_events([(s, False) for s in storm_victims])
+    clock.advance(5.0)
+    drain(clock.now())
+    placement.node_events([(s, True) for s in storm_victims])
+    clock.advance(5.0)
+    drain(clock.now())
+
+    # ---- measured window: transfer guard disallow, compiles watched ----
+    reuse = REGISTRY.get("fleet_solver_resident_reuse_total")
+    xfer = REGISTRY.get("fleet_solver_host_transfers_total")
+    cold0 = reuse.value(outcome="cold")
+    xfer0 = xfer.value()
+    ctrl.wait_samples.clear()
+    placed = departed = sheds = storms = 0
+    resched_ms: list[float] = []
+    pool_down = False
+    guard_prev = os.environ.get("FLEET_TRANSFER_GUARD")
+    os.environ["FLEET_TRANSFER_GUARD"] = "disallow"
+    t_wall = time.perf_counter()
+    try:
+        with _watch_compiles() as compiles:
+            t = 0.0
+            while t < virtual_s:
+                sheds += submit_tick(clock.now(), warm_s + t)
+                # the reclamation storm cadence: kill the pool slice in
+                # ONE coalesced burst mid-cycle, revive it 10 s later
+                phase = t % storm_every
+                if phase == 10.0 and not pool_down:
+                    storms += 1
+                    t0 = time.perf_counter()
+                    placement.node_events(
+                        [(s, False) for s in storm_victims])
+                    resched_ms.append((time.perf_counter() - t0) * 1e3)
+                    pool_down = True
+                elif phase == 20.0 and pool_down:
+                    t0 = time.perf_counter()
+                    placement.node_events(
+                        [(s, True) for s in storm_victims])
+                    resched_ms.append((time.perf_counter() - t0) * 1e3)
+                    pool_down = False
+                clock.advance(1.0)
+                out = drain(clock.now())
+                placed += len(out["placed"])
+                departed += len(out["departed"])
+                t += 1.0
+    finally:
+        if guard_prev is None:
+            os.environ.pop("FLEET_TRANSFER_GUARD", None)
+        else:
+            os.environ["FLEET_TRANSFER_GUARD"] = guard_prev
+    wall_s = time.perf_counter() - t_wall
+    waits = [w for ws in ctrl.wait_samples.values() for w in ws]
+    cold_staged = int(reuse.value(outcome="cold") - cold0)
+    host_transfers = int(xfer.value() - xfer0)
+
+    result = {
+        "ok": True,
+        "shape": [S, N],
+        "backend": jax.default_backend(),
+        "virtual_s": virtual_s,
+        "wall_s": round(wall_s, 2),
+        "arrival_rate": rate,
+        "mean_life_s": mean_life,
+        "tenants": list(tenants),
+        "hotspot_boost": hotspot_boost,
+        "pool_size": len(pool),
+        "storm_victims": len(storm_victims),
+        "storms": storms,
+        "placements": placed,
+        "departures": departed,
+        "placements_per_s": round(placed / wall_s, 1) if wall_s else 0.0,
+        "sheds": sheds,
+        "wait_p50_s": round(float(np.percentile(waits, 50)), 3)
+        if waits else None,
+        "wait_p99_s": round(float(np.percentile(waits, 99)), 3)
+        if waits else None,
+        "resched_ms_p50": round(float(np.percentile(resched_ms, 50)), 1)
+        if resched_ms else None,
+        "resched_ms_p99": round(float(np.percentile(resched_ms, 99)), 1)
+        if resched_ms else None,
+        "compiles": len(compiles),
+        # which computations compiled (empty at steady state): the
+        # difference between "a tier was not warmed" and a real leak
+        "compile_names": list(compiles[:4]) or None,
+        "cold_restages": cold_staged,
+        "host_transfers": host_transfers,
+        "transfer_guard": "disallow",
+        "baseline_solve_s": round(baseline_s, 2),
+    }
+    if os.environ.get("BENCH_WORLD_ASSERT", "").lower() in \
+            ("1", "true", "on", "yes"):
+        # the CI smoke contract: generator-shaped churn through the warm
+        # path must stay resident — and the storm re-solve must stay
+        # bounded (a correlated 30%-pool kill is the worst coalesced
+        # burst production throws at the warm path)
+        assert result["compiles"] == 0, f"world leg recompiled: {result}"
+        assert result["host_transfers"] == 0, \
+            f"world leg crossed the host boundary: {result}"
+        assert result["cold_restages"] == 0, \
+            f"world leg cold-restaged at steady state: {result}"
+        assert result["placements_per_s"] > 0, f"no throughput: {result}"
+        assert result["storms"] >= 1, f"no storm fired: {result}"
+        bound = float(os.environ.get("BENCH_WORLD_RESCHED_MS",
+                                     "5000" if small else "10000"))
+        if result["resched_ms_p99"] is not None:
+            assert result["resched_ms_p99"] < bound, (
+                f"storm reschedule p99 {result['resched_ms_p99']}ms "
+                f">= {bound}ms: {result}")
+    print(json.dumps(result))
+
+
 def _subsolve_outcomes() -> dict:
     """fleet_solver_subsolve_total{outcome} counter values, as a dict."""
     from fleetflow_tpu.obs.metrics import REGISTRY
@@ -2405,6 +2751,8 @@ if __name__ == "__main__":
         _pipeline_child()
     elif os.environ.get("BENCH_ADMISSION_CHILD"):
         _admission_child()
+    elif os.environ.get("BENCH_WORLD_CHILD"):
+        _world_child()
     elif os.environ.get("BENCH_MUX_CHILD"):
         _mux_child()
     else:

@@ -10,21 +10,26 @@ robustness claim becomes a replayable repro.
 """
 
 from .faults import (AgentPartition, ContainerExit, DeployFail, Fault,
-                     FaultSchedule, NodeCrash, NodeFlap, Redeploy,
-                     SilentNodeCrash, SlowAgent, Tick, WorkerKill)
+                     FaultSchedule, HotspotShift, NodeCrash, NodeFlap,
+                     Redeploy, SilentNodeCrash, SlowAgent, SpotReclaim,
+                     Tick, WorkerKill, ZoneOutage, ZoneRevive)
 from .injector import FaultInjector
 from .invariants import FINAL_INVARIANTS, INSTANT_INVARIANTS
 from .runner import ChaosReport, ChaosWorld, VirtualClock, run_schedule
-from .scenarios import SCENARIOS, build_schedule, scenario_names
+from .scenarios import (SCENARIOS, build_schedule, scenario_info,
+                        scenario_names, validate_schedule)
+from .worldgen import WorldSpec, compile_world
 
 __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
-    "SilentNodeCrash", "Tick",
+    "SilentNodeCrash", "Tick", "SpotReclaim", "ZoneOutage", "ZoneRevive",
+    "HotspotShift",
     "FaultSchedule", "FaultInjector", "ChaosReport", "ChaosWorld",
     "VirtualClock", "run_schedule", "run_scenario", "SCENARIOS",
-    "build_schedule", "scenario_names", "INSTANT_INVARIANTS",
-    "FINAL_INVARIANTS",
+    "build_schedule", "scenario_names", "scenario_info",
+    "validate_schedule", "WorldSpec", "compile_world",
+    "INSTANT_INVARIANTS", "FINAL_INVARIANTS",
 ]
 
 

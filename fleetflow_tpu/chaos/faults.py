@@ -19,6 +19,16 @@ reservations, autoscaler reaping, deploy retry/release):
                  apply time: the pool's first online worker)
   Redeploy       operator action: redeploy a stage (Jepsen "client op")
 
+The world-simulator pack (chaos/worldgen.py) adds CORRELATED faults —
+failures that take out a *domain*, not a random sample:
+
+  SpotReclaim    a provider reclamation storm: warning with lead time
+                 (victims cordoned), then the pool members die at once
+  ZoneOutage     every node of one region dies in the same instant
+  ZoneRevive     the lost region comes back (outage victims reconnect)
+  HotspotShift   traffic hotspot migrates onto a tenant (the tenant is
+                 marked as deliberately bursting from here on)
+
 Every fault expands into primitive (time, op, params) events; the
 runner groups same-instant primitives into one burst so coalesced churn
 (`placement.node_events`) is exercised the way production would see it.
@@ -33,6 +43,7 @@ __all__ = [
     "Fault", "NodeCrash", "NodeFlap", "AgentPartition", "SlowAgent",
     "DeployFail", "ContainerExit", "WorkerKill", "Redeploy",
     "SilentNodeCrash", "Tick", "PrimaryKill", "AdmissionWave",
+    "SpotReclaim", "ZoneOutage", "ZoneRevive", "HotspotShift",
     "FaultSchedule",
 ]
 
@@ -52,6 +63,12 @@ WORKER_KILL = "worker_kill"
 REDEPLOY = "redeploy"
 CP_KILL = "cp_kill"
 ADMIT = "admit"
+SPOT_WARNING = "spot_warning"
+SPOT_RECLAIM = "spot_reclaim"
+SPOT_REVIVE = "spot_revive"
+ZONE_DOWN = "zone_down"
+ZONE_UP = "zone_up"
+HOTSPOT_SHIFT = "hotspot_shift"
 
 
 @dataclass(frozen=True)
@@ -233,6 +250,70 @@ class AdmissionWave(Fault):
                                   "stage": self.stage})]
 
 
+@dataclass(frozen=True)
+class SpotReclaim(Fault):
+    """A spot/preemptible reclamation storm against one declared pool
+    (worldgen.SpotPoolSpec): the provider announces at `at` with
+    `warning_s` of lead time — the runner resolves the victims THEN
+    (first `count` online members, sorted) and cordons them, so new
+    placements route around doomed machines — and reclaims them all in
+    ONE instant at `at + warning_s` (correlated, silent: the CP's lease
+    detector must still notice the deaths). `revive_after` reconnects
+    the reclaimed victims that much later (capacity returning to the
+    market); None means the pool stays shrunk."""
+    pool: str = ""
+    count: int = 1
+    warning_s: float = 30.0
+    revive_after: Optional[float] = None
+
+    def expand(self):
+        out = [(self.at, SPOT_WARNING, {"pool": self.pool,
+                                        "count": self.count}),
+               (self.at + self.warning_s, SPOT_RECLAIM,
+                {"pool": self.pool, "count": self.count})]
+        if self.revive_after is not None:
+            out.append((self.at + self.warning_s + self.revive_after,
+                        SPOT_REVIVE, {"pool": self.pool}))
+        return out
+
+
+@dataclass(frozen=True)
+class ZoneOutage(Fault):
+    """A whole failure DOMAIN dies at once: every online node of
+    `region` (schedule.world region membership) disconnects silently in
+    one instant — no node_events, no operator help. Only the lost
+    domain's work may park; the `degraded-gracefully` invariant judges
+    the rest of the fleet through the outage."""
+    region: str = ""
+
+    def expand(self):
+        return [(self.at, ZONE_DOWN, {"region": self.region})]
+
+
+@dataclass(frozen=True)
+class ZoneRevive(Fault):
+    """The lost region comes back: exactly the nodes the matching
+    ZoneOutage killed reconnect. Revival must converge — parked stages
+    un-park, and no idempotency-keyed redelivery may execute twice."""
+    region: str = ""
+
+    def expand(self):
+        return [(self.at, ZONE_UP, {"region": self.region})]
+
+
+@dataclass(frozen=True)
+class HotspotShift(Fault):
+    """The traffic hotspot migrates onto `tenant`: from this instant the
+    generator's arrival waves favor the tenant (already baked into the
+    sampled AdmissionWave counts) and the runner marks it as
+    deliberately bursting, so `admission-fair` exempts it — the hotspot
+    pays for its own flood; the invariant is that nobody else does."""
+    tenant: str = ""
+
+    def expand(self):
+        return [(self.at, HOTSPOT_SHIFT, {"tenant": self.tenant})]
+
+
 @dataclass
 class FaultSchedule:
     """A seeded, replayable fault plan."""
@@ -243,6 +324,12 @@ class FaultSchedule:
     # per-tenant hard admission caps (cp/admission.py tenant_caps) the
     # runner wires into the world's AdmissionConfig; empty = uncapped
     tenant_caps: dict[str, int] = field(default_factory=dict)
+    # world topology metadata (chaos/worldgen.py): region -> node INDEX
+    # list ("regions"), per-region capacity scale ("capacity_scale"),
+    # spot pool -> node INDEX list ("spot_pools"). The runner turns it
+    # into region-labeled servers, region-homed stages, and resolvable
+    # zone/spot fault targets; empty = the classic single-domain fleet
+    world: dict = field(default_factory=dict)
 
     def events(self) -> list[tuple[float, str, dict]]:
         """Expanded primitive timeline, stably sorted by time (ties keep

@@ -23,7 +23,8 @@ __all__ = ["INSTANT_INVARIANTS", "FINAL_INVARIANTS", "check_instant",
            "containers_converged", "metrics_monotonic",
            "agents_gauge_consistent", "selfheal_converged",
            "cp_failover_converged", "admission_fair",
-           "admission_converged", "admission_quota", "slo_met"]
+           "admission_converged", "admission_quota", "slo_met",
+           "record_outage_census", "degraded_gracefully"]
 
 _EPS = 1e-6
 
@@ -374,6 +375,70 @@ def slo_met(world) -> list[str]:
     return out
 
 
+def record_outage_census(world) -> None:
+    """Called by the runner after every fault burst: WHILE a zone outage
+    is live, the lost domain's stages may park — but collateral damage to
+    a SURVIVING region's stage is a blast-radius breach. The final
+    snapshot only sees the healed world, so breaches must be recorded
+    mid-outage; `degraded_gracefully` reports them once the run settles.
+    Not an invariant itself (returns nothing): it only accumulates
+    evidence on `world.outage_breaches`, deduped per detail string."""
+    active = getattr(world, "active_outages", None)
+    stage_region = getattr(world, "stage_region", {}) or {}
+    if not active or not stage_region:
+        return
+    seen = getattr(world, "_outage_breach_seen", None)
+    if seen is None:
+        seen = world._outage_breach_seen = set()
+    rc = getattr(world.state, "reconverger", None)
+    parked = set(rc.parked_stage_keys()) if rc is not None else set()
+    snap = world.state.placement.snapshot()
+    for key in sorted(stage_region):
+        home = stage_region[key]
+        if home in active:
+            continue               # the lost domain's work MAY park
+        view = snap.get(key)
+        if key in parked:
+            detail = (f"surviving-region stage {key} (home {home}) "
+                      f"parked during outage of {sorted(active)}")
+        elif view is not None and not view["feasible"]:
+            detail = (f"surviving-region stage {key} (home {home}) "
+                      f"infeasible during outage of {sorted(active)}")
+        else:
+            continue
+        if detail not in seen:
+            seen.add(detail)
+            world.outage_breaches.append(detail)
+
+
+def degraded_gracefully(world) -> list[str]:
+    """Zone-outage blast radius (chaos/worldgen.py scenarios): during an
+    outage only the lost domain's work parks — every surviving region's
+    stage stays feasible (mid-run census via `record_outage_census`) —
+    and revival converges: nothing remains parked for a region that came
+    back, and no idempotency-keyed redelivery executed twice across the
+    kill/revive. Worlds that never lost a zone pass vacuously; the
+    fabricated-world canaries prove each clause fires."""
+    if not getattr(world, "zone_outages", 0):
+        return []
+    out = list(getattr(world, "outage_breaches", ()))
+    active = getattr(world, "active_outages", set())
+    stage_region = getattr(world, "stage_region", {}) or {}
+    rc = getattr(world.state, "reconverger", None)
+    parked = set(rc.parked_stage_keys()) if rc is not None else set()
+    for key in sorted(parked):
+        home = stage_region.get(key)
+        if home is not None and home not in active:
+            out.append(f"stage {key} still parked after its zone "
+                       f"{home} revived")
+    for _key, (stage, runs) in sorted(
+            getattr(world, "idem_executions", {}).items()):
+        if runs > 1:
+            out.append(f"zone revival doubled execution: a keyed "
+                       f"redelivery for {stage} ran {runs} times")
+    return out
+
+
 def metrics_monotonic(world) -> list[str]:
     """Counters never decrease across the run. The metrics registry is the
     operator's ground truth for rates and totals; a counter that went DOWN
@@ -426,6 +491,7 @@ FINAL_INVARIANTS = {
     "admission-converged": admission_converged,
     "admission-quota": admission_quota,
     "slo-met": slo_met,
+    "degraded-gracefully": degraded_gracefully,
     "metrics-monotonic": metrics_monotonic,
     "agents-gauge-consistent": agents_gauge_consistent,
 }

@@ -42,7 +42,8 @@ from ..cp.auth import NoAuth
 from ..cp.autoscaler import Autoscaler
 from ..cp.failure_detector import FailureDetector, LeaseConfig
 from ..cp.log_router import LogRouter
-from ..cp.models import ServerCapacity, WorkerPool
+from ..cp.models import (SchedulingState, ServerCapacity, ServerLabelsRec,
+                         WorkerPool)
 from ..cp.placement import PlacementService
 from ..cp.reconverge import ReconvergeConfig, Reconverger
 from ..cp.replication import StandbyReplica
@@ -58,10 +59,12 @@ from ..sched.base import Placement, level_schedule
 from ..lower.tensors import local_node, lower_stage
 from . import faults as F
 from .injector import FaultInjector
-from .invariants import check_final, check_instant
+from .invariants import check_final, check_instant, record_outage_census
+from .worldgen import (M_WORLD_ARRIVALS, M_WORLD_RECLAIMS,
+                       M_WORLD_ZONE_OUTAGES, validate_schedule)
 
 __all__ = ["VirtualClock", "ChaosReport", "ChaosWorld", "run_schedule",
-           "make_flow"]
+           "make_flow", "node_slug", "VIRTUAL_SLO_STREAMS", "slo_summary"]
 
 TENANT = "default"
 POOL_NAME = "workers"
@@ -78,6 +81,35 @@ CHAOS_SLOS = {
     "admission-wait-p99-s": 300.0,  # submit -> placed (virtual; shed age
                                     # bounds the queue at 240 s)
 }
+
+# streams whose samples are exact virtual-clock arithmetic — identical
+# on any machine, so `fleet plan simulate` may pin a report digest over
+# them; the remaining streams measure wall-clock host solves and are
+# reported outside the digest
+VIRTUAL_SLO_STREAMS = ("admission_wait_s", "heal_s")
+
+
+def slo_summary(engine) -> dict:
+    """Per-stream lifetime quantiles from a world's SLO engine, split
+    into the deterministic virtual-clock bucket and the wall bucket
+    (trace footers and `fleet plan simulate` reports digest only the
+    former)."""
+    if engine is None:
+        return {}
+    from ..obs.slo import KNOWN_STREAMS
+    out: dict = {"virtual": {}, "wall": {}}
+    for stream in KNOWN_STREAMS:
+        n = engine.samples(stream)
+        if not n:
+            continue
+        row: dict = {"n": n}
+        for label, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+            v = engine.observed_quantile(stream, q)
+            if v is not None:
+                row[label] = round(float(v), 6)
+        bucket = "virtual" if stream in VIRTUAL_SLO_STREAMS else "wall"
+        out[bucket][stream] = row
+    return out
 
 
 class VirtualClock:
@@ -114,10 +146,13 @@ def node_slug(i: int) -> str:
 
 
 def make_flow(n_services: int, n_stages: int, node_slugs: list[str],
-              seed: int) -> Flow:
+              seed: int,
+              stage_servers: Optional[dict[int, list[str]]] = None) -> Flow:
     """Synthetic flow shaped like a production fleet: dependency chains
     of depth <= 5, mixed demand, and every 20th service running 2
-    replicas with hard self-anti-affinity (replica spreading)."""
+    replicas with hard self-anti-affinity (replica spreading).
+    `stage_servers` (stage index -> slugs) homes stages onto subsets of
+    the fleet — the world simulator's region-per-stage layout."""
     rng = random.Random(seed)
     flow = Flow(name="chaosfleet")
     names = [f"svc{i:04d}" for i in range(n_services)]
@@ -141,8 +176,10 @@ def make_flow(n_services: int, n_stages: int, node_slugs: list[str],
         block = names[g * per_stage:(g + 1) * per_stage]
         if not block:
             continue
+        servers = (stage_servers.get(g) if stage_servers else None) \
+            or node_slugs
         flow.stages[f"app{g}"] = Stage(name=f"app{g}", services=block,
-                                       servers=list(node_slugs))
+                                       servers=list(servers))
     return flow
 
 
@@ -255,6 +292,10 @@ class ChaosReport:
     events: list[dict] = field(default_factory=list)
     violations: list[str] = field(default_factory=list)
     stats: dict = field(default_factory=dict)
+    # per-stream SLO quantile summary (slo_summary): the virtual bucket
+    # is deterministic and feeds trace footers / `fleet plan simulate`
+    # report digests; OUTSIDE digest() like tsdb — derived telemetry
+    slo: dict = field(default_factory=dict)
     # fleet-horizon capture (obs/tsdb.py snapshot(), schema-versioned
     # with its own content digest). Deliberately OUTSIDE digest(): the
     # replayable-repro contract hashes the causal event log, and the
@@ -278,6 +319,7 @@ class ChaosReport:
                "services": self.services, "nodes": self.nodes,
                "stages": self.stages, "ok": self.ok,
                "digest": self.digest(), "stats": self.stats,
+               "slo": self.slo,
                "violations": self.violations, "events": self.events}
         if self.tsdb is not None:
             out["tsdb"] = self.tsdb
@@ -296,7 +338,8 @@ class ChaosWorld:
                  clock: VirtualClock, pool_min: int = 0, seed: int = 0,
                  replicated: bool = False,
                  store_dir: Optional[Path] = None,
-                 tenant_caps: Optional[dict] = None):
+                 tenant_caps: Optional[dict] = None,
+                 world_meta: Optional[dict] = None):
         self.flow = flow
         self.clock = clock
         self.injector = injector
@@ -356,6 +399,27 @@ class ChaosWorld:
         self.admission_burst_tenants: set[str] = set()
         self._admit_rng = random.Random(seed ^ 0xAD317)
         self._admit_counts: dict[str, int] = {}
+        # world-simulator topology (chaos/worldgen.py): region/spot-pool
+        # membership by slug, stage -> home region, and the live
+        # correlated-fault bookkeeping the degraded-gracefully invariant
+        # reads. Empty for the classic single-domain scenarios.
+        meta = dict(world_meta or {})
+        self.regions: dict[str, list[str]] = dict(meta.get("regions", {}))
+        self.spot_pools: dict[str, list[str]] = dict(
+            meta.get("spot_pools", {}))
+        self.capacity_scale: dict[str, float] = dict(
+            meta.get("capacity_scale", {}))
+        self.stage_region: dict[str, str] = dict(
+            meta.get("stage_region", {}))
+        self.node_region: dict[str, str] = {
+            slug: r for r, slugs in self.regions.items() for slug in slugs}
+        self.active_outages: set[str] = set()
+        self.outage_killed: dict[str, list[str]] = {}
+        self.outage_breaches: list[str] = []
+        self.zone_outages = 0
+        self.spot_pending: dict[str, list[str]] = {}
+        self.spot_reclaimed: dict[str, list[str]] = {}
+        self.hotspot_tenant: Optional[str] = None
         self.standby: Optional[StandbyReplica] = None
         self.standby_store: Optional[Store] = None
         if replicated:
@@ -501,6 +565,8 @@ class ChaosWorld:
                           "memory": float(self._admit_rng.choice((16, 32))),
                           "disk": 0.0})
         deps = ctrl.streamed_names(tenant, stage=key)[:departures]
+        if specs:
+            M_WORLD_ARRIVALS.inc(len(specs))
         try:
             out = ctrl.submit(tenant, arrivals=specs, departures=deps,
                               stage=key)
@@ -510,6 +576,81 @@ class ChaosWorld:
         except AdmissionRejected as e:
             self.log("admit-shed", tenant=tenant, arrivals=len(specs),
                      reason=e.reason)
+
+    # -- correlated world faults (worldgen scenarios) ----------------------
+
+    def _set_scheduling(self, slug: str, state: str) -> None:
+        s = self.state.store.server_by_slug(slug)
+        if s is not None:
+            self.state.store.update("servers", s.id,
+                                    scheduling_state=state)
+
+    def spot_victims(self, pool: str, count: int) -> list[str]:
+        """Deterministic reclamation targets: the pool's first `count`
+        currently-connected members, sorted by slug."""
+        members = sorted(s for s in self.spot_pools.get(pool, [])
+                         if s in self.agents)
+        return members[:max(int(count), 0)]
+
+    def spot_warning(self, pool: str, count: int) -> list[str]:
+        """Provider reclamation warning: resolve the victims NOW and
+        cordon them, so every placement between warning and reclaim
+        routes around machines that are already doomed."""
+        victims = self.spot_victims(pool, count)
+        self.log("fault", op="spot_warning", pool=pool, nodes=victims)
+        self.spot_pending[pool] = victims
+        for slug in victims:
+            self._set_scheduling(slug, SchedulingState.CORDONED.value)
+        return victims
+
+    def spot_reclaim(self, pool: str, count: int) -> list[str]:
+        """The storm lands: every warned victim dies in this instant —
+        SILENTLY (the provider does not RPC the control plane; lease
+        expiry must find the bodies)."""
+        victims = self.spot_pending.pop(pool, None)
+        if victims is None:               # storm without a warning
+            victims = self.spot_victims(pool, count)
+        victims = [v for v in victims if v in self.agents]
+        self.log("fault", op="spot_reclaim", pool=pool, nodes=victims)
+        for slug in victims:
+            self.disconnect(slug)
+        if victims:
+            M_WORLD_RECLAIMS.inc(len(victims), pool=pool)
+        self.spot_reclaimed.setdefault(pool, []).extend(victims)
+        return victims
+
+    def spot_revive(self, pool: str) -> list[str]:
+        """Reclaimed capacity returns to the market: exactly the nodes
+        the storm took reconnect and uncordon."""
+        victims = self.spot_reclaimed.pop(pool, [])
+        self.log("fault", op="spot_revive", pool=pool, nodes=victims)
+        for slug in victims:
+            self.connect(slug)
+            self._set_scheduling(slug, SchedulingState.SCHEDULABLE.value)
+        return victims
+
+    def zone_down(self, region: str) -> list[str]:
+        """A failure domain dies whole: every connected node of the
+        region disconnects silently in one instant."""
+        victims = sorted(s for s in self.regions.get(region, [])
+                         if s in self.agents)
+        self.log("fault", op="zone_down", region=region, nodes=victims)
+        self.zone_outages += 1
+        self.active_outages.add(region)
+        self.outage_killed[region] = victims
+        for slug in victims:
+            self.disconnect(slug)
+        M_WORLD_ZONE_OUTAGES.inc(region=region)
+        return victims
+
+    def zone_up(self, region: str) -> list[str]:
+        """The domain revives: exactly the outage's victims reconnect."""
+        victims = self.outage_killed.pop(region, [])
+        self.log("fault", op="zone_up", region=region, nodes=victims)
+        self.active_outages.discard(region)
+        for slug in victims:
+            self.connect(slug)
+        return victims
 
     # -- replicated control plane (cp-failover scenario) -------------------
 
@@ -672,7 +813,8 @@ class _SimProvider:
 
 class _Runner:
     def __init__(self, schedule: F.FaultSchedule, n_services: int,
-                 n_nodes: int, n_stages: int, pool_min: int):
+                 n_nodes: int, n_stages: int, pool_min: int,
+                 flow: Optional[Flow] = None):
         self.schedule = schedule
         self.n_services = n_services
         self.n_nodes = n_nodes
@@ -680,8 +822,49 @@ class _Runner:
         self.pool_min = pool_min
         self.node_slugs = [node_slug(i) for i in range(n_nodes)]
         clock = VirtualClock()
-        flow = make_flow(n_services, n_stages, self.node_slugs,
-                         seed=schedule.seed)
+
+        # region-aware world construction: worldgen schedules carry a
+        # `world` block mapping regions/spot pools to node indices.
+        # Stage g homes to region g % R (insertion order), so a zone
+        # outage parks exactly that region's stages and no others.
+        wmeta = dict(getattr(schedule, "world", {}) or {})
+        region_slugs: dict[str, list[str]] = {}
+        for rname, idxs in (wmeta.get("regions") or {}).items():
+            slugs = [node_slug(int(i)) for i in idxs if int(i) < n_nodes]
+            if slugs:
+                region_slugs[rname] = slugs
+        pool_slugs = {
+            pname: [node_slug(int(i)) for i in idxs if int(i) < n_nodes]
+            for pname, idxs in (wmeta.get("spot_pools") or {}).items()}
+        region_names = list(region_slugs)
+        stage_servers: Optional[dict[int, list[str]]] = None
+        if region_names:
+            stage_servers = {
+                g: region_slugs[region_names[g % len(region_names)]]
+                for g in range(n_stages)}
+
+        if flow is None:
+            flow = make_flow(n_services, n_stages, self.node_slugs,
+                             seed=schedule.seed,
+                             stage_servers=stage_servers)
+        elif region_names:
+            # adopted flow (plan simulate): re-home its stages onto the
+            # recorded world's regions in declaration order
+            for g, stage_name in enumerate(sorted(flow.stages)):
+                flow.stages[stage_name].servers = list(
+                    region_slugs[region_names[g % len(region_names)]])
+
+        stage_region: dict[str, str] = {}
+        if region_names:
+            for g, stage_name in enumerate(sorted(flow.stages)):
+                stage_region[f"{flow.name}/{stage_name}"] = \
+                    region_names[g % len(region_names)]
+        world_meta = {
+            "regions": region_slugs,
+            "spot_pools": pool_slugs,
+            "capacity_scale": dict(wmeta.get("capacity_scale") or {}),
+            "stage_region": stage_region,
+        }
         # a schedule that kills the CP primary needs the replicated
         # control plane (warm standby + journaled primary store)
         replicated = any(op == F.CP_KILL for _, op, _ in schedule.events())
@@ -691,7 +874,8 @@ class _Runner:
             flow, FaultInjector(), clock, pool_min=pool_min,
             seed=schedule.seed, replicated=replicated,
             store_dir=Path(self._tmp.name) if self._tmp else None,
-            tenant_caps=getattr(schedule, "tenant_caps", {}))
+            tenant_caps=getattr(schedule, "tenant_caps", {}),
+            world_meta=world_meta)
         self.dirty: set[str] = set()     # stage names needing redeploy
         self.stats = {"deploys_ok": 0, "deploys_failed": 0, "faults": 0,
                       "resolves": 0, "restarts": 0, "scale_actions": 0,
@@ -705,8 +889,15 @@ class _Runner:
         for slug in self.node_slugs:
             db.register_server(slug, tenant=TENANT, hostname=slug)
             s = db.server_by_slug(slug)
-            db.update("servers", s.id, capacity=ServerCapacity(
-                cpu=4.0, memory=8192.0, disk=40960.0))
+            region = w.node_region.get(slug)
+            scale = w.capacity_scale.get(region, 1.0) if region else 1.0
+            cap = ServerCapacity(cpu=4.0 * scale, memory=8192.0 * scale,
+                                 disk=40960.0 * scale)
+            if region:
+                db.update("servers", s.id, capacity=cap,
+                          labels=ServerLabelsRec(region=region))
+            else:
+                db.update("servers", s.id, capacity=cap)
             w.connect(slug)
         if self.pool_min > 0:
             # max leaves headroom for replacements while dead records
@@ -817,6 +1008,25 @@ class _Runner:
             elif op == F.REDEPLOY:
                 w.log("redeploy-requested", stage=p["stage"])
                 self.dirty.add(p["stage"])
+            elif op == F.SPOT_WARNING:
+                w.spot_warning(p["pool"], p["count"])
+            elif op == F.SPOT_RECLAIM:
+                # correlated kill: the whole warned set dies SILENTLY in
+                # one instant — lease expiry finds the bodies, and every
+                # surviving placement already routed around the cordon
+                w.spot_reclaim(p["pool"], p["count"])
+            elif op == F.SPOT_REVIVE:
+                w.spot_revive(p["pool"])
+            elif op == F.ZONE_DOWN:
+                w.zone_down(p["region"])
+            elif op == F.ZONE_UP:
+                w.zone_up(p["region"])
+            elif op == F.HOTSPOT_SHIFT:
+                w.hotspot_tenant = p["tenant"]
+                # a hotspot tenant deliberately bursts: exempt it from
+                # the admission-fair bound while it is hot
+                w.admission_burst_tenants.add(p["tenant"])
+                w.log("fault", op=op, tenant=p["tenant"])
             else:
                 raise ValueError(f"unknown primitive op {op!r}")
         if burst:
@@ -936,6 +1146,10 @@ class _Runner:
         self.world.sample_obs()
 
     def _check_instant(self) -> list[str]:
+        # mid-outage census for degraded-gracefully: collateral damage
+        # must be recorded WHILE the outage is live (the final snapshot
+        # only sees the healed world)
+        record_outage_census(self.world)
         found = check_instant(self.world)
         for v in found:
             self.world.log("violation", detail=v)
@@ -998,20 +1212,30 @@ class _Runner:
             services=self.n_services, nodes=self.n_nodes,
             stages=self.n_stages, events=w.events,
             violations=violations, stats=dict(self.stats),
+            slo=slo_summary(w.state.slo),
             tsdb=w.tsdb.snapshot())
         return report
 
 
 def run_schedule(schedule: F.FaultSchedule, *, services: int, nodes: int,
-                 stages: int = 4, pool_min: int = 2) -> ChaosReport:
+                 stages: int = 4, pool_min: int = 2,
+                 flow: Optional[Flow] = None,
+                 validate: bool = True) -> ChaosReport:
     """Replay one schedule against a freshly built world. Deterministic:
-    the same (schedule, sizes) reproduces the identical event log."""
+    the same (schedule, sizes) reproduces the identical event log.
+    `flow` substitutes a proposed Flow for the synthetic one (the
+    `fleet plan simulate` path); `validate` runs the feasibility
+    pre-check so mis-sized scenarios fail fast with a clear message
+    instead of surfacing as invariant noise."""
+    if validate:
+        validate_schedule(schedule, services=services, nodes=nodes)
     # the world installs its virtual-clock SLO engine as the process
     # default; restore whatever was there so a long-lived process (the
     # test suite, a CP embedding the harness) doesn't keep observing
     # into a dead world's frozen clock after the run
     prev_engine = get_engine()
-    runner = _Runner(schedule, services, nodes, stages, pool_min)
+    runner = _Runner(schedule, services, nodes, stages, pool_min,
+                     flow=flow)
     try:
         return asyncio.run(runner.run())
     finally:

@@ -21,14 +21,19 @@ from .faults import (AdmissionWave, AgentPartition, ContainerExit,
                      PrimaryKill, Redeploy, SilentNodeCrash, SlowAgent,
                      Tick, WorkerKill)
 from .runner import node_slug
+from .worldgen import WORLD_SCENARIOS, validate_schedule
 
-__all__ = ["SCENARIOS", "build_schedule", "scenario_names"]
+__all__ = ["SCENARIOS", "build_schedule", "scenario_names",
+           "scenario_info", "validate_schedule"]
 
 
 def _rolling_kill(seed: int, services: int, nodes: int) -> FaultSchedule:
     """Kill nodes one at a time on a cadence, each revived later; a pool
     worker dies mid-roll and a few containers exit on survivors. At most
-    ~4 nodes are dead at once."""
+    ~4 nodes are dead at once.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     # never make every node a victim: survivors must exist to absorb the
     # displaced services (and to host the container-exit faults)
@@ -58,7 +63,10 @@ def _rolling_kill_selfheal(seed: int, services: int,
     the stranded services to survivors (the `selfheal-converged`
     invariant judges the outcome). Ticks pace the replay so detector
     sweeps observe lease expiry with bounded latency; each victim
-    revives later, exercising the node-online unpark path."""
+    revives later, exercising the node-online unpark path.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     kills = min(max(2, min(nodes // 10, 6)), nodes - 1)
     victims = rng.sample(range(nodes), kills)
@@ -98,7 +106,10 @@ def _cp_failover(seed: int, services: int, nodes: int) -> FaultSchedule:
         leases can find B (phase="burst");
       * the third kill compacts the journal first (phase="compaction");
       * C dies and revives afterwards, exercising plain self-healing +
-        unpark on the twice-promoted primary."""
+        unpark on the twice-promoted primary.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     # survivors must exist: at most nodes-1 victims (tiny fleets get
     # fewer node kills but always all three primary kills)
@@ -129,7 +140,10 @@ def _cp_failover(seed: int, services: int, nodes: int) -> FaultSchedule:
 def _flap_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
     """Waves of short node flaps (the churn-coalescing stress): each wave
     flaps ~20% of the fleet within one instant, down for 5-20s, plus
-    container exits during the instability."""
+    container exits during the instability.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     per_wave = max(1, min(nodes // 5, nodes - 1))
     faults = []
@@ -154,7 +168,10 @@ def _partition_during_deploy(seed: int, services: int,
                              nodes: int) -> FaultSchedule:
     """Partition a slice of the fleet, then redeploy INTO the partition:
     the deploy must fail cleanly (reservation released, nothing
-    half-committed) and succeed after the partition heals."""
+    half-committed) and succeed after the partition heals.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     cut = rng.sample(range(nodes), max(1, min(nodes // 5, nodes - 1)))
     faults = [AgentPartition(at=10.0, node=node_slug(v), duration=120.0)
@@ -173,7 +190,10 @@ def _deploy_fail_burst(seed: int, services: int,
                        nodes: int) -> FaultSchedule:
     """Arm a burst of injected service-start failures, then redeploy:
     each failed deploy must release its reservation; once the burst is
-    spent the redeploy lands. A crash mid-burst stacks churn on top."""
+    spent the redeploy lands. A crash mid-burst stacks churn on top.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     faults = [
         DeployFail(at=10.0, count=3),
@@ -197,7 +217,10 @@ def _arrival_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
     submitted request ends placed/parked/shed/departed, and every live
     streamed service is in the committed placement — `admission-converged`).
     Ticks keep draining after the last wave so the backlog is judged
-    drained, not abandoned."""
+    drained, not abandoned.
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     tenants = ["team-a", "team-b", "team-c"]
     faults: list = []
@@ -228,7 +251,10 @@ def _tenant_storm(seed: int, services: int, nodes: int) -> FaultSchedule:
     with quota parks outstanding; the promoted standby must restore the
     journaled parked arrivals and place them as the capped tenant's
     drain-phase departures free headroom (admission-quota +
-    admission-converged + slo-met judged)."""
+    admission-converged + slo-met judged).
+
+    Sizing: services=60 nodes=10 stages=2
+    """
     rng = random.Random(seed)
     faults: list = []
     t = 20.0
@@ -297,9 +323,28 @@ SCENARIOS: dict[str, tuple[Callable, str]] = {
                      "restore and place them"),
 }
 
+# the world-simulator production pack (chaos/worldgen.py): declarative
+# WorldSpecs compiled into the SAME FaultSchedule contract, so they list
+# and run exactly like the hand-written scenarios above
+SCENARIOS.update(WORLD_SCENARIOS)
+
 
 def scenario_names() -> list[str]:
     return sorted(SCENARIOS)
+
+
+def scenario_info(name: str) -> dict:
+    """Description plus default sizing for `fleet chaos list`, read from
+    the generator's docstring (the `Sizing: ...` convention every
+    builder follows)."""
+    builder, desc = SCENARIOS[name]
+    sizing = ""
+    for line in (builder.__doc__ or "").splitlines():
+        line = line.strip()
+        if line.startswith("Sizing:"):
+            sizing = line[len("Sizing:"):].strip()
+            break
+    return {"name": name, "description": desc, "sizing": sizing}
 
 
 def build_schedule(name: str, seed: int, services: int,

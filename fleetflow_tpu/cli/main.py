@@ -1149,11 +1149,14 @@ def cmd_chaos(args) -> int:
     No project
     config needed — the fleet is synthetic and fully determined by
     (scenario, seed, sizes)."""
-    from ..chaos import build_schedule, run_schedule, SCENARIOS
+    from ..chaos import (build_schedule, run_schedule, scenario_info,
+                         SCENARIOS)
 
     if args.chaos_cmd == "list" or getattr(args, "list", False):
         for name in sorted(SCENARIOS):
-            print(f"{name:26s} {SCENARIOS[name][1]}")
+            info = scenario_info(name)
+            sizing = info["sizing"] or "-"
+            print(f"{name:26s} {sizing:36s} {info['description']}")
         return 0
     schedule = build_schedule(args.scenario, args.seed, args.services,
                               args.nodes)
@@ -1190,6 +1193,15 @@ def cmd_chaos(args) -> int:
         print(f"  tsdb capture ({n} series, digest "
               f"{(report.tsdb or {}).get('digest', '-')[:16]}...) "
               f"-> {args.tsdb_out}")
+    if getattr(args, "record_trace", None):
+        # the plan-simulate bridge: the run's full primitive timeline +
+        # baseline SLO quantiles, replayable against a proposed KDL
+        from ..chaos.trace import write_trace
+        write_trace(args.record_trace, schedule, report,
+                    services=args.services, nodes=args.nodes,
+                    stages=args.stages, pool_min=args.pool_min)
+        print(f"  traffic trace ({len(schedule.events())} events, "
+              f"baseline SLOs) -> {args.record_trace}")
     if report.violations:
         print(f"  {len(report.violations)} INVARIANT VIOLATION(S):")
         for v in report.violations:
@@ -1203,6 +1215,56 @@ def cmd_chaos(args) -> int:
         print(f"  DIGEST MISMATCH: expected {args.expect_digest}")
         return 1
     print("  all invariants hold")
+    return 0
+
+
+def cmd_plan(args) -> int:
+    """Capacity planning against recorded traffic
+    (docs/guide/18-world-simulator.md): replay a `fleet chaos run
+    --record-trace` capture against a PROPOSED flow file through the
+    real control-plane paths and report per-stream SLO deltas before
+    anything deploys."""
+    from ..chaos.simulate import simulate_flow
+    from ..core.parser import parse_kdl_file
+
+    flow = parse_kdl_file(args.flow)
+    doc = simulate_flow(flow, args.trace, pool_min=args.pool_min)
+    t = doc["trace"]
+    print(f"plan simulate: flow {doc['proposal']['flow']!r} "
+          f"({doc['proposal']['services']} services, "
+          f"{len(doc['proposal']['stages'])} stages) vs trace "
+          f"{t['scenario']!r} seed={t['seed']} "
+          f"({t['services']}x{t['nodes']})")
+    for stream, row in sorted(doc["streams"].items()):
+        base = (row.get("baseline") or {}).get("p99")
+        prop = (row.get("proposed") or {}).get("p99")
+        delta = row.get("delta_p99")
+        flag = " REGRESSED" if row.get("regressed") else ""
+        print(f"  {stream:<20} baseline p99="
+              f"{'-' if base is None else f'{base:g}s'} proposed p99="
+              f"{'-' if prop is None else f'{prop:g}s'}"
+              + (f" delta={delta:+g}s" if delta is not None else "")
+              + flag)
+    print(f"  report digest {doc['digest']} "
+          f"(same trace+flow => same digest)")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"  full report -> {args.json}")
+    if doc["violations"]:
+        print(f"  {len(doc['violations'])} INVARIANT VIOLATION(S) "
+              f"under the proposal:")
+        for v in doc["violations"]:
+            print(f"    {v}")
+        return 1
+    if getattr(args, "expect_digest", None) \
+            and doc["digest"] != args.expect_digest:
+        print(f"  DIGEST MISMATCH: expected {args.expect_digest}")
+        return 1
+    if doc["regressions"]:
+        print(f"  SLO regression on: {', '.join(doc['regressions'])}")
+        return 1
+    print("  proposal holds the recorded SLOs")
     return 0
 
 
@@ -2495,12 +2557,34 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--expect-digest", dest="expect_digest",
                    help="fail unless the event-log digest equals this "
                    "(CI pinning: same seed must replay byte-identically)")
+    q.add_argument("--record-trace", dest="record_trace",
+                   help="write the run's traffic trace (JSONL timeline "
+                   "+ baseline SLOs) for `fleet plan simulate`")
     q.add_argument("--show-schedule", action="store_true",
                    help="print the expanded fault schedule and exit")
     q.add_argument("--list", action="store_true",
                    help="list scenarios and exit")
     chs.add_parser("list", help="list canned scenarios")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser("plan", help="capacity planning: replay recorded "
+                       "traffic against a proposed flow")
+    pls = p.add_subparsers(dest="plan_cmd", required=True)
+    q = pls.add_parser("simulate", help="replay a recorded trace "
+                       "against a proposed KDL flow and report SLO "
+                       "deltas")
+    q.add_argument("flow", help="path to the proposed flow KDL file")
+    q.add_argument("--trace", required=True,
+                   help="traffic trace from `fleet chaos run "
+                   "--record-trace`")
+    q.add_argument("--pool-min", type=int, default=None, dest="pool_min",
+                   help="override the trace's worker-pool floor")
+    q.add_argument("--json", help="write the full SLO-delta report to "
+                   "this path")
+    q.add_argument("--expect-digest", dest="expect_digest",
+                   help="fail unless the report digest equals this "
+                   "(CI pinning)")
+    p.set_defaults(fn=cmd_plan)
     return ap
 
 

@@ -36,6 +36,8 @@ def registered() -> set[str]:
     # set, plus the modules only reached lazily from it)
     import fleetflow_tpu.agent.agent        # noqa: F401
     import fleetflow_tpu.agent.monitor      # noqa: F401
+    import fleetflow_tpu.chaos.simulate     # noqa: F401 (plan-simulate families)
+    import fleetflow_tpu.chaos.worldgen     # noqa: F401 (world families)
     import fleetflow_tpu.cloud.provider     # noqa: F401
     import fleetflow_tpu.core.parsecache    # noqa: F401
     import fleetflow_tpu.cp.autoscaler      # noqa: F401

@@ -63,6 +63,8 @@ def scrape() -> str:
     # regardless of which subsystems the web server pulls in transitively
     import fleetflow_tpu.agent.agent      # noqa: F401
     import fleetflow_tpu.agent.monitor    # noqa: F401
+    import fleetflow_tpu.chaos.simulate   # noqa: F401  (plan-simulate families)
+    import fleetflow_tpu.chaos.worldgen   # noqa: F401  (world families)
     import fleetflow_tpu.cloud.provider   # noqa: F401  (degraded alarm)
     import fleetflow_tpu.cp.autoscaler    # noqa: F401  (pressure gauge)
     import fleetflow_tpu.solver.api       # noqa: F401

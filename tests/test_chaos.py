@@ -228,6 +228,167 @@ class TestSmoke:
         assert a.digest() == b.digest()
 
 
+# --------------------------------------------------------------------------
+# the world-simulator pack (chaos/worldgen.py): production-shape traffic
+# and correlated failure domains through the same runner + invariants
+# --------------------------------------------------------------------------
+
+class TestWorldScenarios:
+    def test_diurnal_hotspot_smoke(self):
+        r = run_scenario("diurnal-hotspot", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        assert r.stats["admissions"] > 20
+        ops = {e.get("op") for e in r.events if e["event"] == "fault"}
+        assert "hotspot_shift" in ops
+
+    def test_spot_storm_smoke(self):
+        """Warning -> cordon -> correlated kill -> revival, twice: the
+        causal log must read cause-then-effect (every pool's warning
+        precedes its reclaim precedes its revival)."""
+        r = run_scenario("spot-storm", seed=7, **SMOKE)
+        assert r.ok, r.violations
+        order = [(e.get("op"), e.get("pool")) for e in r.events
+                 if e["event"] == "fault" and e.get("pool")]
+        for pool in ("spot-east", "spot-west"):
+            seq = [op for op, p in order if p == pool]
+            assert seq == ["spot_warning", "spot_reclaim",
+                           "spot_revive"], (pool, seq)
+
+    def test_zone_outage_smoke(self):
+        """A whole failure domain dies and revives; degraded-gracefully
+        must be ACTIVE (the world really lost a zone), with zero
+        blast-radius breaches recorded by the mid-outage census."""
+        from fleetflow_tpu.chaos import build_schedule
+        schedule = build_schedule("zone-outage", 7, SMOKE["services"],
+                                  SMOKE["nodes"])
+        runner = _Runner(schedule, SMOKE["services"], SMOKE["nodes"],
+                         SMOKE["stages"], SMOKE["pool_min"])
+        report = asyncio.run(runner.run())
+        assert report.ok, report.violations
+        w = runner.world
+        assert w.zone_outages == 1         # the invariant was not vacuous
+        assert w.outage_breaches == []
+        assert w.stage_region               # stages actually region-homed
+        ops = [e.get("op") for e in report.events
+               if e["event"] == "fault"]
+        assert "zone_down" in ops and "zone_up" in ops
+
+    def test_production_week_smoke(self):
+        """The composed world: hotspot + quota pressure + spot storm +
+        zone outage in one run, every invariant green."""
+        from fleetflow_tpu.chaos import build_schedule
+        schedule = build_schedule("production-week", 7,
+                                  SMOKE["services"], SMOKE["nodes"])
+        # the capped tenant's quota actually compiled (PR 16 caps)
+        assert schedule.tenant_caps == {"team-us": 7}
+        runner = _Runner(schedule, SMOKE["services"], SMOKE["nodes"],
+                         SMOKE["stages"], SMOKE["pool_min"])
+        report = asyncio.run(runner.run())
+        assert report.ok, report.violations
+        assert runner.world.zone_outages == 1
+        ops = {e.get("op") for e in report.events
+               if e["event"] == "fault"}
+        assert {"spot_reclaim", "zone_down", "zone_up",
+                "hotspot_shift"} <= ops
+
+    def test_world_same_seed_same_digest(self):
+        """Generated worlds stay inside the deterministic-replay
+        contract end to end: compile + replay twice -> one digest."""
+        for name in ("diurnal-hotspot", "production-week"):
+            a = run_scenario(name, seed=11, **SMOKE)
+            b = run_scenario(name, seed=11, **SMOKE)
+            assert a.events == b.events, name
+            assert a.digest() == b.digest(), name
+
+    def test_report_slo_rides_outside_the_digest(self):
+        """The report's SLO quantile summary (wall-clock material) must
+        never move the event-log digest — same exclusion contract as
+        stats/tsdb."""
+        r = run_scenario("diurnal-hotspot", seed=7, **SMOKE)
+        assert r.slo and "virtual" in r.slo
+        before = r.digest()
+        r.slo = {}
+        assert r.digest() == before
+
+    def test_runner_rejects_mis_sized_schedule(self):
+        """validate_schedule is wired into run_schedule: an oversized
+        fabricated schedule fails fast, before any world is built."""
+        from fleetflow_tpu.chaos.faults import SilentNodeCrash
+        from fleetflow_tpu.chaos.runner import run_schedule
+        faults = [SilentNodeCrash(at=10.0, node=f"node{i:03d}",
+                                  revive_after=600.0) for i in range(6)]
+        s = FaultSchedule("oversized", 1, faults, horizon=700.0)
+        with pytest.raises(ValueError, match="concurrently dead"):
+            run_schedule(s, services=20, nodes=10)
+
+    def test_scenario_info_exposes_description_and_sizing(self):
+        """`fleet chaos list` renders both columns from the builder
+        docstrings — every scenario must carry them."""
+        from fleetflow_tpu.chaos import scenario_info
+        for name in scenario_names():
+            info = scenario_info(name)
+            assert info["description"], name
+            assert "services=" in info["sizing"], name
+            assert "nodes=" in info["sizing"], name
+
+
+class TestDegradedGracefullyCanaries:
+    """Fabricated-world canaries: each clause of degraded-gracefully
+    (and the mid-outage census feeding it) proven live."""
+
+    def _zoned(self, home="r-a"):
+        w = _world()
+        w.zone_outages = 1
+        w.stage_region = {k: home for k in w.stage_keys}
+        return w
+
+    def test_vacuous_without_an_outage(self):
+        from fleetflow_tpu.chaos.invariants import degraded_gracefully
+        w = _world()
+        assert degraded_gracefully(w) == []
+
+    def test_census_flags_surviving_region_parked_stage(self):
+        from fleetflow_tpu.chaos.invariants import (degraded_gracefully,
+                                                    record_outage_census)
+        from fleetflow_tpu.cp.reconverge import _Work
+        w = self._zoned(home="r-a")        # stage homed in the SURVIVOR
+        w.active_outages = {"r-b"}
+        w.state.reconverger._park(
+            _Work(stage_key=w.stage_keys[0], idempotency_key="k",
+                  trace_id="t"), "infeasible")
+        record_outage_census(w)
+        assert w.outage_breaches
+        assert "parked during outage" in w.outage_breaches[0]
+        record_outage_census(w)            # census is deduped
+        assert len(w.outage_breaches) == 1
+        w.active_outages.clear()           # ...the zone revives
+        found = degraded_gracefully(w)
+        assert any("parked during outage" in v for v in found)
+        assert any("still parked after" in v for v in found)
+
+    def test_lost_domains_own_work_may_park(self):
+        from fleetflow_tpu.chaos.invariants import record_outage_census
+        from fleetflow_tpu.cp.reconverge import _Work
+        w = self._zoned(home="r-b")        # stage homed in the LOST zone
+        w.active_outages = {"r-b"}
+        w.state.reconverger._park(
+            _Work(stage_key=w.stage_keys[0], idempotency_key="k",
+                  trace_id="t"), "infeasible")
+        record_outage_census(w)
+        assert w.outage_breaches == []     # that is what the domain is for
+
+    def test_fires_on_doubled_execution_across_revival(self):
+        from fleetflow_tpu.chaos.invariants import degraded_gracefully
+        w = self._zoned()
+        w.idem_executions["heal-k1@node000"] = ["app0", 2]
+        found = degraded_gracefully(w)
+        assert found and "ran 2 times" in found[0]
+
+    def test_registered_as_final_invariant(self):
+        from fleetflow_tpu.chaos.invariants import FINAL_INVARIANTS
+        assert "degraded-gracefully" in FINAL_INVARIANTS
+
+
 @pytest.mark.slow
 class TestFullPack:
     @pytest.mark.parametrize("name", scenario_names())

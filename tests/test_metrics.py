@@ -27,7 +27,10 @@ import pytest
 # imported for their metric registrations: the golden test pins the FULL
 # exposition surface, which includes the solver and agent-monitor families
 import fleetflow_tpu.agent.monitor    # noqa: F401
+import fleetflow_tpu.chaos.simulate   # noqa: F401  (plan-simulate families)
+import fleetflow_tpu.chaos.worldgen   # noqa: F401  (world families)
 import fleetflow_tpu.solver.api       # noqa: F401
+import fleetflow_tpu.solver.multiplex  # noqa: F401  (mux batch families)
 import fleetflow_tpu.solver.sharded   # noqa: F401  (pod-scale families)
 from fleetflow_tpu.agent import Agent, AgentConfig
 from fleetflow_tpu.core.loader import load_project_from_root_with_stage

@@ -1,0 +1,286 @@
+"""World-simulator tests (chaos/worldgen.py + trace/simulate).
+
+Four layers:
+  - determinism: same (spec, seed, size) -> byte-identical schedule in
+    SEPARATE PROCESSES (string-seeded rng: no PYTHONHASHSEED exposure),
+    and same event-log digest on replay
+  - distribution sanity: arrivals track the diurnal curve, Little's-law
+    lifetime inference lands near the declared mean, reclamation storms
+    stay confined to the declared pool
+  - validate_schedule(): the scenarios.py sizing rule enforced — every
+    shipped scenario passes at its docstring sizing AND the smoke size,
+    fabricated oversized schedules fail fast with a clear message
+  - trace round-trip + `fleet plan simulate` report determinism
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fleetflow_tpu.chaos import build_schedule, scenario_info
+from fleetflow_tpu.chaos.faults import (ADMIT, SPOT_RECLAIM, SPOT_REVIVE,
+                                        SPOT_WARNING, ZONE_DOWN, ZONE_UP,
+                                        FaultSchedule, SilentNodeCrash,
+                                        ZoneOutage)
+from fleetflow_tpu.chaos.worldgen import (RegionSpec, TenantSpec, WorldSpec,
+                                          compile_world, validate_schedule)
+
+SMOKE = dict(seed=7, services=60, nodes=10)
+WORLD_PACK = ("diurnal-hotspot", "spot-storm", "zone-outage",
+              "production-week")
+
+
+def _events_json(name: str, seed: int, services: int, nodes: int) -> str:
+    s = build_schedule(name, seed, services, nodes)
+    return json.dumps({"events": s.events(), "world": s.world,
+                       "caps": s.tenant_caps, "horizon": s.horizon},
+                      sort_keys=True)
+
+
+class TestDeterminism:
+    def test_same_triple_same_schedule(self):
+        for name in WORLD_PACK:
+            assert _events_json(name, **SMOKE) == \
+                _events_json(name, **SMOKE), name
+
+    def test_seed_and_size_change_the_schedule(self):
+        base = _events_json("diurnal-hotspot", **SMOKE)
+        assert _events_json("diurnal-hotspot", 8, 60, 10) != base
+        assert _events_json("diurnal-hotspot", 7, 61, 10) != base
+
+    def test_cross_process_byte_identical(self):
+        """The worldgen rng is STRING-seeded (random.Random(f"...")),
+        never hash()-seeded: a fresh interpreter with a different
+        PYTHONHASHSEED must produce the identical schedule bytes."""
+        prog = ("import json;"
+                "from fleetflow_tpu.chaos import build_schedule;"
+                "s = build_schedule('production-week', 7, 60, 10);"
+                "print(json.dumps({'events': s.events(),"
+                " 'world': s.world, 'caps': s.tenant_caps,"
+                " 'horizon': s.horizon}, sort_keys=True))")
+        outs = []
+        for hashseed in ("1", "2"):
+            env = dict(os.environ,
+                       PYTHONHASHSEED=hashseed, JAX_PLATFORMS="cpu")
+            r = subprocess.run(
+                [sys.executable, "-c", prog], text=True,
+                capture_output=True, timeout=180, env=env)
+            assert r.returncode == 0, r.stderr
+            outs.append(r.stdout.strip())
+        assert outs[0] == outs[1]
+        assert outs[0] == _events_json("production-week", **SMOKE)
+
+
+class TestDistributionSanity:
+    def test_arrivals_track_the_diurnal_curve(self):
+        """Per-wave arrival counts must correlate with the sine rate
+        the spec declares (not be flat Poisson noise)."""
+        spec = WorldSpec(
+            name="sine-check",
+            tenants=(TenantSpec("t0"),),
+            regions=(RegionSpec("r0"),),
+            duration_s=2000.0, settle_s=0.0,
+            arrivals_per_service=6.0, max_arrivals=10 ** 9,
+            diurnal_amp=0.8, diurnal_period_s=400.0,
+            mean_lifetime_s=50.0)
+        s = compile_world(spec, seed=3, services=400, nodes=10)
+        xs, ys = [], []
+        for t, op, p in s.events():
+            if op == ADMIT:
+                xs.append(math.sin(2.0 * math.pi * t / 400.0))
+                ys.append(p["arrivals"])
+        assert len(xs) > 100
+        mx = sum(xs) / len(xs)
+        my = sum(ys) / len(ys)
+        cov = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+        vx = math.sqrt(sum((x - mx) ** 2 for x in xs))
+        vy = math.sqrt(sum((y - my) ** 2 for y in ys))
+        corr = cov / (vx * vy)
+        assert corr > 0.5, f"arrival/diurnal correlation {corr:.2f}"
+
+    def test_lifetime_mean_within_tolerance(self):
+        """Little's law on the compiled schedule: mean live count /
+        arrival rate must land near the declared mean lifetime (the
+        departure heap actually samples Exp(1/mean))."""
+        life = 100.0
+        spec = WorldSpec(
+            name="little-check",
+            tenants=(TenantSpec("t0"),),
+            regions=(RegionSpec("r0"),),
+            duration_s=4000.0, settle_s=0.0,
+            arrivals_per_service=10.0, max_arrivals=10 ** 9,
+            diurnal_amp=0.0, mean_lifetime_s=life)
+        s = compile_world(spec, seed=5, services=300, nodes=10)
+        live = 0
+        arrivals = 0
+        area = 0.0
+        prev_t = None
+        # steady-state window only: skip the fill-up transient
+        lo, hi = 1000.0, 4000.0
+        for t, op, p in s.events():
+            if op != ADMIT:
+                continue
+            if prev_t is not None and t > prev_t and t >= lo:
+                area += live * (min(t, hi) - max(prev_t, lo))
+            prev_t = t
+            live += p["arrivals"] - p["departures"]
+            if lo <= t < hi:
+                arrivals += p["arrivals"]
+        rate = arrivals / (hi - lo)
+        inferred = (area / (hi - lo)) / rate
+        assert 0.6 * life < inferred < 1.5 * life, (
+            f"Little's-law lifetime {inferred:.0f}s vs declared {life}s")
+
+    def test_storms_confined_to_declared_pool(self):
+        s = build_schedule("spot-storm", 7, 60, 10)
+        pools = s.world["spot_pools"]
+        reclaims = 0
+        for _t, op, p in s.events():
+            if op in (SPOT_WARNING, SPOT_RECLAIM, SPOT_REVIVE):
+                assert p["pool"] in pools, p
+                if op == SPOT_RECLAIM:
+                    reclaims += 1
+                    # the storm may never out-count its pool
+                    assert p["count"] <= len(pools[p["pool"]])
+                    assert p["count"] >= 1
+        assert reclaims >= 2      # two staggered storms by construction
+
+    def test_outage_quiet_window_suppresses_arrivals(self):
+        """Traffic fails away from a dying zone: no arrival wave lands
+        inside [outage-30, revive+30] (admission against a parked
+        region's stage would blow the wait SLO by construction)."""
+        s = build_schedule("zone-outage", 7, 60, 10)
+        outage_at = next(t for t, op, _p in s.events()
+                         if op == ZONE_DOWN)
+        revive_at = next((t for t, op, _p in s.events()
+                          if op == ZONE_UP), None)
+        assert revive_at is not None
+        for t, op, p in s.events():
+            if op == ADMIT and p["arrivals"]:
+                assert not (outage_at - 30.0 <= t <= revive_at + 30.0), (
+                    f"arrival wave at t={t} inside the outage quiet "
+                    f"window [{outage_at - 30}, {revive_at + 30}]")
+
+
+class TestValidateSchedule:
+    def test_shipped_scenarios_pass_at_their_sizings(self):
+        for name in WORLD_PACK:
+            info = scenario_info(name)
+            sizing = dict(kv.split("=") for kv in info["sizing"].split())
+            s = build_schedule(name, 7, int(sizing["services"]),
+                               int(sizing["nodes"]))
+            validate_schedule(s, services=int(sizing["services"]),
+                              nodes=int(sizing["nodes"]))
+            s = build_schedule(name, **SMOKE)
+            validate_schedule(s, services=SMOKE["services"],
+                              nodes=SMOKE["nodes"])
+
+    def test_classic_scenarios_pass_at_smoke(self):
+        from fleetflow_tpu.chaos import scenario_names
+        for name in scenario_names():
+            s = build_schedule(name, **SMOKE)
+            validate_schedule(s, services=SMOKE["services"],
+                              nodes=SMOKE["nodes"])
+
+    def test_too_many_concurrent_dead_fails_fast(self):
+        faults = [SilentNodeCrash(at=10.0, node=f"node{i:03d}",
+                                  revive_after=600.0)
+                  for i in range(6)]
+        s = FaultSchedule("oversized", 1, faults, horizon=700.0)
+        with pytest.raises(ValueError, match="concurrently dead"):
+            validate_schedule(s, services=20, nodes=10)
+
+    def test_outaged_domain_may_exceed_the_third(self):
+        """A declared failure domain is ALLOWED to die whole — the rule
+        charges the domain size, not the flat third."""
+        s = FaultSchedule(
+            "domain", 1, [ZoneOutage(at=10.0, region="big")],
+            horizon=200.0,
+            world={"regions": {"big": [0, 1, 2, 3, 4],
+                               "rest": [5, 6, 7, 8, 9]},
+                   "capacity_scale": {}, "spot_pools": {}})
+        validate_schedule(s, services=20, nodes=10)
+
+    def test_capacity_headroom_fails_fast(self):
+        s = FaultSchedule("toobig", 1, [], horizon=100.0)
+        with pytest.raises(ValueError, match="headroom"):
+            validate_schedule(s, services=2000, nodes=3)
+
+
+class TestTraceRoundTrip:
+    def test_trace_records_and_replays_identically(self, tmp_path):
+        from fleetflow_tpu.chaos.runner import run_schedule
+        from fleetflow_tpu.chaos.trace import load_trace, write_trace
+        s = build_schedule("diurnal-hotspot", **SMOKE)
+        rep = run_schedule(s, services=60, nodes=10, stages=2,
+                           pool_min=2)
+        path = tmp_path / "t.jsonl"
+        write_trace(path, s, rep, services=60, nodes=10, stages=2,
+                    pool_min=2)
+        loaded, header, footer = load_trace(path)
+        assert loaded.events() == s.events()
+        assert loaded.world == s.world
+        assert header["services"] == 60
+        assert footer["digest"] == rep.digest()
+        # the loaded trace replays to the SAME event log as the
+        # original schedule: the trace format loses nothing
+        rep2 = run_schedule(loaded, services=60, nodes=10, stages=2,
+                            pool_min=2)
+        assert rep2.digest() == rep.digest()
+
+    def test_truncated_trace_fails_clearly(self, tmp_path):
+        from fleetflow_tpu.chaos.trace import load_trace
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"kind": "event", "t": 1.0, "op": "tick", "p": {}}\n')
+        with pytest.raises(ValueError, match="no trace header"):
+            load_trace(p)
+
+
+class TestPlanSimulate:
+    def _flow(self):
+        from fleetflow_tpu.core.parser import parse_kdl_string
+        return parse_kdl_string('''
+project "chaosfleet"
+service "web" { resources { cpu 0.1; memory "64m" } }
+service "db"  { resources { cpu 0.2; memory "128m" } }
+stage "app0" { service "web" }
+stage "app1" { service "db" }
+''')
+
+    def test_simulate_report_is_deterministic(self, tmp_path):
+        from fleetflow_tpu.chaos.runner import run_schedule
+        from fleetflow_tpu.chaos.simulate import simulate_flow
+        from fleetflow_tpu.chaos.trace import write_trace
+        s = build_schedule("diurnal-hotspot", **SMOKE)
+        rep = run_schedule(s, services=60, nodes=10, stages=2,
+                           pool_min=2)
+        path = tmp_path / "t.jsonl"
+        write_trace(path, s, rep, services=60, nodes=10, stages=2,
+                    pool_min=2)
+        a = simulate_flow(self._flow(), path)
+        b = simulate_flow(self._flow(), path)
+        assert a["digest"] == b["digest"]
+        assert a["events_digest"] == b["events_digest"]
+        assert a["ok"], a["violations"]
+        assert a["proposal"]["services"] == 2
+        assert a["trace"]["recorded_digest"] == rep.digest()
+        for stream in ("admission_wait_s", "heal_s"):
+            assert stream in a["streams"]
+
+    def test_wall_streams_stay_outside_the_digest(self, tmp_path):
+        from fleetflow_tpu.chaos.simulate import report_digest
+        doc = {"kind": "plan-simulate-report", "streams": {},
+               "wall_streams": {"proposed": {"placement_ms":
+                                             {"p99": 1.0}}},
+               "ok": True, "violations": []}
+        d1 = report_digest(doc)
+        doc["wall_streams"]["proposed"]["placement_ms"]["p99"] = 999.0
+        doc["ok"] = False
+        doc["violations"] = ["[slo-met] wall miss"]
+        assert report_digest(doc) == d1
